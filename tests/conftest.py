@@ -1,0 +1,16 @@
+"""Test configuration.
+
+Force JAX onto the CPU backend with 8 virtual devices BEFORE jax is
+imported anywhere, so multi-chip sharding tests run without TPU hardware
+(the real-TPU path is exercised by bench.py / __graft_entry__.py which
+do not import this file).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

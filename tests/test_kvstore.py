@@ -1,0 +1,62 @@
+"""KV store unit tests."""
+
+from vpp_tpu.kvstore import KVStore
+from vpp_tpu.models import Pod, key_for, resource_for_key
+
+
+def test_put_get_delete():
+    kv = KVStore()
+    assert kv.get("/a") is None
+    rev1 = kv.put("/a", 1)
+    assert kv.get("/a") == 1
+    rev2 = kv.put("/a", 2)
+    assert rev2 > rev1
+    assert kv.delete("/a")
+    assert kv.get("/a") is None
+    assert not kv.delete("/a")
+
+
+def test_put_if_not_exists():
+    kv = KVStore()
+    assert kv.put_if_not_exists("/id/1", "node-a")
+    assert not kv.put_if_not_exists("/id/1", "node-b")
+    assert kv.get("/id/1") == "node-a"
+    assert not kv.compare_and_delete("/id/1", "node-b")
+    assert kv.compare_and_delete("/id/1", "node-a")
+
+
+def test_list_prefix():
+    kv = KVStore()
+    kv.put("/x/a", 1)
+    kv.put("/x/b", 2)
+    kv.put("/y/c", 3)
+    assert kv.list("/x/") == [("/x/a", 1), ("/x/b", 2)]
+    snap = kv.snapshot(["/x/", "/y/"])
+    assert snap == {"/x/a": 1, "/x/b": 2, "/y/c": 3}
+
+
+def test_watch_sees_changes_in_order():
+    kv = KVStore()
+    w = kv.watch(["/x/"])
+    kv.put("/x/a", 1)
+    kv.put("/other", 9)  # not matched
+    kv.put("/x/a", 2)
+    kv.delete("/x/a")
+    evs = [w.get(timeout=1) for _ in range(3)]
+    assert [e.key for e in evs] == ["/x/a", "/x/a", "/x/a"]
+    assert [e.value for e in evs] == [1, 2, None]
+    assert evs[2].is_delete and evs[2].prev_value == 2
+    kv.unwatch(w)
+    kv.put("/x/a", 3)
+    assert w.get(timeout=0.05) is None
+
+
+def test_model_keys():
+    pod = Pod(name="nginx", namespace="default", labels={"app": "web"})
+    key = key_for(pod)
+    assert key == "/vpp-tpu/ksr/k8s/pod/default/nginx"
+    res = resource_for_key(key)
+    assert res is not None and res.keyword == "pod"
+    kv = KVStore()
+    kv.put(key, pod)
+    assert kv.get(key).labels["app"] == "web"

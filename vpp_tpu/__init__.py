@@ -1,0 +1,28 @@
+"""vpp_tpu — a TPU-native packet-processing framework.
+
+A brand-new framework with the capabilities of Contiv-VPP (reference:
+/root/reference): an event-driven Kubernetes-style control plane that
+compiles NetworkPolicies into 5-tuple ACL rule tables and Services into
+NAT44 DNAT/load-balancing maps — with the per-packet classify->rewrite
+data plane implemented as a jit-compiled JAX/Pallas pipeline operating on
+256-packet header batches on TPU, instead of VPP graph nodes in C.
+
+Package layout (mirrors the reference's layer map, SURVEY.md §1):
+
+- ``models``      K8s-state data models + resource registry
+                  (analog of plugins/ksr/model + dbresources)
+- ``kvstore``     in-memory etcd-like KV store with watch/snapshot
+- ``controller``  event loop, events, transactions, dbwatcher
+                  (analog of plugins/controller)
+- ``scheduler``   declarative-config txn scheduler with dependency
+                  resolution (analog of ligato kvscheduler)
+- ``ipam``, ``nodesync``, ``podmanager``, ``ipv4net``
+                  domain plugins (same names as the reference)
+- ``policy``      NetworkPolicy -> ContivRule stack
+- ``service``     Service -> NAT44 stack
+- ``ops``         JAX/Pallas TPU kernels: classify, NAT rewrite, pipeline
+- ``parallel``    device-mesh sharding of rule tables and packet batches
+- ``runtime``     host-side batch runner driving the TPU pipeline
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from .store import KVStore, WatchEvent, Watcher, TxnFailed
+
+__all__ = ["KVStore", "WatchEvent", "Watcher", "TxnFailed"]

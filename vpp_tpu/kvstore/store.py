@@ -1,0 +1,158 @@
+"""In-memory etcd-like KV store with watch + snapshot.
+
+Plays the role of the reference's cluster state store (etcd accessed
+through cn-infra kvdbsync; SURVEY.md §1 L6).  The interface is
+deliberately etcd-shaped so a real etcd client can be slotted in behind
+the same API for production deployments:
+
+- revisioned ``put`` / ``delete`` / ``get``
+- prefix ``list`` (consistent snapshot under one lock)
+- ``put_if_not_exists`` — the atomic primitive nodesync uses for
+  cluster-wide node-ID allocation (reference:
+  plugins/nodesync/nodesync.go putIfNotExists :392)
+- prefix watchers with per-watcher delivery queues (analog of the etcd
+  watch channels consumed by plugins/controller/dbwatcher.go watchDB :231)
+
+Thread-safe; watchers receive events in commit order.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+
+class TxnFailed(Exception):
+    """An atomic KV operation lost its race."""
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    """A single change notification."""
+
+    key: str
+    value: Any  # None on delete
+    prev_value: Any
+    revision: int
+
+    @property
+    def is_delete(self) -> bool:
+        return self.value is None
+
+
+class Watcher:
+    """A registered watch on a set of key prefixes.
+
+    Consume with ``get(timeout)`` or iterate the underlying queue.
+    """
+
+    def __init__(self, prefixes: Tuple[str, ...]):
+        self.prefixes = prefixes
+        self.queue: "queue.Queue[WatchEvent]" = queue.Queue()
+        self.closed = False
+
+    def matches(self, key: str) -> bool:
+        return any(key.startswith(p) for p in self.prefixes)
+
+    def get(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        try:
+            return self.queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+
+class KVStore:
+    """The in-memory store."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._data: Dict[str, Any] = {}
+        self._revision = 0
+        self._watchers: List[Watcher] = []
+
+    # ------------------------------------------------------------------ basic
+
+    def get(self, key: str) -> Optional[Any]:
+        with self._lock:
+            return self._data.get(key)
+
+    def put(self, key: str, value: Any) -> int:
+        if value is None:
+            raise ValueError("use delete() to remove a key")
+        with self._lock:
+            prev = self._data.get(key)
+            self._data[key] = value
+            self._revision += 1
+            self._notify(key, value, prev)
+            return self._revision
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            if key not in self._data:
+                return False
+            prev = self._data.pop(key)
+            self._revision += 1
+            self._notify(key, None, prev)
+            return True
+
+    def put_if_not_exists(self, key: str, value: Any) -> bool:
+        """Atomic create; returns False if the key already exists."""
+        with self._lock:
+            if key in self._data:
+                return False
+            self.put(key, value)
+            return True
+
+    def compare_and_delete(self, key: str, expected: Any) -> bool:
+        """Delete only if the current value equals ``expected``."""
+        with self._lock:
+            if self._data.get(key) != expected:
+                return False
+            return self.delete(key)
+
+    # ------------------------------------------------------------- snapshots
+
+    def list(self, prefix: str = "") -> List[Tuple[str, Any]]:
+        """Consistent snapshot of all (key, value) under ``prefix``."""
+        with self._lock:
+            return sorted(
+                (k, v) for k, v in self._data.items() if k.startswith(prefix)
+            )
+
+    def snapshot(self, prefixes: Iterable[str]) -> Dict[str, Any]:
+        """One consistent snapshot across several prefixes (used for the
+        resync event; analog of dbwatcher.LoadKubeStateForResync :553)."""
+        with self._lock:
+            out: Dict[str, Any] = {}
+            for prefix in prefixes:
+                for k, v in self._data.items():
+                    if k.startswith(prefix):
+                        out[k] = v
+            return out
+
+    @property
+    def revision(self) -> int:
+        with self._lock:
+            return self._revision
+
+    # --------------------------------------------------------------- watches
+
+    def watch(self, prefixes: Iterable[str]) -> Watcher:
+        watcher = Watcher(tuple(prefixes))
+        with self._lock:
+            self._watchers.append(watcher)
+        return watcher
+
+    def unwatch(self, watcher: Watcher) -> None:
+        with self._lock:
+            watcher.closed = True
+            if watcher in self._watchers:
+                self._watchers.remove(watcher)
+
+    def _notify(self, key: str, value: Any, prev: Any) -> None:
+        ev = WatchEvent(key=key, value=value, prev_value=prev, revision=self._revision)
+        for watcher in self._watchers:
+            if not watcher.closed and watcher.matches(key):
+                watcher.queue.put(ev)

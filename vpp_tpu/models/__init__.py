@@ -1,0 +1,66 @@
+"""K8s-state data models — the lingua franca of the framework.
+
+Analog of the reference's ``plugins/ksr/model/*`` protobuf schemas
+(pod.proto, policy.proto, service.proto, endpoints.proto, namespace.proto,
+node.proto) and of ``dbresources/dbresources.go:44-90`` (the registry of
+resources reflected into the KV store).  Implemented as frozen Python
+dataclasses instead of protobuf: values stored in the KV store are
+immutable snapshots.
+"""
+
+from .common import Label, ProtocolType
+from .namespace import Namespace
+from .pod import Pod, PodID, Container, ContainerPort
+from .policy import (
+    Policy,
+    PolicyID,
+    PolicyType,
+    LabelSelector,
+    LabelExpression,
+    ExpressionOperator,
+    PolicyPort,
+    Peer,
+    IPBlock,
+    IngressRule,
+    EgressRule,
+)
+from .service import Service, ServiceID, ServicePort
+from .endpoints import Endpoints, EndpointSubset, EndpointAddress, EndpointPort
+from .node import Node, NodeAddress
+from .vppnode import VppNode
+from .registry import DbResource, DB_RESOURCES, resource_for_key, key_for
+
+__all__ = [
+    "Label",
+    "ProtocolType",
+    "Namespace",
+    "Pod",
+    "PodID",
+    "Container",
+    "ContainerPort",
+    "Policy",
+    "PolicyID",
+    "PolicyType",
+    "LabelSelector",
+    "LabelExpression",
+    "ExpressionOperator",
+    "PolicyPort",
+    "Peer",
+    "IPBlock",
+    "IngressRule",
+    "EgressRule",
+    "Service",
+    "ServiceID",
+    "ServicePort",
+    "Endpoints",
+    "EndpointSubset",
+    "EndpointAddress",
+    "EndpointPort",
+    "Node",
+    "NodeAddress",
+    "VppNode",
+    "DbResource",
+    "DB_RESOURCES",
+    "resource_for_key",
+    "key_for",
+]

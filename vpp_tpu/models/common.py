@@ -1,0 +1,74 @@
+"""Shared model primitives."""
+
+from __future__ import annotations
+
+import enum
+import types
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Label:
+    """A key/value label attached to a K8s object.
+
+    Analog of the repeated ``Label`` message in the reference's
+    pod.proto / policy.proto / namespace.proto.
+    """
+
+    key: str
+    value: str = ""
+
+
+class ProtocolType(enum.IntEnum):
+    """L4 protocol, using IANA protocol numbers.
+
+    The reference uses two enums (TCP=0/UDP=1 in protos,
+    TCP=6/UDP=17 in the service renderer API); here a single IANA-numbered
+    enum is used everywhere, with ANY/OTHER sentinels for the policy layer
+    (reference: plugins/policy/renderer/api.go:170-186).
+    """
+
+    TCP = 6
+    UDP = 17
+    # Some non-TCP, non-UDP traffic (ICMP in tests).
+    OTHER = 255
+    # Any L4 protocol, or pure L3 traffic (ports ignored).
+    ANY = 0
+
+    @classmethod
+    def parse(cls, s) -> "ProtocolType":
+        """Normalize a protocol spec. None/"" (proto3 default) means TCP,
+        matching K8s semantics; "ANY" is explicit."""
+        if isinstance(s, ProtocolType):
+            return s
+        if s is None or s == "":
+            return cls.TCP
+        s = str(s).upper()
+        if s in ("TCP", "6"):
+            return cls.TCP
+        if s in ("UDP", "17"):
+            return cls.UDP
+        if s == "ANY":
+            return cls.ANY
+        return cls.OTHER
+
+
+def labels_to_dict(labels) -> dict:
+    """Collapse a list of Label (or (k, v) tuples) into a dict."""
+    out = {}
+    for item in labels or ():
+        if isinstance(item, Label):
+            out[item.key] = item.value
+        else:
+            k, v = item
+            out[k] = v
+    return out
+
+
+def freeze_mapping(m) -> types.MappingProxyType:
+    """Wrap a mapping in a read-only view so frozen dataclasses holding it
+    are genuinely immutable snapshots (KV-store values are shared across
+    watchers)."""
+    if isinstance(m, types.MappingProxyType):
+        return m
+    return types.MappingProxyType(dict(m or {}))

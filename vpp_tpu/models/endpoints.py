@@ -1,0 +1,54 @@
+"""Endpoints model — analog of plugins/ksr/model/endpoints/endpoints.proto."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+from .common import ProtocolType
+from .pod import PodID
+
+
+@dataclass(frozen=True)
+class EndpointAddress:
+    """A single endpoint IP (endpoints.proto EndpointAddress).
+
+    ``target_pod`` replaces the proto's generic ObjectReference: in the
+    reference the reference is (almost) always to a Pod and the service
+    processor resolves it to one (processor_impl.go getTargetPort).
+    """
+
+    ip: str
+    node_name: str = ""
+    host_name: str = ""
+    target_pod: PodID = None  # type: ignore[assignment]
+
+
+@dataclass(frozen=True)
+class EndpointPort:
+    """A single endpoint port (endpoints.proto EndpointPort)."""
+
+    name: str = ""
+    port: int = 0
+    protocol: ProtocolType = ProtocolType.TCP
+
+    def __post_init__(self):
+        object.__setattr__(self, "protocol", ProtocolType.parse(self.protocol))
+
+
+@dataclass(frozen=True)
+class EndpointSubset:
+    """Addresses × ports product group (endpoints.proto EndpointSubset)."""
+
+    addresses: Tuple[EndpointAddress, ...] = ()
+    not_ready_addresses: Tuple[EndpointAddress, ...] = ()
+    ports: Tuple[EndpointPort, ...] = ()
+
+
+@dataclass(frozen=True)
+class Endpoints:
+    """Endpoints implementing a service; keyed like the Service."""
+
+    name: str
+    namespace: str = "default"
+    subsets: Tuple[EndpointSubset, ...] = ()

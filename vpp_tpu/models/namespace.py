@@ -1,0 +1,19 @@
+"""Namespace model — analog of plugins/ksr/model/namespace/namespace.proto."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Mapping
+
+from .common import freeze_mapping
+
+
+@dataclass(frozen=True)
+class Namespace:
+    """A K8s namespace with its cluster-scoped labels."""
+
+    name: str
+    labels: Mapping[str, str] = field(default_factory=dict)
+
+    def __post_init__(self):
+        object.__setattr__(self, "labels", freeze_mapping(self.labels))

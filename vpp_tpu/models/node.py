@@ -1,0 +1,39 @@
+"""Node model — analog of plugins/ksr/model/node/node.proto."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Mapping, Tuple
+
+from .common import freeze_mapping
+
+
+@dataclass(frozen=True)
+class NodeAddress:
+    """One address of a node. ``type`` follows K8s NodeAddress types."""
+
+    TYPE_HOSTNAME = "Hostname"
+    TYPE_EXTERNAL_IP = "ExternalIP"
+    TYPE_INTERNAL_IP = "InternalIP"
+
+    address: str
+    type: str = TYPE_INTERNAL_IP
+
+
+@dataclass(frozen=True)
+class Node:
+    """A K8s node as reflected from the API server."""
+
+    name: str
+    addresses: Tuple[NodeAddress, ...] = ()
+    pod_cidr: str = ""
+    labels: Mapping[str, str] = field(default_factory=dict)
+
+    def __post_init__(self):
+        object.__setattr__(self, "labels", freeze_mapping(self.labels))
+
+    def internal_ip(self) -> str:
+        for addr in self.addresses:
+            if addr.type == NodeAddress.TYPE_INTERNAL_IP:
+                return addr.address
+        return ""

@@ -1,0 +1,87 @@
+"""Pod model — analog of plugins/ksr/model/pod/pod.proto."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Mapping, Tuple
+
+from .common import ProtocolType, freeze_mapping
+
+
+@dataclass(frozen=True, order=True)
+class PodID:
+    """Unique pod identifier (namespace + name).
+
+    Analog of ``podmodel.ID`` in the reference
+    (plugins/ksr/model/pod/id.go).
+    """
+
+    name: str
+    namespace: str
+
+    def __str__(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    @classmethod
+    def parse(cls, s: str) -> "PodID":
+        """Parse "namespace/name"; a bare name gets the default namespace."""
+        ns, sep, name = s.partition("/")
+        if not sep:
+            return cls(name=s, namespace="default")
+        return cls(name=name, namespace=ns)
+
+
+@dataclass(frozen=True)
+class ContainerPort:
+    """A network port in a single container (pod.proto Container.Port)."""
+
+    name: str = ""
+    host_port: int = 0
+    container_port: int = 0
+    protocol: ProtocolType = ProtocolType.TCP
+    host_ip_address: str = ""
+
+
+@dataclass(frozen=True)
+class Container:
+    """A single application container run within a pod (pod.proto Container)."""
+
+    name: str = ""
+    ports: Tuple[ContainerPort, ...] = ()
+
+
+@dataclass(frozen=True)
+class Pod:
+    """A pod as reflected from the K8s API (pod.proto Pod).
+
+    ``labels`` is a plain mapping (the proto's repeated Label collapsed).
+    ``ip_address`` is empty until allocated; ``host_ip_address`` is empty
+    until scheduled.
+    """
+
+    name: str
+    namespace: str = "default"
+    labels: Mapping[str, str] = field(default_factory=dict)
+    ip_address: str = ""
+    host_ip_address: str = ""
+    containers: Tuple[Container, ...] = ()
+
+    def __post_init__(self):
+        object.__setattr__(self, "labels", freeze_mapping(self.labels))
+
+    @property
+    def id(self) -> PodID:
+        return PodID(name=self.name, namespace=self.namespace)
+
+    def container_port_by_name(self, port_name: str, protocol: ProtocolType):
+        """Resolve a named port to its number, or None.
+
+        Used when policies/services reference ports by name
+        (reference: plugins/policy/configurator/configurator_impl.go
+        getMatchingPorts; service processor target-port resolution).
+        """
+        for container in self.containers:
+            for port in container.ports:
+                if port.name == port_name and port.protocol == protocol:
+                    return port.container_port
+        return None

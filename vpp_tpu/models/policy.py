@@ -1,0 +1,166 @@
+"""NetworkPolicy model — analog of plugins/ksr/model/policy/policy.proto.
+
+Semantics notes carried over from the reference schema (policy.proto):
+
+- A *null* label selector matches nothing; an *empty* selector matches all
+  objects (in its scope).  match_labels and match_expressions are ANDed.
+- PolicyType defaults: policies containing an egress section affect egress;
+  all policies affect ingress unless policy_type says EGRESS only.
+- An IngressRule/EgressRule matches traffic iff it matches (any of ports)
+  AND (any of peers); an empty ports list means "all ports", an empty
+  peers list means "all sources/destinations".
+- IPBlock.except entries are CIDRs *inside* the block that must be
+  excluded.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Mapping, Optional, Tuple
+
+from .common import ProtocolType, freeze_mapping
+
+
+@dataclass(frozen=True, order=True)
+class PolicyID:
+    name: str
+    namespace: str
+
+    def __str__(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+class ExpressionOperator(enum.Enum):
+    """Operator of a label match-expression (policy.proto LabelExpression)."""
+
+    IN = "In"
+    NOT_IN = "NotIn"
+    EXISTS = "Exists"
+    DOES_NOT_EXIST = "DoesNotExist"
+
+
+@dataclass(frozen=True)
+class LabelExpression:
+    key: str
+    operator: ExpressionOperator
+    values: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class LabelSelector:
+    """A label query over a set of resources (policy.proto LabelSelector).
+
+    match_labels and match_expressions are ANDed together.  The *empty*
+    selector (no labels, no expressions) matches everything in scope.
+    Use ``None`` where the reference uses a nil selector (matches nothing).
+    """
+
+    match_labels: Mapping[str, str] = field(default_factory=dict)
+    match_expressions: Tuple[LabelExpression, ...] = ()
+
+    def __post_init__(self):
+        object.__setattr__(self, "match_labels", freeze_mapping(self.match_labels))
+
+    @property
+    def is_empty(self) -> bool:
+        return not self.match_labels and not self.match_expressions
+
+
+class PolicyType(enum.IntEnum):
+    """Which traffic directions the policy restricts (policy.proto)."""
+
+    DEFAULT = 0
+    INGRESS = 1
+    EGRESS = 2
+    INGRESS_AND_EGRESS = 3
+
+
+@dataclass(frozen=True)
+class PolicyPort:
+    """A port selector (policy.proto Port).
+
+    ``port`` may be an int (number), a str (named port, resolved against
+    the destination pod's container ports) or None (match all ports on
+    the protocol).
+    """
+
+    protocol: ProtocolType = ProtocolType.TCP
+    port: Optional[object] = None  # int | str | None
+
+
+@dataclass(frozen=True)
+class IPBlock:
+    """A CIDR with optional excluded sub-CIDRs (policy.proto IPBlock)."""
+
+    cidr: str
+    except_cidrs: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class Peer:
+    """A traffic peer: exactly one of pods / namespaces / ip_block.
+
+    (policy.proto Peer.)  ``pods`` selects pods in the policy's namespace;
+    ``namespaces`` selects all pods in matching namespaces; ``ip_block``
+    matches by CIDR.
+    """
+
+    pods: Optional[LabelSelector] = None
+    namespaces: Optional[LabelSelector] = None
+    ip_block: Optional[IPBlock] = None
+
+
+@dataclass(frozen=True)
+class IngressRule:
+    """Allows traffic matching (any of ports) AND (any of from_peers)."""
+
+    ports: Tuple[PolicyPort, ...] = ()
+    from_peers: Tuple[Peer, ...] = ()
+
+
+@dataclass(frozen=True)
+class EgressRule:
+    """Allows traffic matching (any of ports) AND (any of to_peers)."""
+
+    ports: Tuple[PolicyPort, ...] = ()
+    to_peers: Tuple[Peer, ...] = ()
+
+
+@dataclass(frozen=True)
+class Policy:
+    """A K8s NetworkPolicy (policy.proto Policy)."""
+
+    name: str
+    namespace: str = "default"
+    labels: Mapping[str, str] = field(default_factory=dict)
+    # Pods this policy applies to; empty selector = all pods in namespace.
+    pods: LabelSelector = field(default_factory=LabelSelector)
+    policy_type: PolicyType = PolicyType.DEFAULT
+    ingress_rules: Tuple[IngressRule, ...] = ()
+    egress_rules: Tuple[EgressRule, ...] = ()
+
+    def __post_init__(self):
+        object.__setattr__(self, "labels", freeze_mapping(self.labels))
+
+    @property
+    def id(self) -> PolicyID:
+        return PolicyID(name=self.name, namespace=self.namespace)
+
+    @property
+    def applies_to_ingress(self) -> bool:
+        """Per policy.proto PolicyType doc: everything but EGRESS-only
+        restricts ingress."""
+        return self.policy_type in (
+            PolicyType.DEFAULT,
+            PolicyType.INGRESS,
+            PolicyType.INGRESS_AND_EGRESS,
+        )
+
+    @property
+    def applies_to_egress(self) -> bool:
+        """EGRESS / INGRESS_AND_EGRESS restrict egress; DEFAULT restricts
+        egress iff the policy has an egress section."""
+        if self.policy_type in (PolicyType.EGRESS, PolicyType.INGRESS_AND_EGRESS):
+            return True
+        return self.policy_type == PolicyType.DEFAULT and len(self.egress_rules) > 0

@@ -1,0 +1,75 @@
+"""Registry of resources reflected into the KV store.
+
+Analog of ``dbresources/dbresources.go:44-90`` in the reference: one
+entry per reflected resource, carrying the resource keyword, the key
+prefix under which instances are stored, and the model type.  Extending
+the watched state = adding one entry here (same extension contract as
+the reference).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional, Type
+
+from .endpoints import Endpoints
+from .namespace import Namespace
+from .node import Node
+from .pod import Pod
+from .policy import Policy
+from .service import Service
+from .vppnode import VppNode
+
+# Root prefix of everything the control plane keeps in the KV store
+# (reference: /vnf-agent/contiv-ksr/k8s/...).
+KSR_PREFIX = "/vpp-tpu/ksr/k8s/"
+NODESYNC_PREFIX = "/vpp-tpu/nodesync/"
+
+
+@dataclass(frozen=True)
+class DbResource:
+    """One reflected resource kind."""
+
+    keyword: str
+    key_prefix: str
+    model: Type
+    # Builds the instance key suffix from a model instance.
+    key_suffix: Callable[[object], str]
+
+
+def _namespaced(obj) -> str:
+    return f"{obj.namespace}/{obj.name}"
+
+
+DB_RESOURCES = (
+    DbResource("namespace", KSR_PREFIX + "namespace/", Namespace, lambda o: o.name),
+    DbResource("pod", KSR_PREFIX + "pod/", Pod, _namespaced),
+    DbResource("policy", KSR_PREFIX + "policy/", Policy, _namespaced),
+    DbResource("service", KSR_PREFIX + "service/", Service, _namespaced),
+    DbResource("endpoints", KSR_PREFIX + "endpoints/", Endpoints, _namespaced),
+    DbResource("node", KSR_PREFIX + "node/", Node, lambda o: o.name),
+    DbResource("vppnode", NODESYNC_PREFIX + "vppnode/", VppNode, lambda o: str(o.id)),
+)
+
+_BY_KEYWORD = {r.keyword: r for r in DB_RESOURCES}
+_BY_MODEL = {r.model: r for r in DB_RESOURCES}
+
+
+def resource(keyword: str) -> DbResource:
+    return _BY_KEYWORD[keyword]
+
+
+def resource_for_key(key: str) -> Optional[DbResource]:
+    """Find the resource whose prefix covers ``key`` (longest match)."""
+    best = None
+    for r in DB_RESOURCES:
+        if key.startswith(r.key_prefix):
+            if best is None or len(r.key_prefix) > len(best.key_prefix):
+                best = r
+    return best
+
+
+def key_for(obj) -> str:
+    """Full KV key for a model instance."""
+    r = _BY_MODEL[type(obj)]
+    return r.key_prefix + r.key_suffix(obj)

@@ -1,0 +1,64 @@
+"""Service model — analog of plugins/ksr/model/service/service.proto."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Mapping, Optional, Tuple
+
+from .common import ProtocolType, freeze_mapping
+
+
+@dataclass(frozen=True, order=True)
+class ServiceID:
+    name: str
+    namespace: str
+
+    def __str__(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass(frozen=True)
+class ServicePort:
+    """One exposed service port (service.proto ServicePort).
+
+    ``target_port`` may be an int (port number), a str (named container
+    port looked up on the backend pod) or None (identity map from
+    ``port``).
+    """
+
+    name: str = ""
+    protocol: ProtocolType = ProtocolType.TCP
+    port: int = 0
+    target_port: Optional[object] = None  # int | str | None
+    node_port: int = 0
+
+    def __post_init__(self):
+        object.__setattr__(self, "protocol", ProtocolType.parse(self.protocol))
+
+
+@dataclass(frozen=True)
+class Service:
+    """A K8s Service (service.proto Service)."""
+
+    name: str
+    namespace: str = "default"
+    ports: Tuple[ServicePort, ...] = ()
+    selector: Mapping[str, str] = field(default_factory=dict)
+    cluster_ip: str = ""
+    service_type: str = "ClusterIP"  # ClusterIP | NodePort | LoadBalancer | ExternalName
+    external_ips: Tuple[str, ...] = ()
+    lb_ingress_ips: Tuple[str, ...] = ()
+    session_affinity: str = "None"  # None | ClientIP
+    session_affinity_timeout: int = 0
+    external_traffic_policy: str = "Cluster"  # Cluster | Local
+
+    def __post_init__(self):
+        object.__setattr__(self, "selector", freeze_mapping(self.selector))
+
+    @property
+    def id(self) -> ServiceID:
+        return ServiceID(name=self.name, namespace=self.namespace)
+
+    @property
+    def is_headless(self) -> bool:
+        return self.cluster_ip in ("None", "none")

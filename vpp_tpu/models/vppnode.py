@@ -1,0 +1,29 @@
+"""VppNode model — analog of plugins/nodesync/vppnode/vppnode.proto.
+
+Describes one data-plane node of the cluster: its allocated integer ID
+and the IPs of its TPU-pipeline interfaces, as published by nodesync
+(reference: plugins/nodesync/nodesync.go PublishNodeIPs :122).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+
+@dataclass(frozen=True)
+class VppNode:
+    """Data-plane view of a cluster node.
+
+    ``id`` is the cluster-unique positive integer allocated by nodesync;
+    IPAM derives all of the node's subnets from it
+    (plugins/ipam/ipam.go dissectSubnetForNode :584).
+    """
+
+    id: int
+    name: str
+    # IP addresses (with prefix length, "a.b.c.d/len") of this node's
+    # main data-plane interface.
+    ip_addresses: Tuple[str, ...] = ()
+    # Management IPs (no mask) used for node-to-node control traffic.
+    mgmt_ip_addresses: Tuple[str, ...] = ()
