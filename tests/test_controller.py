@@ -1,0 +1,325 @@
+"""Controller event-loop tests.
+
+Covers the behaviors the reference documents for its event loop
+(docs/dev-guide/EVENT_LOOP.md) but never unit-tests (SURVEY.md §4.4):
+resync-first gating, handler ordering, RevertOnFailure, follow-up
+priority, healing scheduling, blocking events, history.
+"""
+
+import threading
+import time
+
+import pytest
+
+from vpp_tpu.controller import (
+    Controller,
+    DBResync,
+    DBWatcher,
+    Event,
+    EventHandler,
+    EventMethod,
+    HealingResync,
+    KubeStateChange,
+    TxnSink,
+    UpdateDirection,
+    UpdateEvent,
+    UpdateTxnType,
+)
+from vpp_tpu.kvstore import KVStore
+from vpp_tpu.models import Pod, key_for
+
+
+class MockSink(TxnSink):
+    """Captures committed transactions (mock/localclient.TxnTracker analog)."""
+
+    def __init__(self):
+        self.txns = []
+        self.replayed = 0
+
+    def commit(self, txn):
+        self.txns.append(txn)
+
+    def replay(self):
+        self.replayed += 1
+
+
+class TracingHandler(EventHandler):
+    def __init__(self, name, trace, fail_on=None, puts=None):
+        self.name = name
+        self.trace = trace
+        self.fail_on = fail_on or set()
+        self.puts = puts or {}
+
+    def handles_event(self, event):
+        return True
+
+    def resync(self, event, kube_state, resync_count, txn):
+        self.trace.append((self.name, "resync"))
+        if "resync" in self.fail_on:
+            raise RuntimeError(f"{self.name} resync boom")
+        for k, v in self.puts.items():
+            txn.put(k, v)
+
+    def update(self, event, txn):
+        self.trace.append((self.name, "update"))
+        if "update" in self.fail_on:
+            raise RuntimeError(f"{self.name} update boom")
+        for k, v in self.puts.items():
+            txn.put(k, v)
+        return f"{self.name} did things"
+
+    def revert(self, event):
+        self.trace.append((self.name, "revert"))
+
+
+class RevertingEvent(UpdateEvent):
+    name = "Reverting Event"
+
+    def __init__(self, blocking=False):
+        super().__init__(blocking=blocking)
+
+    @property
+    def transaction_type(self):
+        return UpdateTxnType.REVERT_ON_FAILURE
+
+
+class ReverseEvent(UpdateEvent):
+    name = "Reverse Event"
+
+    @property
+    def direction(self):
+        return UpdateDirection.REVERSE
+
+
+def make_controller(handlers, **kw):
+    sink = MockSink()
+    ctl = Controller(handlers, sink, healing_delay=kw.pop("healing_delay", 0.02), **kw)
+    ctl.start()
+    return ctl, sink
+
+
+def test_resync_first_gating_and_order():
+    trace = []
+    h1 = TracingHandler("a", trace, puts={"/cfg/a": 1})
+    h2 = TracingHandler("b", trace, puts={"/cfg/b": 2})
+    ctl, sink = make_controller([h1, h2])
+    try:
+        # Update event arrives BEFORE the first resync: must be delayed.
+        early = KubeStateChange("pod", "/k/p", None, "v")
+        ctl.push_event(early)
+        time.sleep(0.2)
+        assert trace == []  # nothing processed yet
+
+        resync = DBResync(kube_state={"pod": {}})
+        ctl.push_event(resync)
+        assert resync.wait(2) is None
+        assert early.wait(2) is None
+        # Resync ran through both handlers in order, then the delayed update.
+        assert trace == [("a", "resync"), ("b", "resync"), ("a", "update"), ("b", "update")]
+        assert sink.txns[0].is_resync
+        assert sink.txns[0].values == {"/cfg/a": 1, "/cfg/b": 2}
+        assert not sink.txns[1].is_resync
+    finally:
+        ctl.stop()
+
+
+def test_reverse_direction():
+    trace = []
+    ctl, _ = make_controller([TracingHandler("a", trace), TracingHandler("b", trace)])
+    try:
+        ctl.push_event(DBResync())
+        ev = ReverseEvent()
+        ctl.push_event(ev)
+        assert ev.wait(2) is None
+        assert trace[-2:] == [("b", "update"), ("a", "update")]
+    finally:
+        ctl.stop()
+
+
+def test_revert_on_failure_reverts_and_drops_txn():
+    trace = []
+    good = TracingHandler("good", trace, puts={"/cfg/good": 1})
+    bad = TracingHandler("bad", trace, fail_on={"update"})
+    ctl, sink = make_controller([good, bad])
+    try:
+        ctl.push_event(DBResync())
+        ev = RevertingEvent(blocking=True)
+        ctl.push_event(ev)
+        err = ev.wait(2)
+        assert err is not None and "boom" in str(err)
+        # good ran, bad failed, then good reverted (reverse order).
+        assert trace[-3:] == [("good", "update"), ("bad", "update"), ("good", "revert")]
+        # The update txn was dropped: only the resync txn was committed.
+        assert len(sink.txns) == 1 and sink.txns[0].is_resync
+    finally:
+        ctl.stop()
+
+
+def test_healing_resync_after_error():
+    trace = []
+    flaky = TracingHandler("flaky", trace)
+    calls = {"n": 0}
+
+    def update(event, txn):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient")
+        return ""
+
+    flaky.update = update
+    ctl, sink = make_controller([flaky], healing_delay=0.01)
+    try:
+        ctl.push_event(DBResync())
+        ctl.push_event(KubeStateChange("pod", "/k", None, "v"))
+        deadline = time.time() + 3
+        while time.time() < deadline:
+            names = [r.name for r in ctl.event_history]
+            if HealingResync.name in names:
+                break
+            time.sleep(0.02)
+        names = [r.name for r in ctl.event_history]
+        assert HealingResync.name in names
+        assert ctl.resync_count == 2  # startup + healing
+    finally:
+        ctl.stop()
+
+
+def test_followup_priority():
+    """An event pushed from inside a handler runs before queued events."""
+    trace = []
+
+    class Chaining(EventHandler):
+        name = "chaining"
+
+        def __init__(self, ctl_ref):
+            self.ctl_ref = ctl_ref
+            self.fired = False
+
+        def resync(self, event, kube_state, resync_count, txn):
+            pass
+
+        def update(self, event, txn):
+            trace.append(event.key)
+            if event.key == "/first" and not self.fired:
+                self.fired = True
+                self.ctl_ref["ctl"].push_event(KubeStateChange("pod", "/followup", None, "v"))
+            return ""
+
+    ref = {}
+    h = Chaining(ref)
+    ctl, _ = make_controller([h])
+    ref["ctl"] = ctl
+    try:
+        ctl.push_event(DBResync())
+        e1 = KubeStateChange("pod", "/first", None, "v")
+        e2 = KubeStateChange("pod", "/second", None, "v")
+        ctl.push_event(e1)
+        ctl.push_event(e2)
+        assert e2.wait(2) is None
+        assert trace == ["/first", "/followup", "/second"]
+    finally:
+        ctl.stop()
+
+
+def test_blocking_push_from_loop_raises():
+    captured = {}
+
+    class Deadlocker(EventHandler):
+        name = "deadlocker"
+
+        def __init__(self):
+            self.ctl = None
+
+        def resync(self, event, kube_state, resync_count, txn):
+            pass
+
+        def update(self, event, txn):
+            try:
+                self.ctl.push_event(RevertingEvent(blocking=True))
+            except RuntimeError as e:
+                captured["err"] = e
+            return ""
+
+    h = Deadlocker()
+    ctl, _ = make_controller([h])
+    h.ctl = ctl
+    try:
+        ctl.push_event(DBResync())
+        ev = KubeStateChange("pod", "/k", None, "v")
+        ctl.push_event(ev)
+        ev.wait(2)
+        assert "deadlock" in str(captured["err"])
+    finally:
+        ctl.stop()
+
+
+def test_kube_state_cache_tracks_changes():
+    ctl, _ = make_controller([TracingHandler("a", [])])
+    try:
+        ctl.push_event(DBResync(kube_state={"pod": {"/k/p1": "v1"}}))
+        ev = KubeStateChange("pod", "/k/p2", None, "v2")
+        ctl.push_event(ev)
+        ev.wait(2)
+        assert ctl.kube_state["pod"] == {"/k/p1": "v1", "/k/p2": "v2"}
+        ev = KubeStateChange("pod", "/k/p1", "v1", None)
+        ctl.push_event(ev)
+        ev.wait(2)
+        assert ctl.kube_state["pod"] == {"/k/p2": "v2"}
+    finally:
+        ctl.stop()
+
+
+def test_dbwatcher_end_to_end():
+    store = KVStore()
+    pod = Pod(name="web", namespace="default", labels={"app": "web"})
+    store.put(key_for(pod), pod)
+
+    seen = []
+
+    class Recorder(EventHandler):
+        name = "recorder"
+
+        def resync(self, event, kube_state, resync_count, txn):
+            seen.append(("resync", dict(kube_state.get("pod", {}))))
+
+        def update(self, event, txn):
+            seen.append(("update", event.key, event.new_value))
+            return ""
+
+    ctl, _ = make_controller([Recorder()])
+    watcher = DBWatcher(ctl, store)
+    try:
+        watcher.start()
+        deadline = time.time() + 2
+        while time.time() < deadline and not seen:
+            time.sleep(0.02)
+        assert seen and seen[0][0] == "resync"
+        assert key_for(pod) in seen[0][1]
+
+        pod2 = Pod(name="db", namespace="default")
+        store.put(key_for(pod2), pod2)
+        deadline = time.time() + 2
+        while time.time() < deadline and len(seen) < 2:
+            time.sleep(0.02)
+        assert seen[1][0] == "update" and seen[1][1] == key_for(pod2)
+    finally:
+        watcher.stop()
+        ctl.stop()
+
+
+def test_event_history_records():
+    trace = []
+    ctl, _ = make_controller([TracingHandler("a", trace, puts={"/cfg/a": 1})])
+    try:
+        ctl.push_event(DBResync())
+        ev = KubeStateChange("pod", "/k", None, "v")
+        ctl.push_event(ev)
+        ev.wait(2)
+        hist = ctl.event_history
+        assert len(hist) == 2
+        assert hist[0].method is EventMethod.FULL_RESYNC
+        assert hist[0].txn is not None and hist[0].txn.is_resync
+        assert hist[1].handlers[0].change == "a did things"
+        assert hist[1].error is None
+    finally:
+        ctl.stop()

@@ -1,0 +1,152 @@
+"""Txn scheduler tests: diffing, dependencies, cascades, retries."""
+
+from vpp_tpu.controller.txn import RecordedTxn
+from vpp_tpu.scheduler import Applicator, TxnScheduler, ValueState
+
+
+class MockEngine(Applicator):
+    """Records CRUD calls and optionally fails on demand."""
+
+    def __init__(self, prefix):
+        self.prefix = prefix
+        self.state = {}
+        self.ops = []
+        self.fail_keys = set()
+
+    def create(self, key, value):
+        if key in self.fail_keys:
+            raise RuntimeError("backend unavailable")
+        self.ops.append(("create", key, value))
+        self.state[key] = value
+
+    def update(self, key, old_value, new_value):
+        if key in self.fail_keys:
+            raise RuntimeError("backend unavailable")
+        self.ops.append(("update", key, new_value))
+        self.state[key] = new_value
+
+    def delete(self, key, value):
+        self.ops.append(("delete", key))
+        self.state.pop(key, None)
+
+
+def resync(values):
+    return RecordedTxn(is_resync=True, values=values)
+
+
+def update(values):
+    return RecordedTxn(is_resync=False, values=values)
+
+
+def test_resync_diffing():
+    eng = MockEngine("/cfg/")
+    s = TxnScheduler()
+    s.register_applicator(eng)
+
+    s.commit(resync({"/cfg/a": 1, "/cfg/b": 2}))
+    assert eng.state == {"/cfg/a": 1, "/cfg/b": 2}
+
+    # Second resync: modify a, drop b, add c — minimal diff expected.
+    eng.ops.clear()
+    s.commit(resync({"/cfg/a": 10, "/cfg/c": 3}))
+    assert eng.state == {"/cfg/a": 10, "/cfg/c": 3}
+    kinds = sorted(op[0] for op in eng.ops)
+    assert kinds == ["create", "delete", "update"]
+    # Unchanged value would produce no op at all:
+    eng.ops.clear()
+    s.commit(resync({"/cfg/a": 10, "/cfg/c": 3}))
+    assert eng.ops == []
+
+
+def test_update_txn_merge_and_delete():
+    eng = MockEngine("/cfg/")
+    s = TxnScheduler()
+    s.register_applicator(eng)
+    s.commit(resync({"/cfg/a": 1}))
+    s.commit(update({"/cfg/b": 2, "/cfg/a": None}))
+    assert eng.state == {"/cfg/b": 2}
+
+
+def test_dependency_pending_then_applied():
+    eng = MockEngine("/cfg/")
+    s = TxnScheduler()
+    s.register_applicator(eng)
+    # routes depend on their interface being configured
+    s.register_dependencies("/cfg/route/", lambda key, v: {"/cfg/if/" + v["via"]})
+
+    s.commit(update({"/cfg/route/r1": {"via": "eth0", "dst": "10.0.0.0/24"}}))
+    assert eng.state == {}  # pending: interface not there yet
+    assert s.dump()[0].state is ValueState.PENDING
+
+    s.commit(update({"/cfg/if/eth0": {"up": True}}))
+    # Fixed-point application resolved the pending route.
+    assert "/cfg/route/r1" in eng.state
+    states = {v.key: v.state for v in s.dump()}
+    assert states["/cfg/route/r1"] is ValueState.APPLIED
+
+
+def test_dependency_cascade_on_delete():
+    eng = MockEngine("/cfg/")
+    s = TxnScheduler()
+    s.register_applicator(eng)
+    s.register_dependencies("/cfg/route/", lambda key, v: {"/cfg/if/" + v["via"]})
+    s.commit(update({"/cfg/if/eth0": {"up": True},
+                     "/cfg/route/r1": {"via": "eth0"}}))
+    assert "/cfg/route/r1" in eng.state
+
+    eng.ops.clear()
+    s.commit(update({"/cfg/if/eth0": None}))
+    # Route unapplied BEFORE the interface was deleted.
+    assert eng.ops == [("delete", "/cfg/route/r1"), ("delete", "/cfg/if/eth0")]
+    # The route remains desired, pending the interface's return.
+    states = {v.key: v.state for v in s.dump()}
+    assert states["/cfg/route/r1"] is ValueState.PENDING
+
+    s.commit(update({"/cfg/if/eth0": {"up": True}}))
+    assert "/cfg/route/r1" in eng.state
+
+
+def test_retry_after_failure():
+    eng = MockEngine("/cfg/")
+    retries = []
+    s = TxnScheduler(schedule_retry=lambda fn, delay: retries.append((fn, delay)))
+    s.register_applicator(eng)
+
+    eng.fail_keys.add("/cfg/a")
+    s.commit(update({"/cfg/a": 1}))
+    assert s.dump()[0].state is ValueState.FAILED
+    assert len(retries) == 1
+
+    # Backend recovers; fire the scheduled retry.
+    eng.fail_keys.clear()
+    retries[0][0]()
+    assert eng.state == {"/cfg/a": 1}
+    assert s.dump()[0].state is ValueState.APPLIED
+
+
+def test_retry_backoff_and_limit():
+    eng = MockEngine("/cfg/")
+    retries = []
+    s = TxnScheduler(retry_delay=0.5, max_retries=3,
+                     schedule_retry=lambda fn, delay: retries.append((fn, delay)))
+    s.register_applicator(eng)
+    eng.fail_keys.add("/cfg/a")
+    s.commit(update({"/cfg/a": 1}))
+    # Keep failing through all retries.
+    i = 0
+    while i < len(retries):
+        retries[i][0]()
+        i += 1
+    delays = [d for _, d in retries]
+    assert delays == [0.5, 1.0, 2.0]  # exponential backoff, capped at 3 tries
+
+
+def test_replay_downstream_resync():
+    eng = MockEngine("/cfg/")
+    s = TxnScheduler()
+    s.register_applicator(eng)
+    s.commit(resync({"/cfg/a": 1}))
+    # Simulate backend data loss.
+    eng.state.clear()
+    s.replay()
+    assert eng.state == {"/cfg/a": 1}

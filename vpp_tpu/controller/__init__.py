@@ -1,0 +1,54 @@
+"""Controller — the single-threaded event loop at the heart of the control
+plane.
+
+Analog of the reference's ``plugins/controller`` (SURVEY.md §1 L5): the
+dbwatcher converts KV-store changes into events, the event loop runs them
+through an ordered chain of event handlers, and every event's config
+output is committed as one transaction to the txn scheduler.
+"""
+
+from .api import (
+    Event,
+    UpdateEvent,
+    EventHandler,
+    EventMethod,
+    UpdateDirection,
+    UpdateTxnType,
+    KubeStateData,
+    DBResync,
+    KubeStateChange,
+    ExternalConfigChange,
+    HealingResync,
+    HealingResyncType,
+    Shutdown,
+    FatalError,
+    AbortEventError,
+)
+from .txn import Txn, TxnSink, RecordedTxn
+from .eventloop import Controller, EventRecord, HandlerRecord
+from .dbwatcher import DBWatcher
+
+__all__ = [
+    "Event",
+    "UpdateEvent",
+    "EventHandler",
+    "EventMethod",
+    "UpdateDirection",
+    "UpdateTxnType",
+    "KubeStateData",
+    "DBResync",
+    "KubeStateChange",
+    "ExternalConfigChange",
+    "HealingResync",
+    "HealingResyncType",
+    "Shutdown",
+    "FatalError",
+    "AbortEventError",
+    "Txn",
+    "TxnSink",
+    "RecordedTxn",
+    "Controller",
+    "EventRecord",
+    "HandlerRecord",
+    "DBWatcher",
+]

@@ -1,0 +1,250 @@
+"""Event model of the controller.
+
+Analog of the reference's ``plugins/controller/api`` package:
+event_loop.go (Event, UpdateEvent, EventHandler, method/direction/txn-type
+enums), db.go (DBResync, KubeStateChange, ExternalConfigChange),
+healing.go (HealingResync), shutdown.go (Shutdown) and error.go
+(FatalError, AbortEventError).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import Any, Dict, Optional
+
+# KubeStateData: resource keyword -> {full key -> model instance}
+# (analog of api/db.go KubeStateData).
+KubeStateData = Dict[str, Dict[str, Any]]
+
+
+class EventMethod(enum.Enum):
+    """How an event must be reacted to (api/event_loop.go EventMethodType)."""
+
+    # Full re-synchronization: control plane -> scheduler <-> data plane.
+    FULL_RESYNC = "full-resync"
+    # Re-sync between the scheduler and the data plane only; handlers are
+    # not involved.
+    DOWNSTREAM_RESYNC = "downstream-resync"
+    # Re-sync between the control plane and the scheduler (data plane state
+    # assumed to be in sync).
+    UPSTREAM_RESYNC = "upstream-resync"
+    # Incremental change.
+    UPDATE = "update"
+
+    @property
+    def is_resync(self) -> bool:
+        return self is not EventMethod.UPDATE
+
+
+class UpdateDirection(enum.Enum):
+    """Handler iteration order for update events."""
+
+    # Handlers run in registration order (dependencies first).
+    FORWARD = "forward"
+    # Handlers run in reverse order (dependencies still pre-event).
+    REVERSE = "reverse"
+
+
+class UpdateTxnType(enum.Enum):
+    """How to treat partial work of a failed update event."""
+
+    # Keep whatever succeeded (stay as close to desired state as possible).
+    BEST_EFFORT = "best-effort"
+    # Stop on first error and revert already executed changes.
+    REVERT_ON_FAILURE = "revert-on-failure"
+
+
+class FatalError(Exception):
+    """Error after which the agent must restart (api/error.go)."""
+
+
+class AbortEventError(Exception):
+    """Abort event processing without reverting (api/error.go)."""
+
+
+class Event:
+    """Base class of everything flowing through the event loop.
+
+    Subclasses override ``method`` and, for blocking events, construct with
+    ``blocking=True`` so producers can ``wait()`` for the processing result.
+    """
+
+    name = "Event"
+
+    def __init__(self, blocking: bool = False):
+        self._blocking = blocking
+        self._done = threading.Event()
+        self._error: Optional[Exception] = None
+
+    # -- contract ----------------------------------------------------------
+
+    @property
+    def method(self) -> EventMethod:
+        return EventMethod.UPDATE
+
+    @property
+    def is_blocking(self) -> bool:
+        return self._blocking
+
+    def done(self, error: Optional[Exception]) -> None:
+        """Mark the event as processed, delivering the result to waiters."""
+        self._error = error
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[Exception]:
+        """Block until the event has been processed; returns its error."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"event {self.name} not processed in time")
+        return self._error
+
+    def __str__(self) -> str:
+        return self.name
+
+
+class UpdateEvent(Event):
+    """An event that can be reacted to by an incremental change."""
+
+    @property
+    def method(self) -> EventMethod:
+        return EventMethod.UPDATE
+
+    @property
+    def direction(self) -> UpdateDirection:
+        return UpdateDirection.FORWARD
+
+    @property
+    def transaction_type(self) -> UpdateTxnType:
+        return UpdateTxnType.BEST_EFFORT
+
+
+class EventHandler:
+    """A plugin reacting to events (api/event_loop.go EventHandler).
+
+    Handlers are registered with the Controller in dependency order; for
+    every handler processing a Forward event, all its dependencies have
+    already reacted to it.
+    """
+
+    name = "handler"
+
+    def handles_event(self, event: Event) -> bool:
+        return True
+
+    def resync(self, event: Event, kube_state: KubeStateData, resync_count: int, txn) -> None:
+        """Handle a full-resync event. ``resync_count`` is 1 for the startup
+        resync, higher for run-time resyncs."""
+
+    def update(self, event: Event, txn) -> str:
+        """Handle an incremental event; returns a human-readable description
+        of the changes performed (may be empty)."""
+        return ""
+
+    def revert(self, event: Event) -> None:
+        """Revert internal (plugin-state) changes done for a failed
+        RevertOnFailure event."""
+
+    def __str__(self) -> str:
+        return self.name
+
+
+# --------------------------------------------------------------------------
+# Concrete events
+# --------------------------------------------------------------------------
+
+
+class DBResync(Event):
+    """Carries a snapshot of the DB for all watched resources plus external
+    config (api/db.go DBResync)."""
+
+    name = "Database Resync"
+
+    def __init__(self, kube_state: Optional[KubeStateData] = None,
+                 external_config: Optional[Dict[str, Any]] = None,
+                 local: bool = False):
+        super().__init__()
+        self.kube_state: KubeStateData = kube_state if kube_state is not None else {}
+        self.external_config: Dict[str, Any] = external_config or {}
+        self.local = local
+
+    @property
+    def method(self) -> EventMethod:
+        return EventMethod.FULL_RESYNC
+
+    def __str__(self) -> str:
+        where = "Local DB" if self.local else "Remote DB"
+        counts = {k: len(v) for k, v in self.kube_state.items() if v}
+        return f"{self.name} ({where}) {counts}"
+
+
+class KubeStateChange(UpdateEvent):
+    """One changed value of a watched resource (api/db.go KubeStateChange)."""
+
+    name = "Kubernetes State Change"
+
+    def __init__(self, resource: str, key: str, prev_value: Any, new_value: Any):
+        super().__init__()
+        self.resource = resource
+        self.key = key
+        self.prev_value = prev_value
+        self.new_value = new_value
+
+    def __str__(self) -> str:
+        op = "update"
+        if self.prev_value is None:
+            op = "add"
+        elif self.new_value is None:
+            op = "delete"
+        return f"{self.name} [{op} {self.resource}: {self.key}]"
+
+
+class ExternalConfigChange(UpdateEvent):
+    """Change of externally-supplied (non-K8s) config values
+    (api/db.go ExternalConfigChange)."""
+
+    name = "External Config Change"
+
+    def __init__(self, source: str, changes: Dict[str, Any], blocking: bool = False):
+        super().__init__(blocking=blocking)
+        self.source = source
+        self.changes = changes  # key -> new value (None = delete)
+
+    def __str__(self) -> str:
+        return f"{self.name} [source={self.source}, keys={sorted(self.changes)}]"
+
+
+class HealingResyncType(enum.Enum):
+    PERIODIC = "periodic"
+    AFTER_ERROR = "after-error"
+
+
+class HealingResync(Event):
+    """Heals the data-plane state after an error or periodically
+    (api/healing.go)."""
+
+    name = "Healing Resync"
+
+    def __init__(self, type_: HealingResyncType, error: Optional[Exception] = None):
+        super().__init__()
+        self.type = type_
+        self.error = error
+
+    @property
+    def method(self) -> EventMethod:
+        if self.type is HealingResyncType.PERIODIC:
+            return EventMethod.DOWNSTREAM_RESYNC
+        return EventMethod.FULL_RESYNC
+
+    def __str__(self) -> str:
+        if self.type is HealingResyncType.AFTER_ERROR:
+            return f"{self.name} (After error: {self.error})"
+        return f"{self.name} (Periodic)"
+
+
+class Shutdown(Event):
+    """Final event: cleanup before the agent exits (api/shutdown.go)."""
+
+    name = "Shutdown"
+
+    def __init__(self):
+        super().__init__(blocking=True)

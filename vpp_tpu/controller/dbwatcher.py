@@ -1,0 +1,97 @@
+"""DB watcher — converts KV-store activity into controller events.
+
+Analog of ``plugins/controller/dbwatcher.go``: on start it takes one
+consistent snapshot of every registered resource prefix (plus the
+external-config prefix) and pushes a DBResync (runResyncFromRemoteDB
+:334 / LoadKubeStateForResync :553); afterwards every watched change
+becomes a KubeStateChange / ExternalConfigChange event (processChange
+:404).  ``resync()`` re-snapshots on demand — the hook used by healing
+resyncs and by the REST resync trigger.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from ..kvstore import KVStore, WatchEvent
+from ..models import registry
+from .api import DBResync, ExternalConfigChange, KubeStateChange
+from .eventloop import Controller
+
+log = logging.getLogger(__name__)
+
+EXTERNAL_CONFIG_PREFIX = "/vpp-tpu/external-config/"
+
+
+class DBWatcher:
+    """Watches the cluster KV store and feeds the event loop."""
+
+    def __init__(self, controller: Controller, store: KVStore):
+        self.controller = controller
+        self.store = store
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        prefixes = [r.key_prefix for r in registry.DB_RESOURCES] + [EXTERNAL_CONFIG_PREFIX]
+        self._watcher = self.store.watch(prefixes)
+
+    # ------------------------------------------------------------------ life
+
+    def start(self) -> None:
+        """Push the startup DBResync, then stream changes.
+
+        The watch is registered before the snapshot is taken (in
+        __init__/here respectively), so no change can fall between
+        snapshot and stream; duplicates are resolved by the snapshot
+        being authoritative at resync time.
+        """
+        self.resync()
+        self._thread = threading.Thread(target=self._watch_loop, name="db-watcher", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.store.unwatch(self._watcher)
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # ---------------------------------------------------------------- resync
+
+    def resync(self) -> DBResync:
+        """Snapshot all resources and push a DBResync event."""
+        kube_state = {}
+        for resource in registry.DB_RESOURCES:
+            kube_state[resource.keyword] = dict(self.store.list(resource.key_prefix))
+        external = dict(self.store.list(EXTERNAL_CONFIG_PREFIX))
+        event = DBResync(kube_state=kube_state, external_config=external)
+        self.controller.push_event(event)
+        return event
+
+    # ----------------------------------------------------------------- watch
+
+    def _watch_loop(self) -> None:
+        while not self._stop.is_set():
+            ev = self._watcher.get(timeout=0.1)
+            if ev is None:
+                continue
+            self._process_change(ev)
+
+    def _process_change(self, ev: WatchEvent) -> None:
+        if ev.key.startswith(EXTERNAL_CONFIG_PREFIX):
+            self.controller.push_event(
+                ExternalConfigChange(source="db", changes={ev.key: ev.value})
+            )
+            return
+        resource = registry.resource_for_key(ev.key)
+        if resource is None:
+            log.warning("change under unknown prefix: %s", ev.key)
+            return
+        self.controller.push_event(
+            KubeStateChange(
+                resource=resource.keyword,
+                key=ev.key,
+                prev_value=ev.prev_value,
+                new_value=ev.value,
+            )
+        )

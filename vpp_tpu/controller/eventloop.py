@@ -1,0 +1,385 @@
+"""The single-threaded controller event loop.
+
+Analog of ``plugins/controller/plugin_controller.go``: FIFO queue of
+events with
+
+- resync-first gating: nothing is processed until the first DBResync
+  arrives (receiveEvent :500-513) — events arriving earlier are delayed;
+- follow-up priority: events pushed from inside the loop are processed
+  before externally queued ones;
+- per-event transactions committed to the txn scheduler;
+- RevertOnFailure semantics: failed update events get already-executed
+  handlers reverted in reverse order (:833-860);
+- healing: an error during event processing schedules an AfterError
+  HealingResync; a failed healing resync is a FatalError (:873-885, :968);
+- event history with per-handler outcomes (:216-237).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from .api import (
+    DBResync,
+    Event,
+    EventHandler,
+    EventMethod,
+    ExternalConfigChange,
+    FatalError,
+    AbortEventError,
+    HealingResync,
+    HealingResyncType,
+    KubeStateChange,
+    KubeStateData,
+    Shutdown,
+    UpdateDirection,
+    UpdateEvent,
+    UpdateTxnType,
+)
+from .txn import Txn, TxnSink, RecordedTxn
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class HandlerRecord:
+    """Outcome of one handler for one event."""
+
+    handler: str
+    revert: bool = False
+    change: str = ""
+    error: Optional[str] = None
+
+
+@dataclass
+class EventRecord:
+    """One entry of the event history (plugin_controller.go eventRecord)."""
+
+    seq_num: int
+    name: str
+    description: str
+    method: EventMethod
+    is_followup: bool = False
+    handlers: List[HandlerRecord] = field(default_factory=list)
+    txn: Optional[RecordedTxn] = None
+    txn_error: Optional[str] = None
+    started: float = 0.0
+    duration_ms: float = 0.0
+
+    @property
+    def error(self) -> Optional[str]:
+        for rec in self.handlers:
+            if rec.error and not rec.revert:
+                return f"{rec.handler}: {rec.error}"
+        return self.txn_error
+
+
+class Controller:
+    """Runs the event loop in its own thread.
+
+    ``handlers`` must be given in dependency order (the reference's
+    fixed chain is built in cmd/contiv-agent/main.go:203-213).
+    ``sink`` receives one committed transaction per event.
+    """
+
+    def __init__(
+        self,
+        handlers: Sequence[EventHandler],
+        sink: TxnSink,
+        healing_delay: float = 5.0,
+        on_fatal: Optional[Callable[[Exception], None]] = None,
+        history_limit: int = 1000,
+    ):
+        self.handlers = list(handlers)
+        self.sink = sink
+        self.healing_delay = healing_delay
+        self.on_fatal = on_fatal
+
+        self.kube_state: KubeStateData = {}
+        self.external_config: Dict[str, Any] = {}
+
+        self._queue: "queue.Queue[Event]" = queue.Queue()
+        self._followup: "collections.deque[Event]" = collections.deque()
+        self._delayed: List[Event] = []
+        self._started_resync = False
+        self._resync_count = 0
+        self._event_seq = 0
+        self._txn_seq = 0
+        self._shutdown = False
+        self._thread: Optional[threading.Thread] = None
+        self._loop_thread_id: Optional[int] = None
+        self._history: List[EventRecord] = []
+        self._history_limit = history_limit
+        self._healing_scheduled = False
+        self._lock = threading.Lock()
+
+    # ----------------------------------------------------------------- life
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._event_loop, name="event-loop", daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Push Shutdown and wait for the loop to drain."""
+        if self._thread is None or not self._thread.is_alive():
+            return
+        ev = Shutdown()
+        self.push_event(ev)
+        ev.wait(timeout)
+        self._thread.join(timeout)
+
+    # ------------------------------------------------------------ push/queue
+
+    def push_event(self, event: Event) -> None:
+        """Add an event to the queue.
+
+        Called from inside the loop (a handler pushing a follow-up), the
+        event gets priority over externally queued ones.  Pushing a
+        *blocking* event from inside the loop would deadlock and raises
+        instead (the reference panics, plugin_controller.go:350-357).
+        """
+        if threading.get_ident() == self._loop_thread_id:
+            if event.is_blocking:
+                raise RuntimeError(
+                    f"deadlock: blocking event {event.name} pushed from the event loop"
+                )
+            self._followup.append(event)
+        else:
+            self._queue.put(event)
+
+    # --------------------------------------------------------------- history
+
+    @property
+    def event_history(self) -> List[EventRecord]:
+        with self._lock:
+            return list(self._history)
+
+    @property
+    def resync_count(self) -> int:
+        return self._resync_count
+
+    # ------------------------------------------------------------------ loop
+
+    def _event_loop(self) -> None:
+        self._loop_thread_id = threading.get_ident()
+        while not self._shutdown:
+            event = self._receive_event()
+            if event is None:
+                continue
+            try:
+                self._process_event(event)
+            except FatalError as err:
+                log.error("fatal error: %s", err)
+                event.done(err)
+                self._shutdown = True
+                if self.on_fatal:
+                    self.on_fatal(err)
+            if isinstance(event, Shutdown):
+                self._shutdown = True
+        # Drain: fail any events still queued so blocked producers wake up.
+        leftovers = list(self._followup) + self._delayed
+        self._followup.clear()
+        self._delayed = []
+        while True:
+            try:
+                leftovers.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        for ev in leftovers:
+            ev.done(FatalError("event loop is shutting down"))
+
+    def _receive_event(self) -> Optional[Event]:
+        """Dequeue the next event, honouring follow-up priority and the
+        until-first-resync delay (plugin_controller.go receiveEvent :498)."""
+        if self._followup:
+            event = self._followup.popleft()
+            event._from_followup = True
+            return event
+        try:
+            event = self._queue.get(timeout=0.1)
+        except queue.Empty:
+            return None
+        if not self._started_resync:
+            if isinstance(event, (DBResync, Shutdown)):
+                if isinstance(event, DBResync):
+                    self._started_resync = True
+                    # Re-queue events that arrived before the first resync.
+                    delayed, self._delayed = self._delayed, []
+                    for ev in delayed:
+                        self._followup.append(ev)
+                return event
+            log.debug("delaying event %s until first resync", event.name)
+            self._delayed.append(event)
+            return None
+        return event
+
+    # --------------------------------------------------------------- process
+
+    def _process_event(self, event: Event) -> None:
+        """The 13-step pipeline of plugin_controller.go processEvent :555."""
+        self._event_seq += 1
+        record = EventRecord(
+            seq_num=self._event_seq,
+            name=event.name,
+            description=str(event),
+            method=event.method,
+            is_followup=getattr(event, "_from_followup", False),
+            started=time.time(),
+        )
+
+        # 1-2. Update the cached Kubernetes state.
+        if isinstance(event, DBResync):
+            self.kube_state = {k: dict(v) for k, v in event.kube_state.items()}
+            self.external_config = dict(event.external_config)
+        elif isinstance(event, KubeStateChange):
+            resource_state = self.kube_state.setdefault(event.resource, {})
+            if event.new_value is None:
+                resource_state.pop(event.key, None)
+            else:
+                resource_state[event.key] = event.new_value
+        elif isinstance(event, ExternalConfigChange):
+            for key, value in event.changes.items():
+                if value is None:
+                    self.external_config.pop(key, None)
+                else:
+                    self.external_config[key] = value
+
+        err: Optional[Exception] = None
+        if event.method is EventMethod.DOWNSTREAM_RESYNC:
+            # Handlers are not involved; the sink re-applies its own state.
+            err = self._commit(Txn(is_resync=True), record, downstream=True)
+        elif event.method.is_resync:
+            err = self._process_resync(event, record)
+        else:
+            err = self._process_update(event, record)
+
+        record.duration_ms = (time.time() - record.started) * 1000
+        with self._lock:
+            self._history.append(record)
+            if len(self._history) > self._history_limit:
+                self._history = self._history[-self._history_limit:]
+
+        # 11. Deliver the result to blocked producers.
+        event.done(err)
+
+        # 12-13. Healing / fatal handling.
+        if err is not None:
+            if isinstance(event, HealingResync):
+                raise FatalError(f"healing resync failed: {err}") from err
+            if isinstance(err, FatalError):
+                raise err
+            self._schedule_healing(err)
+
+    def _process_resync(self, event: Event, record: EventRecord) -> Optional[Exception]:
+        self._resync_count += 1
+        txn = Txn(is_resync=True)
+        first_err: Optional[Exception] = None
+        for handler in self.handlers:
+            if not handler.handles_event(event):
+                continue
+            hrec = HandlerRecord(handler=handler.name)
+            record.handlers.append(hrec)
+            try:
+                handler.resync(event, self.kube_state, self._resync_count, txn)
+            except FatalError:
+                raise
+            except Exception as e:  # noqa: BLE001 - handler errors are data
+                hrec.error = str(e)
+                log.warning("handler %s failed resync: %s", handler.name, e)
+                if first_err is None:
+                    first_err = e
+                # Resync is best-effort across handlers (reference continues
+                # and reports, scheduling healing afterwards).
+        commit_err = self._commit(txn, record)
+        return first_err or commit_err
+
+    def _process_update(self, event: Event, record: EventRecord) -> Optional[Exception]:
+        direction = UpdateDirection.FORWARD
+        txn_type = UpdateTxnType.BEST_EFFORT
+        if isinstance(event, UpdateEvent):
+            direction = event.direction
+            txn_type = event.transaction_type
+
+        ordered = self.handlers if direction is UpdateDirection.FORWARD else list(reversed(self.handlers))
+        txn = Txn(is_resync=False)
+        executed: List[EventHandler] = []
+        err: Optional[Exception] = None
+        aborted = False
+        for handler in ordered:
+            if not handler.handles_event(event):
+                continue
+            hrec = HandlerRecord(handler=handler.name)
+            record.handlers.append(hrec)
+            try:
+                hrec.change = handler.update(event, txn) or ""
+                executed.append(handler)
+            except FatalError:
+                raise
+            except AbortEventError as e:
+                hrec.error = str(e)
+                err = e
+                aborted = True
+                break
+            except Exception as e:  # noqa: BLE001
+                hrec.error = str(e)
+                log.warning("handler %s failed update: %s", handler.name, e)
+                if err is None:
+                    err = e
+                if txn_type is UpdateTxnType.REVERT_ON_FAILURE:
+                    break
+
+        if err is not None and txn_type is UpdateTxnType.REVERT_ON_FAILURE and not aborted:
+            # 9. Revert plugin-internal changes in reverse order; the txn is
+            # dropped (never committed), reverting the would-be data-plane
+            # changes.
+            for handler in reversed(executed):
+                hrec = HandlerRecord(handler=handler.name, revert=True)
+                record.handlers.append(hrec)
+                try:
+                    handler.revert(event)
+                except Exception as e:  # noqa: BLE001
+                    hrec.error = str(e)
+                    log.error("handler %s failed to revert: %s", handler.name, e)
+            return err
+
+        commit_err = self._commit(txn, record)
+        return err or commit_err
+
+    def _commit(self, txn: Txn, record: EventRecord, downstream: bool = False) -> Optional[Exception]:
+        if txn.empty and not txn.is_resync:
+            return None
+        self._txn_seq += 1
+        record.txn = txn.record(self._txn_seq)
+        try:
+            if downstream:
+                # Ask the sink to re-apply its current desired state.
+                replay = getattr(self.sink, "replay", None)
+                if replay is not None:
+                    replay()
+            else:
+                self.sink.commit(record.txn)
+        except Exception as e:  # noqa: BLE001
+            record.txn_error = str(e)
+            return e
+        return None
+
+    def _schedule_healing(self, err: Exception) -> None:
+        """Schedule an AfterError healing resync (scheduleHealing :968)."""
+        if self._healing_scheduled or self._shutdown:
+            return
+        self._healing_scheduled = True
+
+        def fire():
+            self._healing_scheduled = False
+            if not self._shutdown:
+                self._queue.put(HealingResync(HealingResyncType.AFTER_ERROR, err))
+
+        timer = threading.Timer(self.healing_delay, fire)
+        timer.daemon = True
+        timer.start()

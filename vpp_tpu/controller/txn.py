@@ -1,0 +1,81 @@
+"""Event transactions.
+
+Analog of ``plugins/controller/txn.go``: every event gets one transaction;
+handlers Put()/Delete() typed config values into it and the Controller
+commits it to the txn scheduler (or any other TxnSink — the mock txn
+tracker in tests plays the reference's mock/localclient role).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+class TxnSink:
+    """Where committed transactions go (the txn scheduler, or a mock)."""
+
+    def commit(self, txn: "RecordedTxn") -> None:
+        raise NotImplementedError
+
+
+@dataclass
+class RecordedTxn:
+    """A committed transaction, as recorded in the event history.
+
+    ``is_resync`` distinguishes full-resync commits (desired state is
+    *replaced* by ``values``) from incremental commits (``values`` are
+    merged, None meaning delete).
+    """
+
+    seq_num: int = 0
+    is_resync: bool = False
+    # key -> value; value None = delete (only in non-resync txns)
+    values: Dict[str, Any] = field(default_factory=dict)
+
+    def describe(self) -> str:
+        ops = []
+        for key in sorted(self.values):
+            val = self.values[key]
+            ops.append(f"DELETE {key}" if val is None else f"PUT {key}")
+        kind = "RESYNC" if self.is_resync else "UPDATE"
+        return f"{kind} txn #{self.seq_num}: " + "; ".join(ops)
+
+
+class Txn:
+    """Transaction under construction, exposing the ResyncOperations /
+    UpdateOperations contract of api/txn.go (Put/Get/Delete)."""
+
+    def __init__(self, is_resync: bool):
+        self.is_resync = is_resync
+        self._values: Dict[str, Any] = {}
+
+    def put(self, key: str, value: Any) -> None:
+        """Add or modify a value. ``value`` cannot be None."""
+        if value is None:
+            raise ValueError(f"txn.put({key!r}) with None value; use delete()")
+        self._values[key] = value
+
+    def delete(self, key: str) -> None:
+        """Request removal of an existing value (update txns only)."""
+        if self.is_resync:
+            raise ValueError(
+                "delete() is not available in resync transactions: "
+                "anything not Put() is removed implicitly"
+            )
+        self._values[key] = None
+
+    def get(self, key: str) -> Optional[Any]:
+        """Value already prepared in this txn (None if absent or deleted)."""
+        return self._values.get(key)
+
+    @property
+    def values(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+    @property
+    def empty(self) -> bool:
+        return not self._values
+
+    def record(self, seq_num: int) -> RecordedTxn:
+        return RecordedTxn(seq_num=seq_num, is_resync=self.is_resync, values=dict(self._values))

@@ -41,6 +41,9 @@ class ContainerPort:
     protocol: ProtocolType = ProtocolType.TCP
     host_ip_address: str = ""
 
+    def __post_init__(self):
+        object.__setattr__(self, "protocol", ProtocolType.parse(self.protocol))
+
 
 @dataclass(frozen=True)
 class Container:

@@ -88,6 +88,9 @@ class PolicyPort:
     protocol: ProtocolType = ProtocolType.TCP
     port: Optional[object] = None  # int | str | None
 
+    def __post_init__(self):
+        object.__setattr__(self, "protocol", ProtocolType.parse(self.protocol))
+
 
 @dataclass(frozen=True)
 class IPBlock:

@@ -1,0 +1,15 @@
+from .scheduler import (
+    TxnScheduler,
+    Applicator,
+    ValueState,
+    ValueStatus,
+    DependencyFn,
+)
+
+__all__ = [
+    "TxnScheduler",
+    "Applicator",
+    "ValueState",
+    "ValueStatus",
+    "DependencyFn",
+]

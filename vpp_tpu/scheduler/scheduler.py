@@ -1,0 +1,359 @@
+"""Declarative-config transaction scheduler.
+
+Analog of the ligato kvscheduler the reference vendors
+(vendor/github.com/ligato/vpp-agent/plugins/kvscheduler/ — txn_exec.go,
+plugin_scheduler.go; SURVEY.md §1 L3, §2.3): the reference consumes it
+as a library, so this is a first-party re-implementation of the
+behaviors Contiv-VPP actually relies on:
+
+- **desired-state diffing**: resync transactions *replace* the desired
+  state; the scheduler computes the minimal create/update/delete set
+  against what is currently applied.
+- **dependency resolution**: values may depend on other keys; a value
+  whose dependencies are unmet is held PENDING and applied automatically
+  once they appear, and is removed (back to PENDING) when a dependency
+  disappears — cascading in reverse dependency order.
+- **retries**: failed CRUD operations are retried with exponential
+  backoff (the reference enables this for its config,
+  plugin_controller.go:58-69).
+- **pluggable applicators**: per-prefix sinks that push config into the
+  actual backends — in this framework the TPU pipeline tables and the
+  host FIB; in tests the mock engines.
+
+Commits normally come only from the controller's event-loop thread (the
+reference's model), but retries fire from timer threads, so all public
+entry points (commit/replay/dump and the retry callback) serialize on an
+internal lock.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from ..controller.txn import RecordedTxn, TxnSink
+
+log = logging.getLogger(__name__)
+
+# Given (key, value) returns the set of keys this value depends on.
+DependencyFn = Callable[[str, Any], Set[str]]
+
+
+class ValueState(enum.Enum):
+    """Lifecycle state of one configured value."""
+
+    APPLIED = "applied"
+    PENDING = "pending"      # waiting for dependencies
+    FAILED = "failed"        # last CRUD op errored; awaiting retry
+    REMOVED = "removed"      # transiently, during cascades
+
+
+@dataclass
+class ValueStatus:
+    """Status of one key as exposed by dump()."""
+
+    key: str
+    desired: Any
+    applied: Any
+    state: ValueState
+    last_error: str = ""
+    retries: int = 0
+
+
+class Applicator:
+    """A southbound sink for a key prefix (vppv2-plugin analog).
+
+    Implementations push values into a concrete backend: TPU rule
+    tables, host FIB, Linux netns config, or a mock engine in tests.
+    """
+
+    prefix: str = ""
+
+    def create(self, key: str, value: Any) -> None:
+        raise NotImplementedError
+
+    def update(self, key: str, old_value: Any, new_value: Any) -> None:
+        # Default modify = re-create.
+        self.delete(key, old_value)
+        self.create(key, value=new_value)
+
+    def delete(self, key: str, value: Any) -> None:
+        raise NotImplementedError
+
+
+@dataclass
+class _ValueRecord:
+    desired: Any = None
+    applied: Any = None
+    state: ValueState = ValueState.PENDING
+    last_error: str = ""
+    retries: int = 0
+
+
+class TxnScheduler(TxnSink):
+    """The scheduler. Register applicators and dependency resolvers, then
+    feed it RecordedTxns (it is the controller's TxnSink)."""
+
+    def __init__(
+        self,
+        retry_delay: float = 1.0,
+        max_retries: int = 3,
+        schedule_retry: Optional[Callable[[Callable[[], None], float], None]] = None,
+    ):
+        self._applicators: List[Applicator] = []
+        self._dependency_fns: Dict[str, DependencyFn] = {}
+        self._values: Dict[str, _ValueRecord] = {}
+        self.retry_delay = retry_delay
+        self.max_retries = max_retries
+        self._schedule_retry = schedule_retry or self._default_schedule
+        self._txn_log: List[RecordedTxn] = []
+        self._lock = threading.RLock()
+
+    # -------------------------------------------------------------- registry
+
+    def register_applicator(self, applicator: Applicator) -> None:
+        self._applicators.append(applicator)
+
+    def register_dependencies(self, prefix: str, fn: DependencyFn) -> None:
+        """Declare how to compute dependencies for values under ``prefix``."""
+        self._dependency_fns[prefix] = fn
+
+    def _applicator_for(self, key: str) -> Optional[Applicator]:
+        best = None
+        for a in self._applicators:
+            if key.startswith(a.prefix):
+                if best is None or len(a.prefix) > len(best.prefix):
+                    best = a
+        return best
+
+    def _dependencies(self, key: str, value: Any) -> Set[str]:
+        # A value may carry its own dependencies; otherwise use the
+        # longest-prefix registered resolver.
+        deps = getattr(value, "dependencies", None)
+        if deps is not None:
+            return set(deps() if callable(deps) else deps)
+        best: Optional[Tuple[str, DependencyFn]] = None
+        for prefix, fn in self._dependency_fns.items():
+            if key.startswith(prefix):
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, fn)
+        return set(best[1](key, value)) if best else set()
+
+    # ---------------------------------------------------------------- commit
+
+    def commit(self, txn: RecordedTxn) -> None:
+        """Apply one transaction. Raises only on unexpected internal errors;
+        per-value CRUD failures are absorbed into FAILED state + retries."""
+        with self._lock:
+            self._txn_log.append(txn)
+            if txn.is_resync:
+                self._commit_resync(txn)
+            else:
+                self._commit_update(txn)
+
+    def _commit_resync(self, txn: RecordedTxn) -> None:
+        desired = txn.values
+        # Deletes: everything known that the resync no longer mentions.
+        for key in sorted(set(self._values) - set(desired)):
+            self._request_delete(key)
+        for key, value in desired.items():
+            self._request_put(key, value)
+        self._resolve_pending()
+
+    def _commit_update(self, txn: RecordedTxn) -> None:
+        for key, value in txn.values.items():
+            if value is None:
+                self._request_delete(key)
+            else:
+                self._request_put(key, value)
+        self._resolve_pending()
+
+    # ------------------------------------------------------------ operations
+
+    def _request_put(self, key: str, value: Any) -> None:
+        rec = self._values.setdefault(key, _ValueRecord())
+        rec.desired = value
+        rec.retries = 0
+        self._try_apply(key, rec)
+
+    def _request_delete(self, key: str) -> None:
+        rec = self._values.get(key)
+        if rec is None:
+            return
+        rec.desired = None
+        rec.retries = 0
+        self._cascade_unapply(key)
+        if rec.applied is None:
+            self._values.pop(key, None)
+        else:
+            # Backend delete failed: keep the record in FAILED state so the
+            # retry timer can finish the removal (no stale config forever).
+            rec.state = ValueState.FAILED
+            self._schedule_retry_for(key)
+
+    def _try_apply(self, key: str, rec: _ValueRecord) -> None:
+        deps = self._dependencies(key, rec.desired)
+        unmet = [d for d in deps if not self._is_available(d)]
+        if unmet:
+            if rec.applied is not None:
+                # The new desired value has unmet dependencies while an old
+                # incarnation is applied: take it (and its dependents) out.
+                self._cascade_unapply(key)
+            if rec.applied is not None:
+                # The backend delete failed; retry the removal first.
+                rec.state = ValueState.FAILED
+                self._schedule_retry_for(key)
+            else:
+                rec.state = ValueState.PENDING
+            return
+        applicator = self._applicator_for(key)
+        if applicator is None:
+            # No backend claims this prefix; treat as applied (pure model
+            # value) so dependents can proceed.
+            rec.applied = rec.desired
+            rec.state = ValueState.APPLIED
+            return
+        try:
+            if rec.applied is None:
+                applicator.create(key, rec.desired)
+            elif rec.applied != rec.desired:
+                applicator.update(key, rec.applied, rec.desired)
+            rec.applied = rec.desired
+            rec.state = ValueState.APPLIED
+            rec.last_error = ""
+        except Exception as e:  # noqa: BLE001 - backend errors become state
+            log.warning("apply of %s failed: %s", key, e)
+            rec.state = ValueState.FAILED
+            rec.last_error = str(e)
+            self._schedule_retry_for(key)
+
+    def _unapply(self, key: str, rec: _ValueRecord) -> None:
+        if rec.applied is None:
+            return
+        applicator = self._applicator_for(key)
+        if applicator is not None:
+            try:
+                applicator.delete(key, rec.applied)
+            except Exception as e:  # noqa: BLE001
+                log.warning("delete of %s failed: %s", key, e)
+                rec.last_error = str(e)
+                # Leave rec.applied set: the value is still in the backend
+                # and the caller must keep the record for a delete retry.
+                return
+        rec.applied = None
+
+    def _cascade_unapply(self, key: str) -> None:
+        """Unapply ``key`` and, first, every applied value depending on it
+        (reverse dependency order). Dependents stay PENDING."""
+        for dep_key, dep_rec in list(self._values.items()):
+            if dep_key == key or dep_rec.applied is None:
+                continue
+            if key in self._dependencies(dep_key, dep_rec.applied):
+                self._cascade_unapply(dep_key)
+                dep_rec.state = ValueState.PENDING
+        rec = self._values.get(key)
+        if rec is not None:
+            self._unapply(key, rec)
+
+    def _is_available(self, key: str) -> bool:
+        rec = self._values.get(key)
+        return rec is not None and rec.state is ValueState.APPLIED
+
+    def _resolve_pending(self) -> None:
+        """Fixed-point iteration applying PENDING values whose dependencies
+        became satisfied (the kvscheduler's graph walk)."""
+        progress = True
+        while progress:
+            progress = False
+            for key, rec in list(self._values.items()):
+                if rec.state is ValueState.PENDING and rec.desired is not None:
+                    self._try_apply(key, rec)
+                    if rec.state is ValueState.APPLIED:
+                        progress = True
+
+    # ----------------------------------------------------------------- retry
+
+    def _schedule_retry_for(self, key: str) -> None:
+        rec = self._values.get(key)
+        if rec is None or rec.retries >= self.max_retries:
+            return
+        rec.retries += 1
+        delay = self.retry_delay * (2 ** (rec.retries - 1))
+
+        def retry():
+            with self._lock:
+                r = self._values.get(key)
+                if r is None or r.state is not ValueState.FAILED:
+                    return
+                if r.desired is None:
+                    # Unfinished removal: retry the backend delete.
+                    self._unapply(key, r)
+                    if r.applied is None:
+                        self._values.pop(key, None)
+                    else:
+                        self._schedule_retry_for(key)
+                    return
+                self._try_apply(key, r)
+                self._resolve_pending()
+
+        self._schedule_retry(retry, delay)
+
+    @staticmethod
+    def _default_schedule(fn: Callable[[], None], delay: float) -> None:
+        timer = threading.Timer(delay, fn)
+        timer.daemon = True
+        timer.start()
+
+    # ------------------------------------------------------------- downstream
+
+    def replay(self) -> None:
+        """Downstream resync: re-push every *applied* value into its backend
+        (used by periodic healing; DownstreamResync events).  PENDING values
+        keep waiting for their dependencies — replay must not bypass the
+        dependency gating."""
+        with self._lock:
+            for key, rec in list(self._values.items()):
+                if rec.desired is None or rec.state is not ValueState.APPLIED:
+                    continue
+                applicator = self._applicator_for(key)
+                if applicator is None:
+                    continue
+                try:
+                    applicator.update(key, rec.applied, rec.desired)
+                    rec.applied = rec.desired
+                except Exception as e:  # noqa: BLE001
+                    rec.state = ValueState.FAILED
+                    rec.last_error = str(e)
+                    self._schedule_retry_for(key)
+            self._resolve_pending()
+
+    # ------------------------------------------------------------------ dump
+
+    def dump(self, prefix: str = "") -> List[ValueStatus]:
+        """Current status of all values under ``prefix`` (the kvscheduler
+        REST dump analog, consumed by telemetry/netctl)."""
+        out = []
+        with self._lock:
+            values = dict(self._values)
+        for key in sorted(values):
+            if not key.startswith(prefix):
+                continue
+            rec = values[key]
+            out.append(
+                ValueStatus(
+                    key=key,
+                    desired=rec.desired,
+                    applied=rec.applied,
+                    state=rec.state,
+                    last_error=rec.last_error,
+                    retries=rec.retries,
+                )
+            )
+        return out
+
+    @property
+    def txn_log(self) -> List[RecordedTxn]:
+        return list(self._txn_log)
