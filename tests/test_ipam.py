@@ -1,0 +1,116 @@
+"""IPAM unit tests — ported from the behavioral contract of the
+reference's plugins/ipam/ipam_test.go (subnet math, allocation pool,
+resync re-learning)."""
+
+import ipaddress
+
+import pytest
+
+from vpp_tpu.conf import IPAMConfig
+from vpp_tpu.ipam import IPAM, IPAMError
+from vpp_tpu.ipam.ipam import dissect_subnet_for_node
+from vpp_tpu.models import Pod, PodID
+
+
+def make_ipam(node_id=1, **kw):
+    return IPAM(IPAMConfig(**kw), node_id=node_id)
+
+
+def test_subnet_dissection_defaults():
+    ipam = make_ipam(node_id=5)
+    assert str(ipam.pod_subnet_all_nodes) == "10.1.0.0/16"
+    assert str(ipam.pod_subnet_this_node) == "10.1.5.0/24"
+    assert str(ipam.host_subnet_this_node) == "172.30.5.0/24"
+    assert str(ipam.pod_gateway_ip) == "10.1.5.1"
+    assert str(ipam.nat_loopback_ip()) == "10.1.5.254"
+    assert str(ipam.node_ip()) == "192.168.16.5"
+    assert str(ipam.vxlan_ip()) == "192.168.30.5"
+    assert str(ipam.host_interconnect_ip_dataplane()) == "172.30.5.1"
+    assert str(ipam.host_interconnect_ip_host()) == "172.30.5.2"
+
+
+def test_subnets_of_other_nodes():
+    ipam = make_ipam(node_id=1)
+    assert str(ipam.pod_subnet_other_node(2)) == "10.1.2.0/24"
+    assert str(ipam.pod_subnet_other_node(255)) == "10.1.255.0/24"
+    assert str(ipam.vxlan_ip(7)) == "192.168.30.7"
+    assert str(ipam.node_ip(7)) == "192.168.16.7"
+
+
+def test_node_id_range_checks():
+    net = ipaddress.ip_network("10.1.0.0/16")
+    # 8 bits of node space: ID 256 wraps to part 0 (valid as a subnet).
+    assert str(dissect_subnet_for_node(net, 24, 256)) == "10.1.0.0/24"
+    with pytest.raises(IPAMError):
+        dissect_subnet_for_node(net, 24, 257)
+    with pytest.raises(IPAMError):
+        dissect_subnet_for_node(net, 16, 1)  # node prefix not longer
+    ipam = make_ipam(node_id=1)
+    with pytest.raises(IPAMError):
+        ipam.node_ip(256)  # part 0 invalid for an address
+
+
+def test_excluded_node_ips_shift():
+    ipam = make_ipam(node_id=1, excluded_node_ips=("192.168.16.1",))
+    # Node 1 would get .1 which is excluded -> shifted past it.
+    assert str(ipam.node_ip(1)) == "192.168.16.2"
+    assert str(ipam.node_ip(2)) == "192.168.16.3"
+
+
+def test_pod_ip_allocation_skips_reserved():
+    ipam = make_ipam(node_id=1)
+    first = ipam.allocate_pod_ip(PodID("a", "ns"))
+    # Seq 1 is the gateway; allocation starts after last assigned (1) -> .2
+    assert str(first) == "10.1.1.2"
+    second = ipam.allocate_pod_ip(PodID("b", "ns"))
+    assert str(second) == "10.1.1.3"
+    # Same pod asks again -> same IP (idempotent).
+    assert ipam.allocate_pod_ip(PodID("a", "ns")) == first
+
+
+def test_pod_ip_release_and_reuse():
+    ipam = make_ipam(node_id=1)
+    a = PodID("a", "ns")
+    ip_a = ipam.allocate_pod_ip(a)
+    ipam.release_pod_ip(a)
+    assert ipam.get_pod_ip(a) is None
+    # Round-robin continues forward before wrapping to released IPs.
+    ip_b = ipam.allocate_pod_ip(PodID("b", "ns"))
+    assert ip_b != ip_a
+    # Exhaust the rest; the released IP must eventually be reused.
+    seen = {str(ip_b)}
+    count = 2
+    while True:
+        pid = PodID(f"p{count}", "ns")
+        try:
+            ip = ipam.allocate_pod_ip(pid)
+        except IPAMError:
+            break
+        seen.add(str(ip))
+        count += 1
+    assert str(ip_a) in seen
+    # /24 => 254 usable - gateway - nat loopback = 252 pods.
+    assert ipam.allocated_count == 252
+
+
+def test_pool_exhaustion_error():
+    ipam = make_ipam(node_id=1, pod_subnet_one_node_prefix_len=29)
+    # /29 -> 8 addrs: network, gateway (seq 1), NAT loopback, broadcast
+    # reserved -> seqs 2..5 = 4 usable pod IPs.
+    ips = [ipam.allocate_pod_ip(PodID(f"p{i}", "ns")) for i in range(4)]
+    assert [str(ip) for ip in ips] == ["10.1.0.10", "10.1.0.11", "10.1.0.12", "10.1.0.13"]
+    with pytest.raises(IPAMError):
+        ipam.allocate_pod_ip(PodID("overflow", "ns"))
+
+
+def test_resync_relearns_pool_from_kube_state():
+    ipam = make_ipam(node_id=1)
+    local = Pod(name="mine", namespace="ns", ip_address="10.1.1.7")
+    remote = Pod(name="theirs", namespace="ns", ip_address="10.1.2.9")  # other node
+    bogus = Pod(name="nope", namespace="ns", ip_address="not-an-ip")
+    ipam.resync({"pod": {"/k/1": local, "/k/2": remote, "/k/3": bogus}})
+    assert str(ipam.get_pod_ip(PodID("mine", "ns"))) == "10.1.1.7"
+    assert ipam.get_pod_ip(PodID("theirs", "ns")) is None
+    assert ipam.allocated_count == 1
+    # Next allocation continues after the adopted seq (7 -> .8).
+    assert str(ipam.allocate_pod_ip(PodID("new", "ns"))) == "10.1.1.8"

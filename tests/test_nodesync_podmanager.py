@@ -1,0 +1,128 @@
+"""NodeSync ID allocation and PodManager CNI-event tests."""
+
+import threading
+import time
+
+from vpp_tpu.controller import Controller, DBResync, EventHandler
+from vpp_tpu.kvstore import KVStore
+from vpp_tpu.models import PodID, VppNode
+from vpp_tpu.nodesync import NodeSync
+from vpp_tpu.nodesync.nodesync import VPPNODE_PREFIX
+from vpp_tpu.podmanager import AddPod, DeletePod, PodManager
+from vpp_tpu.scheduler import TxnScheduler
+
+
+def test_first_free_id_allocation():
+    store = KVStore()
+    a = NodeSync(store, "node-a")
+    b = NodeSync(store, "node-b")
+    assert a.allocate_id() == 1
+    assert b.allocate_id() == 2
+    # Departure frees the ID for reuse.
+    a.release_id()
+    c = NodeSync(store, "node-c")
+    assert c.allocate_id() == 1
+    # Restarted agent adopts its old record.
+    b2 = NodeSync(store, "node-b")
+    assert b2.allocate_id() == 2
+
+
+def test_concurrent_allocation_unique_ids():
+    store = KVStore()
+    results = {}
+
+    def alloc(name):
+        ns = NodeSync(store, name)
+        results[name] = ns.allocate_id()
+
+    threads = [threading.Thread(target=alloc, args=(f"n{i}",)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    ids = sorted(results.values())
+    assert ids == list(range(1, 17))  # all unique, first-free
+
+
+def test_publish_and_track_nodes():
+    store = KVStore()
+    ns = NodeSync(store, "node-a")
+    ns.allocate_id()
+    rec = ns.publish_node_ips(("192.168.16.1/24",), ("10.0.0.1",))
+    assert store.get(VPPNODE_PREFIX + "1") == rec
+
+    other = VppNode(id=2, name="node-b", ip_addresses=("192.168.16.2/24",))
+    kube_state = {"vppnode": {VPPNODE_PREFIX + "1": rec, VPPNODE_PREFIX + "2": other}}
+    ns.resync(None, kube_state, 1, None)
+    assert set(ns.get_all_nodes()) == {"node-a", "node-b"}
+    assert set(ns.other_nodes()) == {"node-b"}
+
+
+def test_podmanager_add_delete_flow():
+    """CNI add/del through the real event loop with a wiring handler that
+    fills the CNI reply (the ipv4net role)."""
+
+    class Wiring(EventHandler):
+        name = "wiring"
+
+        def resync(self, event, kube_state, resync_count, txn):
+            pass
+
+        def update(self, event, txn):
+            if isinstance(event, AddPod):
+                event.reply.ip_address = "10.1.1.2/32"
+                event.reply.interfaces.append({"name": "tap-" + event.pod.id.name})
+                txn.put(f"/cfg/pod/{event.pod.id}", {"wired": True})
+            if isinstance(event, DeletePod):
+                txn.delete(f"/cfg/pod/{event.pod_id}")
+            return ""
+
+    pm = PodManager()
+    sched = TxnScheduler()
+    ctl = Controller([pm, Wiring()], sched, healing_delay=0.05)
+    pm.event_loop = ctl
+    ctl.start()
+    try:
+        ctl.push_event(DBResync())
+        reply = pm.add_pod("web", "default", container_id="c1", network_namespace="/proc/1/ns/net")
+        assert reply.ip_address == "10.1.1.2/32"
+        assert reply.interfaces == [{"name": "tap-web"}]
+        assert PodID("web", "default") in pm.local_pods
+        assert sched.dump("/cfg/pod/")[0].key == "/cfg/pod/default/web"
+
+        pm.delete_pod("web", "default")
+        assert pm.local_pods == {}
+        assert sched.dump("/cfg/pod/") == []
+    finally:
+        ctl.stop()
+
+
+def test_podmanager_addpod_revert_on_failure():
+    """A failing downstream handler must revert podmanager's record."""
+
+    class Failing(EventHandler):
+        name = "failing"
+
+        def resync(self, event, kube_state, resync_count, txn):
+            pass
+
+        def update(self, event, txn):
+            if isinstance(event, AddPod):
+                raise RuntimeError("no connectivity for you")
+            return ""
+
+    pm = PodManager()
+    ctl = Controller([pm, Failing()], TxnScheduler(), healing_delay=0.05)
+    pm.event_loop = ctl
+    ctl.start()
+    try:
+        ctl.push_event(DBResync())
+        try:
+            pm.add_pod("web", "default")
+            raise AssertionError("expected failure")
+        except RuntimeError as e:
+            assert "no connectivity" in str(e)
+        # Reverted: no local pod recorded.
+        assert pm.local_pods == {}
+    finally:
+        ctl.stop()

@@ -1,0 +1,3 @@
+from .config import IPAMConfig, InterfaceConfig, RoutingConfig, NetworkConfig
+
+__all__ = ["IPAMConfig", "InterfaceConfig", "RoutingConfig", "NetworkConfig"]

@@ -1,0 +1,115 @@
+"""Framework configuration.
+
+Analog of the reference's ContivConf plugin (plugins/contivconf/
+contivconf_api.go: IPAMConfig :100, InterfaceConfig, RoutingConfig) with
+the same defaults (contivconf.go:74-79 and k8s/contiv-vpp.yaml:42-45).
+The reference merges four config sources by priority (file < NodeConfig
+CRD < STN-reported < runtime); here the file/dict source is implemented
+and the merge hook is ``NetworkConfig.overlay`` for CRD-style per-node
+overrides.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from dataclasses import dataclass, field, replace
+from typing import Optional, Tuple
+
+
+def _net(cidr: str) -> ipaddress.IPv4Network:
+    return ipaddress.ip_network(cidr)
+
+
+@dataclass(frozen=True)
+class IPAMConfig:
+    """Address-space layout of the cluster (contivconf_api.go IPAMConfig)."""
+
+    # Subnet used by all pods across all nodes; each node gets a
+    # /pod_subnet_one_node_prefix_len chunk of it, indexed by node ID.
+    pod_subnet_cidr: str = "10.1.0.0/16"
+    pod_subnet_one_node_prefix_len: int = 24
+
+    # Subnet for data-plane<->host interconnects of all nodes.
+    host_subnet_cidr: str = "172.30.0.0/16"
+    host_subnet_one_node_prefix_len: int = 24
+
+    # Subnet from which node IPs are computed when not supplied externally.
+    node_interconnect_cidr: str = "192.168.16.0/24"
+    # True when node IPs come from the underlying infrastructure (DHCP)
+    # rather than from node_interconnect_cidr arithmetic.
+    node_interconnect_dhcp: bool = False
+
+    # Subnet for VXLAN-tunnel source/destination endpoints (BVI IPs).
+    vxlan_cidr: str = "192.168.30.0/24"
+
+    # K8s service virtual IPs.
+    service_cidr: str = "10.96.0.0/12"
+
+    # IPs inside node_interconnect_cidr that must never be allocated
+    # (e.g. the default gateway).
+    excluded_node_ips: Tuple[str, ...] = ()
+
+    def pod_subnet(self) -> ipaddress.IPv4Network:
+        return _net(self.pod_subnet_cidr)
+
+    def host_subnet(self) -> ipaddress.IPv4Network:
+        return _net(self.host_subnet_cidr)
+
+    def node_interconnect(self) -> ipaddress.IPv4Network:
+        return _net(self.node_interconnect_cidr)
+
+    def vxlan(self) -> ipaddress.IPv4Network:
+        return _net(self.vxlan_cidr)
+
+    def service(self) -> ipaddress.IPv4Network:
+        return _net(self.service_cidr)
+
+
+@dataclass(frozen=True)
+class InterfaceConfig:
+    """Main data-plane interface settings (contivconf_api.go InterfaceConfig)."""
+
+    main_interface: str = ""
+    mtu: int = 1450
+    # Steal-the-NIC mode: the single host NIC is taken over by the
+    # data plane.
+    stn_mode: bool = False
+
+
+@dataclass(frozen=True)
+class RoutingConfig:
+    """Routing behavior knobs (contivconf_api.go RoutingConfig)."""
+
+    # Use a VXLAN overlay between nodes (vs direct L3 when the fabric
+    # routes pod subnets natively).
+    use_vxlan: bool = True
+    # VRF IDs for the two-VRF layout (main + pod).
+    main_vrf_id: int = 0
+    pod_vrf_id: int = 1
+    # Route service CIDR traffic from the host into the data plane.
+    route_service_cidr_to_dataplane: bool = False
+
+
+@dataclass(frozen=True)
+class NetworkConfig:
+    """Top-level configuration (the contiv.conf analog)."""
+
+    ipam: IPAMConfig = field(default_factory=IPAMConfig)
+    interface: InterfaceConfig = field(default_factory=InterfaceConfig)
+    routing: RoutingConfig = field(default_factory=RoutingConfig)
+    # NAT-pipeline batch size: packets per classify->rewrite step.
+    batch_size: int = 256
+
+    @classmethod
+    def from_dict(cls, data: Optional[dict]) -> "NetworkConfig":
+        data = data or {}
+        return cls(
+            ipam=IPAMConfig(**data.get("ipam", {})),
+            interface=InterfaceConfig(**data.get("interface", {})),
+            routing=RoutingConfig(**data.get("routing", {})),
+            batch_size=data.get("batch_size", 256),
+        )
+
+    def overlay(self, **kw) -> "NetworkConfig":
+        """Per-node override merge (NodeConfig-CRD analog)."""
+        return replace(self, **kw)

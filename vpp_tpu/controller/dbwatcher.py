@@ -35,6 +35,12 @@ class DBWatcher:
         self._stop = threading.Event()
         prefixes = [r.key_prefix for r in registry.DB_RESOURCES] + [EXTERNAL_CONFIG_PREFIX]
         self._watcher = self.store.watch(prefixes)
+        # Serializes resync() against the watch thread's event pushes, so a
+        # DBResync snapshot can never be overtaken by a change event that it
+        # does not contain (and stale pre-snapshot events are dropped by
+        # revision).
+        self._order_lock = threading.Lock()
+        self._resync_revision = -1
 
     # ------------------------------------------------------------------ life
 
@@ -59,13 +65,29 @@ class DBWatcher:
     # ---------------------------------------------------------------- resync
 
     def resync(self) -> DBResync:
-        """Snapshot all resources and push a DBResync event."""
-        kube_state = {}
-        for resource in registry.DB_RESOURCES:
-            kube_state[resource.keyword] = dict(self.store.list(resource.key_prefix))
-        external = dict(self.store.list(EXTERNAL_CONFIG_PREFIX))
-        event = DBResync(kube_state=kube_state, external_config=external)
-        self.controller.push_event(event)
+        """Take one consistent snapshot of all resources and push a
+        DBResync event.
+
+        Holding ``_order_lock`` across snapshot+push guarantees that no
+        watch event can slip into the controller queue between them;
+        events committed before the snapshot revision are dropped by the
+        watch loop afterwards (they are already inside the snapshot).
+        """
+        prefixes = [r.key_prefix for r in registry.DB_RESOURCES] + [EXTERNAL_CONFIG_PREFIX]
+        with self._order_lock:
+            snap = self.store.snapshot(prefixes)
+            self._resync_revision = self.store.revision
+            kube_state = {r.keyword: {} for r in registry.DB_RESOURCES}
+            external = {}
+            for key, value in snap.items():
+                if key.startswith(EXTERNAL_CONFIG_PREFIX):
+                    external[key] = value
+                    continue
+                resource = registry.resource_for_key(key)
+                if resource is not None:
+                    kube_state[resource.keyword][key] = value
+            event = DBResync(kube_state=kube_state, external_config=external)
+            self.controller.push_event(event)
         return event
 
     # ----------------------------------------------------------------- watch
@@ -78,6 +100,13 @@ class DBWatcher:
             self._process_change(ev)
 
     def _process_change(self, ev: WatchEvent) -> None:
+        with self._order_lock:
+            if ev.revision <= self._resync_revision:
+                # Already covered by the last resync snapshot.
+                return
+            self._push_change(ev)
+
+    def _push_change(self, ev: WatchEvent) -> None:
         if ev.key.startswith(EXTERNAL_CONFIG_PREFIX):
             self.controller.push_event(
                 ExternalConfigChange(source="db", changes={ev.key: ev.value})

@@ -1,0 +1,3 @@
+from .ipam import IPAM, IPAMError
+
+__all__ = ["IPAM", "IPAMError"]

@@ -1,0 +1,220 @@
+"""IPAM — node-ID-based address-space arithmetic.
+
+Analog of the reference's ``plugins/ipam``: every node derives all of
+its subnets *purely arithmetically* from its cluster-unique integer
+node ID, so no cross-node coordination is ever needed for addressing
+(docs/NETWORKING.md:25-72):
+
+- ``dissect_subnet_for_node`` (ipam.go :584): carve the node's chunk
+  out of a cluster-wide subnet by shifting the node ID into the host
+  bits.
+- ``compute_node_ip`` (ipam.go :618): node interconnect IP =
+  subnet base + node ID (skipping excluded IPs, rejecting part 0).
+- pod IP allocation (ipam.go AllocatePodIP :453): round-robin from the
+  last assigned index; seq 0 (network), seq 1 (gateway) and the last
+  two addresses (NAT loopback = last unicast, broadcast) are reserved.
+- resync (ipam.go :220-276): the in-memory pool is re-learned from the
+  KubeState pod list — pod IPs are never persisted.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import threading
+from typing import Dict, Optional
+
+from ..conf import IPAMConfig
+from ..models import Pod, PodID
+
+# Sequence IDs reserved inside each per-node subnet (reference ipam.go:36-45).
+POD_GATEWAY_SEQ_ID = 1
+HOST_INTERCONNECT_DATAPLANE_SEQ_ID = 1
+HOST_INTERCONNECT_HOST_SEQ_ID = 2
+
+
+class IPAMError(Exception):
+    pass
+
+
+def dissect_subnet_for_node(
+    subnet: ipaddress.IPv4Network, one_node_prefix_len: int, node_id: int
+) -> ipaddress.IPv4Network:
+    """Carve the per-node chunk of ``subnet`` for ``node_id``.
+
+    Mirrors ipam.go dissectSubnetForNode :584: the node ID is placed in
+    the bits between the cluster prefix and the node prefix; ID equal to
+    2^bits wraps to part 0 (valid for a subnet, not for an IP).
+    """
+    if one_node_prefix_len <= subnet.prefixlen:
+        raise IPAMError(
+            f"per-node prefix /{one_node_prefix_len} must be longer than "
+            f"the cluster subnet prefix /{subnet.prefixlen}"
+        )
+    node_bits = one_node_prefix_len - subnet.prefixlen
+    node_part = _node_ip_part(node_id, node_bits)
+    base = int(subnet.network_address)
+    node_subnet_base = base + (node_part << (32 - one_node_prefix_len))
+    return ipaddress.ip_network((node_subnet_base, one_node_prefix_len))
+
+
+def _node_ip_part(node_id: int, bits: int) -> int:
+    """ipam/utils.go convertToNodeIPPart: the ID one-past-the-range maps
+    to part 0 (usable for subnets); anything larger is an error."""
+    if node_id == (1 << bits):
+        return 0
+    if node_id & ((1 << bits) - 1) != node_id:
+        raise IPAMError(f"node ID {node_id} out of range for {bits} bits")
+    return node_id
+
+
+class IPAM:
+    """Per-node address manager."""
+
+    def __init__(self, config: IPAMConfig, node_id: int):
+        if node_id <= 0:
+            raise IPAMError("node ID must be a positive integer")
+        self.config = config
+        self.node_id = node_id
+        self._lock = threading.Lock()
+
+        self.pod_subnet_all_nodes = config.pod_subnet()
+        self.pod_subnet_this_node = dissect_subnet_for_node(
+            self.pod_subnet_all_nodes, config.pod_subnet_one_node_prefix_len, node_id
+        )
+        self.host_subnet_all_nodes = config.host_subnet()
+        self.host_subnet_this_node = dissect_subnet_for_node(
+            self.host_subnet_all_nodes, config.host_subnet_one_node_prefix_len, node_id
+        )
+
+        base = int(self.pod_subnet_this_node.network_address)
+        self.pod_gateway_ip = ipaddress.ip_address(base + POD_GATEWAY_SEQ_ID)
+
+        # Pod allocation pool state (re-learned on resync, never persisted).
+        self._assigned: Dict[int, PodID] = {}  # ip (int) -> pod
+        self._pod_to_ip: Dict[PodID, ipaddress.IPv4Address] = {}
+        self._last_assigned_seq = 1
+
+    # --------------------------------------------------------------- subnets
+
+    def pod_subnet_other_node(self, node_id: int) -> ipaddress.IPv4Network:
+        return dissect_subnet_for_node(
+            self.pod_subnet_all_nodes,
+            self.config.pod_subnet_one_node_prefix_len,
+            node_id,
+        )
+
+    def host_subnet_other_node(self, node_id: int) -> ipaddress.IPv4Network:
+        return dissect_subnet_for_node(
+            self.host_subnet_all_nodes,
+            self.config.host_subnet_one_node_prefix_len,
+            node_id,
+        )
+
+    def service_network(self) -> ipaddress.IPv4Network:
+        return self.config.service()
+
+    # ------------------------------------------------- interconnect addresses
+
+    def host_interconnect_ip_dataplane(self) -> ipaddress.IPv4Address:
+        """Data-plane-side IP of the host<->data-plane interconnect."""
+        base = int(self.host_subnet_this_node.network_address)
+        return ipaddress.ip_address(base + HOST_INTERCONNECT_DATAPLANE_SEQ_ID)
+
+    def host_interconnect_ip_host(self) -> ipaddress.IPv4Address:
+        """Host(Linux)-side IP of the interconnect."""
+        base = int(self.host_subnet_this_node.network_address)
+        return ipaddress.ip_address(base + HOST_INTERCONNECT_HOST_SEQ_ID)
+
+    def node_ip(self, node_id: Optional[int] = None) -> ipaddress.IPv4Address:
+        """Interconnect IP of a node (ipam.go computeNodeIPAddress :618)."""
+        node_id = node_id if node_id is not None else self.node_id
+        subnet = self.config.node_interconnect()
+        part = _node_ip_part(node_id, 32 - subnet.prefixlen)
+        if part == 0:
+            raise IPAMError(f"no free node IP for node ID {node_id}")
+        computed = int(subnet.network_address) + part
+        for excluded in sorted(int(ipaddress.ip_address(e)) for e in self.config.excluded_node_ips):
+            if excluded <= computed:
+                computed += 1
+        return ipaddress.ip_address(computed)
+
+    def vxlan_ip(self, node_id: Optional[int] = None) -> ipaddress.IPv4Address:
+        """BVI/VXLAN IP of a node (ipam.go computeVxlanIPAddress)."""
+        node_id = node_id if node_id is not None else self.node_id
+        subnet = self.config.vxlan()
+        part = _node_ip_part(node_id, 32 - subnet.prefixlen)
+        if part == 0:
+            raise IPAMError(f"no free VXLAN IP for node ID {node_id}")
+        return ipaddress.ip_address(int(subnet.network_address) + part)
+
+    def nat_loopback_ip(self) -> ipaddress.IPv4Address:
+        """Last unicast IP of this node's pod subnet (ipam.go :443)."""
+        return ipaddress.ip_address(int(self.pod_subnet_this_node.broadcast_address) - 1)
+
+    # --------------------------------------------------------- pod allocation
+
+    def allocate_pod_ip(self, pod_id: PodID) -> ipaddress.IPv4Address:
+        """Allocate (or return the existing) IP for a pod.
+
+        Round-robin from the last assigned sequence ID, skipping the
+        gateway; the last unicast IP is the NAT loopback and is never
+        allocated (max seq = 2^host_bits - 2, exclusive).
+        """
+        with self._lock:
+            existing = self._pod_to_ip.get(pod_id)
+            if existing is not None:
+                return existing
+            base = int(self.pod_subnet_this_node.network_address)
+            host_bits = 32 - self.pod_subnet_this_node.prefixlen
+            max_seq = (1 << host_bits) - 2  # exclusive; reserves loopback+bcast
+            start = self._last_assigned_seq + 1
+            for seq in list(range(start, max_seq)) + list(range(1, start)):
+                if seq == POD_GATEWAY_SEQ_ID:
+                    continue
+                ip_int = base + seq
+                if ip_int in self._assigned:
+                    continue
+                self._assigned[ip_int] = pod_id
+                ip = ipaddress.ip_address(ip_int)
+                self._pod_to_ip[pod_id] = ip
+                self._last_assigned_seq = seq
+                return ip
+        raise IPAMError(f"no free pod IP in {self.pod_subnet_this_node}")
+
+    def release_pod_ip(self, pod_id: PodID) -> None:
+        with self._lock:
+            ip = self._pod_to_ip.pop(pod_id, None)
+            if ip is not None:
+                self._assigned.pop(int(ip), None)
+
+    def get_pod_ip(self, pod_id: PodID) -> Optional[ipaddress.IPv4Address]:
+        with self._lock:
+            return self._pod_to_ip.get(pod_id)
+
+    @property
+    def allocated_count(self) -> int:
+        with self._lock:
+            return len(self._assigned)
+
+    # ----------------------------------------------------------------- resync
+
+    def resync(self, kube_state) -> None:
+        """Re-learn the pool from KubeState pods (ipam.go Resync :127):
+        adopt every pod whose IP falls into this node's subnet."""
+        with self._lock:
+            self._assigned.clear()
+            self._pod_to_ip.clear()
+            self._last_assigned_seq = 1
+            for pod in kube_state.get("pod", {}).values():
+                if not isinstance(pod, Pod) or not pod.ip_address:
+                    continue
+                try:
+                    ip = ipaddress.ip_address(pod.ip_address)
+                except ValueError:
+                    continue
+                if ip not in self.pod_subnet_this_node:
+                    continue
+                self._assigned[int(ip)] = pod.id
+                self._pod_to_ip[pod.id] = ip
+                seq = int(ip) - int(self.pod_subnet_this_node.network_address)
+                self._last_assigned_seq = max(self._last_assigned_seq, seq)

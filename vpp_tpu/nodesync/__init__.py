@@ -1,0 +1,3 @@
+from .nodesync import NodeSync, NodeUpdate
+
+__all__ = ["NodeSync", "NodeUpdate"]

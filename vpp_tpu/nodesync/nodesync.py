@@ -1,0 +1,152 @@
+"""NodeSync — cluster membership and node-ID allocation.
+
+Analog of ``plugins/nodesync``: each agent atomically allocates the
+first free positive integer as its node ID using the KV store's
+create-if-absent primitive (nodesync.go allocateID :328,
+putIfNotExists :392), publishes its data-plane IPs as a ``VppNode``
+record (PublishNodeIPs :122), and tracks all other nodes from the
+watched vppnode prefix (GetAllNodes :177) — zero direct agent-to-agent
+communication (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional, Tuple
+
+from ..controller.api import EventHandler, KubeStateChange, UpdateEvent
+from ..kvstore import KVStore
+from ..models import VppNode, key_for
+from ..models.registry import NODESYNC_PREFIX
+
+log = logging.getLogger(__name__)
+
+VPPNODE_PREFIX = NODESYNC_PREFIX + "vppnode/"
+
+
+class NodeUpdate(UpdateEvent):
+    """Another node joined / changed / left (nodesync_api NodeUpdate).
+
+    Re-emitted by NodeSync when the watched vppnode state changes, so
+    downstream handlers (ipv4net connectivity, service NodePorts) get a
+    typed event instead of raw KV changes.
+    """
+
+    name = "Node Update"
+
+    def __init__(self, node_name: str, prev: Optional[VppNode], new: Optional[VppNode]):
+        super().__init__()
+        self.node_name = node_name
+        self.prev = prev
+        self.new = new
+
+    def __str__(self) -> str:
+        op = "update"
+        if self.prev is None:
+            op = "join"
+        elif self.new is None:
+            op = "leave"
+        return f"{self.name} [{op} {self.node_name}]"
+
+
+class NodeSync(EventHandler):
+    """Event handler + node registry."""
+
+    name = "nodesync"
+
+    def __init__(self, store: KVStore, node_name: str):
+        self.store = store
+        self.node_name = node_name
+        self.node_id: Optional[int] = None
+        self._nodes: Dict[str, VppNode] = {}  # name -> record
+
+    # ----------------------------------------------------------- allocation
+
+    def allocate_id(self) -> int:
+        """First-free-positive-integer allocation via atomic create.
+
+        May block on allocation races; the reference likewise blocks the
+        first resync on etcd (SURVEY §3.1).  If a record with our name
+        already exists (agent restart), its ID is adopted.
+        """
+        if self.node_id is not None:
+            return self.node_id
+        while True:
+            taken = {}
+            for _, node in self.store.list(VPPNODE_PREFIX):
+                taken[node.id] = node
+                if node.name == self.node_name:
+                    self.node_id = node.id
+                    log.info("adopted existing node ID %d", node.id)
+                    return node.id
+            candidate = 1
+            while candidate in taken:
+                candidate += 1
+            record = VppNode(id=candidate, name=self.node_name)
+            if self.store.put_if_not_exists(key_for(record), record):
+                self.node_id = candidate
+                log.info("allocated node ID %d for %s", candidate, self.node_name)
+                return candidate
+            # Lost the race; retry with a fresh snapshot.
+
+    def release_id(self) -> None:
+        """Give the ID back on clean departure (release+reuse semantics)."""
+        if self.node_id is None:
+            return
+        record = self._nodes.get(self.node_name)
+        if record is not None:
+            self.store.delete(key_for(record))
+        else:
+            self.store.delete(VPPNODE_PREFIX + str(self.node_id))
+        self.node_id = None
+
+    def publish_node_ips(
+        self,
+        ip_addresses: Tuple[str, ...],
+        mgmt_ip_addresses: Tuple[str, ...] = (),
+    ) -> VppNode:
+        """Publish/refresh this node's VppNode record with its IPs."""
+        if self.node_id is None:
+            raise RuntimeError("node ID not allocated yet")
+        record = VppNode(
+            id=self.node_id,
+            name=self.node_name,
+            ip_addresses=tuple(ip_addresses),
+            mgmt_ip_addresses=tuple(mgmt_ip_addresses),
+        )
+        self.store.put(key_for(record), record)
+        self._nodes[self.node_name] = record
+        return record
+
+    # -------------------------------------------------------------- registry
+
+    def get_all_nodes(self) -> Dict[str, VppNode]:
+        return dict(self._nodes)
+
+    def other_nodes(self) -> Dict[str, VppNode]:
+        return {n: r for n, r in self._nodes.items() if n != self.node_name}
+
+    # ------------------------------------------------------- event handling
+
+    def handles_event(self, event) -> bool:
+        if isinstance(event, KubeStateChange):
+            return event.resource == "vppnode"
+        return True
+
+    def resync(self, event, kube_state, resync_count, txn) -> None:
+        self.allocate_id()
+        self._nodes = {}
+        for node in kube_state.get("vppnode", {}).values():
+            self._nodes[node.name] = node
+
+    def update(self, event, txn) -> str:
+        if not isinstance(event, KubeStateChange) or event.resource != "vppnode":
+            return ""
+        node = event.new_value if event.new_value is not None else event.prev_value
+        if node is None:
+            return ""
+        if event.new_value is None:
+            self._nodes.pop(node.name, None)
+        else:
+            self._nodes[node.name] = event.new_value
+        return f"node {node.name} {'removed' if event.new_value is None else 'updated'}"

@@ -1,0 +1,3 @@
+from .podmanager import PodManager, AddPod, DeletePod, LocalPod
+
+__all__ = ["PodManager", "AddPod", "DeletePod", "LocalPod"]

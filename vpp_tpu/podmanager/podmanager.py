@@ -1,0 +1,161 @@
+"""PodManager — the CNI entry point into the event loop.
+
+Analog of ``plugins/podmanager``: CNI Add/Del requests are wrapped into
+*blocking* AddPod/DeletePod events (podmanager.go Add :240 / Delete
+:275); the handler records LocalPods (container ID + network
+namespace).  AddPod uses RevertOnFailure + Forward direction, DeletePod
+is BestEffort + Reverse (podmanager_api.go:70,178) so connectivity is
+torn down in the opposite order it was built.
+
+Downstream handlers (ipv4net) fill ``event.interfaces`` / ``event.routes``
+during processing — those become the CNI reply (cniReplyForAddPod :289).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..controller.api import EventHandler, UpdateDirection, UpdateEvent, UpdateTxnType
+from ..models import PodID
+
+log = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class LocalPod:
+    """A pod deployed on this node (podmanager_api.go LocalPod :37)."""
+
+    id: PodID
+    container_id: str = ""
+    network_namespace: str = ""
+
+
+@dataclass
+class PodCNIReply:
+    """What the CNI caller gets back: allocated interfaces and routes."""
+
+    interfaces: List[dict] = field(default_factory=list)
+    routes: List[dict] = field(default_factory=list)
+    ip_address: str = ""
+
+
+class AddPod(UpdateEvent):
+    """Blocking CNI-Add event (podmanager_api.go AddPod :70)."""
+
+    name = "Add Pod"
+
+    def __init__(self, pod: LocalPod):
+        super().__init__(blocking=True)
+        self.pod = pod
+        self.reply = PodCNIReply()
+
+    @property
+    def direction(self) -> UpdateDirection:
+        return UpdateDirection.FORWARD
+
+    @property
+    def transaction_type(self) -> UpdateTxnType:
+        return UpdateTxnType.REVERT_ON_FAILURE
+
+    def __str__(self) -> str:
+        return f"{self.name} [{self.pod.id}]"
+
+
+class DeletePod(UpdateEvent):
+    """Blocking CNI-Del event (podmanager_api.go DeletePod :178)."""
+
+    name = "Delete Pod"
+
+    def __init__(self, pod_id: PodID):
+        super().__init__(blocking=True)
+        self.pod_id = pod_id
+
+    @property
+    def direction(self) -> UpdateDirection:
+        return UpdateDirection.REVERSE
+
+    @property
+    def transaction_type(self) -> UpdateTxnType:
+        return UpdateTxnType.BEST_EFFORT
+
+    def __str__(self) -> str:
+        return f"{self.name} [{self.pod_id}]"
+
+
+class PodManager(EventHandler):
+    """Tracks local pods; front end for CNI requests."""
+
+    name = "podmanager"
+
+    def __init__(self, event_loop=None):
+        self.event_loop = event_loop
+        self._local_pods: Dict[PodID, LocalPod] = {}
+
+    # ------------------------------------------------------------ CNI facade
+
+    def add_pod(
+        self,
+        name: str,
+        namespace: str = "default",
+        container_id: str = "",
+        network_namespace: str = "",
+        timeout: float = 30.0,
+    ) -> PodCNIReply:
+        """The CNI-Add RPC: push a blocking AddPod event and wait.
+
+        Raises the processing error on failure (the CNI binary then
+        reports the error back to kubelet).
+        """
+        pod = LocalPod(
+            id=PodID(name=name, namespace=namespace),
+            container_id=container_id,
+            network_namespace=network_namespace,
+        )
+        event = AddPod(pod)
+        self.event_loop.push_event(event)
+        err = event.wait(timeout)
+        if err is not None:
+            raise err
+        return event.reply
+
+    def delete_pod(self, name: str, namespace: str = "default", timeout: float = 30.0) -> None:
+        """The CNI-Del RPC. Idempotent per CNI spec."""
+        event = DeletePod(PodID(name=name, namespace=namespace))
+        self.event_loop.push_event(event)
+        err = event.wait(timeout)
+        if err is not None:
+            raise err
+
+    # --------------------------------------------------------------- queries
+
+    @property
+    def local_pods(self) -> Dict[PodID, LocalPod]:
+        return dict(self._local_pods)
+
+    def get_local_pod(self, pod_id: PodID) -> Optional[LocalPod]:
+        return self._local_pods.get(pod_id)
+
+    # ------------------------------------------------------- event handling
+
+    def handles_event(self, event) -> bool:
+        return isinstance(event, (AddPod, DeletePod)) or event.method.is_resync
+
+    def resync(self, event, kube_state, resync_count, txn) -> None:
+        """On startup the reference re-learns local pods from the container
+        runtime (podmanager.go Resync :137 via Docker inspect); here pods
+        re-register through repeated CNI Adds or an injected runtime list."""
+
+    def update(self, event, txn) -> str:
+        if isinstance(event, AddPod):
+            self._local_pods[event.pod.id] = event.pod
+            return f"added local pod {event.pod.id}"
+        if isinstance(event, DeletePod):
+            removed = self._local_pods.pop(event.pod_id, None)
+            return f"removed local pod {event.pod_id}" if removed else ""
+        return ""
+
+    def revert(self, event) -> None:
+        if isinstance(event, AddPod):
+            self._local_pods.pop(event.pod.id, None)

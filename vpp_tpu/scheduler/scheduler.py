@@ -102,6 +102,7 @@ class TxnScheduler(TxnSink):
         retry_delay: float = 1.0,
         max_retries: int = 3,
         schedule_retry: Optional[Callable[[Callable[[], None], float], None]] = None,
+        on_unrecoverable: Optional[Callable[[str, str], None]] = None,
     ):
         self._applicators: List[Applicator] = []
         self._dependency_fns: Dict[str, DependencyFn] = {}
@@ -111,6 +112,9 @@ class TxnScheduler(TxnSink):
         self._schedule_retry = schedule_retry or self._default_schedule
         self._txn_log: List[RecordedTxn] = []
         self._lock = threading.RLock()
+        # Called (key, error) when a value exhausts its retries; the wiring
+        # uses it to schedule a healing resync through the controller.
+        self._on_unrecoverable = on_unrecoverable
 
     # -------------------------------------------------------------- registry
 
@@ -226,6 +230,11 @@ class TxnScheduler(TxnSink):
             rec.last_error = ""
         except Exception as e:  # noqa: BLE001 - backend errors become state
             log.warning("apply of %s failed: %s", key, e)
+            if rec.applied is not None:
+                # A failed update may have destroyed the old incarnation
+                # (default update = delete+create); assume it is gone so the
+                # retry re-creates instead of re-deleting a missing value.
+                rec.applied = None
             rec.state = ValueState.FAILED
             rec.last_error = str(e)
             self._schedule_retry_for(key)
@@ -247,13 +256,19 @@ class TxnScheduler(TxnSink):
 
     def _cascade_unapply(self, key: str) -> None:
         """Unapply ``key`` and, first, every applied value depending on it
-        (reverse dependency order). Dependents stay PENDING."""
+        (reverse dependency order). Dependents whose backend delete
+        succeeded become PENDING; a failed delete leaves them FAILED with
+        a removal retry scheduled (stale config must not linger silently)."""
         for dep_key, dep_rec in list(self._values.items()):
             if dep_key == key or dep_rec.applied is None:
                 continue
             if key in self._dependencies(dep_key, dep_rec.applied):
                 self._cascade_unapply(dep_key)
-                dep_rec.state = ValueState.PENDING
+                if dep_rec.applied is not None:
+                    dep_rec.state = ValueState.FAILED
+                    self._schedule_retry_for(dep_key)
+                else:
+                    dep_rec.state = ValueState.PENDING
         rec = self._values.get(key)
         if rec is not None:
             self._unapply(key, rec)
@@ -278,7 +293,13 @@ class TxnScheduler(TxnSink):
 
     def _schedule_retry_for(self, key: str) -> None:
         rec = self._values.get(key)
-        if rec is None or rec.retries >= self.max_retries:
+        if rec is None:
+            return
+        if rec.retries >= self.max_retries:
+            # Retries exhausted: escalate so the controller can heal with a
+            # full resync instead of leaving the value FAILED forever.
+            if self._on_unrecoverable is not None:
+                self._on_unrecoverable(key, rec.last_error)
             return
         rec.retries += 1
         delay = self.retry_delay * (2 ** (rec.retries - 1))
@@ -316,7 +337,15 @@ class TxnScheduler(TxnSink):
         dependency gating."""
         with self._lock:
             for key, rec in list(self._values.items()):
-                if rec.desired is None or rec.state is not ValueState.APPLIED:
+                if rec.desired is None:
+                    continue
+                if rec.state is ValueState.FAILED:
+                    # Replay is the recovery point for values that exhausted
+                    # their retries: give them a fresh budget and re-try.
+                    rec.retries = 0
+                    self._try_apply(key, rec)
+                    continue
+                if rec.state is not ValueState.APPLIED:
                     continue
                 applicator = self._applicator_for(key)
                 if applicator is None:
@@ -325,6 +354,7 @@ class TxnScheduler(TxnSink):
                     applicator.update(key, rec.applied, rec.desired)
                     rec.applied = rec.desired
                 except Exception as e:  # noqa: BLE001
+                    rec.applied = None
                     rec.state = ValueState.FAILED
                     rec.last_error = str(e)
                     self._schedule_retry_for(key)
