@@ -33,8 +33,8 @@ class DBWatcher:
         self.store = store
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
-        prefixes = [r.key_prefix for r in registry.DB_RESOURCES] + [EXTERNAL_CONFIG_PREFIX]
-        self._watcher = self.store.watch(prefixes)
+        self._prefixes = [r.key_prefix for r in registry.DB_RESOURCES] + [EXTERNAL_CONFIG_PREFIX]
+        self._watcher = self.store.watch(self._prefixes)
         # Serializes resync() against the watch thread's event pushes, so a
         # DBResync snapshot can never be overtaken by a change event that it
         # does not contain (and stale pre-snapshot events are dropped by
@@ -73,10 +73,8 @@ class DBWatcher:
         events committed before the snapshot revision are dropped by the
         watch loop afterwards (they are already inside the snapshot).
         """
-        prefixes = [r.key_prefix for r in registry.DB_RESOURCES] + [EXTERNAL_CONFIG_PREFIX]
         with self._order_lock:
-            snap = self.store.snapshot(prefixes)
-            self._resync_revision = self.store.revision
+            snap, self._resync_revision = self.store.snapshot_with_revision(self._prefixes)
             kube_state = {r.keyword: {} for r in registry.DB_RESOURCES}
             external = {}
             for key, value in snap.items():

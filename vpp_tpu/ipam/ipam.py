@@ -20,6 +20,7 @@ node ID, so no cross-node coordination is ever needed for addressing
 from __future__ import annotations
 
 import ipaddress
+import logging
 import threading
 from typing import Dict, Optional
 
@@ -27,6 +28,8 @@ from ..conf import IPAMConfig
 from ..models import Pod, PodID
 
 # Sequence IDs reserved inside each per-node subnet (reference ipam.go:36-45).
+log = logging.getLogger(__name__)
+
 POD_GATEWAY_SEQ_ID = 1
 HOST_INTERCONNECT_DATAPLANE_SEQ_ID = 1
 HOST_INTERCONNECT_HOST_SEQ_ID = 2
@@ -205,6 +208,9 @@ class IPAM:
             self._assigned.clear()
             self._pod_to_ip.clear()
             self._last_assigned_seq = 1
+            base = int(self.pod_subnet_this_node.network_address)
+            host_bits = 32 - self.pod_subnet_this_node.prefixlen
+            max_seq = (1 << host_bits) - 2  # exclusive: NAT loopback + bcast
             for pod in kube_state.get("pod", {}).values():
                 if not isinstance(pod, Pod) or not pod.ip_address:
                     continue
@@ -214,7 +220,13 @@ class IPAM:
                     continue
                 if ip not in self.pod_subnet_this_node:
                     continue
+                seq = int(ip) - base
+                if seq == POD_GATEWAY_SEQ_ID or not (0 < seq < max_seq):
+                    # Reserved address (gateway, NAT loopback, broadcast,
+                    # network) recorded by stale/foreign state: never adopt,
+                    # or the allocator could later re-hand it out.
+                    log.warning("ignoring pod %s with reserved IP %s", pod.id, ip)
+                    continue
                 self._assigned[int(ip)] = pod.id
                 self._pod_to_ip[pod.id] = ip
-                seq = int(ip) - int(self.pod_subnet_this_node.network_address)
                 self._last_assigned_seq = max(self._last_assigned_seq, seq)

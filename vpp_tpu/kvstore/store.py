@@ -124,13 +124,20 @@ class KVStore:
     def snapshot(self, prefixes: Iterable[str]) -> Dict[str, Any]:
         """One consistent snapshot across several prefixes (used for the
         resync event; analog of dbwatcher.LoadKubeStateForResync :553)."""
+        return self.snapshot_with_revision(prefixes)[0]
+
+    def snapshot_with_revision(
+        self, prefixes: Iterable[str]
+    ) -> Tuple[Dict[str, Any], int]:
+        """Snapshot plus the revision it corresponds to, read atomically
+        (watch events up to this revision are covered by the snapshot)."""
         with self._lock:
             out: Dict[str, Any] = {}
             for prefix in prefixes:
                 for k, v in self._data.items():
                     if k.startswith(prefix):
                         out[k] = v
-            return out
+            return out, self._revision
 
     @property
     def revision(self) -> int:

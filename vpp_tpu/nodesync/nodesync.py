@@ -54,9 +54,12 @@ class NodeSync(EventHandler):
 
     name = "nodesync"
 
-    def __init__(self, store: KVStore, node_name: str):
+    def __init__(self, store: KVStore, node_name: str, event_loop=None):
         self.store = store
         self.node_name = node_name
+        # When wired, vppnode KV changes are re-emitted as typed NodeUpdate
+        # follow-up events for downstream handlers (ipv4net, service).
+        self.event_loop = event_loop
         self.node_id: Optional[int] = None
         self._nodes: Dict[str, VppNode] = {}  # name -> record
 
@@ -149,4 +152,8 @@ class NodeSync(EventHandler):
             self._nodes.pop(node.name, None)
         else:
             self._nodes[node.name] = event.new_value
+        if self.event_loop is not None and node.name != self.node_name:
+            self.event_loop.push_event(
+                NodeUpdate(node.name, event.prev_value, event.new_value)
+            )
         return f"node {node.name} {'removed' if event.new_value is None else 'updated'}"

@@ -149,6 +149,9 @@ class PodManager(EventHandler):
 
     def update(self, event, txn) -> str:
         if isinstance(event, AddPod):
+            # Remember what we overwrote so revert() can restore it (a
+            # repeated CNI Add for the same pod replaces the sandbox).
+            event._replaced = self._local_pods.get(event.pod.id)
             self._local_pods[event.pod.id] = event.pod
             return f"added local pod {event.pod.id}"
         if isinstance(event, DeletePod):
@@ -158,4 +161,8 @@ class PodManager(EventHandler):
 
     def revert(self, event) -> None:
         if isinstance(event, AddPod):
-            self._local_pods.pop(event.pod.id, None)
+            replaced = getattr(event, "_replaced", None)
+            if replaced is not None:
+                self._local_pods[event.pod.id] = replaced
+            else:
+                self._local_pods.pop(event.pod.id, None)

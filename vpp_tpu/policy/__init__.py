@@ -1,0 +1,44 @@
+"""Policy stack — K8s NetworkPolicy -> 5-tuple ContivRules -> rule tables.
+
+Mirrors the reference's layering (plugins/policy, SURVEY.md §2.1):
+
+    PolicyPlugin (plugin.py)          event-handler skeleton
+      -> PolicyCache (cache.py)       indexed pods/policies/namespaces,
+                                      label-selector matching
+      -> PolicyProcessor (processor.py) which pods are affected, selector
+                                      resolution to concrete peers
+      -> PolicyConfigurator (configurator.py) policies -> ingress/egress
+                                      ContivRule lists per pod
+      -> renderers (renderer/)        rule tables for the TPU data plane
+
+The traffic direction convention is inherited from the reference
+(renderer/api.go Render): *ingress*/*egress* are from the vswitch point
+of view — a pod's "ingress table" filters traffic the pod sends, its
+"egress table" filters traffic delivered to the pod.
+"""
+
+from .renderer.api import (
+    Action,
+    ContivRule,
+    RULE_MATCH_ALL_SRC,
+    RULE_MATCH_ALL_DST,
+)
+from .cache import PolicyCache
+from .configurator import PolicyConfigurator, ContivPolicy, Match, MatchType, PolicyKind
+from .processor import PolicyProcessor
+from .plugin import PolicyPlugin
+
+__all__ = [
+    "Action",
+    "ContivRule",
+    "RULE_MATCH_ALL_SRC",
+    "RULE_MATCH_ALL_DST",
+    "PolicyCache",
+    "PolicyConfigurator",
+    "ContivPolicy",
+    "Match",
+    "MatchType",
+    "PolicyKind",
+    "PolicyProcessor",
+    "PolicyPlugin",
+]

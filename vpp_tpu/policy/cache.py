@@ -1,0 +1,173 @@
+"""Policy cache — indexed view of pods / policies / namespaces with
+label-selector matching.
+
+Analog of ``plugins/policy/cache`` (cache_impl.go + match_expression.go
++ the idxmap indexes): keeps the policy-relevant slice of KubeState
+indexed for the lookups the processor needs.  The reference implements
+selector matching as set intersections over label indexes; the
+per-object predicate here is semantically identical (K8s semantics:
+NOT_IN and DOES_NOT_EXIST also match objects lacking the key) and is
+verified against the same corpus of cases
+(cache/match_expression_test.go).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from ..models import (
+    Endpoints,
+    ExpressionOperator,
+    LabelSelector,
+    Namespace,
+    Pod,
+    PodID,
+    Policy,
+    PolicyID,
+)
+
+
+def selector_matches(selector: Optional[LabelSelector], labels) -> bool:
+    """Evaluate a label selector against a label mapping.
+
+    ``None`` (nil selector) matches nothing; the empty selector matches
+    everything (policy.proto LabelSelector doc).  match_labels and all
+    match_expressions are ANDed.
+    """
+    if selector is None:
+        return False
+    for key, value in selector.match_labels.items():
+        if labels.get(key) != value:
+            return False
+    for expr in selector.match_expressions:
+        has = expr.key in labels
+        if expr.operator is ExpressionOperator.IN:
+            if not has or labels[expr.key] not in expr.values:
+                return False
+        elif expr.operator is ExpressionOperator.NOT_IN:
+            if has and labels[expr.key] in expr.values:
+                return False
+        elif expr.operator is ExpressionOperator.EXISTS:
+            if not has:
+                return False
+        elif expr.operator is ExpressionOperator.DOES_NOT_EXIST:
+            if has:
+                return False
+    return True
+
+
+class PolicyCache:
+    """The indexed state. Fed by the policy plugin from KubeState."""
+
+    def __init__(self):
+        self._pods: Dict[PodID, Pod] = {}
+        self._policies: Dict[PolicyID, Policy] = {}
+        self._namespaces: Dict[str, Namespace] = {}
+        self._pods_by_ns: Dict[str, Set[PodID]] = {}
+
+    # ----------------------------------------------------------------- feeds
+
+    def resync(self, kube_state) -> None:
+        self._pods.clear()
+        self._policies.clear()
+        self._namespaces.clear()
+        self._pods_by_ns.clear()
+        for pod in kube_state.get("pod", {}).values():
+            self.update_pod(pod)
+        for policy in kube_state.get("policy", {}).values():
+            self.update_policy(policy)
+        for ns in kube_state.get("namespace", {}).values():
+            self.update_namespace(ns)
+
+    def update_pod(self, pod: Pod) -> Optional[Pod]:
+        old = self._pods.get(pod.id)
+        self._pods[pod.id] = pod
+        self._pods_by_ns.setdefault(pod.namespace, set()).add(pod.id)
+        return old
+
+    def delete_pod(self, pod_id: PodID) -> Optional[Pod]:
+        old = self._pods.pop(pod_id, None)
+        if old is not None:
+            self._pods_by_ns.get(pod_id.namespace, set()).discard(pod_id)
+        return old
+
+    def update_policy(self, policy: Policy) -> Optional[Policy]:
+        old = self._policies.get(policy.id)
+        self._policies[policy.id] = policy
+        return old
+
+    def delete_policy(self, policy_id: PolicyID) -> Optional[Policy]:
+        return self._policies.pop(policy_id, None)
+
+    def update_namespace(self, ns: Namespace) -> Optional[Namespace]:
+        old = self._namespaces.get(ns.name)
+        self._namespaces[ns.name] = ns
+        return old
+
+    def delete_namespace(self, name: str) -> Optional[Namespace]:
+        return self._namespaces.pop(name, None)
+
+    # --------------------------------------------------------------- lookups
+
+    def lookup_pod(self, pod_id: PodID) -> Optional[Pod]:
+        return self._pods.get(pod_id)
+
+    def lookup_policy(self, policy_id: PolicyID) -> Optional[Policy]:
+        return self._policies.get(policy_id)
+
+    def all_pods(self) -> List[Pod]:
+        return list(self._pods.values())
+
+    def all_policies(self) -> List[Policy]:
+        return list(self._policies.values())
+
+    def pods_in_namespace(self, namespace: str) -> List[Pod]:
+        return [self._pods[pid] for pid in self._pods_by_ns.get(namespace, ())]
+
+    # ------------------------------------------------------------- selectors
+
+    def pods_matching_selector(
+        self, namespace: str, selector: Optional[LabelSelector]
+    ) -> List[Pod]:
+        """Pods in ``namespace`` matched by a pod label selector
+        (cache getPodsByNSLabelSelector / getMatchExpressionPodsInsideNs)."""
+        if selector is None:
+            return []
+        return [
+            pod
+            for pod in self.pods_in_namespace(namespace)
+            if selector_matches(selector, pod.labels)
+        ]
+
+    def namespaces_matching_selector(
+        self, selector: Optional[LabelSelector]
+    ) -> List[Namespace]:
+        """Namespaces matched by a cluster-scoped label selector."""
+        if selector is None:
+            return []
+        return [
+            ns
+            for ns in self._namespaces.values()
+            if selector_matches(selector, ns.labels)
+        ]
+
+    def pods_matching_namespace_selector(
+        self, selector: Optional[LabelSelector]
+    ) -> List[Pod]:
+        """All pods of all namespaces matched by a namespace selector
+        (policy.proto Peer.namespaces semantics)."""
+        out: List[Pod] = []
+        for ns in self.namespaces_matching_selector(selector):
+            out.extend(self.pods_in_namespace(ns.name))
+        return out
+
+    def policies_selecting_pod(self, pod: Pod) -> List[Policy]:
+        """Policies whose ``pods`` selector covers the pod — only policies
+        in the pod's own namespace apply (processor getPoliciesReferencingPod
+        :378)."""
+        return [
+            pol
+            for pol in self._policies.values()
+            if pol.namespace == pod.namespace
+            and selector_matches(pol.pods, pod.labels)
+        ]

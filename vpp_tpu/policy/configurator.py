@@ -1,0 +1,290 @@
+"""Policy configurator — translates sets of policies into ContivRules.
+
+Analog of ``plugins/policy/configurator/configurator_impl.go``:
+
+- ``generate_rules`` (:264): one direction's rule list for a set of
+  policies — peer-pod one-host subnets, IPBlocks with except-CIDR
+  subtraction, port combinations, allow-from-NAT-loopback, final
+  deny-all.
+- direction swap (Commit :196-200): policy *ingress* matches produce
+  the pod's vswitch-*egress* table (traffic delivered to the pod) and
+  policy *egress* matches the vswitch-*ingress* table.
+- processed-set memoisation (Commit :146-210): pods sharing an
+  identical policy set share one generated rule pair (the basis for
+  table sharing downstream).
+- ``subtract_subnet`` (:562): CIDR-minus-CIDR as a minimal set of
+  non-overlapping CIDRs.
+"""
+
+from __future__ import annotations
+
+import enum
+import ipaddress
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..models import PodID, PolicyID, ProtocolType
+from .cache import PolicyCache
+from .renderer.api import (
+    Action,
+    ContivRule,
+    PolicyRendererAPI,
+    insert_rule,
+)
+
+log = logging.getLogger(__name__)
+
+
+class MatchType(enum.Enum):
+    """Direction of a match, from the *pod's* point of view."""
+
+    INGRESS = "ingress"
+    EGRESS = "egress"
+
+
+class PolicyKind(enum.Enum):
+    """Which directions the policy restricts (configurator PolicyType)."""
+
+    INGRESS = "ingress"
+    EGRESS = "egress"
+    BOTH = "both"
+
+
+@dataclass(frozen=True)
+class Match:
+    """One pre-resolved ingress/egress rule of a policy
+    (configurator_api Match): label selectors already resolved by the
+    processor to concrete peer pods; named ports to numbers."""
+
+    type: MatchType
+    # None = peers unspecified (match anything on L3);
+    # empty tuple = peers specified but none matched (match nothing).
+    pods: Optional[Tuple[PodID, ...]] = None
+    ip_blocks: Optional[Tuple[Tuple[ipaddress.IPv4Network, Tuple[ipaddress.IPv4Network, ...]], ...]] = None
+    # (protocol, port number) pairs; empty = all ports.
+    ports: Tuple[Tuple[ProtocolType, int], ...] = ()
+
+
+@dataclass(frozen=True)
+class ContivPolicy:
+    """A policy with pre-resolved matches (configurator_api ContivPolicy)."""
+
+    id: PolicyID
+    kind: PolicyKind
+    matches: Tuple[Match, ...] = ()
+
+
+def subtract_subnet(
+    net1: ipaddress.IPv4Network, net2: ipaddress.IPv4Network
+) -> List[ipaddress.IPv4Network]:
+    """All IPs in net1 but not in net2, as non-overlapping CIDRs
+    (configurator_impl.go subtractSubnet :562)."""
+    if net1.prefixlen > net2.prefixlen:
+        # net2 is higher in the tree: either covers net1 fully or not at all.
+        return [] if net2.supernet_of(net1) else [net1]
+    if net1.prefixlen == net2.prefixlen:
+        return [] if net1 == net2 else [net1]
+    if not net1.supernet_of(net2):
+        return [net1]
+    # net2 strictly inside net1: walk down the tree, emitting the sibling
+    # of each step towards net2.
+    result = []
+    for bit in range(net1.prefixlen, net2.prefixlen):
+        sibling_base = int(net2.network_address) ^ (1 << (31 - bit))
+        sibling = ipaddress.ip_network((sibling_base, bit + 1), strict=False)
+        result.append(ipaddress.ip_network((sibling.network_address, bit + 1)))
+    return result
+
+
+def one_host_subnet(ip: str) -> Optional[ipaddress.IPv4Network]:
+    """Pod IP as a /32 (policy/utils GetOneHostSubnet)."""
+    try:
+        return ipaddress.ip_network(f"{ip}/32")
+    except ValueError:
+        return None
+
+
+class PolicyConfigurator:
+    """Translates per-pod policy sets to rules and drives the renderers
+    (configurator_impl.go PolicyConfigurator)."""
+
+    def __init__(self, cache: PolicyCache, ipam=None):
+        self.cache = cache
+        self.ipam = ipam  # for the NAT-loopback allow rule
+        self.renderers: List[PolicyRendererAPI] = []
+        # pod -> last known IP (to render removals after the pod is gone).
+        self._pod_ips: Dict[PodID, ipaddress.IPv4Network] = {}
+
+    def register_renderer(self, renderer: PolicyRendererAPI) -> None:
+        self.renderers.append(renderer)
+
+    # ------------------------------------------------------------------ txn
+
+    def new_txn(self, resync: bool) -> "ConfiguratorTxn":
+        return ConfiguratorTxn(self, resync)
+
+    # ------------------------------------------------------- rule generation
+
+    def generate_rules(
+        self, direction: MatchType, policies: Sequence[ContivPolicy]
+    ) -> List[ContivRule]:
+        """One direction's rule list (generateRules :264).
+
+        ``direction`` is the *policy* direction being implemented:
+        INGRESS produces rules matching on source (who may reach the
+        pod), EGRESS rules matching on destination.
+        """
+        rules: List[ContivRule] = []
+        has_policy = False
+        all_allowed = False
+
+        for policy in sorted(policies, key=lambda p: p.id):
+            if policy.kind is PolicyKind.INGRESS and direction is MatchType.EGRESS:
+                continue
+            if policy.kind is PolicyKind.EGRESS and direction is MatchType.INGRESS:
+                continue
+            has_policy = True
+
+            for match in policy.matches:
+                if match.type is not direction:
+                    continue
+
+                # Resolve peer pods to one-host subnets.
+                peer_nets: List[ipaddress.IPv4Network] = []
+                for peer in match.pods or ():
+                    peer_data = self.cache.lookup_pod(peer)
+                    if peer_data is None or not peer_data.ip_address:
+                        continue
+                    net = one_host_subnet(peer_data.ip_address)
+                    if net is not None:
+                        peer_nets.append(net)
+
+                # Expand IPBlocks minus their excepts.
+                block_nets: List[ipaddress.IPv4Network] = []
+                for block, excepts in match.ip_blocks or ():
+                    subnets = [block]
+                    for exc in excepts:
+                        subnets = [
+                            out for net in subnets for out in subtract_subnet(net, exc)
+                        ]
+                    block_nets.extend(subnets)
+
+                if match.pods is None and match.ip_blocks is None:
+                    # Unspecified peers = anything on L3.
+                    if not match.ports:
+                        insert_rule(rules, ContivRule(action=Action.PERMIT))
+                        all_allowed = True
+                    else:
+                        for proto, port in match.ports:
+                            insert_rule(
+                                rules,
+                                ContivRule(
+                                    action=Action.PERMIT,
+                                    protocol=proto,
+                                    dst_port=port,
+                                ),
+                            )
+
+                for net in peer_nets + block_nets:
+                    src = net if direction is MatchType.INGRESS else None
+                    dst = net if direction is MatchType.EGRESS else None
+                    if not match.ports:
+                        insert_rule(
+                            rules,
+                            ContivRule(
+                                action=Action.PERMIT,
+                                src_network=src,
+                                dst_network=dst,
+                            ),
+                        )
+                    else:
+                        for proto, port in match.ports:
+                            insert_rule(
+                                rules,
+                                ContivRule(
+                                    action=Action.PERMIT,
+                                    src_network=src,
+                                    dst_network=dst,
+                                    protocol=proto,
+                                    dst_port=port,
+                                ),
+                            )
+
+        if has_policy and not all_allowed:
+            if direction is MatchType.INGRESS and self.ipam is not None:
+                # Allow the virtual NAT loopback (a pod accessing a service
+                # load-balanced back to itself; generateRules :447).
+                nat_net = one_host_subnet(str(self.ipam.nat_loopback_ip()))
+                insert_rule(
+                    rules,
+                    ContivRule(action=Action.PERMIT, src_network=nat_net),
+                )
+            insert_rule(rules, ContivRule(action=Action.DENY))
+
+        return rules
+
+
+@dataclass
+class _PendingConfig:
+    policies: Tuple[ContivPolicy, ...]
+
+
+class ConfiguratorTxn:
+    """One configurator transaction (PolicyConfiguratorTxn)."""
+
+    def __init__(self, configurator: PolicyConfigurator, resync: bool):
+        self.configurator = configurator
+        self.resync = resync
+        self._config: Dict[PodID, Tuple[ContivPolicy, ...]] = {}
+
+    def configure(self, pod: PodID, policies: Sequence[ContivPolicy]) -> "ConfiguratorTxn":
+        """Replace the set of policies assigned to a pod (order-free)."""
+        self._config[pod] = tuple(policies)
+        return self
+
+    def commit(self) -> None:
+        cfg = self.configurator
+        pod_ips = {} if self.resync else dict(cfg._pod_ips)
+
+        # Memoise rule generation per (sorted) policy set (Commit :146).
+        processed: Dict[Tuple[PolicyID, ...], Tuple[List[ContivRule], List[ContivRule]]] = {}
+
+        renderer_txns = [r.new_txn(self.resync) for r in cfg.renderers]
+        for pod, policies in sorted(self._config.items()):
+            pod_data = cfg.cache.lookup_pod(pod)
+            removed = pod_data is None or not pod_data.ip_address
+            if removed:
+                had_ip = pod in pod_ips
+                pod_ip = pod_ips.pop(pod, None)
+                if not had_ip:
+                    continue  # already unconfigured
+                ingress: List[ContivRule] = []
+                egress: List[ContivRule] = []
+            else:
+                pod_ip = one_host_subnet(pod_data.ip_address)
+                if pod_ip is None:
+                    log.warning("pod %s has invalid IP %r", pod, pod_data.ip_address)
+                    continue
+                pod_ips[pod] = pod_ip
+                key = tuple(sorted(p.id for p in policies))
+                if key in processed:
+                    ingress, egress = processed[key]
+                else:
+                    # Direction swap: policy-ingress -> vswitch-egress table.
+                    egress = cfg.generate_rules(MatchType.INGRESS, policies)
+                    ingress = cfg.generate_rules(MatchType.EGRESS, policies)
+                    processed[key] = (ingress, egress)
+
+            for txn in renderer_txns:
+                txn.render(pod, pod_ip, list(ingress), list(egress), removed=removed)
+
+        errors = []
+        for txn in renderer_txns:
+            try:
+                txn.commit()
+            except Exception as e:  # noqa: BLE001 - keep other renderers going
+                errors.append(e)
+        cfg._pod_ips = pod_ips
+        if errors:
+            raise errors[0]

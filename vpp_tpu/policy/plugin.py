@@ -1,0 +1,68 @@
+"""Policy plugin — event-handler skeleton wiring the policy layers.
+
+Analog of ``plugins/policy/plugin_impl_policy.go`` (layer wiring in
+Init :74-141): cache -> processor -> configurator -> registered
+renderers, driven by KubeStateChange events for pods, policies and
+namespaces.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..controller.api import EventHandler, KubeStateChange
+from .cache import PolicyCache
+from .configurator import PolicyConfigurator
+from .processor import PolicyProcessor
+
+log = logging.getLogger(__name__)
+
+
+class PolicyPlugin(EventHandler):
+    """The policy stack as one event handler."""
+
+    name = "policy"
+
+    def __init__(self, ipam=None):
+        self.cache = PolicyCache()
+        self.configurator = PolicyConfigurator(self.cache, ipam=ipam)
+        self.processor = PolicyProcessor(self.cache, self.configurator)
+
+    def register_renderer(self, renderer) -> None:
+        self.configurator.register_renderer(renderer)
+
+    # -------------------------------------------------------- event handling
+
+    def handles_event(self, event) -> bool:
+        if isinstance(event, KubeStateChange):
+            return event.resource in ("pod", "policy", "namespace")
+        return event.method.is_resync
+
+    def resync(self, event, kube_state, resync_count, txn) -> None:
+        self.processor.resync(kube_state)
+
+    def update(self, event, txn) -> str:
+        if not isinstance(event, KubeStateChange):
+            return ""
+        if event.resource == "pod":
+            if event.new_value is not None:
+                self.cache.update_pod(event.new_value)
+            elif event.prev_value is not None:
+                self.cache.delete_pod(event.prev_value.id)
+            self.processor.on_pod_change(event.prev_value, event.new_value)
+            return "reconfigured policies after pod change"
+        if event.resource == "policy":
+            if event.new_value is not None:
+                self.cache.update_policy(event.new_value)
+            elif event.prev_value is not None:
+                self.cache.delete_policy(event.prev_value.id)
+            self.processor.on_policy_change(event.prev_value, event.new_value)
+            return "reconfigured policies after policy change"
+        if event.resource == "namespace":
+            if event.new_value is not None:
+                self.cache.update_namespace(event.new_value)
+            elif event.prev_value is not None:
+                self.cache.delete_namespace(event.prev_value.name)
+            self.processor.on_namespace_change(event.prev_value, event.new_value)
+            return "reconfigured policies after namespace change"
+        return ""

@@ -1,0 +1,225 @@
+"""Policy processor — decides which pods need (re)configuration and
+resolves policies into pre-computed matches.
+
+Analog of ``plugins/policy/processor``:
+
+- ``calculate_matches`` (matches_calculator.go :14): per (policy, pod)
+  resolution of label selectors to concrete peer pod IDs, IPBlock
+  parsing, and named-port resolution — ingress named ports resolve
+  against the *target* pod's container ports, egress named ports expand
+  into extra per-peer-pod matches (portNameToNumber :197).
+- ``process`` (processor.go Process :73): re-run the
+  configurator for a set of possibly-outdated pods.
+- affected-pod computation on pod/policy/namespace changes
+  (getPoliciesReferencingPod :378) — conservatively widened to all
+  policy-holding pods for peer-affecting changes, matching the
+  reference's own "possibly outdated" over-approximation.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import logging
+from typing import List, Optional, Sequence, Set, Tuple
+
+from ..models import (
+    Namespace,
+    Pod,
+    PodID,
+    Policy,
+    PolicyType,
+    ProtocolType,
+)
+from .cache import PolicyCache
+from .configurator import (
+    ContivPolicy,
+    Match,
+    MatchType,
+    PolicyConfigurator,
+    PolicyKind,
+)
+
+log = logging.getLogger(__name__)
+
+
+def _policy_kind(policy: Policy) -> PolicyKind:
+    if policy.applies_to_ingress and policy.applies_to_egress:
+        return PolicyKind.BOTH
+    if policy.applies_to_egress:
+        return PolicyKind.EGRESS
+    return PolicyKind.INGRESS
+
+
+class PolicyProcessor:
+    """Drives the configurator from resolved policy data."""
+
+    def __init__(self, cache: PolicyCache, configurator: PolicyConfigurator):
+        self.cache = cache
+        self.configurator = configurator
+        # Pods that currently have at least one policy configured, so we
+        # know when to render a policy *removal*.
+        self._pods_with_policy: Set[PodID] = set()
+
+    # ------------------------------------------------------------ resolution
+
+    def calculate_matches(self, policy: Policy, pod_id: PodID) -> List[Match]:
+        """Resolve one policy's rules for one target pod."""
+        matches: List[Match] = []
+        namespace = policy.namespace
+
+        for rule in policy.ingress_rules:
+            peers, blocks = self._resolve_peers(namespace, rule.from_peers)
+            ports: List[Tuple[ProtocolType, int]] = []
+            for p in rule.ports:
+                if isinstance(p.port, str):
+                    # Named ingress port: resolve on the target pod.
+                    pod = self.cache.lookup_pod(pod_id)
+                    for number in _named_ports(pod, p.port):
+                        ports.append((p.protocol, number))
+                else:
+                    ports.append((p.protocol, int(p.port or 0)))
+            matches.append(
+                Match(type=MatchType.INGRESS, pods=peers, ip_blocks=blocks, ports=tuple(ports))
+            )
+
+        for rule in policy.egress_rules:
+            peers, blocks = self._resolve_peers(namespace, rule.to_peers)
+            ports = []
+            for p in rule.ports:
+                if isinstance(p.port, str):
+                    # Named egress port: expands into one match per peer pod
+                    # that defines it (matches_calculator.go :172-185).
+                    candidates = peers if peers else tuple(
+                        pod.id for pod in self.cache.all_pods()
+                    )
+                    for peer_id in candidates:
+                        peer = self.cache.lookup_pod(peer_id)
+                        for number in _named_ports(peer, p.port):
+                            matches.append(
+                                Match(
+                                    type=MatchType.EGRESS,
+                                    pods=(peer_id,),
+                                    ip_blocks=(),
+                                    ports=((p.protocol, number),),
+                                )
+                            )
+                else:
+                    ports.append((p.protocol, int(p.port or 0)))
+            matches.append(
+                Match(type=MatchType.EGRESS, pods=peers, ip_blocks=blocks, ports=tuple(ports))
+            )
+
+        return matches
+
+    def _resolve_peers(self, namespace: str, peers) -> Tuple[
+        Optional[Tuple[PodID, ...]],
+        Optional[Tuple[Tuple[ipaddress.IPv4Network, Tuple[ipaddress.IPv4Network, ...]], ...]],
+    ]:
+        """Peers -> (pod IDs, IP blocks); (None, None) when unrestricted."""
+        if not peers:
+            return None, None
+        pod_ids: List[PodID] = []
+        blocks: List[Tuple[ipaddress.IPv4Network, Tuple[ipaddress.IPv4Network, ...]]] = []
+        for peer in peers:
+            if peer.pods is not None:
+                pod_ids.extend(p.id for p in self.cache.pods_matching_selector(namespace, peer.pods))
+            if peer.namespaces is not None:
+                pod_ids.extend(p.id for p in self.cache.pods_matching_namespace_selector(peer.namespaces))
+            if peer.ip_block is not None:
+                try:
+                    net = ipaddress.ip_network(peer.ip_block.cidr, strict=False)
+                    excepts = tuple(
+                        ipaddress.ip_network(e, strict=False)
+                        for e in peer.ip_block.except_cidrs
+                    )
+                except ValueError:
+                    log.warning("ignoring malformed IPBlock %r", peer.ip_block)
+                    continue
+                blocks.append((net, excepts))
+        # Dedup while keeping deterministic order.
+        seen: Set[PodID] = set()
+        unique = tuple(p for p in pod_ids if not (p in seen or seen.add(p)))
+        return unique, tuple(blocks)
+
+    # -------------------------------------------------------------- process
+
+    def process(self, pods: Sequence[PodID], resync: bool = False) -> None:
+        """Re-run the configurator for possibly-outdated pods."""
+        txn = self.configurator.new_txn(resync)
+        touched = False
+        for pod_id in pods:
+            pod = self.cache.lookup_pod(pod_id)
+            policies: List[ContivPolicy] = []
+            if pod is not None:
+                for policy in sorted(self.cache.policies_selecting_pod(pod), key=lambda p: p.id):
+                    policies.append(
+                        ContivPolicy(
+                            id=policy.id,
+                            kind=_policy_kind(policy),
+                            matches=tuple(self.calculate_matches(policy, pod_id)),
+                        )
+                    )
+            if policies:
+                self._pods_with_policy.add(pod_id)
+            elif pod_id in self._pods_with_policy or resync:
+                self._pods_with_policy.discard(pod_id)
+            elif pod is not None:
+                continue  # never had policies; nothing to render
+            txn.configure(pod_id, policies)
+            touched = True
+        if touched or resync:
+            txn.commit()
+
+    def resync(self, kube_state) -> None:
+        self.cache.resync(kube_state)
+        self._pods_with_policy.clear()
+        self.process([pod.id for pod in self.cache.all_pods()], resync=True)
+
+    # ------------------------------------------------------- event reactions
+
+    def on_pod_change(self, old: Optional[Pod], new: Optional[Pod]) -> None:
+        affected: Set[PodID] = set()
+        changed = new if new is not None else old
+        if changed is not None:
+            affected.add(changed.id)
+        # The changed pod may appear as a *peer* in rules of any pod that
+        # has policies (cross-namespace via namespace selectors).
+        affected.update(self._pods_with_policy)
+        # Pods newly selected by policies because of label changes.
+        if new is not None:
+            for policy in self.cache.policies_selecting_pod(new):
+                affected.update(
+                    p.id for p in self.cache.pods_matching_selector(policy.namespace, policy.pods)
+                )
+        self.process(sorted(affected))
+
+    def on_policy_change(self, old: Optional[Policy], new: Optional[Policy]) -> None:
+        affected: Set[PodID] = set()
+        for policy in (old, new):
+            if policy is None:
+                continue
+            affected.update(
+                p.id for p in self.cache.pods_matching_selector(policy.namespace, policy.pods)
+            )
+        # Pods that *had* the old policy but are no longer selected.
+        affected.update(self._pods_with_policy)
+        self.process(sorted(affected))
+
+    def on_namespace_change(self, old: Optional[Namespace], new: Optional[Namespace]) -> None:
+        # Namespace labels affect peer resolution everywhere.
+        affected: Set[PodID] = set(self._pods_with_policy)
+        ns = new if new is not None else old
+        if ns is not None:
+            affected.update(p.id for p in self.cache.pods_in_namespace(ns.name))
+        self.process(sorted(affected))
+
+
+def _named_ports(pod: Optional[Pod], name: str) -> List[int]:
+    out: List[int] = []
+    if pod is None:
+        return out
+    for container in pod.containers:
+        for port in container.ports:
+            if port.name == name:
+                out.append(port.container_port)
+    return out

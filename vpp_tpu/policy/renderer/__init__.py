@@ -1,0 +1,3 @@
+from .api import Action, ContivRule, PolicyRendererAPI, RendererTxn
+
+__all__ = ["Action", "ContivRule", "PolicyRendererAPI", "RendererTxn"]

@@ -1,0 +1,162 @@
+"""Policy renderer boundary — the ContivRule n-tuple.
+
+Analog of the reference's ``plugins/policy/renderer/api.go``: the most
+basic rule definition the destination network stack must support, plus
+the renderer plug-in interface.  This is the seam where the TPU data
+plane plugs into the policy stack (BASELINE.json north star).
+
+Networks are represented as ``ipaddress.IPv4Network`` or ``None``
+(match all) — the reference uses a zero-length IPNet for match-all.
+A total order is defined on rules (api.go Compare :110): if rule A
+matches a subset of rule B's traffic then A sorts before B, which
+permits first-match table layouts.
+"""
+
+from __future__ import annotations
+
+import enum
+import ipaddress
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+from ...models import PodID, ProtocolType
+
+# Sentinels documenting intent at call sites.
+RULE_MATCH_ALL_SRC: Optional[ipaddress.IPv4Network] = None
+RULE_MATCH_ALL_DST: Optional[ipaddress.IPv4Network] = None
+
+
+class Action(enum.IntEnum):
+    """DENY sorts before PERMIT, completing the rule total order
+    (api.go ActionType)."""
+
+    DENY = 0
+    PERMIT = 1
+    # PERMIT with connection tracking: reply traffic of permitted flows
+    # is allowed back through (the ACL renderer's reflective semantics,
+    # acl_renderer.go reflectiveACL :253).
+    PERMIT_REFLECT = 2
+
+
+@dataclass(frozen=True)
+class ContivRule:
+    """A 6-tuple policy rule (api.go ContivRule :65-77)."""
+
+    action: Action
+    src_network: Optional[ipaddress.IPv4Network] = None  # None = match all
+    dst_network: Optional[ipaddress.IPv4Network] = None  # None = match all
+    protocol: ProtocolType = ProtocolType.ANY
+    src_port: int = 0  # 0 = match all
+    dst_port: int = 0  # 0 = match all
+
+    def matches(
+        self,
+        src_ip: ipaddress.IPv4Address,
+        dst_ip: ipaddress.IPv4Address,
+        protocol: ProtocolType,
+        src_port: int,
+        dst_port: int,
+    ) -> bool:
+        """Reference-semantics match of one flow against this rule."""
+        if self.src_network is not None and src_ip not in self.src_network:
+            return False
+        if self.dst_network is not None and dst_ip not in self.dst_network:
+            return False
+        if self.protocol is not ProtocolType.ANY:
+            if self.protocol is not protocol:
+                return False
+            if self.src_port != 0 and self.src_port != src_port:
+                return False
+            if self.dst_port != 0 and self.dst_port != dst_port:
+                return False
+        return True
+
+    # ------------------------------------------------------------- ordering
+
+    def sort_key(self):
+        """Total order (api.go Compare :110): more-specific rules first.
+
+        Networks compare by (larger prefix first, then address); ports by
+        (non-zero first, then number); protocol by enum value with ANY
+        last; ports are ignored for protocol ANY.
+        """
+        def net_key(net: Optional[ipaddress.IPv4Network]):
+            if net is None:
+                return (1, 0, 0)  # match-all sorts after any concrete net
+            return (0, -net.prefixlen, int(net.network_address))
+
+        def port_key(port: int):
+            return (1, 0) if port == 0 else (0, port)
+
+        proto_rank = {
+            ProtocolType.TCP: 0,
+            ProtocolType.UDP: 1,
+            ProtocolType.OTHER: 2,
+            ProtocolType.ANY: 3,
+        }[self.protocol]
+        if self.protocol is ProtocolType.ANY:
+            ports = ((0, 0), (0, 0))
+        else:
+            ports = (port_key(self.src_port), port_key(self.dst_port))
+        return (
+            net_key(self.src_network),
+            net_key(self.dst_network),
+            proto_rank,
+            ports,
+            int(self.action),
+        )
+
+    def __str__(self) -> str:
+        src = str(self.src_network) if self.src_network else "ANY"
+        dst = str(self.dst_network) if self.dst_network else "ANY"
+        sp = self.src_port or "ANY"
+        dp = self.dst_port or "ANY"
+        return (
+            f"Rule <{self.action.name} {src}[{self.protocol.name}:{sp}] -> "
+            f"{dst}[{self.protocol.name}:{dp}]>"
+        )
+
+
+def insert_rule(rules: List[ContivRule], rule: ContivRule) -> bool:
+    """De-duplicating insert, preserving insertion order.
+
+    The reference keeps two lists (sorted for dedup, insertion-ordered
+    for rendering — configurator ContivRules.Insert/CopySlice); since
+    all generated rules are PERMITs followed by one final DENY, the
+    insertion order is the order renderers must evaluate in.
+    """
+    if rule in rules:
+        return False
+    rules.append(rule)
+    return True
+
+
+class RendererTxn:
+    """One transaction of a policy renderer (api.go Txn)."""
+
+    def render(
+        self,
+        pod: PodID,
+        pod_ip: Optional[ipaddress.IPv4Network],
+        ingress: Sequence[ContivRule],
+        egress: Sequence[ContivRule],
+        removed: bool = False,
+    ) -> "RendererTxn":
+        """Replace the rules of one pod.
+
+        Direction is from the vswitch point of view: *ingress* rules
+        filter traffic the pod sends (src unset = match all), *egress*
+        rules filter traffic delivered to the pod (dst unset).
+        An empty rule list allows all traffic in that direction.
+        """
+        raise NotImplementedError
+
+    def commit(self) -> None:
+        raise NotImplementedError
+
+
+class PolicyRendererAPI:
+    """Renderer plug-in interface (api.go PolicyRendererAPI)."""
+
+    def new_txn(self, resync: bool) -> RendererTxn:
+        raise NotImplementedError

@@ -72,6 +72,12 @@ class Applicator:
 
     prefix: str = ""
 
+    # Whether a *failed* update() may have destroyed the old incarnation.
+    # True for the default delete+create implementation; subclasses with an
+    # atomic in-place update() should set this False so the scheduler keeps
+    # tracking (and eventually deletes) the still-programmed old value.
+    update_destroys_on_failure: bool = True
+
     def create(self, key: str, value: Any) -> None:
         raise NotImplementedError
 
@@ -230,10 +236,10 @@ class TxnScheduler(TxnSink):
             rec.last_error = ""
         except Exception as e:  # noqa: BLE001 - backend errors become state
             log.warning("apply of %s failed: %s", key, e)
-            if rec.applied is not None:
-                # A failed update may have destroyed the old incarnation
-                # (default update = delete+create); assume it is gone so the
-                # retry re-creates instead of re-deleting a missing value.
+            if rec.applied is not None and applicator.update_destroys_on_failure:
+                # The failed update destroyed the old incarnation (default
+                # update = delete+create): forget it so the retry re-creates
+                # instead of re-deleting a missing value.
                 rec.applied = None
             rec.state = ValueState.FAILED
             rec.last_error = str(e)
@@ -338,6 +344,11 @@ class TxnScheduler(TxnSink):
         with self._lock:
             for key, rec in list(self._values.items()):
                 if rec.desired is None:
+                    # An unfinished removal: retry the backend delete.
+                    if rec.applied is not None:
+                        self._unapply(key, rec)
+                        if rec.applied is None:
+                            self._values.pop(key, None)
                     continue
                 if rec.state is ValueState.FAILED:
                     # Replay is the recovery point for values that exhausted
@@ -354,7 +365,8 @@ class TxnScheduler(TxnSink):
                     applicator.update(key, rec.applied, rec.desired)
                     rec.applied = rec.desired
                 except Exception as e:  # noqa: BLE001
-                    rec.applied = None
+                    if applicator.update_destroys_on_failure:
+                        rec.applied = None
                     rec.state = ValueState.FAILED
                     rec.last_error = str(e)
                     self._schedule_retry_for(key)

@@ -1,0 +1,12 @@
+"""Test harness engines — semantic oracles for the TPU data plane.
+
+Analog of the reference's ``mock/`` tree (SURVEY.md §4.2): simulated
+data-plane engines that consume *rendered* config and evaluate
+connections, so policy/service correctness is verified end-to-end
+without real hardware — and, here, they double as the ground truth the
+TPU kernels are verified against bit-for-bit.
+"""
+
+from .aclengine import MockACLEngine, OracleRenderer, Verdict
+
+__all__ = ["MockACLEngine", "OracleRenderer", "Verdict"]

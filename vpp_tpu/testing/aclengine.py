@@ -1,0 +1,188 @@
+"""Mock ACL engine — the policy-verdict oracle.
+
+Analog of ``mock/aclengine/aclengine_mock.go``: consumes the rule
+tables produced by the policy stack (through OracleRenderer, which
+implements the PolicyRendererAPI boundary) and evaluates simulated
+connections:
+
+- a connection pod->pod must pass the source pod's *ingress* table
+  (traffic entering the vswitch from the pod) and the destination
+  pod's *egress* table (traffic leaving the vswitch into the pod) —
+  both on this or different nodes (ConnectionPodToPod :273);
+- empty table = allow all in that direction (renderer/api.go Render doc);
+- first matching rule decides (VPP ACL first-match semantics);
+- reply traffic of a permitted connection is implicitly allowed
+  (reflective-ACL semantics, acl_renderer.go reflectiveACL :253) —
+  evaluation here is therefore for the *initiating* direction only.
+
+This oracle defines the exact per-packet semantics the TPU classify
+kernel must reproduce bit-for-bit on randomized connections.
+"""
+
+from __future__ import annotations
+
+import enum
+import ipaddress
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from ..models import PodID, ProtocolType
+from ..policy.renderer.api import Action, ContivRule, PolicyRendererAPI, RendererTxn
+
+
+class Verdict(enum.Enum):
+    ALLOWED = "allowed"
+    DENIED = "denied"
+
+
+@dataclass
+class PodTables:
+    """Rendered rule tables of one pod."""
+
+    pod_ip: Optional[ipaddress.IPv4Network]
+    ingress: List[ContivRule] = field(default_factory=list)  # pod -> vswitch
+    egress: List[ContivRule] = field(default_factory=list)   # vswitch -> pod
+
+
+def evaluate_table(
+    rules: Sequence[ContivRule],
+    src_ip: ipaddress.IPv4Address,
+    dst_ip: ipaddress.IPv4Address,
+    protocol: ProtocolType,
+    src_port: int,
+    dst_port: int,
+) -> Verdict:
+    """First-match evaluation; empty table allows everything."""
+    for rule in rules:
+        if rule.matches(src_ip, dst_ip, protocol, src_port, dst_port):
+            if rule.action is Action.DENY:
+                return Verdict.DENIED
+            return Verdict.ALLOWED
+    return Verdict.ALLOWED if not rules else Verdict.DENIED
+
+
+class MockACLEngine(PolicyRendererAPI):
+    """The engine; also a policy renderer (plug it into the configurator)."""
+
+    def __init__(self):
+        self.tables: Dict[PodID, PodTables] = {}
+        # pod registry: IP + locality (RegisterPod :144 anotherNode flag).
+        self._pod_ips: Dict[PodID, ipaddress.IPv4Address] = {}
+        self._local: Dict[PodID, bool] = {}
+
+    # ----------------------------------------------------------- pod registry
+
+    def register_pod(self, pod_id: PodID, ip: str, another_node: bool = False) -> None:
+        self._pod_ips[pod_id] = ipaddress.ip_address(ip)
+        self._local[pod_id] = not another_node
+
+    # -------------------------------------------------------------- renderer
+
+    def new_txn(self, resync: bool) -> "OracleTxn":
+        return OracleTxn(self, resync)
+
+    # ------------------------------------------------------------ connections
+
+    def connection_pod_to_pod(
+        self,
+        src: PodID,
+        dst: PodID,
+        protocol: ProtocolType = ProtocolType.TCP,
+        src_port: int = 12345,
+        dst_port: int = 80,
+    ) -> Verdict:
+        """Evaluate a connection attempt between two registered pods
+        (aclengine_mock.go ConnectionPodToPod :273)."""
+        src_ip = self._pod_ips[src]
+        dst_ip = self._pod_ips[dst]
+        return self._test_connection(src, src_ip, dst, dst_ip, protocol, src_port, dst_port)
+
+    def connection_pod_to_internet(
+        self,
+        src: PodID,
+        dst_ip: str,
+        protocol: ProtocolType = ProtocolType.TCP,
+        src_port: int = 12345,
+        dst_port: int = 80,
+    ) -> Verdict:
+        """Pod-initiated connection to an external IP
+        (ConnectionPodToInternet :334): only the source side filters."""
+        return self._test_connection(
+            src, self._pod_ips[src], None, ipaddress.ip_address(dst_ip),
+            protocol, src_port, dst_port,
+        )
+
+    def connection_internet_to_pod(
+        self,
+        src_ip: str,
+        dst: PodID,
+        protocol: ProtocolType = ProtocolType.TCP,
+        src_port: int = 12345,
+        dst_port: int = 80,
+    ) -> Verdict:
+        """External connection to a pod (ConnectionInternetToPod :379):
+        only the destination side filters."""
+        return self._test_connection(
+            None, ipaddress.ip_address(src_ip), dst, self._pod_ips[dst],
+            protocol, src_port, dst_port,
+        )
+
+    def _test_connection(
+        self,
+        src: Optional[PodID],
+        src_ip: ipaddress.IPv4Address,
+        dst: Optional[PodID],
+        dst_ip: ipaddress.IPv4Address,
+        protocol: ProtocolType,
+        src_port: int,
+        dst_port: int,
+    ) -> Verdict:
+        # Source side: the pod's ingress table filters what it may send
+        # — applied on the node hosting the source pod.
+        if src is not None and self._local.get(src, False):
+            tables = self.tables.get(src)
+            if tables is not None:
+                verdict = evaluate_table(
+                    tables.ingress, src_ip, dst_ip, protocol, src_port, dst_port
+                )
+                if verdict is Verdict.DENIED:
+                    return Verdict.DENIED
+        # Destination side: the pod's egress table filters what reaches it.
+        if dst is not None and self._local.get(dst, False):
+            tables = self.tables.get(dst)
+            if tables is not None:
+                verdict = evaluate_table(
+                    tables.egress, src_ip, dst_ip, protocol, src_port, dst_port
+                )
+                if verdict is Verdict.DENIED:
+                    return Verdict.DENIED
+        return Verdict.ALLOWED
+
+
+# Alias making the renderer role explicit at wiring sites.
+OracleRenderer = MockACLEngine
+
+
+class OracleTxn(RendererTxn):
+    def __init__(self, engine: MockACLEngine, resync: bool):
+        self.engine = engine
+        self.resync = resync
+        self._changes: Dict[PodID, Optional[PodTables]] = {}
+
+    def render(self, pod, pod_ip, ingress, egress, removed=False):
+        if removed:
+            self._changes[pod] = None
+        else:
+            self._changes[pod] = PodTables(
+                pod_ip=pod_ip, ingress=list(ingress), egress=list(egress)
+            )
+        return self
+
+    def commit(self) -> None:
+        if self.resync:
+            self.engine.tables.clear()
+        for pod, tables in self._changes.items():
+            if tables is None:
+                self.engine.tables.pop(pod, None)
+            else:
+                self.engine.tables[pod] = tables
