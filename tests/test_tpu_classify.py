@@ -1,0 +1,269 @@
+"""TPU classify kernel — bit-for-bit parity against the ACL oracle.
+
+The acceptance suite of SURVEY.md §7.2 stage 6: the same policy state
+is rendered simultaneously into the mock ACL engine (ground truth) and
+the TPU renderer (rule tensors); randomized connections must yield
+identical verdicts from ``classify`` and the oracle.
+"""
+
+import ipaddress
+import random
+
+import numpy as np
+import pytest
+
+from vpp_tpu.models import (
+    Container,
+    ContainerPort,
+    EgressRule,
+    IngressRule,
+    IPBlock,
+    LabelSelector,
+    Namespace,
+    Peer,
+    Pod,
+    PodID,
+    Policy,
+    PolicyPort,
+    PolicyType,
+    ProtocolType,
+    key_for,
+)
+from vpp_tpu.ops import classify, make_batch
+from vpp_tpu.ops.classify import classify_jit
+from vpp_tpu.policy import PolicyPlugin
+from vpp_tpu.policy.renderer.tpu import TpuPolicyRenderer
+from vpp_tpu.testing import MockACLEngine, Verdict
+
+
+def kube_state(*objs):
+    state = {"pod": {}, "policy": {}, "namespace": {}}
+    for obj in objs:
+        kind = {Pod: "pod", Policy: "policy", Namespace: "namespace"}[type(obj)]
+        state[kind][key_for(obj)] = obj
+    return state
+
+
+def build_both(*objs):
+    """Render the same state into oracle + TPU renderer."""
+    engine = MockACLEngine()
+    tpu = TpuPolicyRenderer()
+    plugin = PolicyPlugin()
+    plugin.register_renderer(engine)
+    plugin.register_renderer(tpu)
+    state = kube_state(*objs)
+    for pod in state["pod"].values():
+        engine.register_pod(pod.id, pod.ip_address)
+    plugin.resync(None, state, 1, None)
+    return engine, tpu
+
+
+def assert_parity(engine, tpu, flows):
+    """Every flow must get the same verdict from oracle and kernel."""
+    batch = make_batch([f[:5] for f in flows])
+    verdicts = classify(tpu.tables, batch)
+    allowed = np.asarray(verdicts.allowed)
+    for i, flow in enumerate(flows):
+        src_ip, dst_ip, proto, sport, dport = flow[:5]
+        src_pod, dst_pod = flow[5], flow[6]
+        if src_pod is not None and dst_pod is not None:
+            oracle = engine.connection_pod_to_pod(
+                src_pod, dst_pod, protocol=ProtocolType(proto), src_port=sport, dst_port=dport
+            )
+        elif src_pod is not None:
+            oracle = engine.connection_pod_to_internet(
+                src_pod, dst_ip, protocol=ProtocolType(proto), src_port=sport, dst_port=dport
+            )
+        elif dst_pod is not None:
+            oracle = engine.connection_internet_to_pod(
+                src_ip, dst_pod, protocol=ProtocolType(proto), src_port=sport, dst_port=dport
+            )
+        else:
+            oracle = Verdict.ALLOWED
+        expected = oracle is Verdict.ALLOWED
+        assert bool(allowed[i]) == expected, (
+            f"flow {i}: {src_ip}->{dst_ip} proto={proto} {sport}->{dport} "
+            f"oracle={oracle} tpu={'ALLOW' if allowed[i] else 'DENY'}"
+        )
+
+
+WEB = Pod(name="web", namespace="default", labels={"app": "web"}, ip_address="10.1.1.2")
+DB = Pod(name="db", namespace="default", labels={"app": "db"}, ip_address="10.1.1.3")
+CLIENT = Pod(name="client", namespace="default", labels={"role": "client"}, ip_address="10.1.1.4")
+
+
+def test_basic_scenario_parity():
+    policy = Policy(
+        name="web-allow-db-80",
+        namespace="default",
+        pods=LabelSelector(match_labels={"app": "web"}),
+        ingress_rules=(
+            IngressRule(
+                ports=(PolicyPort(protocol=ProtocolType.TCP, port=80),),
+                from_peers=(Peer(pods=LabelSelector(match_labels={"app": "db"})),),
+            ),
+        ),
+    )
+    engine, tpu = build_both(WEB, DB, CLIENT, policy)
+    flows = [
+        ("10.1.1.3", "10.1.1.2", 6, 40000, 80, DB.id, WEB.id),      # allowed
+        ("10.1.1.3", "10.1.1.2", 6, 40000, 443, DB.id, WEB.id),     # denied port
+        ("10.1.1.3", "10.1.1.2", 17, 40000, 80, DB.id, WEB.id),     # denied proto
+        ("10.1.1.4", "10.1.1.2", 6, 40000, 80, CLIENT.id, WEB.id),  # denied peer
+        ("10.1.1.2", "10.1.1.3", 6, 40000, 5432, WEB.id, DB.id),    # reverse ok
+        ("8.8.8.8", "10.1.1.2", 6, 40000, 80, None, WEB.id),        # inet denied
+        ("10.1.1.4", "8.8.8.8", 6, 40000, 80, CLIENT.id, None),     # egress ok
+    ]
+    assert_parity(engine, tpu, flows)
+
+
+def test_empty_state_allows_all():
+    engine, tpu = build_both(WEB, DB)
+    flows = [
+        ("10.1.1.2", "10.1.1.3", 6, 1, 2, WEB.id, DB.id),
+        ("1.1.1.1", "2.2.2.2", 17, 53, 53, None, None),
+    ]
+    assert_parity(engine, tpu, flows)
+
+
+def _random_selector(rng, labels_pool):
+    if rng.random() < 0.3:
+        return LabelSelector()  # match all
+    k, v = rng.choice(labels_pool)
+    return LabelSelector(match_labels={k: v})
+
+
+def _random_policy(rng, idx, labels_pool):
+    direction = rng.choice(["ingress", "egress", "both"])
+    ports = tuple(
+        PolicyPort(protocol=rng.choice([ProtocolType.TCP, ProtocolType.UDP]),
+                   port=int(rng.choice([80, 443, 8080, 53])))
+        for _ in range(rng.randrange(0, 3))
+    )
+    peers = []
+    r = rng.random()
+    if r < 0.4:
+        peers.append(Peer(pods=_random_selector(rng, labels_pool)))
+    elif r < 0.7:
+        base = f"10.{rng.randrange(1, 4)}.{rng.randrange(0, 4) * 64}.0/18"
+        net = ipaddress.ip_network(base, strict=False)
+        excepts = ()
+        if rng.random() < 0.5:
+            sub = list(net.subnets(prefixlen_diff=3))
+            excepts = (str(rng.choice(sub)),)
+        peers.append(Peer(ip_block=IPBlock(cidr=str(net), except_cidrs=excepts)))
+    # else: no peers = unrestricted
+
+    ingress = (IngressRule(ports=ports, from_peers=tuple(peers)),) if direction in ("ingress", "both") else ()
+    egress = (EgressRule(ports=ports, to_peers=tuple(peers)),) if direction in ("egress", "both") else ()
+    return Policy(
+        name=f"p{idx}",
+        namespace="default",
+        pods=_random_selector(rng, labels_pool),
+        policy_type=PolicyType.DEFAULT if direction != "egress" else PolicyType.EGRESS,
+        ingress_rules=ingress,
+        egress_rules=egress,
+    )
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+def test_randomized_parity(seed):
+    rng = random.Random(seed)
+    labels_pool = [("app", "web"), ("app", "db"), ("role", "client"), ("tier", "backend")]
+    pods = []
+    for i in range(8):
+        labels = dict(rng.sample(labels_pool, rng.randrange(1, 3)))
+        pods.append(
+            Pod(
+                name=f"pod{i}",
+                namespace="default",
+                labels=labels,
+                ip_address=f"10.1.1.{i + 2}",
+            )
+        )
+    policies = [_random_policy(rng, i, labels_pool) for i in range(6)]
+    engine, tpu = build_both(*(pods + policies))
+
+    pod_by_ip = {p.ip_address: p.id for p in pods}
+    flows = []
+    nprng = np.random.default_rng(seed)
+    for _ in range(512):
+        def pick_ip():
+            r = nprng.random()
+            if r < 0.6:
+                return rng.choice(pods).ip_address
+            if r < 0.8:
+                return f"10.{nprng.integers(1, 4)}.{nprng.integers(0, 256)}.{nprng.integers(1, 255)}"
+            return f"{nprng.integers(1, 223)}.{nprng.integers(0, 256)}.{nprng.integers(0, 256)}.{nprng.integers(1, 255)}"
+
+        src, dst = pick_ip(), pick_ip()
+        proto = int(nprng.choice([6, 17]))
+        sport = int(nprng.integers(1, 65536))
+        dport = int(nprng.choice([80, 443, 8080, 53, 22, int(nprng.integers(1, 65536))]))
+        flows.append((src, dst, proto, sport, dport, pod_by_ip.get(src), pod_by_ip.get(dst)))
+
+    assert_parity(engine, tpu, flows)
+
+
+def test_table_sharing():
+    """Pods with identical policy sets share one compiled table."""
+    pods = [
+        Pod(name=f"w{i}", namespace="default", labels={"app": "web"}, ip_address=f"10.1.1.{i+2}")
+        for i in range(5)
+    ]
+    policy = Policy(
+        name="deny-all",
+        namespace="default",
+        pods=LabelSelector(match_labels={"app": "web"}),
+        policy_type=PolicyType.INGRESS,
+    )
+    _, tpu = build_both(*(pods + [policy]))
+    stats = tpu.stats()
+    assert stats["pods"] == 5
+    # All 5 share the same egress (deny) table; no ingress tables.
+    assert stats["tables"] == 1
+
+
+def test_incremental_update_swaps_tables():
+    engine, tpu = build_both(WEB, DB)
+    assert tpu.tables.num_tables == 0
+    plugin = PolicyPlugin()
+    plugin.register_renderer(engine)
+    plugin.register_renderer(tpu)
+    plugin.resync(None, kube_state(WEB, DB), 1, None)
+
+    policy = Policy(
+        name="lockdown",
+        namespace="default",
+        pods=LabelSelector(match_labels={"app": "web"}),
+        policy_type=PolicyType.INGRESS,
+    )
+    plugin.cache.update_policy(policy)
+    plugin.processor.on_policy_change(None, policy)
+    flows = [("10.1.1.3", "10.1.1.2", 6, 1000, 80, DB.id, WEB.id)]
+    engine.register_pod(WEB.id, WEB.ip_address)
+    engine.register_pod(DB.id, DB.ip_address)
+    assert_parity(engine, tpu, flows)
+    batch = make_batch([f[:5] for f in flows])
+    assert not bool(np.asarray(classify(tpu.tables, batch).allowed)[0])
+
+
+def test_jit_compiles_and_matches_eager():
+    policy = Policy(
+        name="web-allow-db",
+        namespace="default",
+        pods=LabelSelector(match_labels={"app": "web"}),
+        ingress_rules=(
+            IngressRule(from_peers=(Peer(pods=LabelSelector(match_labels={"app": "db"})),),),
+        ),
+    )
+    _, tpu = build_both(WEB, DB, CLIENT, policy)
+    flows = [
+        ("10.1.1.3", "10.1.1.2", 6, 1, 80),
+        ("10.1.1.4", "10.1.1.2", 6, 1, 80),
+    ] * 128
+    batch = make_batch(flows, pad_to=256)
+    eager = classify(tpu.tables, batch)
+    jitted = classify_jit(tpu.tables, batch)
+    np.testing.assert_array_equal(np.asarray(eager.allowed), np.asarray(jitted.allowed))
+    assert batch.size == 256

@@ -1,0 +1,33 @@
+"""TPU data-plane kernels.
+
+The per-packet hot path of the framework: where the reference runs VPP
+graph nodes in C over 256-packet vectors (SURVEY.md §3.5), this package
+runs jit-compiled JAX ops over packet-header batches on TPU:
+
+- ``packets``   packet-header batch representation (struct of arrays)
+- ``classify``  ACL rule-table compilation + first-match classify
+- ``nat``       NAT44 DNAT/SNAT map compilation + rewrite
+- ``pipeline``  the combined ingress-ACL -> DNAT -> routing-tag ->
+                SNAT -> egress-ACL step (SERVICES.md:300-307 ordering)
+
+Everything is static-shape: rule tables and NAT maps are padded to
+power-of-two buckets so XLA compiles one program per bucket size, and
+table *content* updates are pure device-array swaps with no recompile
+(the kvscheduler update-vs-resync split mapped onto XLA's compilation
+model).
+"""
+
+from .packets import PacketBatch, ip_to_u32, u32_to_ip, make_batch, random_batch
+from .classify import RuleTables, build_rule_tables, classify, Verdicts
+
+__all__ = [
+    "PacketBatch",
+    "ip_to_u32",
+    "u32_to_ip",
+    "make_batch",
+    "random_batch",
+    "RuleTables",
+    "build_rule_tables",
+    "classify",
+    "Verdicts",
+]

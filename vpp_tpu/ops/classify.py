@@ -1,0 +1,249 @@
+"""ACL classify — rule-table compilation and first-match evaluation.
+
+The TPU replacement for VPP's ``acl-plugin-in/out-ip4-fa`` graph nodes
+(SURVEY.md §2.3): ContivRule tables compile into padded
+struct-of-arrays tensors, and a jit-compiled kernel evaluates a packet
+batch against *all* rules at once — a [B, N] predicate matrix — then
+reduces to the first matching rule per (packet, side-table) with an
+argmax.  Linear-priority first-match becomes a data-parallel reduction
+instead of VPP's per-packet loop.
+
+Semantics are pinned to the oracle (vpp_tpu/testing/aclengine.py,
+itself pinned to mock/aclengine/aclengine_mock.go): a packet must pass
+the *ingress* table of its source pod (what the pod may send) and the
+*egress* table of its destination pod (what may reach it); a pod
+without tables (or non-pod traffic) passes by default; an empty table
+allows everything (compiled as one synthetic permit-all rule); in a
+non-empty table the first match decides and no-match denies.
+
+Static-shape discipline: the rule tensor is padded to the next
+power-of-two bucket.  Table-content changes swap device arrays without
+recompiling; only a bucket-size change triggers a new XLA compile.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from dataclasses import dataclass
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import PodID
+from ..policy.renderer.api import Action, ContivRule
+from .packets import PacketBatch, ip_to_u32
+
+# Action encoding in the tensor.
+_DENY = 0
+_PERMIT = 1
+_PERMIT_REFLECT = 2
+
+# Table-id sentinel: "no table attached" -> side passes by default.
+NO_TABLE = -1
+
+
+@dataclass
+class RuleTables:
+    """Compiled rule state for one node's data plane.
+
+    ``rules_*`` hold every table's rules concatenated ([N], padded);
+    ``rule_tid`` maps each rule row to its table; ``pod_*`` map pod IPs
+    to their (ingress, egress) table ids.  All jnp arrays — ready to be
+    donated to the classify kernel.
+    """
+
+    # Rules (concatenated over all tables, padded to a pow2 bucket).
+    rule_valid: jnp.ndarray     # bool  [N]
+    rule_tid: jnp.ndarray       # int32 [N]
+    rule_src_base: jnp.ndarray  # uint32 [N]
+    rule_src_mask: jnp.ndarray  # uint32 [N]
+    rule_dst_base: jnp.ndarray  # uint32 [N]
+    rule_dst_mask: jnp.ndarray  # uint32 [N]
+    rule_proto: jnp.ndarray     # int32 [N] (0 = ANY)
+    rule_src_port: jnp.ndarray  # int32 [N] (0 = any)
+    rule_dst_port: jnp.ndarray  # int32 [N] (0 = any)
+    rule_action: jnp.ndarray    # int32 [N]
+
+    # Pod IP -> table ids ([P], padded with unmatchable IPs).
+    pod_ip: jnp.ndarray          # uint32 [P]
+    pod_ingress_tid: jnp.ndarray  # int32 [P]
+    pod_egress_tid: jnp.ndarray   # int32 [P]
+
+    num_rules: int = 0
+    num_tables: int = 0
+    num_pods: int = 0
+
+    def tree_flatten(self):
+        children = (
+            self.rule_valid, self.rule_tid,
+            self.rule_src_base, self.rule_src_mask,
+            self.rule_dst_base, self.rule_dst_mask,
+            self.rule_proto, self.rule_src_port, self.rule_dst_port,
+            self.rule_action,
+            self.pod_ip, self.pod_ingress_tid, self.pod_egress_tid,
+        )
+        aux = (self.num_rules, self.num_tables, self.num_pods)
+        return children, aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, num_rules=aux[0], num_tables=aux[1], num_pods=aux[2])
+
+
+jax.tree_util.register_pytree_node(
+    RuleTables, RuleTables.tree_flatten, RuleTables.tree_unflatten
+)
+
+
+def _prefix_mask(net: Optional[ipaddress.IPv4Network]) -> Tuple[int, int]:
+    """(base, mask) for a network; match-all -> (0, 0)."""
+    if net is None:
+        return 0, 0
+    mask = (0xFFFFFFFF << (32 - net.prefixlen)) & 0xFFFFFFFF if net.prefixlen else 0
+    return int(net.network_address) & mask, mask
+
+
+_PERMIT_ALL = ContivRule(action=Action.PERMIT)
+
+
+def _next_pow2(n: int, minimum: int = 8) -> int:
+    size = minimum
+    while size < n:
+        size *= 2
+    return size
+
+
+def build_rule_tables(
+    tables: Sequence[Sequence[ContivRule]],
+    pod_assignments: Dict[int, Tuple[int, int]],
+    bucket_min: int = 8,
+) -> RuleTables:
+    """Compile rule tables + pod assignments to tensors.
+
+    ``tables[t]`` is the ordered rule list of table id ``t`` (empty
+    tables become one permit-all rule so that the uniform
+    "no-match = deny" kernel rule preserves allow-by-default).
+    ``pod_assignments`` maps pod IP (u32) -> (ingress_tid, egress_tid),
+    either of which may be NO_TABLE.
+    """
+    rows: List[Tuple] = []
+    for tid, table in enumerate(tables):
+        rules = list(table) if table else [_PERMIT_ALL]
+        for rule in rules:
+            src_base, src_mask = _prefix_mask(rule.src_network)
+            dst_base, dst_mask = _prefix_mask(rule.dst_network)
+            action = {
+                Action.DENY: _DENY,
+                Action.PERMIT: _PERMIT,
+                Action.PERMIT_REFLECT: _PERMIT_REFLECT,
+            }[rule.action]
+            rows.append(
+                (
+                    tid, src_base, src_mask, dst_base, dst_mask,
+                    int(rule.protocol), rule.src_port, rule.dst_port, action,
+                )
+            )
+
+    n = len(rows)
+    padded = _next_pow2(max(n, 1), bucket_min)
+    arr = np.zeros((padded, 9), dtype=np.int64)
+    if rows:
+        arr[:n] = np.asarray(rows, dtype=np.int64)
+    valid = np.zeros(padded, dtype=bool)
+    valid[:n] = True
+
+    pods = sorted(pod_assignments.items())
+    p = len(pods)
+    p_padded = _next_pow2(max(p, 1), bucket_min)
+    pod_ip = np.zeros(p_padded, dtype=np.uint32)
+    pod_in = np.full(p_padded, NO_TABLE, dtype=np.int32)
+    pod_eg = np.full(p_padded, NO_TABLE, dtype=np.int32)
+    for i, (ip, (in_tid, eg_tid)) in enumerate(pods):
+        pod_ip[i] = ip
+        pod_in[i] = in_tid
+        pod_eg[i] = eg_tid
+    # Padding entries keep ip 0 with NO_TABLE: harmless because lookups of
+    # 0.0.0.0 resolve to NO_TABLE anyway.
+
+    return RuleTables(
+        rule_valid=jnp.asarray(valid),
+        rule_tid=jnp.asarray(arr[:, 0].astype(np.int32)),
+        rule_src_base=jnp.asarray(arr[:, 1].astype(np.uint32)),
+        rule_src_mask=jnp.asarray(arr[:, 2].astype(np.uint32)),
+        rule_dst_base=jnp.asarray(arr[:, 3].astype(np.uint32)),
+        rule_dst_mask=jnp.asarray(arr[:, 4].astype(np.uint32)),
+        rule_proto=jnp.asarray(arr[:, 5].astype(np.int32)),
+        rule_src_port=jnp.asarray(arr[:, 6].astype(np.int32)),
+        rule_dst_port=jnp.asarray(arr[:, 7].astype(np.int32)),
+        rule_action=jnp.asarray(arr[:, 8].astype(np.int32)),
+        pod_ip=jnp.asarray(pod_ip),
+        pod_ingress_tid=jnp.asarray(pod_in),
+        pod_egress_tid=jnp.asarray(pod_eg),
+        num_rules=n,
+        num_tables=len(tables),
+        num_pods=p,
+    )
+
+
+class Verdicts(NamedTuple):
+    """Classify output for a batch."""
+
+    allowed: jnp.ndarray       # bool [B] - passed both sides
+    src_action: jnp.ndarray    # int32 [B] - action on the source side
+    dst_action: jnp.ndarray    # int32 [B] - action on the destination side
+
+
+def _lookup_tid(ip: jnp.ndarray, pod_ip: jnp.ndarray, tid: jnp.ndarray) -> jnp.ndarray:
+    """Per-packet pod-table lookup: [B] x [P] -> [B] table ids
+    (NO_TABLE when the IP is not a local pod)."""
+    hit = ip[:, None] == pod_ip[None, :]           # [B, P]
+    found = jnp.any(hit, axis=1)
+    idx = jnp.argmax(hit, axis=1)
+    return jnp.where(found, tid[idx], NO_TABLE)
+
+
+def _first_match_action(
+    match: jnp.ndarray, rule_tid: jnp.ndarray, rule_action: jnp.ndarray, side_tid: jnp.ndarray
+) -> jnp.ndarray:
+    """First matching rule's action within the packet's side table;
+    DENY when nothing matches; PERMIT when the side has no table."""
+    in_table = match & (rule_tid[None, :] == side_tid[:, None])   # [B, N]
+    has = jnp.any(in_table, axis=1)
+    first = jnp.argmax(in_table, axis=1)
+    action = jnp.where(has, rule_action[first], _DENY)
+    return jnp.where(side_tid == NO_TABLE, _PERMIT, action)
+
+
+def classify(tables: RuleTables, batch: PacketBatch) -> Verdicts:
+    """The ACL stage. jit-compatible; [B] batch vs [N] rules.
+
+    One [B, N] predicate matrix covers all tables; per-side table
+    selection and first-match reduce on top of it.
+    """
+    # Field predicates ([B, N]).
+    src_ok = (batch.src_ip[:, None] & tables.rule_src_mask[None, :]) == tables.rule_src_base[None, :]
+    dst_ok = (batch.dst_ip[:, None] & tables.rule_dst_mask[None, :]) == tables.rule_dst_base[None, :]
+    proto_any = tables.rule_proto[None, :] == 0
+    proto_ok = batch.protocol[:, None] == tables.rule_proto[None, :]
+    sport_ok = (tables.rule_src_port[None, :] == 0) | (
+        batch.src_port[:, None] == tables.rule_src_port[None, :]
+    )
+    dport_ok = (tables.rule_dst_port[None, :] == 0) | (
+        batch.dst_port[:, None] == tables.rule_dst_port[None, :]
+    )
+    l4_ok = proto_any | (proto_ok & sport_ok & dport_ok)
+    match = tables.rule_valid[None, :] & src_ok & dst_ok & l4_ok
+
+    # Side-table resolution per packet.
+    src_tid = _lookup_tid(batch.src_ip, tables.pod_ip, tables.pod_ingress_tid)
+    dst_tid = _lookup_tid(batch.dst_ip, tables.pod_ip, tables.pod_egress_tid)
+
+    src_action = _first_match_action(match, tables.rule_tid, tables.rule_action, src_tid)
+    dst_action = _first_match_action(match, tables.rule_tid, tables.rule_action, dst_tid)
+    allowed = (src_action != _DENY) & (dst_action != _DENY)
+    return Verdicts(allowed=allowed, src_action=src_action, dst_action=dst_action)
+
+
+classify_jit = jax.jit(classify)

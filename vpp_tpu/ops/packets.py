@@ -1,0 +1,111 @@
+"""Packet-header batches — the data-plane unit of work.
+
+The analog of VPP's up-to-256-packet vectors (SURVEY.md §3.5): the host
+shim parses headers off the wire and ships them as a struct-of-arrays
+batch; the TPU pipeline classifies/rewrites the batch and the shim
+applies the verdicts to the buffered payloads.  Only the 5-tuple +
+bookkeeping fields travel to the device — payloads never do.
+
+All arrays share one leading batch dimension.  uint32 IPs, int32
+ports/protocols (TPU-native lane types).
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def ip_to_u32(ip: Union[str, ipaddress.IPv4Address, int]) -> int:
+    if isinstance(ip, int):
+        return ip
+    return int(ipaddress.ip_address(ip))
+
+
+def u32_to_ip(value: int) -> str:
+    return str(ipaddress.ip_address(int(value) & 0xFFFFFFFF))
+
+
+@dataclass
+class PacketBatch:
+    """One batch of packet headers (device or host arrays).
+
+    Registered as a JAX pytree so it can flow through jit directly.
+    """
+
+    src_ip: jnp.ndarray    # uint32 [B]
+    dst_ip: jnp.ndarray    # uint32 [B]
+    protocol: jnp.ndarray  # int32  [B] (IANA numbers; 6 TCP / 17 UDP)
+    src_port: jnp.ndarray  # int32  [B]
+    dst_port: jnp.ndarray  # int32  [B]
+
+    @property
+    def size(self) -> int:
+        return self.src_ip.shape[-1]
+
+    def tree_flatten(self):
+        return (
+            (self.src_ip, self.dst_ip, self.protocol, self.src_port, self.dst_port),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+import jax.tree_util  # noqa: E402
+
+jax.tree_util.register_pytree_node(
+    PacketBatch, PacketBatch.tree_flatten, PacketBatch.tree_unflatten
+)
+
+
+def make_batch(
+    flows: Sequence[Tuple],
+    pad_to: Optional[int] = None,
+) -> PacketBatch:
+    """Build a batch from (src_ip, dst_ip, protocol, src_port, dst_port)
+    tuples; pads by repeating the last flow to reach ``pad_to``."""
+    if not flows:
+        raise ValueError("empty batch")
+    rows = list(flows)
+    if pad_to is not None:
+        if len(rows) > pad_to:
+            raise ValueError(f"{len(rows)} flows exceed pad_to={pad_to}")
+        rows = rows + [rows[-1]] * (pad_to - len(rows))
+    src, dst, proto, sport, dport = zip(*rows)
+    return PacketBatch(
+        src_ip=jnp.asarray([ip_to_u32(s) for s in src], dtype=jnp.uint32),
+        dst_ip=jnp.asarray([ip_to_u32(d) for d in dst], dtype=jnp.uint32),
+        protocol=jnp.asarray([int(p) for p in proto], dtype=jnp.int32),
+        src_port=jnp.asarray([int(p) for p in sport], dtype=jnp.int32),
+        dst_port=jnp.asarray([int(p) for p in dport], dtype=jnp.int32),
+    )
+
+
+def random_batch(
+    rng: np.random.Generator,
+    size: int = 256,
+    subnets: Sequence[str] = ("10.1.0.0/16",),
+) -> PacketBatch:
+    """Random traffic for benchmarks/fuzzing, sourced from given subnets."""
+    nets = [ipaddress.ip_network(s) for s in subnets]
+    bases = np.array([int(n.network_address) for n in nets], dtype=np.uint64)
+    sizes = np.array([n.num_addresses for n in nets], dtype=np.uint64)
+    pick_src = rng.integers(0, len(nets), size)
+    pick_dst = rng.integers(0, len(nets), size)
+    src = bases[pick_src] + (rng.integers(0, 1 << 62, size) % sizes[pick_src])
+    dst = bases[pick_dst] + (rng.integers(0, 1 << 62, size) % sizes[pick_dst])
+    proto = np.where(rng.random(size) < 0.7, 6, 17).astype(np.int32)
+    return PacketBatch(
+        src_ip=jnp.asarray(src.astype(np.uint32)),
+        dst_ip=jnp.asarray(dst.astype(np.uint32)),
+        protocol=jnp.asarray(proto),
+        src_port=jnp.asarray(rng.integers(1, 65536, size).astype(np.int32)),
+        dst_port=jnp.asarray(rng.integers(1, 65536, size).astype(np.int32)),
+    )

@@ -247,8 +247,13 @@ class ConfiguratorTxn:
         cfg = self.configurator
         pod_ips = {} if self.resync else dict(cfg._pod_ips)
 
-        # Memoise rule generation per (sorted) policy set (Commit :146).
-        processed: Dict[Tuple[PolicyID, ...], Tuple[List[ContivRule], List[ContivRule]]] = {}
+        # Memoise rule generation per policy set (Commit :146).  The key is
+        # the full resolved-policy content, not just the IDs: named-port
+        # resolution makes matches per-pod, so pods only share generated
+        # rules when their resolved matches are truly identical (the
+        # reference keys on IDs only and hands every pod the first pod's
+        # rules — a named-port defect not worth inheriting).
+        processed: Dict[Tuple[ContivPolicy, ...], Tuple[List[ContivRule], List[ContivRule]]] = {}
 
         renderer_txns = [r.new_txn(self.resync) for r in cfg.renderers]
         for pod, policies in sorted(self._config.items()):
@@ -267,7 +272,7 @@ class ConfiguratorTxn:
                     log.warning("pod %s has invalid IP %r", pod, pod_data.ip_address)
                     continue
                 pod_ips[pod] = pod_ip
-                key = tuple(sorted(p.id for p in policies))
+                key = tuple(sorted(policies, key=lambda p: p.id))
                 if key in processed:
                     ingress, egress = processed[key]
                 else:

@@ -78,6 +78,11 @@ class PolicyProcessor:
                         ports.append((p.protocol, number))
                 else:
                     ports.append((p.protocol, int(p.port or 0)))
+            if rule.ports and not ports:
+                # The rule restricts ports but none resolved on this pod:
+                # it matches no traffic — emitting ports=() here would
+                # wrongly mean "all ports".
+                continue
             matches.append(
                 Match(type=MatchType.INGRESS, pods=peers, ip_blocks=blocks, ports=tuple(ports))
             )
@@ -89,9 +94,12 @@ class PolicyProcessor:
                 if isinstance(p.port, str):
                     # Named egress port: expands into one match per peer pod
                     # that defines it (matches_calculator.go :172-185).
-                    candidates = peers if peers else tuple(
-                        pod.id for pod in self.cache.all_pods()
-                    )
+                    # peers None = unrestricted -> resolve against all pods;
+                    # peers () = selector matched nothing -> no candidates.
+                    if peers is None:
+                        candidates = tuple(pod.id for pod in self.cache.all_pods())
+                    else:
+                        candidates = peers
                     for peer_id in candidates:
                         peer = self.cache.lookup_pod(peer_id)
                         for number in _named_ports(peer, p.port):
@@ -105,6 +113,10 @@ class PolicyProcessor:
                             )
                 else:
                     ports.append((p.protocol, int(p.port or 0)))
+            if rule.ports and not ports:
+                # All ports were named (already expanded per peer above, or
+                # unresolvable): the residual match would mean "all ports".
+                continue
             matches.append(
                 Match(type=MatchType.EGRESS, pods=peers, ip_blocks=blocks, ports=tuple(ports))
             )

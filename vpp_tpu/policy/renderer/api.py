@@ -71,41 +71,6 @@ class ContivRule:
                 return False
         return True
 
-    # ------------------------------------------------------------- ordering
-
-    def sort_key(self):
-        """Total order (api.go Compare :110): more-specific rules first.
-
-        Networks compare by (larger prefix first, then address); ports by
-        (non-zero first, then number); protocol by enum value with ANY
-        last; ports are ignored for protocol ANY.
-        """
-        def net_key(net: Optional[ipaddress.IPv4Network]):
-            if net is None:
-                return (1, 0, 0)  # match-all sorts after any concrete net
-            return (0, -net.prefixlen, int(net.network_address))
-
-        def port_key(port: int):
-            return (1, 0) if port == 0 else (0, port)
-
-        proto_rank = {
-            ProtocolType.TCP: 0,
-            ProtocolType.UDP: 1,
-            ProtocolType.OTHER: 2,
-            ProtocolType.ANY: 3,
-        }[self.protocol]
-        if self.protocol is ProtocolType.ANY:
-            ports = ((0, 0), (0, 0))
-        else:
-            ports = (port_key(self.src_port), port_key(self.dst_port))
-        return (
-            net_key(self.src_network),
-            net_key(self.dst_network),
-            proto_rank,
-            ports,
-            int(self.action),
-        )
-
     def __str__(self) -> str:
         src = str(self.src_network) if self.src_network else "ANY"
         dst = str(self.dst_network) if self.dst_network else "ANY"

@@ -1,0 +1,125 @@
+"""TPU policy renderer — compiles pod rule tables for the classify kernel.
+
+The 'tpu' renderer that plugs in behind the policy renderer boundary
+(the BASELINE.json north star: a renderer alongside the reference's acl
+and vpptcp renderers, plugins/policy/renderer/).  It maintains the
+per-pod ingress/egress rule lists rendered by the configurator,
+de-duplicates identical tables across pods (the reference ACL
+renderer's table sharing, docs/dev-guide/POLICIES.md:394-400 — pods
+with the same policy set share one table), and on every commit compiles
+the whole state into ``RuleTables`` tensors for the data plane.
+
+Commit cost model: content changes re-build host arrays and swap them
+onto the device; the classify program itself only recompiles when the
+pow2 rule-bucket size changes.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ...models import PodID
+from ...ops.classify import NO_TABLE, RuleTables, build_rule_tables
+from ...ops.packets import ip_to_u32
+from .api import ContivRule, PolicyRendererAPI, RendererTxn
+
+log = logging.getLogger(__name__)
+
+
+class TpuPolicyRenderer(PolicyRendererAPI):
+    """Keeps rendered pod tables; compiles tensors on commit."""
+
+    def __init__(self, on_compiled: Optional[Callable[[RuleTables], None]] = None):
+        # pod -> (pod_ip_u32, ingress rules, egress rules)
+        self._pods: Dict[PodID, Tuple[int, Tuple[ContivRule, ...], Tuple[ContivRule, ...]]] = {}
+        self._lock = threading.Lock()
+        self._compiled: Optional[RuleTables] = None
+        # Hook for the runtime: called with fresh tables after each commit.
+        self._on_compiled = on_compiled
+
+    # -------------------------------------------------------------- renderer
+
+    def new_txn(self, resync: bool) -> "TpuRendererTxn":
+        return TpuRendererTxn(self, resync)
+
+    # --------------------------------------------------------------- queries
+
+    @property
+    def tables(self) -> Optional[RuleTables]:
+        """The latest compiled tables (None until first commit)."""
+        with self._lock:
+            return self._compiled
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            compiled = self._compiled
+            return {
+                "pods": len(self._pods),
+                "tables": compiled.num_tables if compiled else 0,
+                "rules": compiled.num_rules if compiled else 0,
+            }
+
+    # ---------------------------------------------------------------- commit
+
+    def _apply(self, changes, resync: bool) -> None:
+        with self._lock:
+            if resync:
+                self._pods.clear()
+            for pod, entry in changes.items():
+                if entry is None:
+                    self._pods.pop(pod, None)
+                else:
+                    self._pods[pod] = entry
+            compiled = self._compile()
+            self._compiled = compiled
+        if self._on_compiled is not None:
+            # Deliver the tables compiled by THIS commit (re-reading
+            # self._compiled here could hand the hook a newer commit's
+            # tables out of order).
+            self._on_compiled(compiled)
+
+    def _compile(self) -> RuleTables:
+        # Table sharing: identical rule lists compile to one table id.
+        table_ids: Dict[Tuple[ContivRule, ...], int] = {}
+        tables: List[Tuple[ContivRule, ...]] = []
+
+        def intern(rules: Tuple[ContivRule, ...]) -> int:
+            if not rules:
+                return NO_TABLE  # no rules = allow: skip table entirely
+            tid = table_ids.get(rules)
+            if tid is None:
+                tid = len(tables)
+                table_ids[rules] = tid
+                tables.append(rules)
+            return tid
+
+        pod_assignments: Dict[int, Tuple[int, int]] = {}
+        for pod, (ip_u32, ingress, egress) in self._pods.items():
+            pod_assignments[ip_u32] = (intern(ingress), intern(egress))
+
+        compiled = build_rule_tables(tables, pod_assignments)
+        log.debug(
+            "compiled %d rules in %d tables for %d pods",
+            compiled.num_rules, compiled.num_tables, compiled.num_pods,
+        )
+        return compiled
+
+
+class TpuRendererTxn(RendererTxn):
+    def __init__(self, renderer: TpuPolicyRenderer, resync: bool):
+        self.renderer = renderer
+        self.resync = resync
+        self._changes: Dict[PodID, Optional[Tuple[int, Tuple[ContivRule, ...], Tuple[ContivRule, ...]]]] = {}
+
+    def render(self, pod, pod_ip, ingress, egress, removed=False):
+        if removed or pod_ip is None:
+            self._changes[pod] = None
+            return self
+        ip_u32 = ip_to_u32(pod_ip.network_address)
+        self._changes[pod] = (ip_u32, tuple(ingress), tuple(egress))
+        return self
+
+    def commit(self) -> None:
+        self.renderer._apply(self._changes, self.resync)
