@@ -1,0 +1,148 @@
+"""Data-plane benchmark — the BASELINE.md stress configuration.
+
+Runs the FULL pipeline (ingress ACL -> NAT44 -> routing -> SNAT ->
+egress ACL) on real hardware with the scale-stress state of
+BASELINE.md config 5: a 10k-rule ACL table and 1k Services worth of
+DNAT mappings, over randomized pod/service traffic.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "Mpps", "vs_baseline": N}
+
+vs_baseline is measured Mpps / 40 (the >=40 Mpps ACL+NAT44 target of
+BASELINE.json — parity with VPP/DPDK on a 16-core Xeon).
+
+The dispatch pattern is the production one: batches are submitted
+asynchronously (the host shim keeps several in flight), so throughput
+reflects pipelined steady state, not single-batch round-trip latency.
+"""
+
+import ipaddress
+import json
+import random
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def build_stress_state(n_rules=10000, n_services=1000, n_pods=128, seed=0):
+    from vpp_tpu.conf import IPAMConfig
+    from vpp_tpu.ipam import IPAM
+    from vpp_tpu.models import ProtocolType
+    from vpp_tpu.ops.classify import build_rule_tables
+    from vpp_tpu.ops.nat import NatMapping, build_nat_tables, empty_sessions
+    from vpp_tpu.ops.pipeline import make_route_config
+    from vpp_tpu.policy.renderer.api import Action, ContivRule
+    from vpp_tpu.ops.packets import ip_to_u32
+
+    rng = random.Random(seed)
+    ipam = IPAM(IPAMConfig(), node_id=1)
+
+    # One global table of n_rules CIDR rules (the gen-policy.py analog:
+    # 1000 CIDRs x 20 ports scaled up) + per-pod assignment to it.
+    rules = []
+    for _ in range(n_rules - 1):
+        net = ipaddress.ip_network(
+            f"10.{rng.randrange(256)}.{rng.randrange(256)}.0/{rng.choice([16, 20, 24, 28])}",
+            strict=False,
+        )
+        rules.append(
+            ContivRule(
+                action=Action.PERMIT if rng.random() < 0.9 else Action.DENY,
+                src_network=net,
+                protocol=ProtocolType.TCP if rng.random() < 0.7 else ProtocolType.UDP,
+                dst_port=rng.choice([0, 80, 443, 8080, 53]),
+            )
+        )
+    rules.append(ContivRule(action=Action.DENY))
+
+    pod_assignments = {}
+    pod_ips = []
+    for i in range(n_pods):
+        ip = f"10.1.1.{i + 2}"
+        pod_ips.append(ip)
+        pod_assignments[ip_to_u32(ip)] = (0, 0)
+    acl = build_rule_tables([rules], pod_assignments)
+
+    # 1k services x ~4 backends.
+    mappings = []
+    for s in range(n_services):
+        vip = f"10.{96 + (s // 16384)}.{(s // 64) % 256}.{s % 64 + 1}"
+        backends = [
+            (f"10.1.{rng.randrange(1, 64)}.{rng.randrange(2, 250)}", 8080, 1)
+            for _ in range(rng.randrange(2, 6))
+        ]
+        mappings.append(NatMapping(vip, rng.choice([80, 443]), 6, backends))
+    nat = build_nat_tables(
+        mappings,
+        nat_loopback=str(ipam.nat_loopback_ip()),
+        snat_ip="192.168.16.1",
+        snat_enabled=True,
+        pod_subnet=str(ipam.pod_subnet_all_nodes),
+    )
+    route = make_route_config(ipam)
+    sessions = empty_sessions(1 << 16)
+    return acl, nat, route, sessions, pod_ips, mappings
+
+
+def build_traffic(pod_ips, mappings, batch_size, seed=0):
+    from vpp_tpu.ops.packets import make_batch
+
+    rng = random.Random(seed)
+    flows = []
+    for _ in range(batch_size):
+        src = rng.choice(pod_ips)
+        r = rng.random()
+        if r < 0.5:  # service traffic
+            m = rng.choice(mappings)
+            flows.append((src, m.external_ip, 6, rng.randrange(1024, 65535), m.external_port))
+        elif r < 0.8:  # pod-to-pod
+            flows.append(
+                (src, f"10.1.{rng.randrange(1, 64)}.{rng.randrange(2, 250)}",
+                 rng.choice([6, 17]), rng.randrange(1024, 65535), rng.choice([80, 443, 8080]))
+            )
+        else:  # egress
+            flows.append(
+                (src, f"{rng.randrange(20, 200)}.2.3.4", 6, rng.randrange(1024, 65535), 443)
+            )
+    return make_batch(flows)
+
+
+def main():
+    from vpp_tpu.ops.pipeline import pipeline_step_jit
+
+    acl, nat, route, sessions, pod_ips, mappings = build_stress_state()
+    batch_size = 16384  # 64 VPP-vectors coalesced per dispatch
+    batch = build_traffic(pod_ips, mappings, batch_size)
+
+    # Warm-up / compile.
+    result = pipeline_step_jit(acl, nat, route, sessions, batch, jnp.int32(0))
+    result.allowed.block_until_ready()
+    sessions = result.sessions
+
+    # Steady state: pipelined async dispatches.
+    n_iters = 50
+    t0 = time.perf_counter()
+    for i in range(n_iters):
+        result = pipeline_step_jit(acl, nat, route, sessions, batch, jnp.int32(i + 1))
+        sessions = result.sessions
+    result.allowed.block_until_ready()
+    dt = (time.perf_counter() - t0) / n_iters
+
+    mpps = batch_size / dt / 1e6
+    print(
+        json.dumps(
+            {
+                "metric": "ACL+NAT44 pipeline throughput, 10k rules + 1k services, 64B-header batches",
+                "value": round(mpps, 1),
+                "unit": "Mpps",
+                "vs_baseline": round(mpps / 40.0, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -14,3 +14,19 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# Persistent compile cache: the kernels recompile per (batch, table) shape
+# bucket, which dominates suite runtime without a cache.
+import jax  # noqa: E402
+
+# The axon TPU plugin ignores the JAX_PLATFORMS env var — only the config
+# API reliably forces the CPU backend (and thereby honors
+# xla_force_host_platform_device_count for the virtual 8-device mesh).
+# Default is CPU (fast, 8 virtual devices for mesh tests); set
+# VPP_TPU_TEST_PLATFORM=axon to run the whole suite on the real chip and
+# validate TPU lowering/precision (mesh tests will then be skipped for
+# lack of devices).
+jax.config.update("jax_platforms", os.environ.get("VPP_TPU_TEST_PLATFORM", "cpu"))
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_vpp_tpu")
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)

@@ -1,0 +1,178 @@
+"""Full-pipeline tests: ACL+NAT ordering, routing tags, mesh sharding."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from vpp_tpu.conf import IPAMConfig
+from vpp_tpu.ipam import IPAM
+from vpp_tpu.models import (
+    LabelSelector,
+    Peer,
+    Pod,
+    PodID,
+    Policy,
+    PolicyPort,
+    PolicyType,
+    ProtocolType,
+    key_for,
+)
+from vpp_tpu.ops.nat import NatMapping, build_nat_tables, empty_sessions
+from vpp_tpu.ops.packets import make_batch, u32_to_ip
+from vpp_tpu.ops.pipeline import (
+    ROUTE_DROP,
+    ROUTE_HOST,
+    ROUTE_LOCAL,
+    ROUTE_REMOTE,
+    make_route_config,
+    pipeline_step,
+)
+from vpp_tpu.models import IngressRule
+from vpp_tpu.policy import PolicyPlugin
+from vpp_tpu.policy.renderer.tpu import TpuPolicyRenderer
+
+
+def build_world(policies=(), mappings=(), node_id=1):
+    ipam = IPAM(IPAMConfig(), node_id=node_id)
+    pods = [
+        Pod(name=f"p{i}", namespace="default", labels={"app": "web"},
+            ip_address=f"10.1.{node_id}.{i + 2}")
+        for i in range(4)
+    ]
+    renderer = TpuPolicyRenderer()
+    plugin = PolicyPlugin(ipam=ipam)
+    plugin.register_renderer(renderer)
+    state = {"pod": {key_for(p): p for p in pods},
+             "policy": {key_for(p): p for p in policies},
+             "namespace": {}}
+    plugin.resync(None, state, 1, None)
+    nat = build_nat_tables(
+        list(mappings),
+        nat_loopback=str(ipam.nat_loopback_ip()),
+        snat_ip="192.168.16.1",
+        snat_enabled=True,
+        pod_subnet=str(ipam.pod_subnet_all_nodes),
+    )
+    return ipam, pods, renderer.tables, nat, make_route_config(ipam)
+
+
+def run(acl, nat, route, flows, sessions=None, ts=0):
+    sessions = sessions if sessions is not None else empty_sessions(1024)
+    return pipeline_step(acl, nat, route, sessions, make_batch(flows), jnp.int32(ts))
+
+
+def test_routing_tags():
+    _, pods, acl, nat, route = build_world()
+    res = run(acl, nat, route, [
+        ("10.1.1.2", "10.1.1.3", 6, 1000, 80),     # local pod
+        ("10.1.1.2", "10.1.7.9", 6, 1000, 80),     # remote node 7
+        ("10.1.1.2", "93.184.216.34", 6, 1000, 443),  # external -> host
+    ])
+    tags = np.asarray(res.route)
+    assert tags[0] == ROUTE_LOCAL
+    assert tags[1] == ROUTE_REMOTE and int(res.node_id[1]) == 7
+    assert tags[2] == ROUTE_HOST
+
+
+def test_acl_denied_packets_drop():
+    isolate = Policy(
+        name="deny-all", namespace="default",
+        pods=LabelSelector(match_labels={"app": "web"}),
+        policy_type=PolicyType.INGRESS,
+    )
+    _, pods, acl, nat, route = build_world(policies=[isolate])
+    res = run(acl, nat, route, [("10.1.1.3", "10.1.1.2", 6, 1000, 80)])
+    assert not bool(res.allowed[0])
+    assert int(res.route[0]) == ROUTE_DROP
+
+
+def test_egress_acl_sees_post_nat_destination():
+    """SERVICES.md:300-307 ordering: DNAT before egress ACL — a policy on
+    the *backend* pod must apply to service traffic."""
+    allow_80 = Policy(
+        name="backend-80-only", namespace="default",
+        pods=LabelSelector(match_labels={"app": "web"}),
+        ingress_rules=(IngressRule(
+            ports=(PolicyPort(protocol=ProtocolType.TCP, port=8080),),
+            from_peers=(Peer(pods=LabelSelector()),),
+        ),),
+    )
+    mapping = NatMapping("10.96.0.10", 80, 6, [("10.1.1.2", 8080, 1)])
+    _, pods, acl, nat, route = build_world(policies=[allow_80], mappings=[mapping])
+    # Client pod -> VIP:80; DNAT to backend:8080; backend's table allows
+    # 8080 from pods -> allowed END-TO-END only because egress ACL runs
+    # on the rewritten packet.
+    res = run(acl, nat, route, [("10.1.1.3", "10.96.0.10", 6, 1000, 80)])
+    assert bool(res.dnat_hit[0])
+    assert u32_to_ip(int(res.batch.dst_ip[0])) == "10.1.1.2"
+    assert bool(res.allowed[0])
+    # Direct access on the service port number (80) at the backend is
+    # denied (backend only allows 8080).
+    res2 = run(acl, nat, route, [("10.1.1.3", "10.1.1.2", 6, 1000, 80)])
+    assert not bool(res2.allowed[0])
+
+
+def test_reply_skips_acl_reflective():
+    """Replies restored from a NAT session bypass ACL (reflective flows)."""
+    isolate = Policy(
+        name="deny-all", namespace="default",
+        pods=LabelSelector(match_labels={"app": "web"}),
+        policy_type=PolicyType.EGRESS,  # pods may not initiate anything
+    )
+    mapping = NatMapping("10.96.0.10", 80, 6, [("10.1.1.2", 8080, 1)])
+    _, pods, acl, nat, route = build_world(policies=[isolate], mappings=[mapping])
+    # External client hits the VIP (frontend outside the pod subnet).
+    fwd = run(acl, nat, route, [("172.30.1.9", "10.96.0.10", 6, 40000, 80)])
+    assert bool(fwd.dnat_hit[0]) and bool(fwd.allowed[0])
+    # Backend reply: the pod's egress-deny policy would block it as a new
+    # flow, but the session restores + bypasses.
+    rep = run(acl, nat, route, [("10.1.1.2", "172.30.1.9", 6, 8080, 40000)],
+              sessions=fwd.sessions, ts=1)
+    assert bool(rep.reply_hit[0])
+    assert bool(rep.allowed[0])
+    assert u32_to_ip(int(rep.batch.src_ip[0])) == "10.96.0.10"
+
+
+def test_denied_flow_creates_no_session():
+    """An ACL-denied flow must not seed a NAT session — otherwise a
+    crafted 'reply' would ride the reflective bypass around the policy."""
+    isolate = Policy(
+        name="deny-all", namespace="default",
+        pods=LabelSelector(match_labels={"app": "web"}),
+        policy_type=PolicyType.INGRESS,
+    )
+    mapping = NatMapping("10.96.0.10", 80, 6, [("10.1.1.2", 8080, 1)])
+    _, pods, acl, nat, route = build_world(policies=[isolate], mappings=[mapping])
+    fwd = run(acl, nat, route, [("172.30.1.9", "10.96.0.10", 6, 40000, 80)])
+    assert bool(fwd.dnat_hit[0]) and not bool(fwd.allowed[0])
+    # Crafted reply matching what the session tuple would have been:
+    rep = run(acl, nat, route, [("10.1.1.2", "172.30.1.9", 6, 8080, 40000)],
+              sessions=fwd.sessions, ts=1)
+    assert not bool(rep.reply_hit[0])
+    # Not session-restored: the source is NOT rewritten back to the VIP —
+    # the packet is treated as ordinary pod egress (here: SNAT'ed to the
+    # node IP like any cluster-leaving traffic) subject to normal ACLs.
+    assert u32_to_ip(int(rep.batch.src_ip[0])) != "10.96.0.10"
+    assert bool(rep.snat_hit[0])
+
+
+def test_mesh_sharded_pipeline_matches_single_device():
+    from vpp_tpu.parallel import make_mesh, shard_dataplane, sharded_pipeline_step
+    from vpp_tpu.parallel.mesh import shard_batch
+
+    mapping = NatMapping("10.96.0.10", 80, 6, [("10.1.1.2", 8080, 1)])
+    _, pods, acl, nat, route = build_world(mappings=[mapping])
+    flows = [
+        (f"10.1.1.{2 + (i % 4)}", "10.96.0.10", 6, 1000 + i, 80) for i in range(64)
+    ]
+    single = run(acl, nat, route, flows)
+
+    mesh = make_mesh(8)
+    with mesh:
+        acl_s, nat_s, route_s, sess_s = shard_dataplane(mesh, acl, nat, route, empty_sessions(1024))
+        batch_s = shard_batch(mesh, make_batch(flows))
+        step = sharded_pipeline_step(mesh)
+        sharded = step(acl_s, nat_s, route_s, sess_s, batch_s, jnp.int32(0))
+
+    np.testing.assert_array_equal(np.asarray(single.allowed), np.asarray(sharded.allowed))
+    np.testing.assert_array_equal(np.asarray(single.batch.dst_ip), np.asarray(sharded.batch.dst_ip))
+    np.testing.assert_array_equal(np.asarray(single.route), np.asarray(sharded.route))

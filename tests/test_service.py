@@ -1,0 +1,245 @@
+"""Service stack tests — scenarios modeled on the reference's
+plugins/service/nat44_test.go: real processor + TPU NAT renderer,
+assertions on exported mappings and on actual packet rewrites."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from vpp_tpu.models import (
+    Endpoints,
+    EndpointAddress,
+    EndpointPort,
+    EndpointSubset,
+    Pod,
+    PodID,
+    ProtocolType,
+    Service,
+    ServicePort,
+    VppNode,
+    key_for,
+)
+from vpp_tpu.conf import IPAMConfig
+from vpp_tpu.ipam import IPAM
+from vpp_tpu.ops.nat import TWICE_NAT_ENABLED, TWICE_NAT_SELF, empty_sessions, nat_step
+from vpp_tpu.ops.packets import make_batch, u32_to_ip
+from vpp_tpu.service import ServicePlugin
+from vpp_tpu.service.renderer.tpu import TpuNatRenderer
+
+
+class FakeNodeSync:
+    def __init__(self, nodes):
+        self._nodes = nodes
+
+    def get_all_nodes(self):
+        return self._nodes
+
+
+WEB_SVC = Service(
+    name="web",
+    namespace="default",
+    ports=(ServicePort(name="http", protocol="TCP", port=80, target_port=8080),),
+    selector={"app": "web"},
+    cluster_ip="10.96.0.10",
+)
+
+WEB_EPS = Endpoints(
+    name="web",
+    namespace="default",
+    subsets=(
+        EndpointSubset(
+            addresses=(
+                EndpointAddress(ip="10.1.1.2", node_name="node-a", target_pod=PodID("w1", "default")),
+                EndpointAddress(ip="10.1.2.3", node_name="node-b", target_pod=PodID("w2", "default")),
+            ),
+            ports=(EndpointPort(name="http", port=8080, protocol="TCP"),),
+        ),
+    ),
+)
+
+
+def kube_state(*objs):
+    state = {"service": {}, "endpoints": {}, "pod": {}, "vppnode": {}}
+    kinds = {Service: "service", Endpoints: "endpoints", Pod: "pod", VppNode: "vppnode"}
+    for obj in objs:
+        state[kinds[type(obj)]][key_for(obj)] = obj
+    return state
+
+
+def build(*objs, node_name="node-a", nodes=None, **renderer_kw):
+    ipam = IPAM(IPAMConfig(), node_id=1)
+    nodesync = FakeNodeSync(nodes or {})
+    plugin = ServicePlugin(node_name, ipam=ipam, nodesync=nodesync)
+    renderer = TpuNatRenderer(
+        nat_loopback=str(ipam.nat_loopback_ip()),
+        snat_ip="192.168.16.1",
+        snat_enabled=True,
+        pod_subnet=str(ipam.pod_subnet_all_nodes),
+        **renderer_kw,
+    )
+    plugin.register_renderer(renderer)
+    plugin.resync(None, kube_state(*objs), 1, None)
+    return plugin, renderer
+
+
+def test_cluster_ip_mapping_exported():
+    _, renderer = build(WEB_SVC, WEB_EPS)
+    mappings = renderer.mappings()
+    assert len(mappings) == 1
+    m = mappings[0]
+    assert m.external_ip == "10.96.0.10" and m.external_port == 80 and m.protocol == 6
+    assert sorted(b[:2] for b in m.backends) == [("10.1.1.2", 8080), ("10.1.2.3", 8080)]
+    assert m.twice_nat == TWICE_NAT_SELF
+
+
+def test_packet_rewrite_through_rendered_tables():
+    _, renderer = build(WEB_SVC, WEB_EPS)
+    res = nat_step(
+        renderer.tables,
+        empty_sessions(1024),
+        make_batch([("10.1.1.9", "10.96.0.10", 6, 40000, 80)]),
+        jnp.int32(0),
+    )
+    assert bool(res.dnat_hit[0])
+    assert u32_to_ip(int(res.batch.dst_ip[0])) in ("10.1.1.2", "10.1.2.3")
+    assert int(res.batch.dst_port[0]) == 8080
+
+
+def test_node_local_policy_excludes_remote_backends():
+    svc = Service(
+        name="web", namespace="default",
+        ports=(ServicePort(name="http", protocol="TCP", port=80),),
+        cluster_ip="10.96.0.10",
+        external_traffic_policy="Local",
+    )
+    _, renderer = build(svc, WEB_EPS)
+    mappings = renderer.mappings()
+    assert len(mappings) == 1
+    # Only the node-a backend remains.
+    assert [b[:2] for b in mappings[0].backends] == [("10.1.1.2", 8080)]
+
+
+def test_local_endpoint_weight():
+    _, renderer = build(WEB_SVC, WEB_EPS, local_weight=3)
+    m = renderer.mappings()[0]
+    weights = {b[0]: b[2] for b in m.backends}
+    assert weights["10.1.1.2"] == 3  # local (node-a)
+    assert weights["10.1.2.3"] == 1  # remote
+
+
+def test_nodeport_mappings_for_all_nodes():
+    svc = Service(
+        name="web", namespace="default",
+        ports=(ServicePort(name="http", protocol="TCP", port=80, node_port=30080),),
+        cluster_ip="10.96.0.10",
+        service_type="NodePort",
+    )
+    nodes = {
+        "node-a": VppNode(id=1, name="node-a", ip_addresses=("192.168.16.1/24",)),
+        "node-b": VppNode(id=2, name="node-b", ip_addresses=("192.168.16.2/24",), mgmt_ip_addresses=("10.0.0.2",)),
+    }
+    _, renderer = build(svc, WEB_EPS, nodes=nodes)
+    mappings = renderer.mappings()
+    ext = {(m.external_ip, m.external_port) for m in mappings}
+    assert ("10.96.0.10", 80) in ext
+    assert ("192.168.16.1", 30080) in ext
+    assert ("192.168.16.2", 30080) in ext
+    assert ("10.0.0.2", 30080) in ext  # mgmt IP too
+
+
+def test_external_ip_cluster_wide_uses_twice_nat_enabled():
+    svc = Service(
+        name="web", namespace="default",
+        ports=(ServicePort(name="http", protocol="TCP", port=80),),
+        cluster_ip="10.96.0.10",
+        external_ips=("1.2.3.4",),
+    )
+    _, renderer = build(svc, WEB_EPS)
+    by_ip = {m.external_ip: m for m in renderer.mappings()}
+    assert by_ip["1.2.3.4"].twice_nat == TWICE_NAT_ENABLED
+    assert by_ip["10.96.0.10"].twice_nat == TWICE_NAT_SELF
+
+
+def test_endpoints_update_rerenders():
+    plugin, renderer = build(WEB_SVC, WEB_EPS)
+    new_eps = Endpoints(
+        name="web", namespace="default",
+        subsets=(
+            EndpointSubset(
+                addresses=(EndpointAddress(ip="10.1.1.5", node_name="node-a", target_pod=PodID("w3", "default")),),
+                ports=(EndpointPort(name="http", port=9090, protocol="TCP"),),
+            ),
+        ),
+    )
+    plugin.processor.on_endpoints_change(WEB_EPS, new_eps)
+    m = renderer.mappings()[0]
+    assert [b[:2] for b in m.backends] == [("10.1.1.5", 9090)]
+
+
+def test_service_deletion_removes_mappings():
+    plugin, renderer = build(WEB_SVC, WEB_EPS)
+    assert renderer.mappings()
+    plugin.processor.on_service_change(WEB_SVC, None)
+    assert renderer.mappings() == []
+    # And packets no longer match.
+    res = nat_step(
+        renderer.tables, empty_sessions(1024),
+        make_batch([("10.1.1.9", "10.96.0.10", 6, 40000, 80)]), jnp.int32(0),
+    )
+    assert not bool(res.dnat_hit[0])
+
+
+def test_headless_service_not_rendered():
+    svc = Service(
+        name="web", namespace="default",
+        ports=(ServicePort(name="http", protocol="TCP", port=80),),
+        cluster_ip="None",
+    )
+    _, renderer = build(svc, WEB_EPS)
+    assert renderer.mappings() == []
+
+
+def test_no_endpoints_no_mapping():
+    _, renderer = build(WEB_SVC)
+    assert renderer.mappings() == []
+
+
+def test_session_affinity_propagates():
+    svc = Service(
+        name="web", namespace="default",
+        ports=(ServicePort(name="http", protocol="TCP", port=80),),
+        cluster_ip="10.96.0.10",
+        session_affinity="ClientIP",
+        session_affinity_timeout=3600,
+    )
+    _, renderer = build(svc, WEB_EPS)
+    assert renderer.mappings()[0].session_affinity_timeout == 3600
+
+
+def test_udp_service():
+    svc = Service(
+        name="dns", namespace="kube-system",
+        ports=(ServicePort(name="dns", protocol="UDP", port=53),),
+        cluster_ip="10.96.0.2",
+    )
+    eps = Endpoints(
+        name="dns", namespace="kube-system",
+        subsets=(
+            EndpointSubset(
+                addresses=(EndpointAddress(ip="10.1.1.7", node_name="node-a", target_pod=PodID("dns", "kube-system")),),
+                ports=(EndpointPort(name="dns", port=5353, protocol="UDP"),),
+            ),
+        ),
+    )
+    _, renderer = build(svc, eps)
+    m = renderer.mappings()[0]
+    assert m.protocol == 17
+    res = nat_step(
+        renderer.tables, empty_sessions(1024),
+        make_batch([
+            ("10.1.1.9", "10.96.0.2", 17, 40000, 53),
+            ("10.1.1.9", "10.96.0.2", 6, 40000, 53),  # TCP must not match
+        ]),
+        jnp.int32(0),
+    )
+    assert bool(res.dnat_hit[0]) and not bool(res.dnat_hit[1])
+    assert int(res.batch.dst_port[0]) == 5353

@@ -1,0 +1,213 @@
+"""NAT kernel tests: DNAT/LB, hairpin, SNAT, sessions — with oracle parity."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from vpp_tpu.ops.nat import (
+    NatMapping,
+    TWICE_NAT_ENABLED,
+    TWICE_NAT_SELF,
+    build_nat_tables,
+    empty_sessions,
+    nat_step,
+    sweep_sessions,
+)
+from vpp_tpu.ops.packets import PacketBatch, ip_to_u32, make_batch, u32_to_ip
+from vpp_tpu.testing.natengine import Flow, MockNatEngine
+
+
+def run_nat(tables, sessions, flows, ts=0):
+    batch = make_batch(flows)
+    return nat_step(tables, sessions, batch, jnp.int32(ts))
+
+
+CLUSTER_IP = "10.96.0.10"
+BACKENDS = [("10.1.1.2", 8080, 1), ("10.1.2.3", 8080, 1)]
+
+
+def simple_tables(**kw):
+    mapping = NatMapping(
+        external_ip=CLUSTER_IP, external_port=80, protocol=6,
+        backends=kw.pop("backends", BACKENDS),
+        twice_nat=kw.pop("twice_nat", TWICE_NAT_SELF),
+        session_affinity_timeout=kw.pop("affinity", 0),
+    )
+    return build_nat_tables(
+        [mapping],
+        nat_loopback="10.1.1.254",
+        snat_ip="192.168.16.1",
+        snat_enabled=True,
+        pod_subnet="10.1.0.0/16",
+        **kw,
+    )
+
+
+def test_dnat_rewrites_to_backend():
+    tables = simple_tables()
+    res = run_nat(tables, empty_sessions(1024), [("10.1.1.9", CLUSTER_IP, 6, 40000, 80)])
+    assert bool(res.dnat_hit[0])
+    new_dst = u32_to_ip(int(res.batch.dst_ip[0]))
+    assert new_dst in ("10.1.1.2", "10.1.2.3")
+    assert int(res.batch.dst_port[0]) == 8080
+    # Source untouched (no hairpin).
+    assert u32_to_ip(int(res.batch.src_ip[0])) == "10.1.1.9"
+
+
+def test_flow_stickiness_and_distribution():
+    tables = simple_tables()
+    sessions = empty_sessions(1 << 14)
+    flows = [("10.1.1.9", CLUSTER_IP, 6, 1000 + i, 80) for i in range(256)]
+    res = run_nat(tables, sessions, flows)
+    picks = [u32_to_ip(int(ip)) for ip in np.asarray(res.batch.dst_ip)]
+    counts = {b: picks.count(b) for b in set(picks)}
+    # Both backends used, roughly balanced (weighted ring, random hash).
+    assert set(counts) == {"10.1.1.2", "10.1.2.3"}
+    assert min(counts.values()) > 256 * 0.3
+    # Stickiness: same flows again -> identical picks.
+    res2 = run_nat(tables, res.sessions, flows)
+    np.testing.assert_array_equal(np.asarray(res.batch.dst_ip), np.asarray(res2.batch.dst_ip))
+
+
+def test_weighted_backends():
+    tables = simple_tables(backends=[("10.1.1.2", 8080, 3), ("10.1.2.3", 8080, 1)])
+    res = run_nat(
+        tables, empty_sessions(1 << 14),
+        [("10.1.9.9", CLUSTER_IP, 6, 1000 + i, 80) for i in range(512)],
+    )
+    picks = [u32_to_ip(int(ip)) for ip in np.asarray(res.batch.dst_ip)]
+    heavy = picks.count("10.1.1.2") / len(picks)
+    assert 0.6 < heavy < 0.9  # ~0.75 expected
+
+
+def test_client_ip_affinity():
+    tables = simple_tables(affinity=10800)
+    flows = [("10.1.1.9", CLUSTER_IP, 6, 1000 + i, 80) for i in range(64)]
+    res = run_nat(tables, empty_sessions(1024), flows)
+    # One client IP -> one backend regardless of source port.
+    assert len(set(np.asarray(res.batch.dst_ip).tolist())) == 1
+
+
+def test_hairpin_self_twice_nat():
+    tables = simple_tables(backends=[("10.1.1.2", 8080, 1)])
+    res = run_nat(tables, empty_sessions(1024), [("10.1.1.2", CLUSTER_IP, 6, 4000, 80)])
+    # Backend == client -> source rewritten to NAT loopback.
+    assert u32_to_ip(int(res.batch.src_ip[0])) == "10.1.1.254"
+    assert u32_to_ip(int(res.batch.dst_ip[0])) == "10.1.1.2"
+
+
+def test_twice_nat_enabled_always_rewrites_source():
+    tables = simple_tables(twice_nat=TWICE_NAT_ENABLED, backends=[("10.1.2.3", 8080, 1)])
+    res = run_nat(tables, empty_sessions(1024), [("10.1.1.9", CLUSTER_IP, 6, 4000, 80)])
+    assert u32_to_ip(int(res.batch.src_ip[0])) == "10.1.1.254"
+
+
+def test_reply_restoration_via_session():
+    tables = simple_tables(backends=[("10.1.1.2", 8080, 1)])
+    sessions = empty_sessions(1024)
+    fwd = run_nat(tables, sessions, [("10.1.1.9", CLUSTER_IP, 6, 40000, 80)])
+    assert bool(fwd.dnat_hit[0])
+    # Reply: backend -> client.
+    rep = run_nat(tables, fwd.sessions, [("10.1.1.2", "10.1.1.9", 6, 8080, 40000)], ts=1)
+    assert bool(rep.reply_hit[0])
+    assert u32_to_ip(int(rep.batch.src_ip[0])) == CLUSTER_IP
+    assert int(rep.batch.src_port[0]) == 80
+    assert u32_to_ip(int(rep.batch.dst_ip[0])) == "10.1.1.9"
+    assert int(rep.batch.dst_port[0]) == 40000
+
+
+def test_snat_egress_and_reply():
+    tables = simple_tables()
+    fwd = run_nat(tables, empty_sessions(1024), [("10.1.1.9", "93.184.216.34", 6, 40000, 443)])
+    assert bool(fwd.snat_hit[0])
+    assert u32_to_ip(int(fwd.batch.src_ip[0])) == "192.168.16.1"
+    snat_port = int(fwd.batch.src_port[0])
+    assert 32768 <= snat_port < 65536
+    # Inbound reply to the SNAT address restores the pod.
+    rep = run_nat(tables, fwd.sessions, [("93.184.216.34", "192.168.16.1", 6, 443, snat_port)], ts=1)
+    assert bool(rep.reply_hit[0])
+    assert u32_to_ip(int(rep.batch.dst_ip[0])) == "10.1.1.9"
+    assert int(rep.batch.dst_port[0]) == 40000
+
+
+def test_pod_to_pod_untouched():
+    tables = simple_tables()
+    res = run_nat(tables, empty_sessions(1024), [("10.1.1.9", "10.1.2.7", 6, 1, 2)])
+    assert not bool(res.dnat_hit[0]) and not bool(res.snat_hit[0])
+    assert u32_to_ip(int(res.batch.dst_ip[0])) == "10.1.2.7"
+    assert int(res.batch.src_port[0]) == 1
+
+
+def test_session_sweep_expires_idle():
+    tables = simple_tables(backends=[("10.1.1.2", 8080, 1)])
+    fwd = run_nat(tables, empty_sessions(1024), [("10.1.1.9", CLUSTER_IP, 6, 40000, 80)], ts=0)
+    swept = sweep_sessions(fwd.sessions, now=100, max_age=50)
+    rep = run_nat(tables, swept, [("10.1.1.2", "10.1.1.9", 6, 8080, 40000)], ts=101)
+    # Session gone -> no restoration.
+    assert not bool(rep.reply_hit[0])
+
+
+@pytest.mark.parametrize("seed", [7, 11, 13])
+def test_randomized_oracle_parity(seed):
+    rng = np.random.default_rng(seed)
+    mappings = []
+    for i in range(8):
+        n_back = int(rng.integers(1, 5))
+        backends = [
+            (f"10.1.{rng.integers(1, 5)}.{rng.integers(2, 250)}", int(rng.integers(1, 65535)), int(rng.integers(1, 4)))
+            for _ in range(n_back)
+        ]
+        mappings.append(
+            NatMapping(
+                external_ip=f"10.96.0.{i + 1}",
+                external_port=int(rng.choice([80, 443, 8080])),
+                protocol=int(rng.choice([6, 17])),
+                backends=backends,
+                twice_nat=int(rng.choice([TWICE_NAT_SELF, TWICE_NAT_ENABLED])),
+                session_affinity_timeout=int(rng.choice([0, 10800])),
+            )
+        )
+    tables = build_nat_tables(
+        mappings, nat_loopback="10.1.1.254", snat_ip="192.168.16.1",
+        snat_enabled=True, pod_subnet="10.1.0.0/16",
+    )
+    oracle = MockNatEngine(
+        nat_loopback="10.1.1.254", snat_ip="192.168.16.1",
+        snat_enabled=True, pod_subnet="10.1.0.0/16",
+        session_capacity=65536,
+    )
+    oracle.set_mappings(mappings)
+
+    sessions = empty_sessions(65536)
+    for ts in range(4):
+        flows = []
+        for _ in range(128):
+            r = rng.random()
+            if r < 0.5:  # pod -> service VIP
+                src = f"10.1.{rng.integers(1, 5)}.{rng.integers(2, 250)}"
+                dst = f"10.96.0.{rng.integers(1, 10)}"
+                dport = int(rng.choice([80, 443, 8080, 9999]))
+            elif r < 0.7:  # pod -> internet
+                src = f"10.1.{rng.integers(1, 5)}.{rng.integers(2, 250)}"
+                dst = f"{rng.integers(20, 200)}.{rng.integers(0, 255)}.{rng.integers(0, 255)}.{rng.integers(1, 255)}"
+                dport = 443
+            else:  # pod -> pod
+                src = f"10.1.{rng.integers(1, 5)}.{rng.integers(2, 250)}"
+                dst = f"10.1.{rng.integers(1, 5)}.{rng.integers(2, 250)}"
+                dport = int(rng.integers(1, 65535))
+            flows.append((src, dst, int(rng.choice([6, 17])), int(rng.integers(1024, 65535)), dport))
+
+        res = run_nat(tables, sessions, flows, ts=ts)
+        sessions = res.sessions
+        for i, flow in enumerate(flows):
+            expected = oracle.process(Flow.make(*flow), timestamp=ts)
+            got = res.batch
+            label = f"seed={seed} ts={ts} flow#{i} {expected.flow}"
+            assert bool(res.dnat_hit[i]) == expected.dnat, label
+            assert bool(res.snat_hit[i]) == expected.snat, label
+            assert bool(res.reply_hit[i]) == expected.reply, label
+            assert int(got.src_ip[i]) == expected.flow.src_ip, label
+            assert int(got.dst_ip[i]) == expected.flow.dst_ip, label
+            assert int(got.src_port[i]) == expected.flow.src_port, label
+            assert int(got.dst_port[i]) == expected.flow.dst_port, label

@@ -109,6 +109,8 @@ _PERMIT_ALL = ContivRule(action=Action.PERMIT)
 
 
 def _next_pow2(n: int, minimum: int = 8) -> int:
+    """Shared static-shape bucketing policy for ACL and NAT tables:
+    pad to the next power of two so XLA compiles one program per bucket."""
     size = minimum
     while size < n:
         size *= 2
@@ -216,13 +218,8 @@ def _first_match_action(
     return jnp.where(side_tid == NO_TABLE, _PERMIT, action)
 
 
-def classify(tables: RuleTables, batch: PacketBatch) -> Verdicts:
-    """The ACL stage. jit-compatible; [B] batch vs [N] rules.
-
-    One [B, N] predicate matrix covers all tables; per-side table
-    selection and first-match reduce on top of it.
-    """
-    # Field predicates ([B, N]).
+def match_matrix(tables: RuleTables, batch: PacketBatch) -> jnp.ndarray:
+    """The [B, N] all-rules predicate matrix."""
     src_ok = (batch.src_ip[:, None] & tables.rule_src_mask[None, :]) == tables.rule_src_base[None, :]
     dst_ok = (batch.dst_ip[:, None] & tables.rule_dst_mask[None, :]) == tables.rule_dst_base[None, :]
     proto_any = tables.rule_proto[None, :] == 0
@@ -234,7 +231,32 @@ def classify(tables: RuleTables, batch: PacketBatch) -> Verdicts:
         batch.dst_port[:, None] == tables.rule_dst_port[None, :]
     )
     l4_ok = proto_any | (proto_ok & sport_ok & dport_ok)
-    match = tables.rule_valid[None, :] & src_ok & dst_ok & l4_ok
+    return tables.rule_valid[None, :] & src_ok & dst_ok & l4_ok
+
+
+def classify_src(tables: RuleTables, batch: PacketBatch) -> jnp.ndarray:
+    """Source-side (pod ingress table) action only — the pipeline's
+    pre-NAT ACL stage; [B] int32 actions."""
+    match = match_matrix(tables, batch)
+    src_tid = _lookup_tid(batch.src_ip, tables.pod_ip, tables.pod_ingress_tid)
+    return _first_match_action(match, tables.rule_tid, tables.rule_action, src_tid)
+
+
+def classify_dst(tables: RuleTables, batch: PacketBatch) -> jnp.ndarray:
+    """Destination-side (pod egress table) action only — the pipeline's
+    post-NAT ACL stage; [B] int32 actions."""
+    match = match_matrix(tables, batch)
+    dst_tid = _lookup_tid(batch.dst_ip, tables.pod_ip, tables.pod_egress_tid)
+    return _first_match_action(match, tables.rule_tid, tables.rule_action, dst_tid)
+
+
+def classify(tables: RuleTables, batch: PacketBatch) -> Verdicts:
+    """The ACL stage. jit-compatible; [B] batch vs [N] rules.
+
+    One [B, N] predicate matrix covers all tables; per-side table
+    selection and first-match reduce on top of it.
+    """
+    match = match_matrix(tables, batch)
 
     # Side-table resolution per packet.
     src_tid = _lookup_tid(batch.src_ip, tables.pod_ip, tables.pod_ingress_tid)

@@ -1,0 +1,485 @@
+"""NAT44 — DNAT/LB map compilation, session table, and rewrite kernel.
+
+The TPU replacement for VPP's nat44 plugin (SURVEY.md §2.3): K8s
+Services become static DNAT mappings with load-balanced backends
+(nat44_renderer.go exportDNATMappings :421); the per-packet work is a
+jit-compiled rewrite over header batches:
+
+- **DNAT (out2in)**: match (dst ip, dst port, proto) against the
+  mapping table, pick a backend by *flow hash* over a weighted bucket
+  ring — deterministic and flow-sticky, the TPU-native analog of VPP's
+  probability-based random pick (SURVEY §7.3: hash keeps flows sticky
+  without per-packet RNG divergence).  Client-IP session affinity
+  hashes only the source address.
+- **self-twice-NAT hairpin**: when the chosen backend equals the
+  client, the source is rewritten to the virtual NAT loopback so
+  replies return through the data plane (nat44 TwiceNat=SELF);
+  mappings with twice-NAT ENABLED always rewrite the source.
+- **SNAT (in2out)**: pod traffic leaving the cluster is source-NATted
+  to the node IP with a hash-allocated ephemeral port.
+- **sessions**: a device-resident open-addressed hash table keyed by
+  the *reply* flow 5-tuple; the forward pass scatters new sessions in,
+  the reply pass restores original addresses.  The host sweeps stale
+  entries by age (the reference's idle-session GC goroutine,
+  nat44_renderer.go ~:691, becomes a host-side sweep of ``last_seen``).
+
+All state lives in device arrays; updates are functional (the caller
+threads ``NatSessions`` through) so the whole step stays inside one
+XLA program.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from dataclasses import dataclass
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .classify import _next_pow2
+from .packets import PacketBatch, ip_to_u32
+
+# Twice-NAT modes (nat44 DNat44_StaticMapping TwiceNat).
+TWICE_NAT_NONE = 0
+TWICE_NAT_SELF = 1
+TWICE_NAT_ENABLED = 2
+
+
+@dataclass
+class NatMapping:
+    """One DNAT static mapping (host-side description)."""
+
+    external_ip: str
+    external_port: int
+    protocol: int  # 6 / 17
+    # (backend_ip, backend_port, weight) — weight models LocalIps
+    # Probability (ServiceLocalEndpointWeight for local backends).
+    backends: List[Tuple[str, int, int]]
+    twice_nat: int = TWICE_NAT_SELF
+    # ClientIP session affinity timeout (0 = disabled).
+    session_affinity_timeout: int = 0
+
+
+@dataclass
+class NatTables:
+    """Compiled NAT state (device arrays)."""
+
+    # Mappings [M].
+    map_ext_ip: jnp.ndarray     # uint32
+    map_ext_port: jnp.ndarray   # int32
+    map_proto: jnp.ndarray      # int32
+    map_twice_nat: jnp.ndarray  # int32
+    map_affinity: jnp.ndarray   # int32 (bool: hash client IP only)
+    map_valid: jnp.ndarray      # bool
+
+    # Weighted backend bucket ring [M, K].
+    backend_ip: jnp.ndarray     # uint32
+    backend_port: jnp.ndarray   # int32
+
+    # SNAT config (scalars).
+    nat_loopback: jnp.ndarray   # uint32 []
+    snat_ip: jnp.ndarray        # uint32 [] - node IP for egress SNAT
+    snat_enabled: jnp.ndarray   # bool []
+    # Pod/service subnets for routing decisions (base, mask).
+    pod_subnet_base: jnp.ndarray  # uint32 []
+    pod_subnet_mask: jnp.ndarray  # uint32 []
+
+    num_mappings: int = 0
+    bucket_size: int = 0
+
+    def tree_flatten(self):
+        children = (
+            self.map_ext_ip, self.map_ext_port, self.map_proto,
+            self.map_twice_nat, self.map_affinity, self.map_valid,
+            self.backend_ip, self.backend_port,
+            self.nat_loopback, self.snat_ip, self.snat_enabled,
+            self.pod_subnet_base, self.pod_subnet_mask,
+        )
+        return children, (self.num_mappings, self.bucket_size)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, num_mappings=aux[0], bucket_size=aux[1])
+
+
+jax.tree_util.register_pytree_node(NatTables, NatTables.tree_flatten, NatTables.tree_unflatten)
+
+
+@dataclass
+class NatSessions:
+    """Device-resident session hash table [S], keyed by reply-flow hash.
+
+    Slot fields hold the *original* forward 5-tuple plus the translated
+    addresses, enough to restore replies and to let the host GC by age.
+    """
+
+    valid: jnp.ndarray        # bool
+    # Reply-flow key (what a reply packet's 5-tuple will look like).
+    r_src_ip: jnp.ndarray     # uint32 (backend / server ip)
+    r_dst_ip: jnp.ndarray     # uint32 (client ip after twice-nat)
+    r_proto: jnp.ndarray      # int32
+    r_src_port: jnp.ndarray   # int32
+    r_dst_port: jnp.ndarray   # int32
+    # Restoration values for replies.
+    orig_src_ip: jnp.ndarray   # uint32 (original client ip)
+    orig_src_port: jnp.ndarray  # int32
+    orig_dst_ip: jnp.ndarray   # uint32 (the VIP / node IP)
+    orig_dst_port: jnp.ndarray  # int32
+    last_seen: jnp.ndarray     # int32 batch-counter timestamp
+
+    @property
+    def capacity(self) -> int:
+        return self.valid.shape[0]
+
+    def tree_flatten(self):
+        return (
+            (
+                self.valid, self.r_src_ip, self.r_dst_ip, self.r_proto,
+                self.r_src_port, self.r_dst_port,
+                self.orig_src_ip, self.orig_src_port,
+                self.orig_dst_ip, self.orig_dst_port, self.last_seen,
+            ),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(NatSessions, NatSessions.tree_flatten, NatSessions.tree_unflatten)
+
+
+def empty_sessions(capacity: int = 65536) -> NatSessions:
+    """Fresh session table (capacity must be a power of two)."""
+    assert capacity & (capacity - 1) == 0, "capacity must be a power of two"
+    u32 = lambda: jnp.zeros(capacity, dtype=jnp.uint32)  # noqa: E731
+    i32 = lambda: jnp.zeros(capacity, dtype=jnp.int32)   # noqa: E731
+    return NatSessions(
+        valid=jnp.zeros(capacity, dtype=bool),
+        r_src_ip=u32(), r_dst_ip=u32(), r_proto=i32(),
+        r_src_port=i32(), r_dst_port=i32(),
+        orig_src_ip=u32(), orig_src_port=i32(),
+        orig_dst_ip=u32(), orig_dst_port=i32(),
+        last_seen=i32(),
+    )
+
+
+def build_nat_tables(
+    mappings: Sequence[NatMapping],
+    nat_loopback: str = "0.0.0.0",
+    snat_ip: str = "0.0.0.0",
+    snat_enabled: bool = False,
+    pod_subnet: str = "10.1.0.0/16",
+    bucket_size: int = 64,
+) -> NatTables:
+    """Compile DNAT mappings to tensors.
+
+    The backend ring of each mapping is filled by weighted round-robin
+    so that ``flow_hash %% K`` lands on backend b with probability
+    weight_b / sum(weights) (up to rounding) — flow-sticky weighted LB.
+    """
+    m = len(mappings)
+    padded = _next_pow2(max(m, 1))
+    ext_ip = np.zeros(padded, dtype=np.uint32)
+    ext_port = np.zeros(padded, dtype=np.int32)
+    proto = np.zeros(padded, dtype=np.int32)
+    twice = np.zeros(padded, dtype=np.int32)
+    affinity = np.zeros(padded, dtype=np.int32)
+    valid = np.zeros(padded, dtype=bool)
+    b_ip = np.zeros((padded, bucket_size), dtype=np.uint32)
+    b_port = np.zeros((padded, bucket_size), dtype=np.int32)
+
+    for i, mapping in enumerate(mappings):
+        ext_ip[i] = ip_to_u32(mapping.external_ip)
+        ext_port[i] = mapping.external_port
+        proto[i] = mapping.protocol
+        twice[i] = mapping.twice_nat
+        affinity[i] = 1 if mapping.session_affinity_timeout > 0 else 0
+        valid[i] = True
+        if not mapping.backends:
+            valid[i] = False
+            continue
+        # Weighted ring fill: repeat each backend `weight` times, then
+        # tile the expanded list across the bucket.
+        expanded: List[Tuple[int, int]] = []
+        for ip, port, weight in mapping.backends:
+            expanded.extend([(ip_to_u32(ip), port)] * max(1, weight))
+        for k in range(bucket_size):
+            ip_u, port_u = expanded[k % len(expanded)]
+            b_ip[i, k] = ip_u
+            b_port[i, k] = port_u
+
+    net = ipaddress.ip_network(pod_subnet)
+    mask = (0xFFFFFFFF << (32 - net.prefixlen)) & 0xFFFFFFFF if net.prefixlen else 0
+
+    return NatTables(
+        map_ext_ip=jnp.asarray(ext_ip),
+        map_ext_port=jnp.asarray(ext_port),
+        map_proto=jnp.asarray(proto),
+        map_twice_nat=jnp.asarray(twice),
+        map_affinity=jnp.asarray(affinity),
+        map_valid=jnp.asarray(valid),
+        backend_ip=jnp.asarray(b_ip),
+        backend_port=jnp.asarray(b_port),
+        nat_loopback=jnp.asarray(ip_to_u32(nat_loopback), dtype=jnp.uint32),
+        snat_ip=jnp.asarray(ip_to_u32(snat_ip), dtype=jnp.uint32),
+        snat_enabled=jnp.asarray(snat_enabled),
+        pod_subnet_base=jnp.asarray(int(net.network_address), dtype=jnp.uint32),
+        pod_subnet_mask=jnp.asarray(mask, dtype=jnp.uint32),
+        num_mappings=m,
+        bucket_size=bucket_size,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Hashing
+# ---------------------------------------------------------------------------
+
+
+def _mix(h: jnp.ndarray) -> jnp.ndarray:
+    """Final avalanche of a murmur3-style 32-bit mixer."""
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def flow_hash(
+    src_ip: jnp.ndarray,
+    dst_ip: jnp.ndarray,
+    proto: jnp.ndarray,
+    src_port: jnp.ndarray,
+    dst_port: jnp.ndarray,
+) -> jnp.ndarray:
+    """Deterministic per-flow 32-bit hash (uint32 [B])."""
+    h = src_ip.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+    h = _mix(h ^ dst_ip.astype(jnp.uint32))
+    h = _mix(h ^ (proto.astype(jnp.uint32) << 16) ^ src_port.astype(jnp.uint32))
+    h = _mix(h ^ dst_port.astype(jnp.uint32))
+    return h
+
+
+class NatResult(NamedTuple):
+    batch: PacketBatch        # rewritten headers
+    sessions: NatSessions     # updated session table
+    dnat_hit: jnp.ndarray     # bool [B] forward DNAT applied
+    reply_hit: jnp.ndarray    # bool [B] reply restoration applied
+    snat_hit: jnp.ndarray     # bool [B] egress SNAT applied
+    dropped: jnp.ndarray      # bool [B] (DNAT matched but no backend)
+
+
+class NatRewrite(NamedTuple):
+    """Output of the pure rewrite phase (no session writes yet)."""
+
+    batch: PacketBatch
+    dnat_hit: jnp.ndarray
+    reply_hit: jnp.ndarray
+    snat_hit: jnp.ndarray
+    reply_slot: jnp.ndarray  # int32 [B] session slot of reply hits
+
+
+def nat_rewrite(
+    tables: NatTables,
+    sessions: NatSessions,
+    batch: PacketBatch,
+) -> NatRewrite:
+    """The pure NAT translation: reply restore -> DNAT LB -> SNAT.
+
+    Reads the session table but does not modify it; call
+    ``nat_commit_sessions`` afterwards with the flows that may record
+    sessions (the pipeline gates this on its ACL verdict so denied flows
+    can never seed a reflective bypass).
+    """
+    cap = sessions.capacity
+    slot_mask = jnp.uint32(cap - 1)
+
+    # ---------------------------------------------------- 1. reply restore
+    rhash = flow_hash(batch.src_ip, batch.dst_ip, batch.protocol, batch.src_port, batch.dst_port)
+    slot = (rhash & slot_mask).astype(jnp.int32)
+    s_valid = sessions.valid[slot]
+    key_match = (
+        s_valid
+        & (sessions.r_src_ip[slot] == batch.src_ip)
+        & (sessions.r_dst_ip[slot] == batch.dst_ip)
+        & (sessions.r_proto[slot] == batch.protocol)
+        & (sessions.r_src_port[slot] == batch.src_port)
+        & (sessions.r_dst_port[slot] == batch.dst_port)
+    )
+    reply_hit = key_match
+    # Restore: src <- original dst (VIP), dst <- original src (client).
+    src_ip1 = jnp.where(reply_hit, sessions.orig_dst_ip[slot], batch.src_ip)
+    src_port1 = jnp.where(reply_hit, sessions.orig_dst_port[slot], batch.src_port)
+    dst_ip1 = jnp.where(reply_hit, sessions.orig_src_ip[slot], batch.dst_ip)
+    dst_port1 = jnp.where(reply_hit, sessions.orig_src_port[slot], batch.dst_port)
+
+    # --------------------------------------------------------- 2. DNAT LB
+    hit = (
+        tables.map_valid[None, :]
+        & (dst_ip1[:, None] == tables.map_ext_ip[None, :])
+        & (dst_port1[:, None] == tables.map_ext_port[None, :])
+        & (batch.protocol[:, None] == tables.map_proto[None, :])
+    )  # [B, M]
+    dnat_hit = jnp.any(hit, axis=1) & ~reply_hit
+    midx = jnp.argmax(hit, axis=1)
+
+    # Backend pick: affinity hashes the client IP only, else full 5-tuple.
+    h_full = flow_hash(src_ip1, dst_ip1, batch.protocol, src_port1, dst_port1)
+    h_aff = _mix(src_ip1.astype(jnp.uint32) * jnp.uint32(0x9E3779B1))
+    use_aff = tables.map_affinity[midx] == 1
+    h_pick = jnp.where(use_aff, h_aff, h_full)
+    k = (h_pick % jnp.uint32(tables.bucket_size)).astype(jnp.int32)
+    new_dst_ip = tables.backend_ip[midx, k]
+    new_dst_port = tables.backend_port[midx, k]
+    # A mapping that lost all backends was compiled invalid -> no hit; a
+    # zero backend entry inside a valid mapping cannot occur (ring filled).
+
+    dst_ip2 = jnp.where(dnat_hit, new_dst_ip, dst_ip1)
+    dst_port2 = jnp.where(dnat_hit, new_dst_port, dst_port1)
+
+    # Twice-NAT: SELF only when the backend is the client itself
+    # (hairpin); ENABLED always.
+    mode = tables.map_twice_nat[midx]
+    hairpin = dnat_hit & (
+        ((mode == TWICE_NAT_SELF) & (dst_ip2 == src_ip1))
+        | (mode == TWICE_NAT_ENABLED)
+    )
+    src_ip2 = jnp.where(hairpin, jnp.broadcast_to(tables.nat_loopback, src_ip1.shape), src_ip1)
+
+    # ------------------------------------------------------------ 3. SNAT
+    in_cluster = (dst_ip2 & tables.pod_subnet_mask) == tables.pod_subnet_base
+    from_pod = (src_ip2 & tables.pod_subnet_mask) == tables.pod_subnet_base
+    snat_hit = (
+        jnp.broadcast_to(tables.snat_enabled, dnat_hit.shape)
+        & from_pod & ~in_cluster & ~dnat_hit & ~reply_hit
+    )
+    # Hash-allocated ephemeral port (32768..65535).
+    snat_port = (h_full % jnp.uint32(32768)).astype(jnp.int32) + 32768
+    src_ip3 = jnp.where(snat_hit, jnp.broadcast_to(tables.snat_ip, src_ip2.shape), src_ip2)
+    src_port3 = jnp.where(snat_hit, snat_port, src_port1)
+
+    out = PacketBatch(
+        src_ip=src_ip3,
+        dst_ip=dst_ip2,
+        protocol=batch.protocol,
+        src_port=src_port3,
+        dst_port=dst_port2,
+    )
+    return NatRewrite(
+        batch=out,
+        dnat_hit=dnat_hit,
+        reply_hit=reply_hit,
+        snat_hit=snat_hit,
+        reply_slot=slot,
+    )
+
+
+def nat_commit_sessions(
+    sessions: NatSessions,
+    orig: PacketBatch,
+    rewritten: PacketBatch,
+    record: jnp.ndarray,
+    reply_hit: jnp.ndarray,
+    reply_slot: jnp.ndarray,
+    timestamp: jnp.ndarray,
+) -> NatSessions:
+    """Scatter new sessions in and refresh reply keep-alives.
+
+    ``record`` (bool [B]) marks flows allowed to create a session —
+    the pipeline's (translated ∧ ACL-permitted) mask.  Sessions are
+    keyed by the hash of the expected *reply* tuple (src=server,
+    dst=translated client).
+    """
+    cap = sessions.capacity
+    slot_mask = jnp.uint32(cap - 1)
+    reply_key_hash = flow_hash(
+        rewritten.dst_ip, rewritten.src_ip, rewritten.protocol,
+        rewritten.dst_port, rewritten.src_port,
+    )
+    ins_slot = (reply_key_hash & slot_mask).astype(jnp.int32)
+    # Collision policy: newest flow wins the slot (the evicted flow's
+    # replies fall back to the host slow path); duplicate slots within a
+    # batch resolve to the last writer — same-flow packets write equal
+    # values so the race is benign.
+    drop_sentinel = jnp.int32(cap)  # out-of-range -> scatter drops the write
+    w = jnp.where(record, ins_slot, drop_sentinel)
+    sessions = NatSessions(
+        valid=sessions.valid.at[w].set(True, mode="drop"),
+        r_src_ip=sessions.r_src_ip.at[w].set(rewritten.dst_ip, mode="drop"),
+        r_dst_ip=sessions.r_dst_ip.at[w].set(rewritten.src_ip, mode="drop"),
+        r_proto=sessions.r_proto.at[w].set(rewritten.protocol, mode="drop"),
+        r_src_port=sessions.r_src_port.at[w].set(rewritten.dst_port, mode="drop"),
+        r_dst_port=sessions.r_dst_port.at[w].set(rewritten.src_port, mode="drop"),
+        orig_src_ip=sessions.orig_src_ip.at[w].set(orig.src_ip, mode="drop"),
+        orig_src_port=sessions.orig_src_port.at[w].set(orig.src_port, mode="drop"),
+        orig_dst_ip=sessions.orig_dst_ip.at[w].set(orig.dst_ip, mode="drop"),
+        orig_dst_port=sessions.orig_dst_port.at[w].set(orig.dst_port, mode="drop"),
+        last_seen=sessions.last_seen.at[w].set(timestamp, mode="drop"),
+    )
+    # Touch last_seen for reply hits too (keep-alive for the GC sweep).
+    touch = jnp.where(reply_hit, reply_slot, drop_sentinel)
+    return NatSessions(
+        **{
+            **{f: getattr(sessions, f) for f in (
+                "valid", "r_src_ip", "r_dst_ip", "r_proto", "r_src_port",
+                "r_dst_port", "orig_src_ip", "orig_src_port", "orig_dst_ip",
+                "orig_dst_port",
+            )},
+            "last_seen": sessions.last_seen.at[touch].set(timestamp, mode="drop"),
+        }
+    )
+
+
+def nat_step(
+    tables: NatTables,
+    sessions: NatSessions,
+    batch: PacketBatch,
+    timestamp: jnp.ndarray,
+    permit: Optional[jnp.ndarray] = None,
+) -> NatResult:
+    """One NAT pass over a batch: rewrite + session commit.
+
+    ``permit`` (bool [B]) gates session creation: sessions must only be
+    recorded for flows the ACL stages permitted, otherwise a crafted
+    "reply" to a denied flow would ride the reflective bypass.  The
+    pipeline gates on its combined ACL verdict; standalone use defaults
+    to all-permitted.
+    """
+    rw = nat_rewrite(tables, sessions, batch)
+    record = rw.dnat_hit | rw.snat_hit
+    if permit is not None:
+        record = record & permit
+    new_sessions = nat_commit_sessions(
+        sessions, batch, rw.batch, record, rw.reply_hit, rw.reply_slot, timestamp
+    )
+    return NatResult(
+        batch=rw.batch,
+        sessions=new_sessions,
+        dnat_hit=rw.dnat_hit,
+        reply_hit=rw.reply_hit,
+        snat_hit=rw.snat_hit,
+        dropped=jnp.zeros_like(rw.dnat_hit),
+    )
+
+
+nat_step_jit = jax.jit(nat_step, donate_argnums=(1,))
+
+
+def sweep_sessions(sessions: NatSessions, now: int, max_age: int) -> NatSessions:
+    """Host-side idle-session GC: invalidate entries not seen for
+    ``max_age`` batches (the reference's cleanup goroutine analog)."""
+    stale = sessions.valid & ((now - sessions.last_seen) > max_age)
+    return NatSessions(
+        **{
+            **{f: getattr(sessions, f) for f in (
+                "r_src_ip", "r_dst_ip", "r_proto", "r_src_port", "r_dst_port",
+                "orig_src_ip", "orig_src_port", "orig_dst_ip", "orig_dst_port",
+                "last_seen",
+            )},
+            "valid": sessions.valid & ~stale,
+        }
+    )

@@ -1,0 +1,161 @@
+"""The full data-plane step: ACL -> NAT -> routing, in VPP node order.
+
+One jit-compiled program per batch-size/table-bucket combination,
+implementing the reference's per-packet pipeline ordering
+(docs/dev-guide/SERVICES.md:300-307):
+
+    ingress ACL  ->  nat44 out2in (reply restore + DNAT)  ->
+    ip4 routing  ->  nat44 in2out (SNAT)  ->  egress ACL
+
+- The ingress ACL (source pod's table) sees the *original* headers;
+  the egress ACL (destination pod's table) sees the *rewritten* ones —
+  exactly how VPP orders `acl-plugin-in-ip4-fa` before nat44 and
+  `acl-plugin-out-ip4-fa` after it.
+- Routing is node-ID arithmetic (plugins/ipam dissection inverted):
+  the post-NAT destination resolves to LOCAL (this node's pod subnet),
+  REMOTE (another node's chunk of the cluster pod subnet, yielding the
+  node ID for VXLAN encap by the host shim), or HOST/external.
+- Reflective-ACL semantics ride the NAT session table: reply packets
+  restored from a session skip the ACL stages.  Session creation is
+  gated on the ACL verdict, so a session exists only when the forward
+  direction was actually permitted — the analog of the reference's
+  reflective ACL on permitted flows.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .classify import RuleTables, _DENY, classify_dst, classify_src
+from .nat import NatSessions, NatTables, nat_commit_sessions, nat_rewrite
+from .packets import PacketBatch
+
+# Route tags.
+ROUTE_DROP = 0
+ROUTE_LOCAL = 1    # deliver to a pod on this node
+ROUTE_REMOTE = 2   # VXLAN-encap to another node (see node_id)
+ROUTE_HOST = 3     # hand to the host stack / external uplink
+
+
+@dataclass
+class RouteConfig:
+    """Node-ID routing arithmetic (device scalars)."""
+
+    pod_subnet_base: jnp.ndarray    # uint32 [] cluster pod subnet base
+    pod_subnet_mask: jnp.ndarray    # uint32 []
+    this_node_base: jnp.ndarray     # uint32 [] this node's pod subnet base
+    this_node_mask: jnp.ndarray     # uint32 []
+    host_bits: jnp.ndarray          # int32 [] bits of per-node subnet
+
+    def tree_flatten(self):
+        return (
+            (
+                self.pod_subnet_base, self.pod_subnet_mask,
+                self.this_node_base, self.this_node_mask, self.host_bits,
+            ),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    RouteConfig, RouteConfig.tree_flatten, RouteConfig.tree_unflatten
+)
+
+
+def make_route_config(ipam) -> RouteConfig:
+    """Build routing scalars from an IPAM instance."""
+    import ipaddress
+
+    all_net = ipam.pod_subnet_all_nodes
+    this_net = ipam.pod_subnet_this_node
+    all_mask = (0xFFFFFFFF << (32 - all_net.prefixlen)) & 0xFFFFFFFF
+    this_mask = (0xFFFFFFFF << (32 - this_net.prefixlen)) & 0xFFFFFFFF
+    return RouteConfig(
+        pod_subnet_base=jnp.asarray(int(all_net.network_address), dtype=jnp.uint32),
+        pod_subnet_mask=jnp.asarray(all_mask, dtype=jnp.uint32),
+        this_node_base=jnp.asarray(int(this_net.network_address), dtype=jnp.uint32),
+        this_node_mask=jnp.asarray(this_mask, dtype=jnp.uint32),
+        host_bits=jnp.asarray(32 - this_net.prefixlen, dtype=jnp.int32),
+    )
+
+
+class PipelineResult(NamedTuple):
+    batch: PacketBatch      # rewritten headers
+    sessions: NatSessions   # updated NAT session table
+    allowed: jnp.ndarray    # bool [B]
+    route: jnp.ndarray      # int32 [B] ROUTE_* tag (DROP when denied)
+    node_id: jnp.ndarray    # int32 [B] destination node for ROUTE_REMOTE
+    dnat_hit: jnp.ndarray   # bool [B]
+    snat_hit: jnp.ndarray   # bool [B]
+    reply_hit: jnp.ndarray  # bool [B]
+
+
+def pipeline_step(
+    acl: RuleTables,
+    nat: NatTables,
+    route: RouteConfig,
+    sessions: NatSessions,
+    batch: PacketBatch,
+    timestamp: jnp.ndarray,
+) -> PipelineResult:
+    """One batch through the whole data plane."""
+    # 1. Ingress ACL on original headers (source pod's table).
+    src_action = classify_src(acl, batch)
+
+    # 2. NAT translation: reply restore -> DNAT LB -> SNAT (no session
+    # writes yet — those are gated on the full ACL verdict below).
+    rw = nat_rewrite(nat, sessions, batch)
+    rewritten = rw.batch
+
+    # 3. Egress ACL on rewritten headers (destination pod's table).
+    dst_action = classify_dst(acl, rewritten)
+
+    # Session-restored replies skip ACLs (reflective semantics — valid
+    # precisely because only permitted flows ever record sessions).
+    acl_ok = (src_action != _DENY) & (dst_action != _DENY)
+    allowed = acl_ok | rw.reply_hit
+
+    # Commit sessions for translated AND permitted flows only: a denied
+    # flow must never seed a session a crafted "reply" could ride.
+    record = (rw.dnat_hit | rw.snat_hit) & allowed
+    new_sessions = nat_commit_sessions(
+        sessions, batch, rewritten, record, rw.reply_hit, rw.reply_slot, timestamp
+    )
+
+    # 4. Routing on the post-NAT destination.
+    dst = rewritten.dst_ip
+    in_cluster = (dst & route.pod_subnet_mask) == route.pod_subnet_base
+    on_this_node = (dst & route.this_node_mask) == route.this_node_base
+    tag = jnp.where(
+        on_this_node,
+        ROUTE_LOCAL,
+        jnp.where(in_cluster, ROUTE_REMOTE, ROUTE_HOST),
+    )
+    tag = jnp.where(allowed, tag, ROUTE_DROP)
+    node_id = jnp.where(
+        in_cluster & ~on_this_node,
+        ((dst - route.pod_subnet_base) >> route.host_bits.astype(jnp.uint32)).astype(jnp.int32),
+        jnp.int32(0),
+    )
+
+    return PipelineResult(
+        batch=rewritten,
+        sessions=new_sessions,
+        allowed=allowed,
+        route=tag,
+        node_id=node_id,
+        dnat_hit=rw.dnat_hit,
+        snat_hit=rw.snat_hit,
+        reply_hit=rw.reply_hit,
+    )
+
+
+pipeline_step_jit = jax.jit(pipeline_step, donate_argnums=(3,))

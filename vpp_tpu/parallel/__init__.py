@@ -1,0 +1,13 @@
+from .mesh import (
+    make_mesh,
+    shard_dataplane,
+    sharded_pipeline_step,
+    dryrun_multichip,
+)
+
+__all__ = [
+    "make_mesh",
+    "shard_dataplane",
+    "sharded_pipeline_step",
+    "dryrun_multichip",
+]

@@ -1,0 +1,31 @@
+"""Service stack — K8s Services -> NAT44 DNAT/LB maps.
+
+Mirrors the reference's layering (plugins/service, SURVEY.md §2.1):
+
+    ServicePlugin (plugin.py)        event-handler skeleton
+      -> ServiceProcessor (processor.py) pairs Services with Endpoints,
+                                     builds ContivService, tracks
+                                     frontends/backends and node IPs
+      -> renderers (renderer/)       DNAT mapping tensors for the TPU
+                                     NAT kernel (ops/nat.py)
+"""
+
+from .renderer.api import (
+    ContivService,
+    ServiceBackend,
+    ServicePortSpec,
+    ServiceRendererAPI,
+    TrafficPolicy,
+)
+from .processor import ServiceProcessor
+from .plugin import ServicePlugin
+
+__all__ = [
+    "ContivService",
+    "ServiceBackend",
+    "ServicePortSpec",
+    "ServiceRendererAPI",
+    "TrafficPolicy",
+    "ServiceProcessor",
+    "ServicePlugin",
+]

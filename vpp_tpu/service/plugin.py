@@ -1,0 +1,55 @@
+"""Service plugin — event-handler skeleton wiring the service layers.
+
+Analog of ``plugins/service/plugin_impl_service.go`` (:41-129): routes
+KubeStateChange events for services/endpoints/pods and NodeUpdate
+events into the processor.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..controller.api import EventHandler, KubeStateChange
+from ..nodesync import NodeUpdate
+from .processor import ServiceProcessor
+
+log = logging.getLogger(__name__)
+
+
+class ServicePlugin(EventHandler):
+    name = "service"
+
+    def __init__(self, node_name: str, ipam=None, nodesync=None):
+        self.processor = ServiceProcessor(node_name, ipam=ipam, nodesync=nodesync)
+
+    def register_renderer(self, renderer) -> None:
+        self.processor.register_renderer(renderer)
+
+    # -------------------------------------------------------- event handling
+
+    def handles_event(self, event) -> bool:
+        if isinstance(event, KubeStateChange):
+            return event.resource in ("service", "endpoints", "pod")
+        if isinstance(event, NodeUpdate):
+            return True
+        return event.method.is_resync
+
+    def resync(self, event, kube_state, resync_count, txn) -> None:
+        self.processor.resync(kube_state)
+
+    def update(self, event, txn) -> str:
+        if isinstance(event, NodeUpdate):
+            self.processor.on_node_change()
+            return "re-rendered NodePort mappings"
+        if not isinstance(event, KubeStateChange):
+            return ""
+        if event.resource == "service":
+            self.processor.on_service_change(event.prev_value, event.new_value)
+            return "re-rendered service"
+        if event.resource == "endpoints":
+            self.processor.on_endpoints_change(event.prev_value, event.new_value)
+            return "re-rendered endpoints"
+        if event.resource == "pod":
+            self.processor.on_pod_change(event.prev_value, event.new_value)
+            return "refreshed frontends/backends"
+        return ""

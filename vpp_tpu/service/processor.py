@@ -1,0 +1,249 @@
+"""Service processor — pairs Services with Endpoints and drives renderers.
+
+Analog of ``plugins/service/processor/processor_impl.go``:
+
+- pairs Service metadata with Endpoints by (namespace, name)
+  (processNewEndpoints/-Service :205-266);
+- builds ContivService per the reference's Refresh() semantics
+  (processor/service.go :80-203): cluster/external/LB-ingress IPs,
+  per-port backend lists, locality (endpoint node name vs this node),
+  host-network detection (IP outside the pod subnet);
+- tracks local frontends (all local pods) and local backends (local
+  pods serving >=1 service);
+- re-renders NodePort services whenever cluster node IPs change
+  (renderNodePorts :366, getNodeIPs :391).
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import logging
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..models import (
+    Endpoints,
+    Pod,
+    PodID,
+    ProtocolType,
+    Service,
+    ServiceID,
+)
+from .renderer.api import (
+    ContivService,
+    ServiceBackend,
+    ServicePortSpec,
+    ServiceRendererAPI,
+    TrafficPolicy,
+)
+
+log = logging.getLogger(__name__)
+
+
+class ServiceProcessor:
+    def __init__(self, node_name: str, ipam=None, nodesync=None):
+        self.node_name = node_name
+        self.ipam = ipam          # pod-subnet membership for host_network
+        self.nodesync = nodesync  # cluster node IPs for NodePorts
+        self.renderers: List[ServiceRendererAPI] = []
+
+        self._services: Dict[ServiceID, Service] = {}
+        self._endpoints: Dict[ServiceID, Endpoints] = {}
+        self._rendered: Dict[ServiceID, ContivService] = {}
+        self._local_pods: Dict[PodID, str] = {}  # pod -> IP
+        self._backend_pods: Set[str] = set()
+
+    def register_renderer(self, renderer: ServiceRendererAPI) -> None:
+        self.renderers.append(renderer)
+
+    # ------------------------------------------------------------- building
+
+    def _build_contiv_service(self, svc: Service, eps: Optional[Endpoints]) -> Optional[ContivService]:
+        """Refresh() equivalent: combine metadata + endpoints."""
+        if eps is None:
+            return None
+        out = ContivService(
+            id=svc.id,
+            traffic_policy=(
+                TrafficPolicy.NODE_LOCAL
+                if svc.external_traffic_policy == "Local"
+                else TrafficPolicy.CLUSTER_WIDE
+            ),
+            session_affinity_timeout=(
+                (svc.session_affinity_timeout or 10800)
+                if svc.session_affinity == "ClientIP"
+                else 0
+            ),
+        )
+        cluster_ips = []
+        if svc.cluster_ip and not svc.is_headless:
+            cluster_ips.append(svc.cluster_ip)
+        out.cluster_ips = tuple(cluster_ips)
+        external = list(svc.external_ips)
+        if svc.service_type == "LoadBalancer":
+            external.extend(ip for ip in svc.lb_ingress_ips if ip)
+        out.external_ips = tuple(dict.fromkeys(external))
+
+        for port in svc.ports:
+            out.ports[port.name] = ServicePortSpec(
+                protocol=port.protocol, port=port.port, node_port=port.node_port
+            )
+            out.backends[port.name] = []
+
+        pod_subnet = self.ipam.pod_subnet_all_nodes if self.ipam else None
+        for subset in eps.subsets:
+            for addr in subset.addresses:
+                try:
+                    ep_ip = ipaddress.ip_address(addr.ip)
+                except ValueError:
+                    log.warning("service %s: bad endpoint IP %r", svc.id, addr.ip)
+                    continue
+                local = addr.node_name == "" or addr.node_name == self.node_name
+                host_network = pod_subnet is not None and ep_ip not in pod_subnet
+                for ep_port in subset.ports:
+                    if ep_port.name in out.ports:
+                        out.backends[ep_port.name].append(
+                            ServiceBackend(
+                                ip=addr.ip,
+                                port=ep_port.port,
+                                local=local,
+                                host_network=host_network,
+                            )
+                        )
+        return out
+
+    def _local_backend_ips(self) -> Set[str]:
+        """IPs of local pods that serve at least one service."""
+        out: Set[str] = set()
+        local_ips = set(self._local_pods.values())
+        for contiv in self._rendered.values():
+            for backends in contiv.backends.values():
+                for b in backends:
+                    if b.local and b.ip in local_ips:
+                        out.add(b.ip)
+        return out
+
+    def node_ips(self) -> List[str]:
+        """All node IPs in the cluster, without duplicates (getNodeIPs)."""
+        out: List[str] = []
+        if self.nodesync is None:
+            return out
+        for node in self.nodesync.get_all_nodes().values():
+            for ip in node.ip_addresses:
+                plain = ip.split("/")[0]
+                if plain not in out:
+                    out.append(plain)
+            for ip in node.mgmt_ip_addresses:
+                if ip not in out:
+                    out.append(ip)
+        return out
+
+    # ------------------------------------------------------------ rendering
+
+    def _render(self, sid: ServiceID) -> None:
+        svc = self._services.get(sid)
+        eps = self._endpoints.get(sid)
+        new = self._build_contiv_service(svc, eps) if svc is not None else None
+        old = self._rendered.get(sid)
+        if new is not None:
+            self._rendered[sid] = new
+            for r in self.renderers:
+                if old is None:
+                    r.add_service(new)
+                else:
+                    r.update_service(old, new)
+        elif old is not None:
+            self._rendered.pop(sid, None)
+            for r in self.renderers:
+                r.delete_service(old)
+        self._refresh_backends()
+        # NodePort mappings are re-exported by the renderer itself from its
+        # stored node-IP set on every add/update/delete — a second
+        # update_node_port_services() here would just recompile twice.
+        # _render_node_ports() is reserved for node-membership changes.
+
+    def _refresh_backends(self) -> None:
+        backends = self._local_backend_ips()
+        if backends != self._backend_pods:
+            self._backend_pods = backends
+            for r in self.renderers:
+                r.update_local_backends(set(backends))
+
+    def _render_node_ports(self) -> None:
+        np_services = [s for s in self._rendered.values() if s.has_node_port]
+        ips = self.node_ips()
+        for r in self.renderers:
+            r.update_node_port_services(ips, np_services)
+
+    # --------------------------------------------------------------- events
+
+    def resync(self, kube_state) -> None:
+        self._services = {s.id: s for s in kube_state.get("service", {}).values()}
+        self._endpoints = {
+            ServiceID(e.name, e.namespace): e
+            for e in kube_state.get("endpoints", {}).values()
+        }
+        self._local_pods = {}
+        for pod in kube_state.get("pod", {}).values():
+            if pod.ip_address and self._is_local_ip(pod.ip_address):
+                self._local_pods[pod.id] = pod.ip_address
+        self._rendered = {}
+        for sid, svc in self._services.items():
+            contiv = self._build_contiv_service(svc, self._endpoints.get(sid))
+            if contiv is not None:
+                self._rendered[sid] = contiv
+        self._backend_pods = self._local_backend_ips()
+        for r in self.renderers:
+            r.resync(
+                list(self._rendered.values()),
+                self.node_ips(),
+                set(self._local_pods.values()),
+                set(self._backend_pods),
+            )
+
+    def _is_local_ip(self, ip: str) -> bool:
+        """A pod is local iff its IP falls in this node's IPAM-dissected
+        pod subnet — pure arithmetic, no extra state (the reference keys
+        locality off podmanager's Docker-learned LocalPods instead)."""
+        if self.ipam is None:
+            return True
+        try:
+            return ipaddress.ip_address(ip) in self.ipam.pod_subnet_this_node
+        except ValueError:
+            return False
+
+    def on_service_change(self, old: Optional[Service], new: Optional[Service]) -> None:
+        svc = new if new is not None else old
+        if svc is None:
+            return
+        if new is not None:
+            self._services[new.id] = new
+        else:
+            self._services.pop(old.id, None)
+        self._render(svc.id)
+
+    def on_endpoints_change(self, old: Optional[Endpoints], new: Optional[Endpoints]) -> None:
+        eps = new if new is not None else old
+        if eps is None:
+            return
+        sid = ServiceID(eps.name, eps.namespace)
+        if new is not None:
+            self._endpoints[sid] = new
+        else:
+            self._endpoints.pop(sid, None)
+        self._render(sid)
+
+    def on_pod_change(self, old: Optional[Pod], new: Optional[Pod]) -> None:
+        pod = new if new is not None else old
+        if pod is None:
+            return
+        if new is not None and new.ip_address and self._is_local_ip(new.ip_address):
+            self._local_pods[new.id] = new.ip_address
+        else:
+            self._local_pods.pop(pod.id, None)
+        self._refresh_backends()
+        for r in self.renderers:
+            r.update_local_frontends(set(self._local_pods.values()))
+
+    def on_node_change(self) -> None:
+        """Node joined/left/changed IPs: refresh all NodePort mappings."""
+        self._render_node_ports()

@@ -1,0 +1,15 @@
+from .api import (
+    ContivService,
+    ServiceBackend,
+    ServicePortSpec,
+    ServiceRendererAPI,
+    TrafficPolicy,
+)
+
+__all__ = [
+    "ContivService",
+    "ServiceBackend",
+    "ServicePortSpec",
+    "ServiceRendererAPI",
+    "TrafficPolicy",
+]

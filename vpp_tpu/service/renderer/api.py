@@ -1,0 +1,95 @@
+"""Service renderer boundary — ContivService.
+
+Analog of ``plugins/service/renderer/api.go``: a less-abstract,
+reference-free representation of one K8s Service with its endpoints
+combined in, plus the renderer plug-in interface the processor drives
+(AddService/UpdateService/DeleteService/UpdateNodePortServices/Resync
+:78-111).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Sequence, Set, Tuple
+
+from ...models import ProtocolType, ServiceID
+
+
+class TrafficPolicy(enum.Enum):
+    """Cluster-wide vs node-local load balancing (api.go TrafficPolicyType)."""
+
+    CLUSTER_WIDE = "cluster-wide"
+    NODE_LOCAL = "node-local"
+
+
+@dataclass(frozen=True)
+class ServicePortSpec:
+    """One exposed port (api.go ServicePort)."""
+
+    protocol: ProtocolType
+    port: int          # exposed on cluster/external IPs (0 if none)
+    node_port: int = 0  # exposed on node IPs (0 if none)
+
+
+@dataclass(frozen=True)
+class ServiceBackend:
+    """One endpoint (api.go ServiceBackend)."""
+
+    ip: str
+    port: int
+    local: bool = False         # deployed on this node
+    host_network: bool = False  # IP outside the pod subnet
+
+
+@dataclass
+class ContivService:
+    """One service, endpoints combined in (api.go ContivService :113)."""
+
+    id: ServiceID
+    traffic_policy: TrafficPolicy = TrafficPolicy.CLUSTER_WIDE
+    session_affinity_timeout: int = 0
+    cluster_ips: Tuple[str, ...] = ()
+    external_ips: Tuple[str, ...] = ()
+    # port name -> spec / backends.
+    ports: Dict[str, ServicePortSpec] = field(default_factory=dict)
+    backends: Dict[str, List[ServiceBackend]] = field(default_factory=dict)
+
+    @property
+    def has_node_port(self) -> bool:
+        return any(p.node_port != 0 for p in self.ports.values())
+
+
+class ServiceRendererAPI:
+    """Renderer plug-in interface (api.go ServiceRendererAPI)."""
+
+    def add_service(self, service: ContivService) -> None:
+        raise NotImplementedError
+
+    def update_service(self, old: ContivService, new: ContivService) -> None:
+        raise NotImplementedError
+
+    def delete_service(self, service: ContivService) -> None:
+        raise NotImplementedError
+
+    def update_node_port_services(
+        self, node_ips: Sequence[str], np_services: Sequence[ContivService]
+    ) -> None:
+        """Called whenever the set of node IPs changes."""
+        raise NotImplementedError
+
+    def update_local_frontends(self, frontends: Set[str]) -> None:
+        """Pod IPs acting as service clients on this node (the reference's
+        interface-name sets become pod-IP sets in the TPU data plane)."""
+
+    def update_local_backends(self, backends: Set[str]) -> None:
+        """Pod IPs acting as service endpoints on this node."""
+
+    def resync(
+        self,
+        services: Sequence[ContivService],
+        node_ips: Sequence[str],
+        frontends: Set[str],
+        backends: Set[str],
+    ) -> None:
+        raise NotImplementedError

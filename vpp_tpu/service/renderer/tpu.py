@@ -1,0 +1,185 @@
+"""TPU service renderer — ContivService -> NAT mapping tensors.
+
+Analog of ``plugins/service/renderer/nat44/nat44_renderer.go``: exports
+one DNAT mapping per (service IP x port), with weighted backends and
+twice-NAT flags (exportDNATMappings :421-513), and compiles the whole
+mapping set into ``NatTables`` for the NAT kernel on every change.
+
+Reference semantics kept:
+- NodePort mappings are exported for every node IP in the cluster;
+- remote backends are skipped when the traffic policy is node-local;
+- local backends get ``local_weight`` (ServiceLocalEndpointWeight);
+- external-IP mappings of cluster-wide services use twice-NAT ENABLED
+  (client source always rewritten), everything else SELF (hairpin only);
+- a mapping with no eligible backends is not installed.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from ...models import ProtocolType, ServiceID
+from ...ops.nat import (
+    NatMapping,
+    NatTables,
+    TWICE_NAT_ENABLED,
+    TWICE_NAT_SELF,
+    build_nat_tables,
+)
+from .api import ContivService, ServiceRendererAPI, TrafficPolicy
+
+log = logging.getLogger(__name__)
+
+
+class TpuNatRenderer(ServiceRendererAPI):
+    """Keeps rendered services; compiles NAT tensors on every change."""
+
+    def __init__(
+        self,
+        nat_loopback: str = "0.0.0.0",
+        snat_ip: str = "0.0.0.0",
+        snat_enabled: bool = False,
+        pod_subnet: str = "10.1.0.0/16",
+        local_weight: int = 1,
+        on_compiled: Optional[Callable[[NatTables], None]] = None,
+    ):
+        self.nat_loopback = nat_loopback
+        self.snat_ip = snat_ip
+        self.snat_enabled = snat_enabled
+        self.pod_subnet = pod_subnet
+        self.local_weight = max(1, local_weight)
+        self._services: Dict[ServiceID, ContivService] = {}
+        self._node_ips: List[str] = []
+        self._frontends: Set[str] = set()
+        self._backends: Set[str] = set()
+        self._lock = threading.Lock()
+        self._compiled: Optional[NatTables] = None
+        self._on_compiled = on_compiled
+        self._recompile()
+
+    # --------------------------------------------------------------- queries
+
+    @property
+    def tables(self) -> Optional[NatTables]:
+        with self._lock:
+            return self._compiled
+
+    def mappings(self) -> List[NatMapping]:
+        with self._lock:
+            return self._export_all()
+
+    # ------------------------------------------------------------- renderer
+
+    def add_service(self, service: ContivService) -> None:
+        with self._lock:
+            self._services[service.id] = service
+        self._recompile()
+
+    def update_service(self, old: ContivService, new: ContivService) -> None:
+        with self._lock:
+            self._services[new.id] = new
+        self._recompile()
+
+    def delete_service(self, service: ContivService) -> None:
+        with self._lock:
+            self._services.pop(service.id, None)
+        self._recompile()
+
+    def update_node_port_services(self, node_ips, np_services) -> None:
+        with self._lock:
+            self._node_ips = list(node_ips)
+            for svc in np_services:
+                self._services[svc.id] = svc
+        self._recompile()
+
+    def update_local_frontends(self, frontends: Set[str]) -> None:
+        with self._lock:
+            self._frontends = set(frontends)
+
+    def update_local_backends(self, backends: Set[str]) -> None:
+        with self._lock:
+            self._backends = set(backends)
+
+    def resync(self, services, node_ips, frontends, backends) -> None:
+        with self._lock:
+            self._services = {s.id: s for s in services}
+            self._node_ips = list(node_ips)
+            self._frontends = set(frontends)
+            self._backends = set(backends)
+        self._recompile()
+
+    # ---------------------------------------------------------------- export
+
+    def _export_service(self, svc: ContivService) -> List[NatMapping]:
+        """exportDNATMappings for one service."""
+        out: List[NatMapping] = []
+
+        def backends_for(port_name: str) -> List[Tuple[str, int, int]]:
+            chosen: List[Tuple[str, int, int]] = []
+            for b in svc.backends.get(port_name, []):
+                if svc.traffic_policy is not TrafficPolicy.CLUSTER_WIDE and not b.local:
+                    continue  # do not LB to remote backends (node-local policy)
+                weight = self.local_weight if b.local else 1
+                chosen.append((b.ip, b.port, weight))
+            if len(chosen) == 1:
+                # Single backend: weight is irrelevant (reference sets
+                # probability 0 = unconfigured).
+                chosen = [(chosen[0][0], chosen[0][1], 1)]
+            return chosen
+
+        def add(ip: str, port: int, proto: ProtocolType, twice_nat: int, port_name: str):
+            if port == 0:
+                return
+            backends = backends_for(port_name)
+            if not backends:
+                return
+            out.append(
+                NatMapping(
+                    external_ip=ip,
+                    external_port=port,
+                    protocol=int(proto),
+                    backends=backends,
+                    twice_nat=twice_nat,
+                    session_affinity_timeout=svc.session_affinity_timeout,
+                )
+            )
+
+        for port_name, spec in svc.ports.items():
+            # NodePort mappings on every node IP.
+            if spec.node_port:
+                for node_ip in self._node_ips:
+                    add(node_ip, spec.node_port, spec.protocol, TWICE_NAT_SELF, port_name)
+            # Cluster IPs.
+            for ip in svc.cluster_ips:
+                add(ip, spec.port, spec.protocol, TWICE_NAT_SELF, port_name)
+            # External IPs: cluster-wide services rewrite the client source
+            # so replies return through this node (twice-NAT ENABLED).
+            twice = (
+                TWICE_NAT_ENABLED
+                if svc.traffic_policy is TrafficPolicy.CLUSTER_WIDE
+                else TWICE_NAT_SELF
+            )
+            for ip in svc.external_ips:
+                add(ip, spec.port, spec.protocol, twice, port_name)
+        return out
+
+    def _export_all(self) -> List[NatMapping]:
+        mappings: List[NatMapping] = []
+        for sid in sorted(self._services):
+            mappings.extend(self._export_service(self._services[sid]))
+        return mappings
+
+    def _recompile(self) -> None:
+        with self._lock:
+            compiled = build_nat_tables(
+                self._export_all(),
+                nat_loopback=self.nat_loopback,
+                snat_ip=self.snat_ip,
+                snat_enabled=self.snat_enabled,
+                pod_subnet=self.pod_subnet,
+            )
+            self._compiled = compiled
+        if self._on_compiled is not None:
+            self._on_compiled(compiled)

@@ -1,0 +1,191 @@
+"""Mock NAT engine — the NAT44 semantics oracle.
+
+Analog of ``mock/natplugin/natplugin_mock.go``: consumes the compiled
+DNAT mapping state and simulates per-flow NAT processing in plain
+Python, defining the exact semantics the TPU ``nat_step`` kernel must
+reproduce — including the flow-hash backend pick (same mixer, same
+bucket ring) so backend choices are bit-for-bit comparable.
+
+Also exposes the mapping-level assertions the reference mock provides
+(HasStaticMapping :502 etc.) for control-plane tests.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops.nat import (
+    NatMapping,
+    TWICE_NAT_ENABLED,
+    TWICE_NAT_SELF,
+)
+from ..ops.packets import ip_to_u32, u32_to_ip
+
+
+def _mix(h: int) -> int:
+    h &= 0xFFFFFFFF
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h
+
+
+def flow_hash_py(src_ip: int, dst_ip: int, proto: int, src_port: int, dst_port: int) -> int:
+    """Python replica of ops.nat.flow_hash (must stay in lockstep)."""
+    h = (src_ip * 0x9E3779B1) & 0xFFFFFFFF
+    h = _mix(h ^ dst_ip)
+    h = _mix(h ^ ((proto << 16) & 0xFFFFFFFF) ^ src_port)
+    h = _mix(h ^ dst_port)
+    return h
+
+
+@dataclass
+class Flow:
+    src_ip: int
+    dst_ip: int
+    proto: int
+    src_port: int
+    dst_port: int
+
+    @classmethod
+    def make(cls, src_ip, dst_ip, proto, src_port, dst_port) -> "Flow":
+        return cls(ip_to_u32(src_ip), ip_to_u32(dst_ip), int(proto), int(src_port), int(dst_port))
+
+    def key(self) -> Tuple:
+        return (self.src_ip, self.dst_ip, self.proto, self.src_port, self.dst_port)
+
+    def __str__(self) -> str:
+        return (
+            f"{u32_to_ip(self.src_ip)}:{self.src_port} -> "
+            f"{u32_to_ip(self.dst_ip)}:{self.dst_port} ({self.proto})"
+        )
+
+
+@dataclass
+class FlowResult:
+    flow: Flow
+    dnat: bool = False
+    reply: bool = False
+    snat: bool = False
+
+
+class MockNatEngine:
+    """Semantics mirror of the nat_step kernel."""
+
+    def __init__(
+        self,
+        nat_loopback: str = "0.0.0.0",
+        snat_ip: str = "0.0.0.0",
+        snat_enabled: bool = False,
+        pod_subnet: str = "10.1.0.0/16",
+        bucket_size: int = 64,
+        session_capacity: int = 65536,
+    ):
+        self.mappings: List[NatMapping] = []
+        self.nat_loopback = ip_to_u32(nat_loopback)
+        self.snat_ip = ip_to_u32(snat_ip)
+        self.snat_enabled = snat_enabled
+        self.pod_subnet = ipaddress.ip_network(pod_subnet)
+        self.bucket_size = bucket_size
+        self.session_capacity = session_capacity
+        # slot -> (reply key tuple, restore (src_ip, src_port, dst_ip, dst_port))
+        self.sessions: Dict[int, Tuple[Tuple, Tuple]] = {}
+
+    # ---------------------------------------------------------- assertions
+
+    def set_mappings(self, mappings: Sequence[NatMapping]) -> None:
+        self.mappings = list(mappings)
+
+    def has_static_mapping(self, external_ip: str, external_port: int, protocol: int) -> bool:
+        ip = ip_to_u32(external_ip)
+        return any(
+            ip_to_u32(m.external_ip) == ip
+            and m.external_port == external_port
+            and m.protocol == protocol
+            and m.backends
+            for m in self.mappings
+        )
+
+    def backends_of(self, external_ip: str, external_port: int) -> List[Tuple[str, int, int]]:
+        ip = ip_to_u32(external_ip)
+        for m in self.mappings:
+            if ip_to_u32(m.external_ip) == ip and m.external_port == external_port:
+                return list(m.backends)
+        return []
+
+    # ------------------------------------------------------------- traffic
+
+    def _bucket_ring(self, mapping: NatMapping) -> List[Tuple[int, int]]:
+        expanded: List[Tuple[int, int]] = []
+        for ip, port, weight in mapping.backends:
+            expanded.extend([(ip_to_u32(ip), port)] * max(1, weight))
+        return [expanded[k % len(expanded)] for k in range(self.bucket_size)]
+
+    def process(self, flow: Flow, timestamp: int = 0) -> FlowResult:
+        """Mirror of nat_step for one flow: reply -> DNAT -> SNAT."""
+        result = FlowResult(flow=Flow(*flow.key()))
+        f = result.flow
+
+        # 1. Reply restoration.
+        slot = flow_hash_py(*f.key()) & (self.session_capacity - 1)
+        entry = self.sessions.get(slot)
+        if entry is not None and entry[0] == f.key():
+            orig_src_ip, orig_src_port, orig_dst_ip, orig_dst_port = entry[1]
+            f.src_ip, f.src_port = orig_dst_ip, orig_dst_port
+            f.dst_ip, f.dst_port = orig_src_ip, orig_src_port
+            result.reply = True
+            return result
+
+        orig = flow.key()
+
+        # 2. DNAT (first mapping wins, matching the kernel's argmax).
+        for mapping in self.mappings:
+            if not mapping.backends:
+                continue
+            if (
+                ip_to_u32(mapping.external_ip) == f.dst_ip
+                and mapping.external_port == f.dst_port
+                and mapping.protocol == f.proto
+            ):
+                if mapping.session_affinity_timeout > 0:
+                    h = _mix((f.src_ip * 0x9E3779B1) & 0xFFFFFFFF)
+                else:
+                    h = flow_hash_py(*f.key())
+                ring = self._bucket_ring(mapping)
+                b_ip, b_port = ring[h % self.bucket_size]
+                hairpin = (
+                    mapping.twice_nat == TWICE_NAT_ENABLED
+                    or (mapping.twice_nat == TWICE_NAT_SELF and b_ip == f.src_ip)
+                )
+                f.dst_ip, f.dst_port = b_ip, b_port
+                if hairpin:
+                    f.src_ip = self.nat_loopback
+                result.dnat = True
+                break
+
+        # 3. SNAT for pod egress.
+        if not result.dnat:
+            in_cluster = ipaddress.ip_address(f.dst_ip) in self.pod_subnet
+            from_pod = ipaddress.ip_address(f.src_ip) in self.pod_subnet
+            if self.snat_enabled and from_pod and not in_cluster:
+                h = flow_hash_py(*orig)
+                f.src_ip = self.snat_ip
+                f.src_port = (h % 32768) + 32768
+                result.snat = True
+
+        # 4. Session recording, keyed by the expected reply tuple.
+        if result.dnat or result.snat:
+            reply_key = (f.dst_ip, f.src_ip, f.proto, f.dst_port, f.src_port)
+            ins = flow_hash_py(*reply_key) & (self.session_capacity - 1)
+            orig_src_ip, orig_dst_ip, _, orig_src_port, orig_dst_port = orig
+            self.sessions[ins] = (
+                reply_key,
+                (orig_src_ip, orig_src_port, orig_dst_ip, orig_dst_port),
+            )
+        return result
