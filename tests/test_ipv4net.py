@@ -1,0 +1,161 @@
+"""ipv4net connectivity tests: two-node cluster wiring through the full
+controller + scheduler + host-FIB mock (the reference's untested-in-unit
+ipv4net paths, done better per SURVEY.md §4.4)."""
+
+import time
+
+from vpp_tpu.conf import NetworkConfig
+from vpp_tpu.controller import Controller, DBWatcher
+from vpp_tpu.ipv4net import IPv4Net
+from vpp_tpu.ipv4net.model import IF_PREFIX
+from vpp_tpu.kvstore import KVStore
+from vpp_tpu.models import Pod, key_for
+from vpp_tpu.nodesync import NodeSync
+from vpp_tpu.podmanager import PodManager
+from vpp_tpu.scheduler import TxnScheduler
+from vpp_tpu.testing.hostfib import MockHostFIB
+
+
+def boot(store, node_name, config=None):
+    config = config or NetworkConfig()
+    nodesync = NodeSync(store, node_name)
+    podmanager = PodManager()
+    ipv4net = IPv4Net(config, nodesync, podmanager=podmanager)
+    fib = MockHostFIB()
+    sched = TxnScheduler()
+    sched.register_applicator(fib)
+    ctl = Controller([nodesync, podmanager, ipv4net], sched, healing_delay=0.05)
+    podmanager.event_loop = ctl
+    nodesync.event_loop = ctl
+    ctl.start()
+    watcher = DBWatcher(ctl, store)
+    watcher.start()
+    return {
+        "nodesync": nodesync, "podmanager": podmanager, "ipv4net": ipv4net,
+        "fib": fib, "ctl": ctl, "watcher": watcher, "sched": sched,
+    }
+
+
+def wait_for(cond, timeout=3.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_single_node_base_config():
+    store = KVStore()
+    node = boot(store, "node-a")
+    try:
+        fib = node["fib"]
+        assert wait_for(lambda: fib.get_interface("tap-vpp2") is not None)
+        # Two VRFs + host interconnect + BVI.
+        assert {v.id for v in fib.vrfs()} == {0, 1}
+        bvi = fib.get_interface("vxlanBVI")
+        assert bvi is not None and bvi.ip_addresses == ("192.168.30.1/24",)
+        assert fib.bridge_domain("vxlanBD") is not None
+        # Pod VRF leaks to main.
+        assert fib.has_route("0.0.0.0/0", vrf=1)
+    finally:
+        node["watcher"].stop()
+        node["ctl"].stop()
+
+
+def test_pod_wiring_via_cni():
+    store = KVStore()
+    node = boot(store, "node-a")
+    try:
+        fib = node["fib"]
+        assert wait_for(lambda: fib.get_interface("tap-vpp2") is not None)
+        reply = node["podmanager"].add_pod("web", "default")
+        assert reply.ip_address == "10.1.1.2/32"
+        assert reply.routes[0]["gw"] == "10.1.1.1"
+        tap = fib.get_interface("tap-default-web")
+        assert tap is not None and tap.vrf == 1
+        assert fib.has_route("10.1.1.2/32", vrf=1)
+        assert any(a.ip_address == "10.1.1.2" for a in fib.arp_entries())
+
+        node["podmanager"].delete_pod("web", "default")
+        assert fib.get_interface("tap-default-web") is None
+        assert not fib.has_route("10.1.1.2/32", vrf=1)
+    finally:
+        node["watcher"].stop()
+        node["ctl"].stop()
+
+
+def test_two_node_overlay_full_mesh():
+    store = KVStore()
+    a = boot(store, "node-a")
+    try:
+        assert wait_for(lambda: a["fib"].get_interface("tap-vpp2") is not None)
+        b = boot(store, "node-b")
+        try:
+            # Node B sees A and built its tunnel; A reacts to B's join.
+            assert wait_for(lambda: a["fib"].get_interface("vxlan2") is not None)
+            assert wait_for(lambda: b["fib"].get_interface("vxlan1") is not None)
+
+            vx = a["fib"].get_interface("vxlan2")
+            assert vx.vxlan_src == "192.168.16.1" and vx.vxlan_dst == "192.168.16.2"
+            # Routes to B's pod/host subnets via B's BVI.
+            assert a["fib"].has_route("10.1.2.0/24", vrf=1)
+            assert a["fib"].has_route("172.30.2.0/24", vrf=1)
+            # L2FIB entry toward B.
+            assert any(
+                e.outgoing_interface == "vxlan2" for e in a["fib"].l2_fib_entries()
+            )
+            # Bridge domain includes the tunnel.
+            assert wait_for(lambda: "vxlan2" in a["fib"].bridge_domain("vxlanBD").interfaces)
+
+            # Node B leaves: A tears the tunnel + routes down.
+            b["nodesync"].release_id()
+            assert wait_for(lambda: a["fib"].get_interface("vxlan2") is None)
+            assert not a["fib"].has_route("10.1.2.0/24", vrf=1)
+        finally:
+            b["watcher"].stop()
+            b["ctl"].stop()
+    finally:
+        a["watcher"].stop()
+        a["ctl"].stop()
+
+
+def test_healing_resync_preserves_cni_pods():
+    """A full resync must NOT tear down pods added via CNI that KubeState
+    does not reflect yet (and must not reuse their IPs)."""
+    store = KVStore()
+    node = boot(store, "node-a")
+    try:
+        fib = node["fib"]
+        assert wait_for(lambda: fib.get_interface("tap-vpp2") is not None)
+        reply = node["podmanager"].add_pod("web", "default")
+        assert reply.ip_address == "10.1.1.2/32"
+        # Trigger an on-demand full resync (the healing path).
+        node["watcher"].resync()
+        time.sleep(0.3)
+        assert fib.get_interface("tap-default-web") is not None
+        assert fib.has_route("10.1.1.2/32", vrf=1)
+        # The IP stays allocated: the next pod gets a different one.
+        reply2 = node["podmanager"].add_pod("db", "default")
+        assert reply2.ip_address == "10.1.1.3/32"
+    finally:
+        node["watcher"].stop()
+        node["ctl"].stop()
+
+
+def test_resync_rebuilds_pod_wiring_from_kube_state():
+    store = KVStore()
+    pod = Pod(name="web", namespace="default", ip_address="10.1.1.7")
+    store.put(key_for(pod), pod)
+    node = boot(store, "node-a")
+    try:
+        fib = node["fib"]
+        # Startup resync adopts the pod (IP in node-a's subnet) and wires it.
+        assert wait_for(lambda: fib.get_interface("tap-default-web") is not None)
+        assert fib.has_route("10.1.1.7/32", vrf=1)
+        # The IPAM pool was re-learned: next pod continues after .7.
+        reply = node["podmanager"].add_pod("db", "default")
+        assert reply.ip_address == "10.1.1.8/32"
+    finally:
+        node["watcher"].stop()
+        node["ctl"].stop()

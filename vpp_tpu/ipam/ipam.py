@@ -201,6 +201,23 @@ class IPAM:
 
     # ----------------------------------------------------------------- resync
 
+    def adopt(self, pod_id: PodID, ip) -> bool:
+        """Force-register an existing allocation (used to preserve
+        CNI-granted IPs of pods not yet reflected into KubeState across a
+        resync). Returns False if the IP is reserved/foreign."""
+        ip = ipaddress.ip_address(str(ip))
+        with self._lock:
+            base = int(self.pod_subnet_this_node.network_address)
+            host_bits = 32 - self.pod_subnet_this_node.prefixlen
+            max_seq = (1 << host_bits) - 2
+            seq = int(ip) - base
+            if seq == POD_GATEWAY_SEQ_ID or not (0 < seq < max_seq):
+                return False
+            self._assigned[int(ip)] = pod_id
+            self._pod_to_ip[pod_id] = ip
+            self._last_assigned_seq = max(self._last_assigned_seq, seq)
+            return True
+
     def resync(self, kube_state) -> None:
         """Re-learn the pool from KubeState pods (ipam.go Resync :127):
         adopt every pod whose IP falls into this node's subnet."""

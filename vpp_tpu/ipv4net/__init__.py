@@ -1,0 +1,23 @@
+from .model import (
+    Interface,
+    InterfaceType,
+    Route,
+    ArpEntry,
+    BridgeDomain,
+    L2FibEntry,
+    VrfTable,
+    CONFIG_PREFIX,
+)
+from .plugin import IPv4Net
+
+__all__ = [
+    "Interface",
+    "InterfaceType",
+    "Route",
+    "ArpEntry",
+    "BridgeDomain",
+    "L2FibEntry",
+    "VrfTable",
+    "CONFIG_PREFIX",
+    "IPv4Net",
+]

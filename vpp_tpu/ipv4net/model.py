@@ -1,0 +1,154 @@
+"""Typed network-config values — the southbound model layer.
+
+Analog of the vpp-agent proto models the reference renders into
+(vpp_interfaces.Interface, vpp_l3.Route, vpp_l2.BridgeDomain, ... —
+consumed through the vendored vppv2 configurators, SURVEY.md §1 L2).
+These are the values ipv4net Put()s into event transactions; the txn
+scheduler diffs them and drives the host-FIB applicator (and, for the
+TPU path, route-table updates).
+
+Each value type carries its dependency semantics (interfaces before
+routes/ARP referencing them, bridge domains before L2 FIB entries) via
+``dependencies()`` — picked up generically by the scheduler.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional, Set, Tuple
+
+CONFIG_PREFIX = "/vpp-tpu/config/"
+IF_PREFIX = CONFIG_PREFIX + "interface/"
+ROUTE_PREFIX = CONFIG_PREFIX + "route/"
+ARP_PREFIX = CONFIG_PREFIX + "arp/"
+BD_PREFIX = CONFIG_PREFIX + "bd/"
+L2FIB_PREFIX = CONFIG_PREFIX + "l2fib/"
+VRF_PREFIX = CONFIG_PREFIX + "vrf/"
+
+
+class InterfaceType(enum.Enum):
+    TAP = "tap"            # pod-side interconnect (reference: VPP TAP + Linux TAP)
+    VETH = "veth"
+    LOOPBACK = "loopback"  # e.g. the BVI
+    VXLAN = "vxlan"        # overlay tunnel to another node
+    DPDK = "dpdk"          # physical uplink (name kept for familiarity)
+    MEMIF = "memif"        # host<->data-plane shim attachment
+
+
+@dataclass(frozen=True)
+class Interface:
+    """One interface (vpp_interfaces.Interface analog)."""
+
+    name: str
+    type: InterfaceType
+    enabled: bool = True
+    ip_addresses: Tuple[str, ...] = ()  # "a.b.c.d/len"
+    vrf: int = 0
+    mtu: int = 1450
+    # VXLAN specifics.
+    vxlan_src: str = ""
+    vxlan_dst: str = ""
+    vxlan_vni: int = 0
+    # TAP specifics: the pod/host peer namespace.
+    host_if_name: str = ""
+    namespace: str = ""
+    physical_address: str = ""
+
+    @property
+    def key(self) -> str:
+        return IF_PREFIX + self.name
+
+    def dependencies(self) -> Set[str]:
+        return set()
+
+
+@dataclass(frozen=True)
+class VrfTable:
+    """A routing table (vpp_l3.VrfTable analog)."""
+
+    id: int
+    label: str = ""
+
+    @property
+    def key(self) -> str:
+        return f"{VRF_PREFIX}{self.id}"
+
+    def dependencies(self) -> Set[str]:
+        return set()
+
+
+@dataclass(frozen=True)
+class Route:
+    """A static route (vpp_l3.Route analog)."""
+
+    dst_network: str
+    next_hop: str = ""
+    outgoing_interface: str = ""
+    vrf: int = 0
+    # Route leaking between VRFs (the reference's inter-VRF routes).
+    via_vrf: Optional[int] = None
+
+    @property
+    def key(self) -> str:
+        return f"{ROUTE_PREFIX}vrf{self.vrf}/{self.dst_network}"
+
+    def dependencies(self) -> Set[str]:
+        deps = {f"{VRF_PREFIX}{self.vrf}"}
+        if self.outgoing_interface:
+            deps.add(IF_PREFIX + self.outgoing_interface)
+        return deps
+
+
+@dataclass(frozen=True)
+class ArpEntry:
+    """A static ARP entry (vpp_l3.ARPEntry analog)."""
+
+    interface: str
+    ip_address: str
+    physical_address: str
+    static: bool = True
+
+    @property
+    def key(self) -> str:
+        return f"{ARP_PREFIX}{self.interface}/{self.ip_address}"
+
+    def dependencies(self) -> Set[str]:
+        return {IF_PREFIX + self.interface}
+
+
+@dataclass(frozen=True)
+class BridgeDomain:
+    """An L2 bridge domain (vpp_l2.BridgeDomain analog)."""
+
+    name: str
+    interfaces: Tuple[str, ...] = ()
+    bvi_interface: str = ""
+
+    @property
+    def key(self) -> str:
+        return BD_PREFIX + self.name
+
+    def dependencies(self) -> Set[str]:
+        # The BD exists as soon as the BVI does; member interfaces attach
+        # as they appear (matching vpp-agent's partial-BD semantics).
+        deps = set()
+        if self.bvi_interface:
+            deps.add(IF_PREFIX + self.bvi_interface)
+        return deps
+
+
+@dataclass(frozen=True)
+class L2FibEntry:
+    """A static L2 FIB entry (vpp_l2.FIBEntry analog)."""
+
+    bridge_domain: str
+    physical_address: str
+    outgoing_interface: str
+
+    @property
+    def key(self) -> str:
+        return f"{L2FIB_PREFIX}{self.bridge_domain}/{self.physical_address}"
+
+    def dependencies(self) -> Set[str]:
+        return {BD_PREFIX + self.bridge_domain, IF_PREFIX + self.outgoing_interface}

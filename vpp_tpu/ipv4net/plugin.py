@@ -1,0 +1,327 @@
+"""IPv4Net — builds ALL connectivity configuration.
+
+Analog of ``plugins/ipv4net`` (SURVEY.md §2.1): renders, as typed KVs
+into event transactions,
+
+- the vswitch base config: VRF tables, the host interconnect (TAP pair
+  analog), the VXLAN BVI loopback + bridge domain
+  (resync_events.go configureVswitchConnectivity);
+- per-pod connectivity: TAP interface, static ARP, /32 route in the pod
+  VRF (pod.go podConnectivityConfig :57, podVPPTap :129) — and fills
+  the CNI reply of AddPod events;
+- the full-mesh overlay: one VXLAN tunnel per other node, static L2FIB
+  entry to its BVI MAC, routes to its pod/host subnets via its BVI IP
+  (node.go vxlanBridgeDomain :482, vxlanIfToOtherNode :524,
+  routesPodToMainVRF :338).
+
+MACs are derived deterministically from IPs (the reference hardcodes
+generation schemes per interface kind).
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import logging
+from typing import Dict, List, Optional
+
+from ..conf import NetworkConfig
+from ..controller.api import EventHandler, KubeStateChange
+from ..ipam import IPAM
+from ..models import PodID
+from ..nodesync import NodeSync, NodeUpdate
+from ..podmanager import AddPod, DeletePod
+from .model import (
+    ArpEntry,
+    BridgeDomain,
+    Interface,
+    InterfaceType,
+    L2FibEntry,
+    Route,
+    VrfTable,
+)
+
+log = logging.getLogger(__name__)
+
+VXLAN_BVI_NAME = "vxlanBVI"
+VXLAN_BD_NAME = "vxlanBD"
+HOST_INTERCONNECT_IF = "tap-vpp2"
+POD_IF_PREFIX = "tap-"
+VXLAN_VNI = 10  # the reference uses VNI 10 for the pod overlay
+
+
+def mac_from_ip(ip: str, prefix: int = 0x02) -> str:
+    """Deterministic locally-administered MAC from an IPv4 address."""
+    octets = [int(o) for o in str(ip).split(".")]
+    return ":".join(f"{b:02x}" for b in [prefix, 0xFE] + octets)
+
+
+class IPv4Net(EventHandler):
+    """The connectivity event handler."""
+
+    name = "ipv4net"
+
+    def __init__(
+        self,
+        config: NetworkConfig,
+        nodesync: NodeSync,
+        ipam: Optional[IPAM] = None,
+        podmanager=None,
+    ):
+        self.config = config
+        self.nodesync = nodesync
+        # IPAM is constructed after nodesync allocates the node ID; the
+        # first resync wires it (matching the reference's plugin order).
+        self.ipam = ipam
+        # PodManager supplies CNI-added local pods not (yet) reflected
+        # into KubeState, so resyncs do not tear their wiring down.
+        self.podmanager = podmanager
+
+    # --------------------------------------------------------------- resync
+
+    def handles_event(self, event) -> bool:
+        if isinstance(event, (AddPod, DeletePod, NodeUpdate)):
+            return True
+        if isinstance(event, KubeStateChange):
+            return False
+        return event.method.is_resync
+
+    def resync(self, event, kube_state, resync_count, txn) -> None:
+        if self.ipam is None:
+            if self.nodesync.node_id is None:
+                self.nodesync.allocate_id()
+            self.ipam = IPAM(self.config.ipam, self.nodesync.node_id)
+
+        # Re-learn the allocation pool from KubeState on EVERY resync,
+        # preserving CNI-granted IPs of live local pods that KubeState
+        # does not (yet) reflect — otherwise a healing resync could hand
+        # out duplicate IPs or tear down running pods.
+        preserved = {}
+        if self.podmanager is not None:
+            for pod_id in self.podmanager.local_pods:
+                ip = self.ipam.get_pod_ip(pod_id)
+                if ip is not None:
+                    preserved[pod_id] = ip
+        self.ipam.resync(kube_state)
+        for pod_id, ip in preserved.items():
+            self.ipam.adopt(pod_id, ip)
+
+        for kv in self.vswitch_connectivity_config():
+            txn.put(kv.key, kv)
+        for node in self.nodesync.other_nodes().values():
+            for kv in self.node_connectivity_config(node.id):
+                txn.put(kv.key, kv)
+        # Re-render all local pods: those recorded in KubeState (IPs in
+        # this node's subnet) plus live CNI-added ones.
+        local_pods: Dict[PodID, str] = {}
+        for pod in kube_state.get("pod", {}).values():
+            if not pod.ip_address:
+                continue
+            try:
+                ip = ipaddress.ip_address(pod.ip_address)
+            except ValueError:
+                continue
+            if ip in self.ipam.pod_subnet_this_node:
+                local_pods[pod.id] = str(ip)
+        for pod_id, ip in preserved.items():
+            local_pods[pod_id] = str(ip)
+        for pod_id, ip in local_pods.items():
+            for kv in self.pod_connectivity_config(pod_id, ip):
+                txn.put(kv.key, kv)
+
+        # Publish our data-plane IPs for other nodes.
+        self.nodesync.publish_node_ips(
+            (f"{self.ipam.node_ip()}/{self.config.ipam.node_interconnect().prefixlen}",),
+        )
+
+    # ------------------------------------------------------- config builders
+
+    def vswitch_connectivity_config(self) -> List:
+        """Base vswitch config (configureVswitchConnectivity analog)."""
+        ipam = self.ipam
+        routing = self.config.routing
+        kvs: List = [
+            VrfTable(id=routing.main_vrf_id, label="main"),
+            VrfTable(id=routing.pod_vrf_id, label="pods"),
+            # Host interconnect (the host side of the memif/TAP shim).
+            Interface(
+                name=HOST_INTERCONNECT_IF,
+                type=InterfaceType.TAP,
+                ip_addresses=(f"{ipam.host_interconnect_ip_dataplane()}/{ipam.host_subnet_this_node.prefixlen}",),
+                vrf=routing.main_vrf_id,
+                host_if_name="vpp1",
+                mtu=self.config.interface.mtu,
+            ),
+            # Route host-side traffic to the host interconnect peer.
+            Route(
+                dst_network=f"{ipam.host_interconnect_ip_host()}/32",
+                outgoing_interface=HOST_INTERCONNECT_IF,
+                vrf=routing.main_vrf_id,
+            ),
+        ]
+        if routing.use_vxlan:
+            bvi_ip = ipam.vxlan_ip()
+            kvs += [
+                Interface(
+                    name=VXLAN_BVI_NAME,
+                    type=InterfaceType.LOOPBACK,
+                    ip_addresses=(f"{bvi_ip}/{self.config.ipam.vxlan().prefixlen}",),
+                    vrf=routing.pod_vrf_id,
+                    physical_address=mac_from_ip(bvi_ip, prefix=0x12),
+                    mtu=self.config.interface.mtu,
+                ),
+                self._render_bridge_domain(),
+            ]
+        # Pod VRF default: leak to main VRF (two-VRF layout).
+        kvs.append(
+            Route(
+                dst_network="0.0.0.0/0",
+                vrf=self.config.routing.pod_vrf_id,
+                via_vrf=self.config.routing.main_vrf_id,
+            )
+        )
+        return kvs
+
+    def _vxlan_if_name(self, node_id: int) -> str:
+        return f"vxlan{node_id}"
+
+    def node_connectivity_config(self, node_id: int) -> List:
+        """Connectivity to one other node (vxlanIfToOtherNode :524 +
+        routesToOtherNode)."""
+        ipam = self.ipam
+        routing = self.config.routing
+        kvs: List = []
+        if routing.use_vxlan:
+            this_bvi = ipam.vxlan_ip()
+            other_bvi = ipam.vxlan_ip(node_id)
+            vxlan_if = self._vxlan_if_name(node_id)
+            kvs += [
+                Interface(
+                    name=vxlan_if,
+                    type=InterfaceType.VXLAN,
+                    vxlan_src=str(ipam.node_ip()),
+                    vxlan_dst=str(ipam.node_ip(node_id)),
+                    vxlan_vni=VXLAN_VNI,
+                    mtu=self.config.interface.mtu,
+                ),
+                # The remote BVI is reachable through the tunnel.
+                ArpEntry(
+                    interface=VXLAN_BVI_NAME,
+                    ip_address=str(other_bvi),
+                    physical_address=mac_from_ip(other_bvi, prefix=0x12),
+                ),
+                L2FibEntry(
+                    bridge_domain=VXLAN_BD_NAME,
+                    physical_address=mac_from_ip(other_bvi, prefix=0x12),
+                    outgoing_interface=vxlan_if,
+                ),
+            ]
+            next_hop = str(other_bvi)
+            out_if = VXLAN_BVI_NAME
+        else:
+            next_hop = str(ipam.node_ip(node_id))
+            out_if = ""
+        kvs += [
+            Route(
+                dst_network=str(ipam.pod_subnet_other_node(node_id)),
+                next_hop=next_hop,
+                outgoing_interface=out_if,
+                vrf=routing.pod_vrf_id,
+            ),
+            Route(
+                dst_network=str(ipam.host_subnet_other_node(node_id)),
+                next_hop=next_hop,
+                outgoing_interface=out_if,
+                vrf=routing.pod_vrf_id,
+            ),
+        ]
+        return kvs
+
+    def pod_connectivity_config(self, pod_id: PodID, pod_ip: str) -> List:
+        """One pod's wiring (podConnectivityConfig :57)."""
+        if_name = f"{POD_IF_PREFIX}{pod_id.namespace}-{pod_id.name}"
+        pod_mac = mac_from_ip(pod_ip)
+        return [
+            Interface(
+                name=if_name,
+                type=InterfaceType.TAP,
+                vrf=self.config.routing.pod_vrf_id,
+                host_if_name="eth0",
+                namespace=str(pod_id),
+                mtu=self.config.interface.mtu,
+            ),
+            ArpEntry(interface=if_name, ip_address=pod_ip, physical_address=pod_mac),
+            Route(
+                dst_network=f"{pod_ip}/32",
+                outgoing_interface=if_name,
+                vrf=self.config.routing.pod_vrf_id,
+            ),
+        ]
+
+    # --------------------------------------------------------------- update
+
+    def update(self, event, txn) -> str:
+        if isinstance(event, AddPod):
+            return self._add_pod(event, txn)
+        if isinstance(event, DeletePod):
+            return self._delete_pod(event, txn)
+        if isinstance(event, NodeUpdate):
+            return self._node_update(event, txn)
+        return ""
+
+    def _add_pod(self, event: AddPod, txn) -> str:
+        pod_id = event.pod.id
+        ip = self.ipam.allocate_pod_ip(pod_id)
+        for kv in self.pod_connectivity_config(pod_id, str(ip)):
+            txn.put(kv.key, kv)
+        event.reply.ip_address = f"{ip}/32"
+        event.reply.interfaces.append(
+            {"name": "eth0", "ip": f"{ip}/{self.ipam.pod_subnet_this_node.prefixlen}"}
+        )
+        event.reply.routes.append(
+            {"dst": "0.0.0.0/0", "gw": str(self.ipam.pod_gateway_ip)}
+        )
+        return f"wired pod {pod_id} at {ip}"
+
+    def _delete_pod(self, event: DeletePod, txn) -> str:
+        ip = self.ipam.get_pod_ip(event.pod_id)
+        if ip is None:
+            return ""
+        for kv in self.pod_connectivity_config(event.pod_id, str(ip)):
+            txn.delete(kv.key)
+        self.ipam.release_pod_ip(event.pod_id)
+        return f"unwired pod {event.pod_id}"
+
+    def _node_update(self, event: NodeUpdate, txn) -> str:
+        if event.prev is not None and event.new is None:
+            for kv in self.node_connectivity_config(event.prev.id):
+                txn.delete(kv.key)
+            self._refresh_bridge_domain(txn)
+            return f"removed connectivity to {event.node_name}"
+        if event.new is not None:
+            for kv in self.node_connectivity_config(event.new.id):
+                txn.put(kv.key, kv)
+            self._refresh_bridge_domain(txn)
+            return f"configured connectivity to {event.node_name}"
+        return ""
+
+    def _render_bridge_domain(self) -> BridgeDomain:
+        """The VXLAN bridge domain with the current tunnel membership —
+        single construction point for resync and NodeUpdate paths."""
+        return BridgeDomain(
+            name=VXLAN_BD_NAME,
+            bvi_interface=VXLAN_BVI_NAME,
+            interfaces=tuple(
+                self._vxlan_if_name(node.id)
+                for node in self.nodesync.other_nodes().values()
+            ),
+        )
+
+    def _refresh_bridge_domain(self, txn) -> None:
+        if not self.config.routing.use_vxlan:
+            return
+        bd = self._render_bridge_domain()
+        txn.put(bd.key, bd)
+
+    def revert(self, event) -> None:
+        if isinstance(event, AddPod):
+            self.ipam.release_pod_ip(event.pod.id)

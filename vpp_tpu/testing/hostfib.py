@@ -1,0 +1,87 @@
+"""Mock host-FIB engine — applicator for ipv4net's typed config.
+
+Analog of the reference's mock ifplugin/vpp-plugins consumed through
+mock/localclient: receives Interface/Route/Arp/BD/L2FIB/Vrf values from
+the txn scheduler, keeps them queryable, and validates basic
+referential integrity (the scheduler's dependency tracking should make
+violations impossible — the mock raises if not).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..ipv4net.model import (
+    ArpEntry,
+    BridgeDomain,
+    Interface,
+    L2FibEntry,
+    Route,
+    VrfTable,
+    ARP_PREFIX,
+    BD_PREFIX,
+    CONFIG_PREFIX,
+    IF_PREFIX,
+    L2FIB_PREFIX,
+    ROUTE_PREFIX,
+    VRF_PREFIX,
+)
+from ..scheduler import Applicator
+
+
+class MockHostFIB(Applicator):
+    """The applicator + assertion surface."""
+
+    prefix = CONFIG_PREFIX
+    update_destroys_on_failure = False
+
+    def __init__(self):
+        self.state: Dict[str, object] = {}
+
+    # ------------------------------------------------------------ applicator
+
+    def create(self, key: str, value) -> None:
+        self._check_deps(key, value)
+        self.state[key] = value
+
+    def update(self, key: str, old_value, new_value) -> None:
+        self._check_deps(key, new_value)
+        self.state[key] = new_value
+
+    def delete(self, key: str, value) -> None:
+        self.state.pop(key, None)
+
+    def _check_deps(self, key: str, value) -> None:
+        deps = value.dependencies() if hasattr(value, "dependencies") else set()
+        missing = [d for d in deps if d not in self.state]
+        if missing:
+            raise RuntimeError(f"{key} applied before dependencies: {missing}")
+
+    # ------------------------------------------------------------ assertions
+
+    def interfaces(self) -> List[Interface]:
+        return [v for k, v in self.state.items() if k.startswith(IF_PREFIX)]
+
+    def get_interface(self, name: str) -> Optional[Interface]:
+        return self.state.get(IF_PREFIX + name)
+
+    def routes(self, vrf: Optional[int] = None) -> List[Route]:
+        out = [v for k, v in self.state.items() if k.startswith(ROUTE_PREFIX)]
+        if vrf is not None:
+            out = [r for r in out if r.vrf == vrf]
+        return out
+
+    def has_route(self, dst_network: str, vrf: int = 0) -> bool:
+        return any(r.dst_network == dst_network for r in self.routes(vrf))
+
+    def arp_entries(self) -> List[ArpEntry]:
+        return [v for k, v in self.state.items() if k.startswith(ARP_PREFIX)]
+
+    def bridge_domain(self, name: str) -> Optional[BridgeDomain]:
+        return self.state.get(BD_PREFIX + name)
+
+    def l2_fib_entries(self) -> List[L2FibEntry]:
+        return [v for k, v in self.state.items() if k.startswith(L2FIB_PREFIX)]
+
+    def vrfs(self) -> List[VrfTable]:
+        return [v for k, v in self.state.items() if k.startswith(VRF_PREFIX)]
