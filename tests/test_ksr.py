@@ -1,0 +1,285 @@
+"""KSR reflector tests.
+
+Modeled on the reference's ``plugins/ksr/*_reflector_test.go`` pattern:
+a fake K8s ListWatch + a KV broker, asserting on data-store contents and
+reflector stats, including the data-store failure → mark-and-sweep
+reconciliation path.
+"""
+
+import time
+
+import pytest
+
+from vpp_tpu.ksr import KSRPlugin, KVBroker, make_reflectors
+from vpp_tpu.kvstore import KVStore
+from vpp_tpu.models import (
+    Namespace,
+    Policy,
+    PolicyType,
+    Pod,
+    Service,
+)
+from vpp_tpu.models.registry import key_for, resource
+from vpp_tpu.testing.k8s import FakeK8sCluster
+
+
+def k8s_pod(name, namespace="default", labels=None, ip="", host_ip="", containers=None):
+    return {
+        "metadata": {"name": name, "namespace": namespace, "labels": labels or {}},
+        "spec": {"containers": containers or []},
+        "status": {"podIP": ip, "hostIP": host_ip},
+    }
+
+
+@pytest.fixture()
+def setup():
+    cluster = FakeK8sCluster()
+    store = KVStore()
+    broker = KVBroker(store)
+    reflectors = make_reflectors(cluster, broker,
+                                 min_resync_timeout=0.01, max_resync_timeout=0.05)
+    return cluster, store, broker, reflectors
+
+
+class TestPodReflector:
+    def test_initial_list_reflected(self, setup):
+        cluster, store, _, reflectors = setup
+        cluster.apply("pods", k8s_pod("web-1", labels={"app": "web"}, ip="10.1.1.2"))
+        cluster.apply("pods", k8s_pod("db-1", namespace="prod", ip="10.1.1.3"))
+        r = reflectors["pods"]
+        r.start()
+        assert r.has_synced
+        assert r.stats.adds == 2
+        pod = store.get(resource("pod").key_prefix + "default/web-1")
+        assert isinstance(pod, Pod)
+        assert pod.ip_address == "10.1.1.2"
+        assert dict(pod.labels) == {"app": "web"}
+
+    def test_add_update_delete_flow(self, setup):
+        cluster, store, _, reflectors = setup
+        r = reflectors["pods"]
+        r.start()
+        cluster.apply("pods", k8s_pod("web-1", ip=""))
+        key = resource("pod").key_prefix + "default/web-1"
+        assert store.get(key).ip_address == ""
+        # IP assignment arrives as an update.
+        cluster.apply("pods", k8s_pod("web-1", ip="10.1.1.7"))
+        assert store.get(key).ip_address == "10.1.1.7"
+        assert r.stats.updates == 1
+        # No-op update is skipped (proto.Equal analog).
+        cluster.apply("pods", k8s_pod("web-1", ip="10.1.1.7"))
+        assert r.stats.updates == 1
+        cluster.delete("pods", "web-1")
+        assert store.get(key) is None
+        assert r.stats.deletes == 1
+
+    def test_stale_data_store_entries_swept(self, setup):
+        cluster, store, _, reflectors = setup
+        stale_key = resource("pod").key_prefix + "default/gone"
+        store.put(stale_key, Pod(name="gone"))
+        changed_key = resource("pod").key_prefix + "default/web-1"
+        store.put(changed_key, Pod(name="web-1", ip_address="10.9.9.9"))
+        cluster.apply("pods", k8s_pod("web-1", ip="10.1.1.2"))
+        r = reflectors["pods"]
+        r.start()
+        assert store.get(stale_key) is None
+        assert store.get(changed_key).ip_address == "10.1.1.2"
+        assert r.stats.deletes == 1 and r.stats.updates == 1
+
+    def test_malformed_object_counts_arg_error(self, setup):
+        cluster, _, _, reflectors = setup
+        r = reflectors["pods"]
+        r.start()
+        cluster.apply("pods", {"metadata": {}})  # no name
+        assert r.stats.arg_errors == 1
+        assert r.stats.adds == 0
+
+
+class FlakyBroker(KVBroker):
+    """Broker whose writes can be switched off (etcd outage analog)."""
+
+    def __init__(self, store):
+        super().__init__(store)
+        self.down = False
+
+    def _check(self):
+        if self.down:
+            raise ConnectionError("store down")
+
+    def put(self, key, value):
+        self._check()
+        super().put(key, value)
+
+    def delete(self, key):
+        self._check()
+        super().delete(key)
+
+    def list_values(self, prefix):
+        self._check()
+        return super().list_values(prefix)
+
+    def probe(self):
+        return not self.down
+
+
+class TestResync:
+    def test_write_failure_triggers_background_resync(self):
+        cluster = FakeK8sCluster()
+        store = KVStore()
+        broker = FlakyBroker(store)
+        r = make_reflectors(cluster, broker,
+                            min_resync_timeout=0.01, max_resync_timeout=0.05)["pods"]
+        r.start()
+        assert r.has_synced
+        broker.down = True
+        cluster.apply("pods", k8s_pod("web-1", ip="10.1.1.2"))
+        assert not r.has_synced
+        assert r.stats.add_errors == 1
+        # While out of sync, further changes only land in the K8s cache.
+        cluster.apply("pods", k8s_pod("web-2", ip="10.1.1.3"))
+        key1 = resource("pod").key_prefix + "default/web-1"
+        key2 = resource("pod").key_prefix + "default/web-2"
+        assert store.get(key1) is None and store.get(key2) is None
+        # Store recovers; the backoff loop reconciles both pods.
+        broker.down = False
+        deadline = time.time() + 2.0
+        while not r.has_synced and time.time() < deadline:
+            time.sleep(0.01)
+        assert r.has_synced
+        assert store.get(key1).ip_address == "10.1.1.2"
+        assert store.get(key2).ip_address == "10.1.1.3"
+
+
+class TestConverters:
+    def test_network_policy_conversion(self, setup):
+        cluster, store, _, reflectors = setup
+        reflectors["networkpolicies"].start()
+        cluster.apply(
+            "networkpolicies",
+            {
+                "metadata": {"name": "allow-web", "namespace": "prod"},
+                "spec": {
+                    "podSelector": {"matchLabels": {"app": "web"}},
+                    "policyTypes": ["Ingress", "Egress"],
+                    "ingress": [
+                        {
+                            "ports": [{"protocol": "TCP", "port": 80}],
+                            "from": [
+                                {"podSelector": {"matchLabels": {"role": "fe"}}},
+                                {"ipBlock": {"cidr": "10.0.0.0/8",
+                                             "except": ["10.1.0.0/16"]}},
+                            ],
+                        }
+                    ],
+                    "egress": [
+                        {"to": [{"namespaceSelector": {
+                            "matchExpressions": [
+                                {"key": "env", "operator": "In",
+                                 "values": ["prod", "stage"]}]}}]}
+                    ],
+                },
+            },
+        )
+        pol = store.get(resource("policy").key_prefix + "prod/allow-web")
+        assert isinstance(pol, Policy)
+        assert pol.policy_type == PolicyType.INGRESS_AND_EGRESS
+        assert dict(pol.pods.match_labels) == {"app": "web"}
+        rule = pol.ingress_rules[0]
+        assert rule.ports[0].port == 80
+        assert rule.from_peers[1].ip_block.cidr == "10.0.0.0/8"
+        assert rule.from_peers[1].ip_block.except_cidrs == ("10.1.0.0/16",)
+        expr = pol.egress_rules[0].to_peers[0].namespaces.match_expressions[0]
+        assert expr.key == "env" and expr.values == ("prod", "stage")
+
+    def test_service_and_endpoints_conversion(self, setup):
+        cluster, store, _, reflectors = setup
+        reflectors["services"].start()
+        reflectors["endpoints"].start()
+        cluster.apply(
+            "services",
+            {
+                "metadata": {"name": "web", "namespace": "default"},
+                "spec": {
+                    "type": "NodePort",
+                    "clusterIP": "10.96.0.10",
+                    "selector": {"app": "web"},
+                    "externalTrafficPolicy": "Local",
+                    "ports": [{"name": "http", "protocol": "TCP", "port": 80,
+                               "targetPort": 8080, "nodePort": 30080}],
+                },
+            },
+        )
+        svc = store.get(resource("service").key_prefix + "default/web")
+        assert isinstance(svc, Service)
+        assert svc.service_type == "NodePort"
+        assert svc.ports[0].node_port == 30080
+        assert svc.external_traffic_policy == "Local"
+
+        cluster.apply(
+            "endpoints",
+            {
+                "metadata": {"name": "web", "namespace": "default"},
+                "subsets": [
+                    {
+                        "addresses": [
+                            {"ip": "10.1.1.2", "nodeName": "node-1",
+                             "targetRef": {"kind": "Pod", "name": "web-1",
+                                           "namespace": "default"}}],
+                        "ports": [{"name": "http", "port": 8080, "protocol": "TCP"}],
+                    }
+                ],
+            },
+        )
+        eps = store.get(resource("endpoints").key_prefix + "default/web")
+        addr = eps.subsets[0].addresses[0]
+        assert addr.ip == "10.1.1.2" and addr.target_pod.name == "web-1"
+
+    def test_namespace_and_node_conversion(self, setup):
+        cluster, store, _, reflectors = setup
+        reflectors["namespaces"].start()
+        reflectors["nodes"].start()
+        cluster.apply("namespaces",
+                      {"metadata": {"name": "prod", "labels": {"env": "prod"}}})
+        ns = store.get(resource("namespace").key_prefix + "prod")
+        assert isinstance(ns, Namespace) and dict(ns.labels) == {"env": "prod"}
+        cluster.apply(
+            "nodes",
+            {
+                "metadata": {"name": "node-1"},
+                "spec": {"podCIDR": "10.1.1.0/24"},
+                "status": {"addresses": [
+                    {"type": "InternalIP", "address": "192.168.16.1"},
+                    {"type": "Hostname", "address": "node-1"}]},
+            },
+        )
+        node = store.get(resource("node").key_prefix + "node-1")
+        assert node.internal_ip() == "192.168.16.1"
+        assert node.pod_cidr == "10.1.1.0/24"
+
+
+class TestPlugin:
+    def test_store_outage_and_recovery_via_monitor(self):
+        cluster = FakeK8sCluster()
+        store = KVStore()
+        broker = FlakyBroker(store)
+        plugin = KSRPlugin(cluster, broker, probe_interval=0.01,
+                           min_resync_timeout=0.01, max_resync_timeout=0.05)
+        plugin.init(start_monitor=False)
+        assert plugin.has_synced()
+        # Outage: monitor notices, reflectors hold updates.
+        broker.down = True
+        assert plugin.check_data_store() is False
+        cluster.apply("pods", k8s_pod("web-1", ip="10.1.1.2"))
+        assert not plugin.has_synced()
+        # Recovery: up event reconciles everything.
+        broker.down = False
+        assert plugin.check_data_store() is True
+        deadline = time.time() + 2.0
+        while not plugin.has_synced() and time.time() < deadline:
+            time.sleep(0.01)
+        assert plugin.has_synced()
+        key = resource("pod").key_prefix + "default/web-1"
+        assert store.get(key).ip_address == "10.1.1.2"
+        stats = plugin.get_stats()
+        assert stats["pods"]["adds"] >= 1
+        plugin.close()

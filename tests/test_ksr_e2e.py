@@ -1,0 +1,91 @@
+"""End-to-end: fake K8s API → KSR → KV store → dbwatcher → controller →
+policy stack → TPU classify verdicts.
+
+The full control-plane path of SURVEY.md §3.3, with the K8s API played
+by FakeK8sCluster and the data plane by the real jit classify kernel.
+"""
+
+import time
+
+from vpp_tpu.conf import IPAMConfig
+from vpp_tpu.controller.dbwatcher import DBWatcher
+from vpp_tpu.controller.eventloop import Controller
+from vpp_tpu.controller.txn import TxnSink
+from vpp_tpu.ipam import IPAM
+from vpp_tpu.ksr import KSRPlugin, KVBroker
+from vpp_tpu.kvstore import KVStore
+from vpp_tpu.ops.classify import classify
+from vpp_tpu.ops.packets import make_batch
+from vpp_tpu.policy import PolicyPlugin
+from vpp_tpu.policy.renderer.tpu import TpuPolicyRenderer
+from vpp_tpu.testing.k8s import FakeK8sCluster
+
+
+class RecordingSink(TxnSink):
+    def __init__(self):
+        self.txns = []
+
+    def commit(self, txn):
+        self.txns.append(txn)
+
+
+def _wait(predicate, timeout=3.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return predicate()
+
+
+def test_k8s_to_tpu_verdicts():
+    store = KVStore()
+    cluster = FakeK8sCluster()
+    ksr = KSRPlugin(cluster, KVBroker(store))
+    ksr.init(start_monitor=False)
+    assert ksr.has_synced()
+
+    renderer = TpuPolicyRenderer()
+    policy = PolicyPlugin(ipam=IPAM(IPAMConfig(), node_id=1))
+    policy.register_renderer(renderer)
+    ctl = Controller(handlers=[policy], sink=RecordingSink())
+    ctl.start()
+    watcher = DBWatcher(ctl, store)
+    watcher.start()
+
+    try:
+        for i in range(3):
+            cluster.apply("pods", {
+                "metadata": {"name": f"web-{i}", "namespace": "default",
+                             "labels": {"app": "web"}},
+                "status": {"podIP": f"10.1.1.{i + 2}"}, "spec": {}})
+        cluster.apply("pods", {
+            "metadata": {"name": "intruder", "namespace": "default",
+                         "labels": {"app": "other"}},
+            "status": {"podIP": "10.1.1.99"}, "spec": {}})
+        cluster.apply("networkpolicies", {
+            "metadata": {"name": "web-isolate", "namespace": "default"},
+            "spec": {"podSelector": {"matchLabels": {"app": "web"}},
+                     "policyTypes": ["Ingress"],
+                     "ingress": [{"ports": [{"protocol": "TCP", "port": 80}],
+                                  "from": [{"podSelector":
+                                            {"matchLabels": {"app": "web"}}}]}]}})
+        assert _wait(lambda: int(renderer.tables.rule_valid.sum()) > 0)
+
+        batch = make_batch([
+            ("10.1.1.2", "10.1.1.3", 6, 4444, 80),    # web -> web :80
+            ("10.1.1.99", "10.1.1.3", 6, 4444, 80),   # intruder
+            ("10.1.1.2", "10.1.1.3", 6, 4444, 443),   # wrong port
+        ])
+        allowed = [int(v) for v in classify(renderer.tables, batch).allowed]
+        assert allowed == [1, 0, 0]
+
+        # Policy withdrawn via the API -> traffic opens up.
+        cluster.delete("networkpolicies", "web-isolate")
+        assert _wait(lambda: int(renderer.tables.rule_valid.sum()) == 0)
+        allowed = [int(v) for v in classify(renderer.tables, batch).allowed]
+        assert allowed == [1, 1, 1]
+    finally:
+        watcher.stop()
+        ctl.stop()
+        ksr.close()

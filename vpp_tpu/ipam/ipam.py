@@ -201,22 +201,41 @@ class IPAM:
 
     # ----------------------------------------------------------------- resync
 
+    def _adopt_locked(self, pod_id: PodID, ip: ipaddress.IPv4Address) -> bool:
+        """Register an existing allocation; single source of the
+        reserved-address rules.  A conflicting prior owner of the IP (or a
+        prior IP of the pod) is evicted — last writer wins, with both maps
+        kept consistent.  Caller holds the lock."""
+        base = int(self.pod_subnet_this_node.network_address)
+        host_bits = 32 - self.pod_subnet_this_node.prefixlen
+        max_seq = (1 << host_bits) - 2  # exclusive: NAT loopback + bcast
+        seq = int(ip) - base
+        if seq == POD_GATEWAY_SEQ_ID or not (0 < seq < max_seq):
+            # Reserved address (gateway, NAT loopback, broadcast, network)
+            # recorded by stale/foreign state: never adopt, or the
+            # allocator could later re-hand it out.
+            log.warning("ignoring pod %s with reserved IP %s", pod_id, ip)
+            return False
+        prior_owner = self._assigned.get(int(ip))
+        if prior_owner is not None and prior_owner != pod_id:
+            self._pod_to_ip.pop(prior_owner, None)
+        prior_ip = self._pod_to_ip.get(pod_id)
+        if prior_ip is not None and prior_ip != ip:
+            self._assigned.pop(int(prior_ip), None)
+        self._assigned[int(ip)] = pod_id
+        self._pod_to_ip[pod_id] = ip
+        self._last_assigned_seq = max(self._last_assigned_seq, seq)
+        return True
+
     def adopt(self, pod_id: PodID, ip) -> bool:
         """Force-register an existing allocation (used to preserve
         CNI-granted IPs of pods not yet reflected into KubeState across a
         resync). Returns False if the IP is reserved/foreign."""
         ip = ipaddress.ip_address(str(ip))
         with self._lock:
-            base = int(self.pod_subnet_this_node.network_address)
-            host_bits = 32 - self.pod_subnet_this_node.prefixlen
-            max_seq = (1 << host_bits) - 2
-            seq = int(ip) - base
-            if seq == POD_GATEWAY_SEQ_ID or not (0 < seq < max_seq):
+            if ip not in self.pod_subnet_this_node:
                 return False
-            self._assigned[int(ip)] = pod_id
-            self._pod_to_ip[pod_id] = ip
-            self._last_assigned_seq = max(self._last_assigned_seq, seq)
-            return True
+            return self._adopt_locked(pod_id, ip)
 
     def resync(self, kube_state) -> None:
         """Re-learn the pool from KubeState pods (ipam.go Resync :127):
@@ -225,9 +244,6 @@ class IPAM:
             self._assigned.clear()
             self._pod_to_ip.clear()
             self._last_assigned_seq = 1
-            base = int(self.pod_subnet_this_node.network_address)
-            host_bits = 32 - self.pod_subnet_this_node.prefixlen
-            max_seq = (1 << host_bits) - 2  # exclusive: NAT loopback + bcast
             for pod in kube_state.get("pod", {}).values():
                 if not isinstance(pod, Pod) or not pod.ip_address:
                     continue
@@ -237,13 +253,11 @@ class IPAM:
                     continue
                 if ip not in self.pod_subnet_this_node:
                     continue
-                seq = int(ip) - base
-                if seq == POD_GATEWAY_SEQ_ID or not (0 < seq < max_seq):
-                    # Reserved address (gateway, NAT loopback, broadcast,
-                    # network) recorded by stale/foreign state: never adopt,
-                    # or the allocator could later re-hand it out.
-                    log.warning("ignoring pod %s with reserved IP %s", pod.id, ip)
-                    continue
-                self._assigned[int(ip)] = pod.id
-                self._pod_to_ip[pod.id] = ip
-                self._last_assigned_seq = max(self._last_assigned_seq, seq)
+                self._adopt_locked(pod.id, ip)
+
+    def assigned_pods(self) -> Dict[PodID, ipaddress.IPv4Address]:
+        """Snapshot of all current pod→IP assignments (the authoritative
+        local-pod set after a resync — already filtered by the
+        reserved-address rules)."""
+        with self._lock:
+            return dict(self._pod_to_ip)

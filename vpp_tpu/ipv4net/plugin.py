@@ -20,7 +20,6 @@ generation schemes per interface kind).
 
 from __future__ import annotations
 
-import ipaddress
 import logging
 from typing import Dict, List, Optional
 
@@ -110,22 +109,13 @@ class IPv4Net(EventHandler):
         for node in self.nodesync.other_nodes().values():
             for kv in self.node_connectivity_config(node.id):
                 txn.put(kv.key, kv)
-        # Re-render all local pods: those recorded in KubeState (IPs in
-        # this node's subnet) plus live CNI-added ones.
-        local_pods: Dict[PodID, str] = {}
-        for pod in kube_state.get("pod", {}).values():
-            if not pod.ip_address:
-                continue
-            try:
-                ip = ipaddress.ip_address(pod.ip_address)
-            except ValueError:
-                continue
-            if ip in self.ipam.pod_subnet_this_node:
-                local_pods[pod.id] = str(ip)
-        for pod_id, ip in preserved.items():
-            local_pods[pod_id] = str(ip)
-        for pod_id, ip in local_pods.items():
-            for kv in self.pod_connectivity_config(pod_id, ip):
+        # Re-render all local pods.  The authoritative set is IPAM's
+        # post-resync assignment map (KubeState pods + preserved CNI pods),
+        # which already excludes reserved addresses (gateway, NAT loopback,
+        # broadcast) that stale/foreign KubeState records could carry —
+        # rendering those would hijack e.g. the pod gateway IP.
+        for pod_id, ip in sorted(self.ipam.assigned_pods().items()):
+            for kv in self.pod_connectivity_config(pod_id, str(ip)):
                 txn.put(kv.key, kv)
 
         # Publish our data-plane IPs for other nodes.

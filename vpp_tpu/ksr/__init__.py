@@ -1,0 +1,26 @@
+"""KSR — Kubernetes State Reflector.
+
+Analog of the reference's ``plugins/ksr``: a generic Reflector framework
+over a K8s ListWatch that converts API objects into typed models and
+reflects them into the cluster KV store under the registry prefixes
+(SURVEY.md §2.2).
+"""
+
+from .listwatch import K8sListWatch, ListWatchHandler
+from .reflector import Broker, KsrStats, KVBroker, Reflector
+from .reflectors import CONVERTERS, make_reflectors
+from .registry import ReflectorRegistry
+from .plugin import KSRPlugin
+
+__all__ = [
+    "Broker",
+    "CONVERTERS",
+    "K8sListWatch",
+    "KSRPlugin",
+    "KVBroker",
+    "KsrStats",
+    "ListWatchHandler",
+    "Reflector",
+    "ReflectorRegistry",
+    "make_reflectors",
+]

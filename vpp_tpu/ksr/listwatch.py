@@ -1,0 +1,38 @@
+"""ListWatch interface towards the K8s API server.
+
+Analog of the reference's ``K8sListWatcher`` abstraction
+(plugins/ksr/ksr_api.go + client-go informers): a reflector needs (a) a
+consistent initial listing of a resource kind and (b) a stream of
+add/update/delete notifications.  Production backends implement this
+over the real API server; tests use ``vpp_tpu.testing.k8s.FakeK8sCluster``
+(the analog of the reference's ``mockK8sListWatch`` used by every
+``*_reflector_test.go``).
+
+Objects crossing this interface are K8s-JSON-shaped dicts
+(``metadata``/``spec``/``status``), exactly what the API server returns;
+the per-resource converters in ``reflectors.py`` parse them into typed
+models.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Protocol
+
+# handler(event, obj, old_obj): event is "add" | "update" | "delete".
+ListWatchHandler = Callable[[str, Dict, Dict], None]
+
+
+class K8sListWatch(Protocol):
+    """What a reflector needs from the K8s API."""
+
+    def list(self, kind: str) -> List[Dict]:
+        """Consistent snapshot of all objects of ``kind``."""
+        ...
+
+    def subscribe(self, kind: str, handler: ListWatchHandler) -> None:
+        """Register for change notifications of ``kind``."""
+        ...
+
+    def unsubscribe(self, kind: str, handler: ListWatchHandler) -> None:
+        """Deregister a previously subscribed handler."""
+        ...

@@ -1,0 +1,78 @@
+"""KSR plugin — wires reflectors and monitors data-store connectivity.
+
+Analog of ``plugins/ksr/plugin_impl_ksr.go``: builds the reflector set
+against a ListWatch + broker, starts them, and runs the periodic
+data-store connectivity monitor (:255-311 — the etcd monitor that fires
+``dataStoreDownEvent``/``dataStoreUpEvent`` on transitions).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .listwatch import K8sListWatch
+from .reflector import Broker
+from .reflectors import make_reflectors
+from .registry import ReflectorRegistry
+
+
+class KSRPlugin:
+    def __init__(
+        self,
+        list_watch: K8sListWatch,
+        broker: Broker,
+        probe_interval: float = 1.0,
+        min_resync_timeout: float = 0.1,
+        max_resync_timeout: float = 1.0,
+    ):
+        self.broker = broker
+        self.probe_interval = probe_interval
+        self.registry = ReflectorRegistry()
+        for reflector in make_reflectors(
+            list_watch, broker, min_resync_timeout, max_resync_timeout
+        ).values():
+            self.registry.add(reflector)
+        self._store_up = True
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- lifecycle
+
+    def init(self, start_monitor: bool = True) -> None:
+        self.registry.start_reflectors()
+        if start_monitor:
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, name="ksr-store-monitor", daemon=True
+            )
+            self._monitor.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        self.registry.close()
+
+    def has_synced(self) -> bool:
+        return self.registry.ksr_has_synced()
+
+    def get_stats(self):
+        return {k: s.as_dict() for k, s in self.registry.get_stats().items()}
+
+    # ------------------------------------------------------------ monitoring
+
+    def check_data_store(self) -> bool:
+        """One probe + transition handling; returns current up/down state."""
+        try:
+            up = self.broker.probe()
+        except Exception:
+            up = False
+        if up and not self._store_up:
+            self._store_up = True
+            self.registry.data_store_up_event()
+        elif not up and self._store_up:
+            self._store_up = False
+            self.registry.data_store_down_event()
+        return up
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval):
+            self.check_data_store()

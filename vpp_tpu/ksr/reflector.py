@@ -1,0 +1,297 @@
+"""Generic KSR reflector: K8s cache → data store mark-and-sweep.
+
+Analog of ``plugins/ksr/ksr_reflector.go``:
+
+- change handlers gated on the data-store-synced flag (:408-435);
+- equal-value updates skipped (``ksrUpdate`` :342);
+- any data-store write error flips the synced flag and kicks off a
+  background reconciliation (``ksrAdd``/``ksrUpdate``/``ksrDelete``
+  :325-373);
+- reconciliation = **mark-and-sweep** between the K8s cache and a data
+  store snapshot (``markAndSweep`` :184-227), retried with exponential
+  backoff between ``min_resync_timeout`` and ``max_resync_timeout``
+  (``dataStoreResyncWait`` :253-275, 100→1000 ms in the reference);
+- per-reflector stats gauges (ksrapi ``KsrStats``).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Protocol, Tuple
+
+from ..kvstore import KVStore
+from .listwatch import K8sListWatch
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class KsrStats:
+    """Per-reflector usage gauges (plugins/ksr/model/ksrapi)."""
+
+    adds: int = 0
+    updates: int = 0
+    deletes: int = 0
+    add_errors: int = 0
+    upd_errors: int = 0
+    del_errors: int = 0
+    arg_errors: int = 0
+    resyncs: int = 0
+    res_errors: int = 0
+
+    def as_dict(self) -> Dict[str, int]:
+        return dict(self.__dict__)
+
+
+class BrokerDown(Exception):
+    """The data store rejected an operation (etcd-down analog)."""
+
+
+class Broker(Protocol):
+    """Key-value access for one reflector (KeyProtoValBroker analog)."""
+
+    def put(self, key: str, value: object) -> None: ...
+
+    def delete(self, key: str) -> None: ...
+
+    def list_values(self, prefix: str) -> List[Tuple[str, object]]: ...
+
+    def probe(self) -> bool:
+        """Cheap connectivity check (plugin_impl_ksr.go etcd monitor)."""
+        ...
+
+
+class KVBroker:
+    """Broker over the in-process :class:`KVStore`."""
+
+    def __init__(self, store: KVStore):
+        self.store = store
+
+    def put(self, key: str, value: object) -> None:
+        self.store.put(key, value)
+
+    def delete(self, key: str) -> None:
+        self.store.delete(key)
+
+    def list_values(self, prefix: str) -> List[Tuple[str, object]]:
+        return self.store.list(prefix)
+
+    def probe(self) -> bool:
+        return True
+
+
+# converter(k8s_obj_dict) -> (model, full_key) or None on a malformed
+# object (K8sToProtoConverter analog).
+Converter = Callable[[Dict], Optional[Tuple[object, str]]]
+
+
+class Reflector:
+    """Reflects one K8s resource kind into the data store."""
+
+    def __init__(
+        self,
+        kind: str,
+        prefix: str,
+        converter: Converter,
+        list_watch: K8sListWatch,
+        broker: Broker,
+        min_resync_timeout: float = 0.1,
+        max_resync_timeout: float = 1.0,
+    ):
+        self.kind = kind
+        self.prefix = prefix
+        self.converter = converter
+        self.list_watch = list_watch
+        self.broker = broker
+        self.min_resync_timeout = min_resync_timeout
+        self.max_resync_timeout = max_resync_timeout
+
+        self.stats = KsrStats()
+        self._lock = threading.RLock()
+        self._k8s_cache: Dict[str, object] = {}  # key -> model
+        self._k8s_synced = False
+        self._ds_synced = False
+        self._closed = False
+        self._abort = threading.Event()
+        self._resync_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        """Subscribe for changes, list the resource into the K8s cache and
+        reconcile the data store (ksrInit + Start + startDataStoreResync).
+
+        Subscribe happens BEFORE the initial listing so an object created
+        in between is not lost (the same watch-before-snapshot order the
+        controller's dbwatcher uses); early change events simply land in
+        the cache (``_ds_synced`` is still False) and the reconciliation
+        absorbs duplicates."""
+        self.list_watch.subscribe(self.kind, self._on_change)
+        with self._lock:
+            for obj in self.list_watch.list(self.kind):
+                conv = self._convert(obj)
+                if conv is not None:
+                    model, key = conv
+                    self._k8s_cache.setdefault(key, model)
+            self._k8s_synced = True
+        if not self._try_sync_once():
+            self.start_data_store_resync()
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+        self._abort.set()
+        unsubscribe = getattr(self.list_watch, "unsubscribe", None)
+        if unsubscribe is not None:
+            unsubscribe(self.kind, self._on_change)
+
+    @property
+    def has_synced(self) -> bool:
+        with self._lock:
+            return self._ds_synced
+
+    # ------------------------------------------------------- change handling
+
+    def _convert(self, obj: Dict) -> Optional[Tuple[object, str]]:
+        try:
+            conv = self.converter(obj)
+        except Exception:
+            conv = None
+        if conv is None:
+            self.stats.arg_errors += 1
+            log.warning("%s reflector: malformed object dropped", self.kind)
+        return conv
+
+    def _on_change(self, event: str, obj: Dict, old_obj: Optional[Dict]) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            conv = self._convert(obj)
+            if conv is None:
+                return
+            model, key = conv
+            if event == "delete":
+                self._k8s_cache.pop(key, None)
+            else:
+                self._k8s_cache[key] = model
+            if not self._ds_synced:
+                # Updates are held back while out of sync; the ongoing
+                # mark-and-sweep will pick the cache change up (:408-435).
+                return
+            try:
+                if event == "add":
+                    self.broker.put(key, model)
+                    self.stats.adds += 1
+                elif event == "update":
+                    old_conv = self._convert(old_obj) if old_obj else None
+                    if old_conv is not None and old_conv[0] == model:
+                        return  # no-op update (ksrUpdate proto.Equal check)
+                    self.broker.put(key, model)
+                    self.stats.updates += 1
+                elif event == "delete":
+                    self.broker.delete(key)
+                    self.stats.deletes += 1
+            except Exception:
+                if event == "add":
+                    self.stats.add_errors += 1
+                elif event == "update":
+                    self.stats.upd_errors += 1
+                else:
+                    self.stats.del_errors += 1
+                log.warning("%s reflector: data-store %s failed; resyncing",
+                            self.kind, event)
+                self._ds_synced = False
+                self.start_data_store_resync()
+
+    # ----------------------------------------------------------- resync path
+
+    def stop_data_store_updates(self) -> None:
+        """Data store reported down: hold back updates (stopDataStoreUpdates)."""
+        with self._lock:
+            self._ds_synced = False
+
+    def _mark_and_sweep(self, ds_items: Dict[str, object]) -> None:
+        """Reconcile the data store with the K8s cache (markAndSweep
+        :184-227).  Raises on the first failed write."""
+        for key, model in list(self._k8s_cache.items()):
+            if key in ds_items:
+                if ds_items[key] != model:
+                    try:
+                        self.broker.put(key, model)
+                    except Exception:
+                        self.stats.upd_errors += 1
+                        raise
+                    self.stats.updates += 1
+                del ds_items[key]
+            else:
+                try:
+                    self.broker.put(key, model)
+                except Exception:
+                    self.stats.add_errors += 1
+                    raise
+                self.stats.adds += 1
+        for key in list(ds_items):
+            try:
+                self.broker.delete(key)
+            except Exception:
+                self.stats.del_errors += 1
+                raise
+            self.stats.deletes += 1
+            del ds_items[key]
+
+    def _try_sync_once(self) -> bool:
+        """One full reconciliation attempt (syncDataStoreWithK8sCache)."""
+        try:
+            ds_items = dict(self.broker.list_values(self.prefix))
+        except Exception:
+            self.stats.res_errors += 1
+            return False
+        with self._lock:
+            self.stats.resyncs += 1
+            if not self._k8s_synced:
+                self.stats.res_errors += 1
+                return False
+            try:
+                self._mark_and_sweep(ds_items)
+            except Exception:
+                self.stats.res_errors += 1
+                return False
+            self._ds_synced = True
+            return True
+
+    def start_data_store_resync(self) -> None:
+        """Reconcile in the background until it succeeds or is aborted
+        (startDataStoreResync :279-323), with exponential backoff.
+
+        Always supersedes any previous reconciliation: the old loop's
+        abort event is set and a fresh loop (with its own abort event)
+        started — so a down→up flap that aborts a loop mid-attempt cannot
+        leave the reflector permanently unsynced."""
+        with self._lock:
+            if self._closed:
+                return
+            self._abort.set()  # retire any previous loop
+            self._abort = threading.Event()
+            abort = self._abort
+            self._resync_thread = threading.Thread(
+                target=self._resync_loop, args=(abort,),
+                name=f"ksr-resync-{self.kind}", daemon=True,
+            )
+            self._resync_thread.start()
+
+    def abort_resync(self) -> None:
+        """Abort an in-progress reconciliation (dataStoreDownEvent path)."""
+        self._abort.set()
+
+    def _resync_loop(self, abort: threading.Event) -> None:
+        timeout = self.min_resync_timeout
+        while not abort.is_set():
+            if self._try_sync_once():
+                log.info("%s reflector: data sync done, stats %s",
+                         self.kind, self.stats.as_dict())
+                return
+            if abort.wait(timeout):
+                return
+            timeout = min(timeout * 2, self.max_resync_timeout)

@@ -1,0 +1,327 @@
+"""Per-resource K8s → model converters and reflector construction.
+
+Analog of the reference's per-resource reflectors
+(``plugins/ksr/{pod,namespace,policy,service,endpoints,node}_reflector.go``):
+each converter parses a K8s-JSON-shaped dict into the corresponding typed
+model (the ``podToProto``/``policyToProto``/... analogs) and yields the
+data-store key from the model registry.
+
+The input shape is the K8s API wire format (``metadata``/``spec``/
+``status``), so a production ListWatch can feed API-server JSON straight
+through.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..models import (
+    Container,
+    ContainerPort,
+    EndpointAddress,
+    EndpointPort,
+    Endpoints,
+    EgressRule,
+    IPBlock,
+    IngressRule,
+    LabelExpression,
+    LabelSelector,
+    Namespace,
+    Node,
+    NodeAddress,
+    Peer,
+    Pod,
+    PodID,
+    Policy,
+    PolicyPort,
+    PolicyType,
+    ExpressionOperator,
+    Service,
+    ServicePort,
+)
+from ..models.registry import key_for, resource
+from .listwatch import K8sListWatch
+from .reflector import Broker, Reflector
+
+
+def _meta(obj: Dict) -> Tuple[str, str, Dict[str, str]]:
+    meta = obj.get("metadata", {})
+    return meta.get("name", ""), meta.get("namespace", "default"), meta.get("labels") or {}
+
+
+# ------------------------------------------------------------------- pod
+
+
+def pod_to_model(obj: Dict) -> Optional[Tuple[Pod, str]]:
+    """podToProto analog (pod_reflector.go:120-160)."""
+    name, namespace, labels = _meta(obj)
+    if not name:
+        return None
+    spec = obj.get("spec", {})
+    status = obj.get("status", {})
+    containers = []
+    for c in spec.get("containers", []):
+        ports = tuple(
+            ContainerPort(
+                name=p.get("name", ""),
+                host_port=p.get("hostPort", 0),
+                container_port=p.get("containerPort", 0),
+                protocol=p.get("protocol", "TCP"),
+                host_ip_address=p.get("hostIP", ""),
+            )
+            for p in c.get("ports", [])
+        )
+        containers.append(Container(name=c.get("name", ""), ports=ports))
+    model = Pod(
+        name=name,
+        namespace=namespace,
+        labels=labels,
+        ip_address=status.get("podIP", ""),
+        host_ip_address=status.get("hostIP", ""),
+        containers=tuple(containers),
+    )
+    return model, key_for(model)
+
+
+# ------------------------------------------------------------- namespace
+
+
+def namespace_to_model(obj: Dict) -> Optional[Tuple[Namespace, str]]:
+    name, _, labels = _meta(obj)
+    if not name:
+        return None
+    model = Namespace(name=name, labels=labels)
+    return model, key_for(model)
+
+
+# ---------------------------------------------------------------- policy
+
+
+def _selector(sel: Optional[Dict]) -> Optional[LabelSelector]:
+    """K8s LabelSelector dict → model; None stays None (matches nothing)."""
+    if sel is None:
+        return None
+    exprs = tuple(
+        LabelExpression(
+            key=e["key"],
+            operator=ExpressionOperator(e["operator"]),
+            values=tuple(e.get("values") or ()),
+        )
+        for e in sel.get("matchExpressions", [])
+    )
+    return LabelSelector(match_labels=sel.get("matchLabels") or {}, match_expressions=exprs)
+
+
+def _peers(peers: List[Dict]) -> Tuple[Peer, ...]:
+    out = []
+    for p in peers:
+        block = p.get("ipBlock")
+        out.append(
+            Peer(
+                pods=_selector(p.get("podSelector")),
+                namespaces=_selector(p.get("namespaceSelector")),
+                ip_block=IPBlock(
+                    cidr=block["cidr"], except_cidrs=tuple(block.get("except") or ())
+                )
+                if block
+                else None,
+            )
+        )
+    return tuple(out)
+
+
+def _policy_ports(ports: List[Dict]) -> Tuple[PolicyPort, ...]:
+    return tuple(
+        PolicyPort(protocol=p.get("protocol", "TCP"), port=p.get("port"))
+        for p in ports
+    )
+
+
+def policy_to_model(obj: Dict) -> Optional[Tuple[Policy, str]]:
+    """policyToProto analog (policy_reflector.go): maps networking/v1
+    NetworkPolicy including policyTypes defaulting."""
+    name, namespace, labels = _meta(obj)
+    if not name:
+        return None
+    spec = obj.get("spec", {})
+    types = spec.get("policyTypes")
+    if types is None:
+        ptype = PolicyType.DEFAULT
+    else:
+        ingress, egress = "Ingress" in types, "Egress" in types
+        if ingress and egress:
+            ptype = PolicyType.INGRESS_AND_EGRESS
+        elif egress:
+            ptype = PolicyType.EGRESS
+        elif ingress:
+            ptype = PolicyType.INGRESS
+        else:
+            ptype = PolicyType.DEFAULT
+    ingress_rules = tuple(
+        IngressRule(ports=_policy_ports(r.get("ports", [])),
+                    from_peers=_peers(r.get("from", [])))
+        for r in spec.get("ingress", [])
+    )
+    egress_rules = tuple(
+        EgressRule(ports=_policy_ports(r.get("ports", [])),
+                   to_peers=_peers(r.get("to", [])))
+        for r in spec.get("egress", [])
+    )
+    pod_sel = _selector(spec.get("podSelector")) or LabelSelector()
+    model = Policy(
+        name=name,
+        namespace=namespace,
+        labels=labels,
+        pods=pod_sel,
+        policy_type=ptype,
+        ingress_rules=ingress_rules,
+        egress_rules=egress_rules,
+    )
+    return model, key_for(model)
+
+
+# --------------------------------------------------------------- service
+
+
+def service_to_model(obj: Dict) -> Optional[Tuple[Service, str]]:
+    name, namespace, _ = _meta(obj)
+    if not name:
+        return None
+    spec = obj.get("spec", {})
+    status = obj.get("status", {})
+    ports = tuple(
+        ServicePort(
+            name=p.get("name", ""),
+            protocol=p.get("protocol", "TCP"),
+            port=p.get("port", 0),
+            target_port=p.get("targetPort"),
+            node_port=p.get("nodePort", 0),
+        )
+        for p in spec.get("ports", [])
+    )
+    affinity_cfg = (spec.get("sessionAffinityConfig") or {}).get("clientIP") or {}
+    lb_ips = tuple(
+        ing.get("ip", "")
+        for ing in (status.get("loadBalancer") or {}).get("ingress", [])
+        if ing.get("ip")
+    )
+    model = Service(
+        name=name,
+        namespace=namespace,
+        ports=ports,
+        selector=spec.get("selector") or {},
+        cluster_ip=spec.get("clusterIP", ""),
+        service_type=spec.get("type", "ClusterIP"),
+        external_ips=tuple(spec.get("externalIPs") or ()),
+        lb_ingress_ips=lb_ips,
+        session_affinity=spec.get("sessionAffinity", "None"),
+        session_affinity_timeout=affinity_cfg.get("timeoutSeconds", 0),
+        external_traffic_policy=spec.get("externalTrafficPolicy", "Cluster"),
+    )
+    return model, key_for(model)
+
+
+# ------------------------------------------------------------- endpoints
+
+
+def _endpoint_addresses(addrs: List[Dict]) -> Tuple[EndpointAddress, ...]:
+    out = []
+    for a in addrs:
+        ref = a.get("targetRef") or {}
+        target = (
+            PodID(name=ref.get("name", ""), namespace=ref.get("namespace", "default"))
+            if ref.get("kind") == "Pod"
+            else None
+        )
+        out.append(
+            EndpointAddress(
+                ip=a.get("ip", ""),
+                node_name=a.get("nodeName", ""),
+                host_name=a.get("hostname", ""),
+                target_pod=target,
+            )
+        )
+    return tuple(out)
+
+
+def endpoints_to_model(obj: Dict) -> Optional[Tuple[Endpoints, str]]:
+    name, namespace, _ = _meta(obj)
+    if not name:
+        return None
+    subsets = []
+    from ..models import EndpointSubset
+
+    for s in obj.get("subsets", []):
+        subsets.append(
+            EndpointSubset(
+                addresses=_endpoint_addresses(s.get("addresses", [])),
+                not_ready_addresses=_endpoint_addresses(s.get("notReadyAddresses", [])),
+                ports=tuple(
+                    EndpointPort(
+                        name=p.get("name", ""),
+                        port=p.get("port", 0),
+                        protocol=p.get("protocol", "TCP"),
+                    )
+                    for p in s.get("ports", [])
+                ),
+            )
+        )
+    model = Endpoints(name=name, namespace=namespace, subsets=tuple(subsets))
+    return model, key_for(model)
+
+
+# ------------------------------------------------------------------ node
+
+
+def node_to_model(obj: Dict) -> Optional[Tuple[Node, str]]:
+    name, _, labels = _meta(obj)
+    if not name:
+        return None
+    status = obj.get("status", {})
+    spec = obj.get("spec", {})
+    addresses = tuple(
+        NodeAddress(address=a.get("address", ""), type=a.get("type", ""))
+        for a in status.get("addresses", [])
+    )
+    model = Node(
+        name=name,
+        addresses=addresses,
+        pod_cidr=spec.get("podCIDR", ""),
+        labels=labels,
+    )
+    return model, key_for(model)
+
+
+# --------------------------------------------------------------- factory
+
+# K8s resource kind → (registry keyword, converter).
+CONVERTERS = {
+    "pods": ("pod", pod_to_model),
+    "namespaces": ("namespace", namespace_to_model),
+    "networkpolicies": ("policy", policy_to_model),
+    "services": ("service", service_to_model),
+    "endpoints": ("endpoints", endpoints_to_model),
+    "nodes": ("node", node_to_model),
+}
+
+
+def make_reflectors(
+    list_watch: K8sListWatch,
+    broker: Broker,
+    min_resync_timeout: float = 0.1,
+    max_resync_timeout: float = 1.0,
+) -> Dict[str, Reflector]:
+    """One reflector per reflected resource (the reflector set wired by
+    plugin_impl_ksr.go Init)."""
+    out: Dict[str, Reflector] = {}
+    for kind, (keyword, converter) in CONVERTERS.items():
+        out[kind] = Reflector(
+            kind=kind,
+            prefix=resource(keyword).key_prefix,
+            converter=converter,
+            list_watch=list_watch,
+            broker=broker,
+            min_resync_timeout=min_resync_timeout,
+            max_resync_timeout=max_resync_timeout,
+        )
+    return out

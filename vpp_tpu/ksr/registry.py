@@ -1,0 +1,70 @@
+"""Reflector registry — lifecycle + data-store connectivity fan-out.
+
+Analog of ``plugins/ksr/reflector_registry.go``: start all reflectors,
+broadcast data-store down/up events (down = hold updates + abort any
+in-progress reconciliation; up = start reconciliation), and aggregate
+stats / sync status.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from .reflector import KsrStats, Reflector
+
+
+class ReflectorRegistry:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._reflectors: Dict[str, Reflector] = {}
+
+    def add(self, reflector: Reflector) -> None:
+        with self._lock:
+            if reflector.kind in self._reflectors:
+                raise ValueError(f"duplicate reflector for {reflector.kind}")
+            self._reflectors[reflector.kind] = reflector
+
+    def get(self, kind: str) -> Optional[Reflector]:
+        with self._lock:
+            return self._reflectors.get(kind)
+
+    @property
+    def kinds(self):
+        with self._lock:
+            return sorted(self._reflectors)
+
+    def start_reflectors(self) -> None:
+        with self._lock:
+            reflectors = list(self._reflectors.values())
+        for r in reflectors:
+            r.start()
+
+    def close(self) -> None:
+        with self._lock:
+            reflectors = list(self._reflectors.values())
+        for r in reflectors:
+            r.close()
+
+    def data_store_down_event(self) -> None:
+        """Hold back updates and abort reconciliations (dataStoreDownEvent)."""
+        with self._lock:
+            reflectors = list(self._reflectors.values())
+        for r in reflectors:
+            r.stop_data_store_updates()
+            r.abort_resync()
+
+    def data_store_up_event(self) -> None:
+        """Data store is back: reconcile every reflector (dataStoreUpEvent)."""
+        with self._lock:
+            reflectors = list(self._reflectors.values())
+        for r in reflectors:
+            r.start_data_store_resync()
+
+    def ksr_has_synced(self) -> bool:
+        with self._lock:
+            return all(r.has_synced for r in self._reflectors.values())
+
+    def get_stats(self) -> Dict[str, KsrStats]:
+        with self._lock:
+            return {kind: r.stats for kind, r in self._reflectors.items()}

@@ -1,0 +1,70 @@
+"""Fake K8s API server for tests — the ListWatch backend.
+
+Analog of the reference's ``mockK8sListWatch`` used by every
+``plugins/ksr/*_reflector_test.go``: tests apply/delete K8s-JSON-shaped
+objects and subscribed reflectors receive add/update/delete events; the
+``list`` call returns the current object set (the informer's initial
+listing).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..ksr.listwatch import ListWatchHandler
+
+
+def _obj_key(obj: Dict) -> Tuple[str, str]:
+    meta = obj.get("metadata", {})
+    return meta.get("namespace", "default"), meta.get("name", "")
+
+
+class FakeK8sCluster:
+    """In-memory K8s API: per-kind object stores + change notification."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._objects: Dict[str, Dict[Tuple[str, str], Dict]] = {}
+        self._handlers: Dict[str, List[ListWatchHandler]] = {}
+
+    # ----------------------------------------------------- ListWatch API
+
+    def list(self, kind: str) -> List[Dict]:
+        with self._lock:
+            return list(self._objects.get(kind, {}).values())
+
+    def subscribe(self, kind: str, handler: ListWatchHandler) -> None:
+        with self._lock:
+            self._handlers.setdefault(kind, []).append(handler)
+
+    def unsubscribe(self, kind: str, handler: ListWatchHandler) -> None:
+        with self._lock:
+            handlers = self._handlers.get(kind, [])
+            if handler in handlers:
+                handlers.remove(handler)
+
+    # ------------------------------------------------------- test driver
+
+    def apply(self, kind: str, obj: Dict) -> None:
+        """Create or update an object (kubectl apply analog)."""
+        key = _obj_key(obj)
+        with self._lock:
+            store = self._objects.setdefault(kind, {})
+            old = store.get(key)
+            store[key] = obj
+            handlers = list(self._handlers.get(kind, []))
+        event = "update" if old is not None else "add"
+        for h in handlers:
+            h(event, obj, old)
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> Optional[Dict]:
+        key = (namespace, name)
+        with self._lock:
+            store = self._objects.setdefault(kind, {})
+            old = store.pop(key, None)
+            handlers = list(self._handlers.get(kind, []))
+        if old is not None:
+            for h in handlers:
+                h("delete", old, old)
+        return old
