@@ -1,0 +1,138 @@
+"""CNI gRPC server + shim tests: kubelet-exec → gRPC → event loop →
+ipv4net wiring → CNI result JSON."""
+
+import io
+import json
+
+import pytest
+
+from vpp_tpu.cni import CNIRequest, CNIServer, remote_cni_add, remote_cni_delete
+from vpp_tpu.cni.shim import main as shim_main
+from vpp_tpu.conf import NetworkConfig
+from vpp_tpu.controller.eventloop import Controller
+from vpp_tpu.controller.txn import TxnSink
+from vpp_tpu.ipv4net import IPv4Net
+from vpp_tpu.kvstore import KVStore
+from vpp_tpu.models import PodID
+from vpp_tpu.nodesync import NodeSync
+from vpp_tpu.podmanager import PodManager
+
+
+class Sink(TxnSink):
+    def __init__(self):
+        self.txns = []
+
+    def commit(self, txn):
+        self.txns.append(txn)
+
+
+@pytest.fixture()
+def agent():
+    """A minimal agent: controller + podmanager + ipv4net + CNI server."""
+    store = KVStore()
+    nodesync = NodeSync(store, node_name="node-1")
+    podmanager = PodManager()
+    ipv4net = IPv4Net(NetworkConfig(), nodesync, podmanager=podmanager)
+    ctl = Controller(handlers=[podmanager, ipv4net], sink=Sink())
+    podmanager.event_loop = ctl
+    ctl.start()
+    # Startup resync (allocates node id, builds IPAM).
+    from vpp_tpu.controller.api import DBResync
+
+    ev = DBResync()
+    ctl.push_event(ev)
+    deadline_err = None
+    import time
+
+    for _ in range(100):
+        if ipv4net.ipam is not None:
+            break
+        time.sleep(0.02)
+    assert ipv4net.ipam is not None, deadline_err
+
+    server = CNIServer(podmanager, port=0)
+    port = server.start()
+    yield ctl, podmanager, ipv4net, f"127.0.0.1:{port}"
+    server.stop()
+    ctl.stop()
+
+
+def _request(name, container="c1", namespace="default"):
+    return CNIRequest(
+        container_id=container,
+        network_namespace=f"/proc/42/ns/net",
+        interface_name="eth0",
+        extra_arguments=(
+            f"IgnoreUnknown=1;K8S_POD_NAMESPACE={namespace};"
+            f"K8S_POD_NAME={name};K8S_POD_INFRA_CONTAINER_ID={container}"
+        ),
+    )
+
+
+def test_add_then_delete_roundtrip(agent):
+    ctl, podmanager, ipv4net, target = agent
+    reply = remote_cni_add(target, _request("web-1"))
+    assert reply.result == 0, reply.error
+    assert reply.interfaces and reply.interfaces[0]["ip"].startswith("10.1.1.")
+    assert reply.routes[0]["gw"] == str(ipv4net.ipam.pod_gateway_ip)
+    assert PodID("web-1", "default") in podmanager.local_pods
+
+    reply = remote_cni_delete(target, _request("web-1"))
+    assert reply.result == 0
+    assert PodID("web-1", "default") not in podmanager.local_pods
+
+
+def test_add_missing_pod_name_is_error(agent):
+    _, _, _, target = agent
+    reply = remote_cni_add(target, CNIRequest(container_id="c9"))
+    assert reply.result == 1
+    assert "K8S_POD_NAME" in reply.error
+
+
+def test_shim_add_prints_cni_result(agent):
+    _, _, ipv4net, target = agent
+    env = {
+        "CNI_COMMAND": "ADD",
+        "CNI_CONTAINERID": "c7",
+        "CNI_NETNS": "/proc/7/ns/net",
+        "CNI_IFNAME": "eth0",
+        "CNI_ARGS": "K8S_POD_NAMESPACE=default;K8S_POD_NAME=shimmed",
+    }
+    stdin = io.StringIO(json.dumps({"cniVersion": "0.3.1", "name": "vpp-tpu",
+                                    "grpcServer": target}))
+    stdout = io.StringIO()
+    rc = shim_main(env=env, stdin=stdin, stdout=stdout)
+    assert rc == 0
+    result = json.loads(stdout.getvalue())
+    assert result["cniVersion"] == "0.3.1"
+    assert result["ips"][0]["address"].startswith("10.1.1.")
+    assert result["ips"][0]["gateway"] == str(ipv4net.ipam.pod_gateway_ip)
+    assert result["routes"][0]["dst"] == "0.0.0.0/0"
+
+    env["CNI_COMMAND"] = "DEL"
+    stdin = io.StringIO(json.dumps({"grpcServer": target}))
+    rc = shim_main(env=env, stdin=stdin, stdout=io.StringIO())
+    assert rc == 0
+
+
+def test_shim_version_and_bad_command():
+    out = io.StringIO()
+    rc = shim_main(env={"CNI_COMMAND": "VERSION"}, stdin=io.StringIO(""), stdout=out)
+    assert rc == 0
+    assert "0.3.1" in out.getvalue()
+    out = io.StringIO()
+    rc = shim_main(env={"CNI_COMMAND": "BOGUS"}, stdin=io.StringIO(""), stdout=out)
+    assert rc == 1
+
+
+def test_shim_agent_unreachable_reports_cni_error():
+    env = {
+        "CNI_COMMAND": "ADD",
+        "CNI_ARGS": "K8S_POD_NAMESPACE=default;K8S_POD_NAME=p",
+    }
+    stdin = io.StringIO(json.dumps({"grpcServer": "127.0.0.1:1"}))
+    out = io.StringIO()
+    rc = shim_main(env=env, stdin=stdin, stdout=out)
+    assert rc == 1
+    err = json.loads(out.getvalue())
+    assert err["code"] == 11

@@ -1,0 +1,9 @@
+"""CNI front end: gRPC server + shim binary.
+
+Analog of the reference's ``plugins/podmanager/cni`` (the RemoteCNI gRPC
+service) and ``cmd/contiv-cni`` (the CNI binary kubelet executes).
+"""
+
+from .rpc import CNIReply, CNIRequest, CNIServer, remote_cni_add, remote_cni_delete
+
+__all__ = ["CNIReply", "CNIRequest", "CNIServer", "remote_cni_add", "remote_cni_delete"]

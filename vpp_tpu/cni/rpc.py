@@ -1,0 +1,172 @@
+"""RemoteCNI gRPC service — the kubelet↔agent boundary.
+
+Analog of ``plugins/podmanager/cni/cni.proto`` (service RemoteCNI with
+Add/Delete taking a CNIRequest and returning a CNIReply) and of the
+server registration in ``plugins/podmanager/podmanager.go:97-111``.
+
+The wire protocol is gRPC (HTTP/2) with JSON-encoded messages: the
+environment has no protoc service-stub generator, so the service is
+registered through ``grpc.method_handlers_generic_handler`` with
+explicit serializers — same RPC shape, schema documented by the
+dataclasses below (field names follow cni.proto).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from concurrent import futures
+from dataclasses import asdict, dataclass, field
+from typing import List, Optional
+
+import grpc
+
+log = logging.getLogger(__name__)
+
+SERVICE_NAME = "cni.RemoteCNI"
+DEFAULT_PORT = 9111  # the reference agent's CNI gRPC port
+
+
+@dataclass
+class CNIRequest:
+    """cni.proto CNIRequest."""
+
+    version: str = ""
+    container_id: str = ""
+    network_namespace: str = ""
+    interface_name: str = ""
+    extra_nw_config: str = ""
+    extra_arguments: str = ""  # "K8S_POD_NAME=..;K8S_POD_NAMESPACE=.."
+    ipam_type: str = ""
+    ipam_data: str = ""
+
+    def extra_args(self) -> dict:
+        out = {}
+        for part in self.extra_arguments.split(";"):
+            key, sep, value = part.partition("=")
+            if sep:
+                out[key] = value
+        return out
+
+
+@dataclass
+class CNIReply:
+    """cni.proto CNIReply (interfaces/routes as plain dicts)."""
+
+    result: int = 0
+    error: str = ""
+    interfaces: List[dict] = field(default_factory=list)
+    routes: List[dict] = field(default_factory=list)
+    dns: List[dict] = field(default_factory=list)
+
+
+def _encode(msg) -> bytes:
+    return json.dumps(asdict(msg)).encode()
+
+
+def _decode_request(data: bytes) -> CNIRequest:
+    return CNIRequest(**json.loads(data.decode()))
+
+
+def _decode_reply(data: bytes) -> CNIReply:
+    return CNIReply(**json.loads(data.decode()))
+
+
+class CNIServer:
+    """gRPC server bridging CNI RPCs into blocking pod events.
+
+    ``podmanager`` must expose ``add_pod(...) -> PodCNIReply`` and
+    ``delete_pod(...)`` (the blocking-event facade).
+    """
+
+    def __init__(self, podmanager, port: int = DEFAULT_PORT, host: str = "127.0.0.1"):
+        self.podmanager = podmanager
+        self.port = port
+        self.host = host
+        self._server: Optional[grpc.Server] = None
+
+    # ------------------------------------------------------------- handlers
+
+    def _pod_identity(self, request: CNIRequest):
+        args = request.extra_args()
+        return args.get("K8S_POD_NAME", ""), args.get("K8S_POD_NAMESPACE", "default")
+
+    def add(self, request: CNIRequest, context=None) -> CNIReply:
+        name, namespace = self._pod_identity(request)
+        if not name:
+            return CNIReply(result=1, error="missing K8S_POD_NAME in extra arguments")
+        try:
+            reply = self.podmanager.add_pod(
+                name=name,
+                namespace=namespace,
+                container_id=request.container_id,
+                network_namespace=request.network_namespace,
+            )
+        except Exception as err:  # error propagates as non-zero CNI result
+            log.exception("CNI Add failed for %s/%s", namespace, name)
+            return CNIReply(result=1, error=str(err))
+        return CNIReply(result=0, interfaces=list(reply.interfaces),
+                        routes=list(reply.routes))
+
+    def delete(self, request: CNIRequest, context=None) -> CNIReply:
+        name, namespace = self._pod_identity(request)
+        if not name:
+            return CNIReply(result=1, error="missing K8S_POD_NAME in extra arguments")
+        try:
+            self.podmanager.delete_pod(name=name, namespace=namespace)
+        except Exception as err:
+            log.exception("CNI Delete failed for %s/%s", namespace, name)
+            return CNIReply(result=1, error=str(err))
+        return CNIReply(result=0)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> int:
+        """Start serving; returns the bound port (0 picks a free one)."""
+        handlers = {
+            "Add": grpc.unary_unary_rpc_method_handler(
+                lambda req, ctx: self.add(req, ctx),
+                request_deserializer=_decode_request,
+                response_serializer=_encode,
+            ),
+            "Delete": grpc.unary_unary_rpc_method_handler(
+                lambda req, ctx: self.delete(req, ctx),
+                request_deserializer=_decode_request,
+                response_serializer=_encode,
+            ),
+        }
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+        )
+        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        self._server.start()
+        log.info("RemoteCNI gRPC server listening on %s:%d", self.host, self.port)
+        return self.port
+
+    def stop(self, grace: float = 1.0) -> None:
+        if self._server is not None:
+            self._server.stop(grace)
+            self._server = None
+
+
+# ------------------------------------------------------------------ client
+
+
+def _call(target: str, method: str, request: CNIRequest, timeout: float) -> CNIReply:
+    with grpc.insecure_channel(target) as channel:
+        rpc = channel.unary_unary(
+            f"/{SERVICE_NAME}/{method}",
+            request_serializer=_encode,
+            response_deserializer=_decode_reply,
+        )
+        return rpc(request, timeout=timeout)
+
+
+def remote_cni_add(target: str, request: CNIRequest, timeout: float = 60.0) -> CNIReply:
+    """Client side of RemoteCNI.Add (cmd/contiv-cni grpcConnect + Add)."""
+    return _call(target, "Add", request, timeout)
+
+
+def remote_cni_delete(target: str, request: CNIRequest, timeout: float = 60.0) -> CNIReply:
+    return _call(target, "Delete", request, timeout)

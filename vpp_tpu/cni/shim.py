@@ -1,0 +1,115 @@
+"""The CNI shim binary kubelet executes.
+
+Analog of ``cmd/contiv-cni/contiv_cni.go``: reads the CNI environment
+(CNI_COMMAND, CNI_CONTAINERID, CNI_NETNS, CNI_IFNAME, CNI_ARGS) and the
+network config from stdin, forwards the request over gRPC to the agent's
+RemoteCNI server (cmdAdd :122 / cmdDel :259), and prints the CNI result
+JSON (spec 0.3.1) on stdout — errors as the CNI error object with a
+non-zero exit code (main :318).
+
+Run as ``python -m vpp_tpu.cni.shim`` with the CNI env set.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+from .rpc import CNIRequest, DEFAULT_PORT, remote_cni_add, remote_cni_delete
+
+CNI_VERSION = "0.3.1"
+
+
+def _error_result(code: int, msg: str) -> dict:
+    return {"cniVersion": CNI_VERSION, "code": code, "msg": msg}
+
+
+def _reply_to_result(reply) -> dict:
+    """CNIReply → CNI 0.3.1 result JSON (cmdAdd result assembly)."""
+    interfaces = []
+    ips = []
+    for idx, iface in enumerate(reply.interfaces):
+        interfaces.append(
+            {
+                "name": iface.get("name", "eth0"),
+                "mac": iface.get("mac", ""),
+                "sandbox": iface.get("sandbox", ""),
+            }
+        )
+        if iface.get("ip"):
+            ips.append(
+                {
+                    "version": "4",
+                    "address": iface["ip"],
+                    "gateway": iface.get("gateway", ""),
+                    "interface": idx,
+                }
+            )
+    routes = [
+        {"dst": r.get("dst", "0.0.0.0/0"), **({"gw": r["gw"]} if r.get("gw") else {})}
+        for r in reply.routes
+    ]
+    return {
+        "cniVersion": CNI_VERSION,
+        "interfaces": interfaces,
+        "ips": ips,
+        "routes": routes,
+        "dns": {},
+    }
+
+
+def build_request(env: dict, stdin_config: str) -> CNIRequest:
+    return CNIRequest(
+        version=CNI_VERSION,
+        container_id=env.get("CNI_CONTAINERID", ""),
+        network_namespace=env.get("CNI_NETNS", ""),
+        interface_name=env.get("CNI_IFNAME", "eth0"),
+        extra_nw_config=stdin_config,
+        extra_arguments=env.get("CNI_ARGS", ""),
+    )
+
+
+def main(env=None, stdin=None, stdout=None) -> int:
+    env = env if env is not None else os.environ
+    stdin = stdin if stdin is not None else sys.stdin
+    stdout = stdout if stdout is not None else sys.stdout
+
+    command = env.get("CNI_COMMAND", "")
+    config = stdin.read() if command in ("ADD", "DEL") else ""
+    try:
+        conf = json.loads(config) if config else {}
+    except ValueError:
+        conf = {}
+    target = conf.get("grpcServer", f"127.0.0.1:{DEFAULT_PORT}")
+    request = build_request(env, config)
+
+    if command == "VERSION":
+        json.dump({"cniVersion": CNI_VERSION,
+                   "supportedVersions": [CNI_VERSION]}, stdout)
+        return 0
+    if command not in ("ADD", "DEL"):
+        json.dump(_error_result(4, f"unsupported CNI_COMMAND {command!r}"), stdout)
+        return 1
+
+    try:
+        if command == "ADD":
+            reply = remote_cni_add(target, request)
+        else:
+            reply = remote_cni_delete(target, request)
+    except Exception as err:
+        json.dump(_error_result(11, f"agent RPC failed: {err}"), stdout)
+        return 1
+
+    if reply.result != 0:
+        json.dump(_error_result(11, reply.error), stdout)
+        return 1
+    if command == "ADD":
+        json.dump(_reply_to_result(reply), stdout)
+    else:
+        stdout.write("{}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -265,7 +265,12 @@ class IPv4Net(EventHandler):
             txn.put(kv.key, kv)
         event.reply.ip_address = f"{ip}/32"
         event.reply.interfaces.append(
-            {"name": "eth0", "ip": f"{ip}/{self.ipam.pod_subnet_this_node.prefixlen}"}
+            {
+                "name": "eth0",
+                "ip": f"{ip}/{self.ipam.pod_subnet_this_node.prefixlen}",
+                "gateway": str(self.ipam.pod_gateway_ip),
+                "sandbox": event.pod.network_namespace,
+            }
         )
         event.reply.routes.append(
             {"dst": "0.0.0.0/0", "gw": str(self.ipam.pod_gateway_ip)}
