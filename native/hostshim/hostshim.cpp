@@ -1,0 +1,193 @@
+// Host shim — native packet batch assembler / applier.
+//
+// The TPU-native analog of the reference's native transport layer
+// (GoVPP shared-memory adapter + DPDK NIC IO, SURVEY.md §2.3): raw
+// Ethernet frames are parsed into struct-of-arrays 5-tuple header
+// vectors (what the jit pipeline consumes), and the pipeline's verdicts
+// + NAT rewrites are applied back onto the frames with RFC 1624
+// incremental checksum updates — per-packet byte work stays native,
+// the TPU only ever sees fixed-shape header tensors.
+//
+// C ABI, consumed from Python via ctypes (no pybind11 in the image).
+// Frames live in ONE contiguous buffer described by (offset, len)
+// arrays — a single memcpy-free view for both sides.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint16_t kEthertypeIPv4 = 0x0800;
+constexpr uint16_t kEthertypeVlan = 0x8100;
+constexpr uint8_t kProtoTCP = 6;
+constexpr uint8_t kProtoUDP = 17;
+
+inline uint16_t load_be16(const uint8_t* p) {
+  return static_cast<uint16_t>(p[0]) << 8 | p[1];
+}
+inline uint32_t load_be32(const uint8_t* p) {
+  return static_cast<uint32_t>(p[0]) << 24 | static_cast<uint32_t>(p[1]) << 16 |
+         static_cast<uint32_t>(p[2]) << 8 | p[3];
+}
+inline void store_be16(uint8_t* p, uint16_t v) {
+  p[0] = v >> 8;
+  p[1] = v & 0xff;
+}
+inline void store_be32(uint8_t* p, uint32_t v) {
+  p[0] = v >> 24;
+  p[1] = (v >> 16) & 0xff;
+  p[2] = (v >> 8) & 0xff;
+  p[3] = v & 0xff;
+}
+
+// RFC 1624 eqn. 3: HC' = ~(~HC + ~m + m'), one 16-bit field update.
+inline uint16_t csum_update16(uint16_t hc, uint16_t m_old, uint16_t m_new) {
+  uint32_t sum = static_cast<uint32_t>(static_cast<uint16_t>(~hc)) +
+                 static_cast<uint16_t>(~m_old) + m_new;
+  while (sum >> 16) sum = (sum & 0xffff) + (sum >> 16);
+  return static_cast<uint16_t>(~sum);
+}
+
+inline uint16_t csum_update32(uint16_t hc, uint32_t m_old, uint32_t m_new) {
+  hc = csum_update16(hc, m_old >> 16, m_new >> 16);
+  return csum_update16(hc, m_old & 0xffff, m_new & 0xffff);
+}
+
+struct FrameView {
+  uint8_t* ip = nullptr;   // IPv4 header start
+  uint8_t* l4 = nullptr;   // L4 header start (null if truncated/fragment)
+  uint8_t proto = 0;
+  bool valid = false;
+  bool has_ports = false;
+};
+
+// Parse one frame: Ethernet II (+ optional single 802.1Q tag) → IPv4 →
+// TCP/UDP ports.  Non-IPv4 and truncated frames yield valid=false; a
+// non-first fragment keeps valid but has no port view.
+FrameView parse_frame(uint8_t* frame, uint32_t len) {
+  FrameView v;
+  if (len < 14) return v;
+  uint32_t off = 12;
+  uint16_t ethertype = load_be16(frame + off);
+  off += 2;
+  if (ethertype == kEthertypeVlan) {
+    if (len < off + 4) return v;
+    ethertype = load_be16(frame + off + 2);
+    off += 4;
+  }
+  if (ethertype != kEthertypeIPv4) return v;
+  if (len < off + 20) return v;
+  uint8_t* ip = frame + off;
+  if ((ip[0] >> 4) != 4) return v;
+  uint32_t ihl = static_cast<uint32_t>(ip[0] & 0x0f) * 4;
+  if (ihl < 20 || len < off + ihl) return v;
+  v.ip = ip;
+  v.proto = ip[9];
+  v.valid = true;
+  uint16_t frag = load_be16(ip + 6);
+  bool first_fragment = (frag & 0x1fff) == 0;
+  if (!first_fragment) return v;  // ports live in the first fragment only
+  if ((v.proto == kProtoTCP || v.proto == kProtoUDP) && len >= off + ihl + 4) {
+    v.l4 = ip + ihl;
+    v.has_ports = true;
+  }
+  return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse n frames into SoA header arrays. flags: bit0 = IPv4, bit1 =
+// ports present. Returns the number of IPv4 frames.
+int32_t hs_parse_batch(const uint8_t* buf, const uint64_t* offsets,
+                       const uint32_t* lens, int32_t n, uint32_t* src_ip,
+                       uint32_t* dst_ip, int32_t* protocol, int32_t* src_port,
+                       int32_t* dst_port, uint8_t* flags) {
+  int32_t parsed = 0;
+  for (int32_t i = 0; i < n; ++i) {
+    // parse_frame does not write; const_cast confines the mutable API
+    // to hs_apply_batch.
+    FrameView v = parse_frame(const_cast<uint8_t*>(buf + offsets[i]), lens[i]);
+    if (!v.valid) {
+      src_ip[i] = dst_ip[i] = 0;
+      protocol[i] = src_port[i] = dst_port[i] = 0;
+      flags[i] = 0;
+      continue;
+    }
+    src_ip[i] = load_be32(v.ip + 12);
+    dst_ip[i] = load_be32(v.ip + 16);
+    protocol[i] = v.proto;
+    src_port[i] = v.has_ports ? load_be16(v.l4) : 0;
+    dst_port[i] = v.has_ports ? load_be16(v.l4 + 2) : 0;
+    flags[i] = static_cast<uint8_t>(1 | (v.has_ports ? 2 : 0));
+    ++parsed;
+  }
+  return parsed;
+}
+
+// Apply verdicts + header rewrites in place. allowed[i] == 0 drops the
+// frame (fwd[i] = 0). Changed IPs/ports are patched with incremental
+// updates of the IPv4 header checksum and the TCP/UDP checksum
+// (pseudo-header includes the IPs). Returns the forwarded count.
+int32_t hs_apply_batch(uint8_t* buf, const uint64_t* offsets,
+                       const uint32_t* lens, int32_t n, const uint8_t* allowed,
+                       const uint32_t* new_src_ip, const uint32_t* new_dst_ip,
+                       const int32_t* new_src_port, const int32_t* new_dst_port,
+                       uint8_t* fwd) {
+  int32_t forwarded = 0;
+  for (int32_t i = 0; i < n; ++i) {
+    FrameView v = parse_frame(buf + offsets[i], lens[i]);
+    if (!v.valid || !allowed[i]) {
+      fwd[i] = 0;
+      continue;
+    }
+    fwd[i] = 1;
+    ++forwarded;
+
+    uint32_t old_src = load_be32(v.ip + 12);
+    uint32_t old_dst = load_be32(v.ip + 16);
+    uint16_t ip_csum = load_be16(v.ip + 10);
+
+    uint8_t* l4_csum_p = nullptr;
+    if (v.l4 != nullptr) {
+      if (v.proto == kProtoTCP) {
+        l4_csum_p = v.l4 + 16;
+      } else if (v.proto == kProtoUDP && load_be16(v.l4 + 6) != 0) {
+        l4_csum_p = v.l4 + 6;  // UDP checksum 0 = disabled, keep it so
+      }
+    }
+    uint16_t l4_csum = l4_csum_p ? load_be16(l4_csum_p) : 0;
+
+    if (new_src_ip[i] != old_src) {
+      ip_csum = csum_update32(ip_csum, old_src, new_src_ip[i]);
+      if (l4_csum_p) l4_csum = csum_update32(l4_csum, old_src, new_src_ip[i]);
+      store_be32(v.ip + 12, new_src_ip[i]);
+    }
+    if (new_dst_ip[i] != old_dst) {
+      ip_csum = csum_update32(ip_csum, old_dst, new_dst_ip[i]);
+      if (l4_csum_p) l4_csum = csum_update32(l4_csum, old_dst, new_dst_ip[i]);
+      store_be32(v.ip + 16, new_dst_ip[i]);
+    }
+    store_be16(v.ip + 10, ip_csum);
+
+    if (v.has_ports) {
+      uint16_t old_sport = load_be16(v.l4);
+      uint16_t old_dport = load_be16(v.l4 + 2);
+      uint16_t sport = static_cast<uint16_t>(new_src_port[i]);
+      uint16_t dport = static_cast<uint16_t>(new_dst_port[i]);
+      if (sport != old_sport) {
+        if (l4_csum_p) l4_csum = csum_update16(l4_csum, old_sport, sport);
+        store_be16(v.l4, sport);
+      }
+      if (dport != old_dport) {
+        if (l4_csum_p) l4_csum = csum_update16(l4_csum, old_dport, dport);
+        store_be16(v.l4 + 2, dport);
+      }
+    }
+    if (l4_csum_p) store_be16(l4_csum_p, l4_csum);
+  }
+  return forwarded;
+}
+
+}  // extern "C"

@@ -1,0 +1,168 @@
+"""Native host shim tests: C++ parse/apply vs the pure-Python oracle,
+and the full frames → pipeline → rewritten-frames round trip."""
+
+import numpy as np
+import pytest
+
+from vpp_tpu.ops.packets import PacketBatch, ip_to_u32, u32_to_ip
+from vpp_tpu.shim import HostShim
+from vpp_tpu.testing.frames import build_frame, frame_tuple, verify_checksums
+
+
+@pytest.fixture(scope="module")
+def shim():
+    return HostShim()
+
+
+class TestParse:
+    def test_parse_matches_python_oracle(self, shim):
+        rng = np.random.default_rng(7)
+        frames = []
+        for i in range(64):
+            proto = [6, 17, 1][i % 3]
+            frames.append(
+                build_frame(
+                    src_ip=f"10.1.1.{rng.integers(2, 250)}",
+                    dst_ip=f"10.96.0.{rng.integers(1, 250)}",
+                    protocol=proto,
+                    src_port=int(rng.integers(1024, 65535)),
+                    dst_port=[80, 443, 53][i % 3],
+                    vlan=100 if i % 5 == 0 else None,
+                    payload=bytes(rng.integers(0, 256, rng.integers(0, 64), dtype=np.uint8)),
+                )
+            )
+        fb = shim.parse(frames)
+        assert fb.n == 64
+        assert fb.batch.src_ip.shape[0] == 256  # padded to the vector size
+        for i, frame in enumerate(frames):
+            src, dst, proto, sport, dport = frame_tuple(frame)
+            assert int(fb.batch.src_ip[i]) == ip_to_u32(src)
+            assert int(fb.batch.dst_ip[i]) == ip_to_u32(dst)
+            assert int(fb.batch.protocol[i]) == proto
+            assert int(fb.batch.src_port[i]) == sport
+            assert int(fb.batch.dst_port[i]) == dport
+            assert fb.flags[i] & 1
+            assert bool(fb.flags[i] & 2) == (proto in (6, 17))
+
+    def test_non_ip_and_truncated_frames(self, shim):
+        arp = b"\xff" * 12 + b"\x08\x06" + b"\x00" * 28
+        runt = b"\x02\x00"
+        fb = shim.parse([arp, runt, build_frame("10.0.0.1", "10.0.0.2")])
+        assert fb.flags[0] == 0 and fb.flags[1] == 0
+        assert fb.flags[2] & 1
+        assert int(fb.batch.src_ip[0]) == 0
+
+    def test_fragment_has_no_ports(self, shim):
+        f = bytearray(build_frame("10.0.0.1", "10.0.0.2", protocol=17))
+        # Set fragment offset 185 (non-first fragment).
+        f[14 + 6] = 0x00 | (185 >> 8)
+        f[14 + 7] = 185 & 0xFF
+        fb = shim.parse([bytes(f)])
+        assert fb.flags[0] & 1 and not (fb.flags[0] & 2)
+        assert int(fb.batch.src_port[0]) == 0
+
+
+class TestApply:
+    def _rewrite(self, fb, **overrides):
+        b = fb.batch
+        fields = dict(
+            src_ip=np.asarray(b.src_ip).copy(), dst_ip=np.asarray(b.dst_ip).copy(),
+            protocol=np.asarray(b.protocol).copy(),
+            src_port=np.asarray(b.src_port).copy(),
+            dst_port=np.asarray(b.dst_port).copy(),
+        )
+        for k, v in overrides.items():
+            fields[k][: len(v)] = v
+        return PacketBatch(**fields)
+
+    def test_dnat_rewrite_keeps_checksums_valid(self, shim):
+        for proto in (6, 17):
+            frames = [
+                build_frame("10.1.1.2", "10.96.0.10", protocol=proto,
+                            src_port=40000, dst_port=80),
+            ]
+            fb = shim.parse(frames)
+            rewritten = self._rewrite(
+                fb,
+                dst_ip=[ip_to_u32("10.1.1.7")],
+                dst_port=[8080],
+            )
+            out = shim.apply(fb, np.ones(fb.n), rewritten)
+            assert len(out) == 1
+            src, dst, p, sport, dport = frame_tuple(out[0])
+            assert (dst, dport) == ("10.1.1.7", 8080)
+            assert verify_checksums(out[0]), "incremental checksum broke the frame"
+
+    def test_snat_rewrite_and_drop(self, shim):
+        frames = [
+            build_frame("10.1.1.2", "93.184.216.34", src_port=40000, dst_port=443),
+            build_frame("10.1.1.3", "10.1.1.4", src_port=1000, dst_port=80),
+        ]
+        fb = shim.parse(frames)
+        rewritten = self._rewrite(
+            fb,
+            src_ip=[ip_to_u32("192.168.16.1"), ip_to_u32("10.1.1.3")],
+            src_port=[61000, 1000],
+        )
+        out = shim.apply(fb, np.array([1, 0]), rewritten)
+        assert len(out) == 1  # second dropped
+        src, dst, p, sport, dport = frame_tuple(out[0])
+        assert (src, sport) == ("192.168.16.1", 61000)
+        assert verify_checksums(out[0])
+
+    def test_udp_disabled_checksum_stays_disabled(self, shim):
+        frames = [build_frame("10.1.1.2", "10.96.0.10", protocol=17,
+                              src_port=5000, dst_port=53, udp_checksum=False)]
+        fb = shim.parse(frames)
+        rewritten = self._rewrite(fb, dst_ip=[ip_to_u32("10.1.1.9")])
+        out = shim.apply(fb, np.ones(1), rewritten)
+        # Checksum field must remain 0 (disabled), frame otherwise valid.
+        assert verify_checksums(out[0])
+        _, dst, _, _, _ = frame_tuple(out[0])
+        assert dst == "10.1.1.9"
+
+    def test_vlan_frame_rewrite(self, shim):
+        frames = [build_frame("10.1.1.2", "10.96.0.10", vlan=42,
+                              src_port=40000, dst_port=80)]
+        fb = shim.parse(frames)
+        rewritten = self._rewrite(fb, dst_ip=[ip_to_u32("10.1.1.7")], dst_port=[8080])
+        out = shim.apply(fb, np.ones(1), rewritten)
+        assert verify_checksums(out[0])
+        assert frame_tuple(out[0])[1] == "10.1.1.7"
+
+
+class TestEndToEnd:
+    def test_frames_through_pipeline(self, shim):
+        """frames -> shim.parse -> jit pipeline -> shim.apply -> frames."""
+        import jax.numpy as jnp
+
+        from vpp_tpu.conf import IPAMConfig
+        from vpp_tpu.ipam import IPAM
+        from vpp_tpu.ops.classify import build_rule_tables
+        from vpp_tpu.ops.nat import NatMapping, build_nat_tables, empty_sessions
+        from vpp_tpu.ops.pipeline import make_route_config, pipeline_step
+        from vpp_tpu.policy.renderer.api import Action, ContivRule
+
+        ipam = IPAM(IPAMConfig(), node_id=1)
+        acl = build_rule_tables([], {})
+        nat = build_nat_tables(
+            [NatMapping("10.96.0.10", 80, 6, [("10.1.1.7", 8080, 1)])],
+            nat_loopback=str(ipam.nat_loopback_ip()),
+            snat_ip="192.168.16.1",
+            snat_enabled=True,
+            pod_subnet=str(ipam.pod_subnet_all_nodes),
+        )
+        route = make_route_config(ipam)
+        frames = [
+            build_frame("10.1.1.2", "10.96.0.10", src_port=40000 + i, dst_port=80)
+            for i in range(8)
+        ]
+        fb = shim.parse(frames)
+        res = pipeline_step(acl, nat, route, empty_sessions(1024),
+                            fb.batch, jnp.int32(0))
+        out = shim.apply(fb, res.allowed, res.batch)
+        assert len(out) == 8
+        for frame in out:
+            src, dst, proto, sport, dport = frame_tuple(frame)
+            assert (dst, dport) == ("10.1.1.7", 8080), "DNAT not applied"
+            assert verify_checksums(frame)

@@ -20,6 +20,11 @@ import jax.numpy as jnp
 import numpy as np
 
 
+# The data-plane vector size — the VPP 256-packet vector analog
+# (SURVEY.md §3.5); batches are padded to multiples of this.
+VECTOR_SIZE = 256
+
+
 def ip_to_u32(ip: Union[str, ipaddress.IPv4Address, int]) -> int:
     if isinstance(ip, int):
         return ip

@@ -1,0 +1,5 @@
+"""Host shim — native packet-batch assembly for the TPU pipeline."""
+
+from .hostshim import HostShim, FrameBatch
+
+__all__ = ["HostShim", "FrameBatch"]

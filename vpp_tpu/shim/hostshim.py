@@ -1,0 +1,152 @@
+"""ctypes binding + batch plumbing for the native host shim.
+
+The analog of the reference's GoVPP/DPDK transport boundary (SURVEY.md
+§2.3): ``HostShim.parse`` turns raw Ethernet frames into the
+fixed-shape :class:`PacketBatch` the jit pipeline consumes (padded to
+the 256-packet vector size), and ``HostShim.apply`` writes the
+pipeline's verdicts + NAT rewrites back into the frames with
+incremental checksum updates — all per-byte work in C++.
+
+The shared library is built on demand from ``native/hostshim`` with the
+baked-in g++ toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..ops.packets import PacketBatch, VECTOR_SIZE
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_SRC = os.path.join(_NATIVE_DIR, "hostshim", "hostshim.cpp")
+_LIB = os.path.join(_NATIVE_DIR, "build", "libhostshim.so")
+
+
+def _build_library() -> str:
+    src = os.path.abspath(_SRC)
+    lib = os.path.abspath(_LIB)
+    if not os.path.exists(lib) or os.path.getmtime(lib) < os.path.getmtime(src):
+        subprocess.run(
+            ["make", "-s", "-C", os.path.dirname(src)],
+            check=True,
+            capture_output=True,
+        )
+    return lib
+
+
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+_u32p = ctypes.POINTER(ctypes.c_uint32)
+_u64p = ctypes.POINTER(ctypes.c_uint64)
+_i32p = ctypes.POINTER(ctypes.c_int32)
+
+
+def _load() -> ctypes.CDLL:
+    lib = ctypes.CDLL(_build_library())
+    lib.hs_parse_batch.restype = ctypes.c_int32
+    lib.hs_parse_batch.argtypes = [
+        _u8p, _u64p, _u32p, ctypes.c_int32,
+        _u32p, _u32p, _i32p, _i32p, _i32p, _u8p,
+    ]
+    lib.hs_apply_batch.restype = ctypes.c_int32
+    lib.hs_apply_batch.argtypes = [
+        _u8p, _u64p, _u32p, ctypes.c_int32,
+        _u8p, _u32p, _u32p, _i32p, _i32p, _u8p,
+    ]
+    return lib
+
+
+@dataclass
+class FrameBatch:
+    """Frames packed into one contiguous buffer + parsed header SoA."""
+
+    buf: np.ndarray        # uint8 [total_bytes]
+    offsets: np.ndarray    # uint64 [n]
+    lens: np.ndarray       # uint32 [n]
+    flags: np.ndarray      # uint8 [n]: bit0 IPv4, bit1 ports
+    batch: PacketBatch     # padded to VECTOR_SIZE multiples
+    n: int
+
+    def frame(self, i: int) -> bytes:
+        off, ln = int(self.offsets[i]), int(self.lens[i])
+        return self.buf[off:off + ln].tobytes()
+
+
+class HostShim:
+    """The packet-batch assembler/applier."""
+
+    def __init__(self):
+        self._lib = _load()
+
+    # --------------------------------------------------------------- parse
+
+    def parse(self, frames: Sequence[bytes],
+              pad_to: Optional[int] = VECTOR_SIZE) -> FrameBatch:
+        """Parse raw frames into a (padded) PacketBatch."""
+        n = len(frames)
+        lens = np.array([len(f) for f in frames], dtype=np.uint32)
+        offsets = np.zeros(n, dtype=np.uint64)
+        if n:
+            np.cumsum(lens[:-1], dtype=np.uint64, out=offsets[1:])
+        buf = np.frombuffer(b"".join(frames), dtype=np.uint8).copy()
+
+        size = n
+        if pad_to:
+            size = max(pad_to, ((n + pad_to - 1) // pad_to) * pad_to)
+        src_ip = np.zeros(size, dtype=np.uint32)
+        dst_ip = np.zeros(size, dtype=np.uint32)
+        protocol = np.zeros(size, dtype=np.int32)
+        src_port = np.zeros(size, dtype=np.int32)
+        dst_port = np.zeros(size, dtype=np.int32)
+        flags = np.zeros(n, dtype=np.uint8)
+
+        if n:
+            self._lib.hs_parse_batch(
+                buf.ctypes.data_as(_u8p),
+                offsets.ctypes.data_as(_u64p),
+                lens.ctypes.data_as(_u32p),
+                n,
+                src_ip.ctypes.data_as(_u32p),
+                dst_ip.ctypes.data_as(_u32p),
+                protocol.ctypes.data_as(_i32p),
+                src_port.ctypes.data_as(_i32p),
+                dst_port.ctypes.data_as(_i32p),
+                flags.ctypes.data_as(_u8p),
+            )
+        batch = PacketBatch(
+            src_ip=src_ip, dst_ip=dst_ip, protocol=protocol,
+            src_port=src_port, dst_port=dst_port,
+        )
+        return FrameBatch(buf=buf, offsets=offsets, lens=lens,
+                          flags=flags, batch=batch, n=n)
+
+    # --------------------------------------------------------------- apply
+
+    def apply(self, fb: FrameBatch, allowed, rewritten: PacketBatch) -> List[bytes]:
+        """Apply pipeline verdicts + rewrites; returns forwarded frames."""
+        n = fb.n
+        allowed = np.asarray(allowed).astype(np.uint8)[:n].copy()
+        new_src = np.asarray(rewritten.src_ip).astype(np.uint32)[:n].copy()
+        new_dst = np.asarray(rewritten.dst_ip).astype(np.uint32)[:n].copy()
+        new_sport = np.asarray(rewritten.src_port).astype(np.int32)[:n].copy()
+        new_dport = np.asarray(rewritten.dst_port).astype(np.int32)[:n].copy()
+        fwd = np.zeros(n, dtype=np.uint8)
+        if n:
+            self._lib.hs_apply_batch(
+                fb.buf.ctypes.data_as(_u8p),
+                fb.offsets.ctypes.data_as(_u64p),
+                fb.lens.ctypes.data_as(_u32p),
+                n,
+                allowed.ctypes.data_as(_u8p),
+                new_src.ctypes.data_as(_u32p),
+                new_dst.ctypes.data_as(_u32p),
+                new_sport.ctypes.data_as(_i32p),
+                new_dport.ctypes.data_as(_i32p),
+                fwd.ctypes.data_as(_u8p),
+            )
+        return [fb.frame(i) for i in range(n) if fwd[i]]

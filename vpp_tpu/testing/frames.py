@@ -1,0 +1,126 @@
+"""Pure-Python Ethernet/IPv4 frame builder + checksum verifier.
+
+The reference oracle for the native host shim tests: frames built here
+have full (non-incremental) checksums, and ``verify_checksums`` recomputes
+them from scratch — so the C++ incremental RFC 1624 updates are checked
+against ground truth.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import struct
+from typing import Optional
+
+
+def _csum(data: bytes) -> int:
+    if len(data) % 2:
+        data += b"\x00"
+    total = sum(struct.unpack(f"!{len(data) // 2}H", data))
+    while total >> 16:
+        total = (total & 0xFFFF) + (total >> 16)
+    return ~total & 0xFFFF
+
+
+def _ip(addr) -> bytes:
+    return int(ipaddress.ip_address(str(addr))).to_bytes(4, "big")
+
+
+def build_frame(
+    src_ip: str,
+    dst_ip: str,
+    protocol: int = 6,
+    src_port: int = 1234,
+    dst_port: int = 80,
+    payload: bytes = b"hello",
+    vlan: Optional[int] = None,
+    src_mac: bytes = b"\x02\x00\x00\x00\x00\x01",
+    dst_mac: bytes = b"\x02\x00\x00\x00\x00\x02",
+    udp_checksum: bool = True,
+    ttl: int = 64,
+) -> bytes:
+    """Ethernet II (+optional 802.1Q) / IPv4 / {TCP,UDP,other} frame with
+    correct checksums."""
+    if protocol == 6:
+        # Minimal TCP header: ports, seq/ack, offset, flags, window.
+        l4_wo_csum = struct.pack(
+            "!HHIIBBH", src_port, dst_port, 1, 0, 5 << 4, 0x18, 8192
+        )
+        l4 = l4_wo_csum + b"\x00\x00" + struct.pack("!H", 0) + payload
+        csum_off = 16
+    elif protocol == 17:
+        length = 8 + len(payload)
+        l4 = struct.pack("!HHHH", src_port, dst_port, length, 0) + payload
+        csum_off = 6
+    else:
+        l4 = payload
+        csum_off = None
+
+    total_len = 20 + len(l4)
+    ip_hdr = struct.pack(
+        "!BBHHHBBH4s4s",
+        0x45, 0, total_len, 0x1234, 0, ttl, protocol, 0,
+        _ip(src_ip), _ip(dst_ip),
+    )
+    ip_hdr = ip_hdr[:10] + struct.pack("!H", _csum(ip_hdr)) + ip_hdr[12:]
+
+    if csum_off is not None:
+        pseudo = _ip(src_ip) + _ip(dst_ip) + struct.pack("!BBH", 0, protocol, len(l4))
+        c = _csum(pseudo + l4)
+        if protocol == 17:
+            if not udp_checksum:
+                c = 0
+            elif c == 0:
+                c = 0xFFFF
+        l4 = l4[:csum_off] + struct.pack("!H", c) + l4[csum_off + 2:]
+
+    eth = dst_mac + src_mac
+    if vlan is not None:
+        eth += struct.pack("!HH", 0x8100, vlan) + struct.pack("!H", 0x0800)
+    else:
+        eth += struct.pack("!H", 0x0800)
+    return eth + ip_hdr + l4
+
+
+def _l3_offset(frame: bytes) -> int:
+    ethertype = struct.unpack("!H", frame[12:14])[0]
+    return 18 if ethertype == 0x8100 else 14
+
+
+def verify_checksums(frame: bytes) -> bool:
+    """Recompute IPv4 + L4 checksums from scratch; True iff both hold."""
+    off = _l3_offset(frame)
+    ip = frame[off:]
+    ihl = (ip[0] & 0x0F) * 4
+    if _csum(ip[:10] + b"\x00\x00" + ip[12:ihl]) != struct.unpack("!H", ip[10:12])[0]:
+        return False
+    proto = ip[9]
+    l4 = ip[ihl:]
+    if proto == 6:
+        csum_off = 16
+    elif proto == 17:
+        if struct.unpack("!H", l4[6:8])[0] == 0:
+            return True  # UDP checksum disabled
+        csum_off = 6
+    else:
+        return True
+    pseudo = ip[12:16] + ip[16:20] + struct.pack("!BBH", 0, proto, len(l4))
+    zeroed = l4[:csum_off] + b"\x00\x00" + l4[csum_off + 2:]
+    expect = _csum(pseudo + zeroed)
+    if proto == 17 and expect == 0:
+        expect = 0xFFFF
+    return expect == struct.unpack("!H", l4[csum_off:csum_off + 2])[0]
+
+
+def frame_tuple(frame: bytes):
+    """(src_ip, dst_ip, proto, sport, dport) parsed pythonically."""
+    off = _l3_offset(frame)
+    ip = frame[off:]
+    ihl = (ip[0] & 0x0F) * 4
+    proto = ip[9]
+    src = str(ipaddress.ip_address(ip[12:16]))
+    dst = str(ipaddress.ip_address(ip[16:20]))
+    sport = dport = 0
+    if proto in (6, 17):
+        sport, dport = struct.unpack("!HH", ip[ihl:ihl + 4])
+    return src, dst, proto, sport, dport
