@@ -1,0 +1,140 @@
+"""StatsCollector + BGPReflector tests."""
+
+from prometheus_client import CollectorRegistry
+
+from vpp_tpu.bgpreflector import (
+    BGPReflector,
+    BGPRouteUpdate,
+    RouteEvent,
+)
+from vpp_tpu.bgpreflector.plugin import BIRD_PROTO_NUMBER, RouteEventType
+from vpp_tpu.conf import NetworkConfig
+from vpp_tpu.controller.txn import Txn
+from vpp_tpu.models import PodID
+from vpp_tpu.podmanager import DeletePod
+from vpp_tpu.statscollector import InterfaceStats, StatsCollector
+
+
+def _gauge_value(registry, metric, pod, namespace, if_name):
+    return registry.get_sample_value(
+        metric,
+        {"podName": pod, "podNamespace": namespace, "interfaceName": if_name},
+    )
+
+
+class TestStatsCollector:
+    def test_pod_interface_exported(self):
+        registry = CollectorRegistry()
+        sc = StatsCollector(registry=registry)
+        sc.put("tap-default-web-1",
+               InterfaceStats(in_packets=100, out_packets=90, in_bytes=6400,
+                              out_bytes=5760, drop_packets=10))
+        assert _gauge_value(registry, "inPackets", "web-1", "default",
+                            "tap-default-web-1") == 100
+        assert _gauge_value(registry, "dropPackets", "web-1", "default",
+                            "tap-default-web-1") == 10
+        # Counter update overwrites.
+        sc.put("tap-default-web-1", InterfaceStats(in_packets=150))
+        assert _gauge_value(registry, "inPackets", "web-1", "default",
+                            "tap-default-web-1") == 150
+
+    def test_system_interfaces_not_exported(self):
+        registry = CollectorRegistry()
+        sc = StatsCollector(registry=registry)
+        sc.put("tap-vpp2", InterfaceStats(in_packets=5))
+        sc.put("vxlanBVI", InterfaceStats(in_packets=5))
+        sc.put("GigabitEthernet0/0/0", InterfaceStats(in_packets=5))
+        assert not sc.pod_stats(PodID("vpp2", "tap"))
+
+    def test_delete_pod_prunes_gauges(self):
+        registry = CollectorRegistry()
+        sc = StatsCollector(registry=registry)
+        sc.put("tap-default-web-1", InterfaceStats(in_packets=1))
+        assert sc.update(DeletePod(PodID("web-1", "default")), None)
+        assert _gauge_value(registry, "inPackets", "web-1", "default",
+                            "tap-default-web-1") is None
+        assert not sc.pod_stats(PodID("web-1", "default"))
+
+    def test_counters_from_pipeline_result(self):
+        import numpy as np
+
+        from vpp_tpu.statscollector import counters_from_result
+
+        class R:
+            allowed = np.array([1, 1, 0, 1], dtype=bool)
+
+        stats = counters_from_result(R())
+        assert stats.in_packets == 4
+        assert stats.out_packets == 3
+        assert stats.drop_packets == 1
+
+
+class FakeRouteSource:
+    def __init__(self, routes=()):
+        self.routes = list(routes)
+        self.handler = None
+
+    def list_routes(self):
+        return list(self.routes)
+
+    def subscribe(self, handler):
+        self.handler = handler
+
+    def emit(self, ev):
+        self.handler(ev)
+
+
+class FakeLoop:
+    def __init__(self):
+        self.events = []
+
+    def push_event(self, ev):
+        self.events.append(ev)
+
+
+def _bgp_route(dst, gw, proto=BIRD_PROTO_NUMBER, type_=RouteEventType.ADD):
+    return RouteEvent(type=type_, dst_network=dst, gateway=gw, protocol=proto)
+
+
+class TestBGPReflector:
+    def setup_method(self):
+        from vpp_tpu.conf.config import InterfaceConfig
+
+        self.config = NetworkConfig(
+            interface=InterfaceConfig(main_interface="GigabitEthernet0/0/0")
+        )
+
+    def test_resync_reflects_bird_routes_only(self):
+        source = FakeRouteSource([
+            _bgp_route("172.16.0.0/24", "192.168.16.100"),
+            _bgp_route("172.17.0.0/24", "192.168.16.100", proto=3),  # kernel
+            _bgp_route("172.18.0.0/24", "0.0.0.0"),  # unspecified gw
+        ])
+        br = BGPReflector(self.config, route_source=source)
+        txn = Txn(is_resync=True)
+        br.resync(None, {}, 1, txn)
+        routes = list(txn.values.values())
+        assert len(routes) == 1
+        assert routes[0].dst_network == "172.16.0.0/24"
+        assert routes[0].next_hop == "192.168.16.100"
+        assert routes[0].outgoing_interface == "GigabitEthernet0/0/0"
+
+    def test_route_change_becomes_event_then_txn(self):
+        source = FakeRouteSource()
+        loop = FakeLoop()
+        br = BGPReflector(self.config, route_source=source, event_loop=loop)
+        br.init()
+        source.emit(_bgp_route("172.16.5.0/24", "192.168.16.100"))
+        source.emit(_bgp_route("172.16.6.0/24", "192.168.16.100", proto=2))
+        assert len(loop.events) == 1
+        ev = loop.events[0]
+        assert isinstance(ev, BGPRouteUpdate)
+        txn = Txn(is_resync=False)
+        assert br.update(ev, txn) == "BGP route Add"
+        assert any(v is not None for v in txn.values.values())
+        # Delete flows through as txn.delete.
+        source.emit(_bgp_route("172.16.5.0/24", "192.168.16.100",
+                               type_=RouteEventType.DELETE))
+        txn2 = Txn(is_resync=False)
+        assert br.update(loop.events[1], txn2) == "BGP route Delete"
+        assert list(txn2.values.values()) == [None]

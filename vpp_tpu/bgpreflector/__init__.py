@@ -1,0 +1,5 @@
+"""BGPReflector — mirrors BGP-learned host routes into the data plane."""
+
+from .plugin import BGPReflector, BGPRouteUpdate, RouteEvent, RouteSource
+
+__all__ = ["BGPReflector", "BGPRouteUpdate", "RouteEvent", "RouteSource"]

@@ -1,0 +1,150 @@
+"""BGPReflector plugin.
+
+Analog of ``plugins/bgpreflector/bgpreflector.go``: watches the host
+routing table for BGP-learned routes (the BIRD protocol number in the
+reference, ``watchRoutes`` :151) and mirrors them into the data plane's
+main VRF (``vppRoute`` :188) — adds/deletes arrive as
+``BGPRouteUpdate`` events (bgpreflector_api.go :34), full state is
+re-reflected on resync.
+
+The netlink subscription is abstracted as :class:`RouteSource`; tests
+and non-Linux hosts inject a mock.  A production source can shell out
+to ``ip monitor route`` or bind rtnetlink directly.
+"""
+
+from __future__ import annotations
+
+import enum
+import ipaddress
+import logging
+from dataclasses import dataclass
+from typing import Callable, Iterable, Optional, Protocol
+
+from ..controller.api import EventHandler, UpdateEvent
+from ..ipv4net.model import Route
+
+log = logging.getLogger(__name__)
+
+# Routes installed by the BIRD BGP daemon carry this routing-protocol
+# number (the reference's birdRouteProtoNumber).
+BIRD_PROTO_NUMBER = 12
+
+
+class RouteEventType(enum.Enum):
+    ADD = "add"
+    DELETE = "delete"
+
+
+@dataclass(frozen=True)
+class RouteEvent:
+    """One host routing-table change (netlink.RouteUpdate analog)."""
+
+    type: RouteEventType
+    dst_network: str
+    gateway: str
+    protocol: int = BIRD_PROTO_NUMBER
+
+
+class RouteSource(Protocol):
+    """Where host routes come from (netlink in production, mock in tests)."""
+
+    def list_routes(self) -> Iterable[RouteEvent]:
+        """Current routing table (RouteList analog)."""
+        ...
+
+    def subscribe(self, handler: Callable[[RouteEvent], None]) -> None:
+        """Stream subsequent changes (RouteSubscribe analog)."""
+        ...
+
+
+class BGPRouteUpdate(UpdateEvent):
+    """Event carrying one BGP route add/delete (bgpreflector_api.go :34)."""
+
+    name = "BGP Route Update"
+
+    def __init__(self, type_: RouteEventType, dst_network: str, gateway: str):
+        super().__init__()
+        self.type = type_
+        self.dst_network = dst_network
+        self.gateway = gateway
+
+    def __str__(self) -> str:
+        return f"{self.name} [{self.type.value} {self.dst_network} via {self.gateway}]"
+
+
+def _is_valid_route(dst: str, gw: str) -> bool:
+    """isValidRoute analog: needs a destination and a specified gateway."""
+    if not dst or not gw:
+        return False
+    try:
+        if ipaddress.ip_address(gw).is_unspecified:
+            return False
+        ipaddress.ip_network(dst, strict=False)
+    except ValueError:
+        return False
+    return True
+
+
+class BGPReflector(EventHandler):
+    name = "bgpreflector"
+
+    def __init__(self, config, route_source: Optional[RouteSource] = None,
+                 event_loop=None):
+        self.config = config  # NetworkConfig (routing + interface sections)
+        self.route_source = route_source
+        self.event_loop = event_loop
+
+    # ------------------------------------------------------------ lifecycle
+
+    def init(self) -> None:
+        """Subscribe to host routing-table changes (watchRoutes :151)."""
+        if self.route_source is not None:
+            self.route_source.subscribe(self._on_route_change)
+
+    def _on_route_change(self, ev: RouteEvent) -> None:
+        if ev.protocol != BIRD_PROTO_NUMBER:
+            return
+        if not _is_valid_route(ev.dst_network, ev.gateway):
+            return
+        if self.event_loop is not None:
+            self.event_loop.push_event(
+                BGPRouteUpdate(ev.type, ev.dst_network, ev.gateway)
+            )
+
+    # ---------------------------------------------------------------- route
+
+    def _data_plane_route(self, dst_network: str, gateway: str) -> Route:
+        """vppRoute analog: BGP route → main-VRF route out the uplink."""
+        return Route(
+            dst_network=str(ipaddress.ip_network(dst_network, strict=False)),
+            next_hop=gateway,
+            outgoing_interface=self.config.interface.main_interface,
+            vrf=self.config.routing.main_vrf_id,
+        )
+
+    # --------------------------------------------------------------- events
+
+    def handles_event(self, event) -> bool:
+        return isinstance(event, BGPRouteUpdate) or event.method.is_resync
+
+    def resync(self, event, kube_state, resync_count, txn) -> None:
+        """Reflect the whole current table (Resync :100-113)."""
+        if self.route_source is None:
+            return
+        for ev in self.route_source.list_routes():
+            if ev.protocol != BIRD_PROTO_NUMBER:
+                continue
+            if not _is_valid_route(ev.dst_network, ev.gateway):
+                continue
+            route = self._data_plane_route(ev.dst_network, ev.gateway)
+            txn.put(route.key, route)
+
+    def update(self, event, txn) -> str:
+        if not isinstance(event, BGPRouteUpdate):
+            return ""
+        route = self._data_plane_route(event.dst_network, event.gateway)
+        if event.type is RouteEventType.ADD:
+            txn.put(route.key, route)
+            return "BGP route Add"
+        txn.delete(route.key)
+        return "BGP route Delete"

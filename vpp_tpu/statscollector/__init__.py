@@ -1,0 +1,5 @@
+"""StatsCollector — per-pod data-plane statistics → Prometheus."""
+
+from .plugin import InterfaceStats, StatsCollector, counters_from_result
+
+__all__ = ["InterfaceStats", "StatsCollector", "counters_from_result"]

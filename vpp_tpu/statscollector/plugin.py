@@ -1,0 +1,191 @@
+"""StatsCollector plugin.
+
+Analog of ``plugins/statscollector/plugin_impl_statscollector.go``: the
+data plane pushes per-interface counters into ``put()`` (:213, the
+datasync-sink analog), the collector maps interface names to pods
+through the ipv4net naming scheme, and exports one Prometheus gauge per
+(metric, pod, interface) — pruned when the pod is deleted
+(:213-357).  System interfaces (host interconnect, BVI, uplink) are
+skipped exactly like the reference's ``systemIfNames`` filter.
+
+Metric/label names match the reference so dashboards carry over.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from prometheus_client import CollectorRegistry, Gauge
+
+from ..controller.api import EventHandler
+from ..ipv4net.plugin import HOST_INTERCONNECT_IF, POD_IF_PREFIX, VXLAN_BVI_NAME
+from ..models import PodID
+from ..podmanager import DeletePod
+
+log = logging.getLogger(__name__)
+
+POD_NAME_LABEL = "podName"
+POD_NAMESPACE_LABEL = "podNamespace"
+INTERFACE_NAME_LABEL = "interfaceName"
+
+METRICS = (
+    ("inPackets", "Number of received packets for interface"),
+    ("outPackets", "Number of transmitted packets for interface"),
+    ("inBytes", "Number of received bytes for interface"),
+    ("outBytes", "Number of transmitted bytes for interface"),
+    ("dropPackets", "Number of dropped packets for interface"),
+    ("puntPackets", "Number of punted packets for interface"),
+    ("inErrorPackets", "Number of received packets with error for interface"),
+    ("outErrorPackets", "Number of transmitted packets with error for interface"),
+)
+
+SYSTEM_IF_NAMES = (HOST_INTERCONNECT_IF, VXLAN_BVI_NAME, "vpp2", "loopbackNIC")
+
+
+@dataclass
+class InterfaceStats:
+    """One interface's counters (vpp_interfaces.InterfaceState analog)."""
+
+    in_packets: int = 0
+    out_packets: int = 0
+    in_bytes: int = 0
+    out_bytes: int = 0
+    drop_packets: int = 0
+    punt_packets: int = 0
+    in_error_packets: int = 0
+    out_error_packets: int = 0
+
+    def as_metric_values(self) -> Dict[str, float]:
+        return {
+            "inPackets": self.in_packets,
+            "outPackets": self.out_packets,
+            "inBytes": self.in_bytes,
+            "outBytes": self.out_bytes,
+            "dropPackets": self.drop_packets,
+            "puntPackets": self.punt_packets,
+            "inErrorPackets": self.in_error_packets,
+            "outErrorPackets": self.out_error_packets,
+        }
+
+
+def _pod_from_if_name(if_name: str) -> Optional[PodID]:
+    """tap-<namespace>-<name> → PodID (ipv4net naming scheme)."""
+    if not if_name.startswith(POD_IF_PREFIX) or if_name in SYSTEM_IF_NAMES:
+        return None
+    rest = if_name[len(POD_IF_PREFIX):]
+    namespace, sep, name = rest.partition("-")
+    if not sep or not name:
+        return None
+    return PodID(name=name, namespace=namespace)
+
+
+@dataclass
+class _Entry:
+    pod: PodID
+    if_name: str
+    stats: InterfaceStats = field(default_factory=InterfaceStats)
+
+
+class StatsCollector(EventHandler):
+    """Maps data-plane interface counters to pods and exports gauges."""
+
+    name = "statscollector"
+
+    def __init__(self, registry: Optional[CollectorRegistry] = None):
+        self.registry = registry if registry is not None else CollectorRegistry()
+        self._lock = threading.Lock()
+        self._entries: Dict[str, _Entry] = {}
+        self._gauges: Dict[str, Gauge] = {
+            metric: Gauge(
+                metric, help_text,
+                [POD_NAME_LABEL, POD_NAMESPACE_LABEL, INTERFACE_NAME_LABEL],
+                registry=self.registry,
+            )
+            for metric, help_text in METRICS
+        }
+
+    # ----------------------------------------------------------- data plane
+
+    def put(self, if_name: str, stats: InterfaceStats) -> None:
+        """Ingest one interface's counters (the datasync Put analog)."""
+        pod = _pod_from_if_name(if_name)
+        if pod is None:
+            return  # system interface or unknown naming — not exported
+        with self._lock:
+            entry = self._entries.get(if_name)
+            if entry is None:
+                entry = _Entry(pod=pod, if_name=if_name)
+                self._entries[if_name] = entry
+            entry.stats = stats
+            self._update_gauges(entry)
+
+    def _update_gauges(self, entry: _Entry) -> None:
+        labels = {
+            POD_NAME_LABEL: entry.pod.name,
+            POD_NAMESPACE_LABEL: entry.pod.namespace,
+            INTERFACE_NAME_LABEL: entry.if_name,
+        }
+        for metric, value in entry.stats.as_metric_values().items():
+            self._gauges[metric].labels(**labels).set(value)
+
+    # --------------------------------------------------------------- events
+
+    def handles_event(self, event) -> bool:
+        return isinstance(event, DeletePod) or event.method.is_resync
+
+    def update(self, event, txn) -> str:
+        if isinstance(event, DeletePod):
+            self.prune_pod(event.pod_id)
+            return f"pruned stats of {event.pod_id}"
+        return ""
+
+    def resync(self, event, kube_state, resync_count, txn) -> None:
+        """Drop entries for pods no longer known (mirrors the reference
+        pruning on resync)."""
+
+    def prune_pod(self, pod_id: PodID) -> None:
+        with self._lock:
+            for if_name in [k for k, e in self._entries.items() if e.pod == pod_id]:
+                entry = self._entries.pop(if_name)
+                labels = (entry.pod.name, entry.pod.namespace, entry.if_name)
+                for gauge in self._gauges.values():
+                    try:
+                        gauge.remove(*labels)
+                    except KeyError:
+                        pass
+
+    # -------------------------------------------------------------- queries
+
+    def pod_stats(self, pod_id: PodID) -> Dict[str, InterfaceStats]:
+        with self._lock:
+            return {
+                e.if_name: e.stats for e in self._entries.values() if e.pod == pod_id
+            }
+
+
+def counters_from_result(result, fb=None) -> InterfaceStats:
+    """Aggregate one pipeline step's result into interface counters —
+    the bridge from the TPU data plane into ``put()``.
+
+    ``fb`` (a shim FrameBatch) supplies byte counts when available.
+    """
+    import numpy as np
+
+    allowed = np.asarray(result.allowed)
+    n = allowed.shape[0]
+    forwarded = int(allowed.sum())
+    in_bytes = out_bytes = 0
+    if fb is not None:
+        lens = np.asarray(fb.lens)
+        in_bytes = int(lens.sum())
+        out_bytes = int(lens[: len(allowed)][allowed[: len(lens)] > 0].sum())
+    return InterfaceStats(
+        in_packets=n,
+        out_packets=forwarded,
+        in_bytes=in_bytes,
+        out_bytes=out_bytes,
+        drop_packets=n - forwarded,
+    )
