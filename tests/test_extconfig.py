@@ -1,0 +1,93 @@
+"""External-config gRPC plugin tests: NB API → store → controller events,
+persistence across restart."""
+
+import os
+import time
+
+import pytest
+
+from vpp_tpu.controller.api import ExternalConfigChange
+from vpp_tpu.controller.dbwatcher import EXTERNAL_CONFIG_PREFIX, DBWatcher
+from vpp_tpu.controller.eventloop import Controller
+from vpp_tpu.controller.txn import TxnSink
+from vpp_tpu.extconfig import (
+    ExternalConfigPlugin,
+    ext_config_get,
+    ext_config_put,
+    ext_config_resync,
+)
+from vpp_tpu.extconfig.plugin import ext_config_delete
+from vpp_tpu.kvstore import KVStore
+
+
+@pytest.fixture()
+def plugin():
+    store = KVStore()
+    p = ExternalConfigPlugin(store, port=0)
+    target = f"127.0.0.1:{p.start()}"
+    yield p, store, target
+    p.stop()
+
+
+def test_put_get_delete_roundtrip(plugin):
+    p, store, target = plugin
+    assert ext_config_put(target, "routes/vrf0/172.16.0.0-24",
+                          {"dst": "172.16.0.0/24", "gw": "192.168.16.9"})["ok"]
+    assert store.get(EXTERNAL_CONFIG_PREFIX + "routes/vrf0/172.16.0.0-24")["gw"] == "192.168.16.9"
+    got = ext_config_get(target)
+    assert got["values"]["routes/vrf0/172.16.0.0-24"]["dst"] == "172.16.0.0/24"
+    assert ext_config_delete(target, "routes/vrf0/172.16.0.0-24")["ok"]
+    assert store.get(EXTERNAL_CONFIG_PREFIX + "routes/vrf0/172.16.0.0-24") is None
+    assert ext_config_get(target)["values"] == {}
+
+
+def test_resync_replaces_snapshot(plugin):
+    p, store, target = plugin
+    ext_config_put(target, "a", {"v": 1})
+    ext_config_put(target, "b", {"v": 2})
+    res = ext_config_resync(target, {"b": {"v": 20}, "c": {"v": 3}})
+    assert res["ok"] and res["count"] == 2
+    assert store.get(EXTERNAL_CONFIG_PREFIX + "a") is None  # stale deleted
+    assert store.get(EXTERNAL_CONFIG_PREFIX + "b")["v"] == 20
+    assert store.get(EXTERNAL_CONFIG_PREFIX + "c")["v"] == 3
+
+
+def test_changes_reach_controller_as_external_config(plugin):
+    p, store, target = plugin
+    seen = []
+
+    class Sink(TxnSink):
+        def commit(self, txn):
+            seen.append(txn)
+
+    ctl = Controller(handlers=[], sink=Sink())
+    ctl.start()
+    watcher = DBWatcher(ctl, store)
+    watcher.start()
+    try:
+        ext_config_put(target, "nat/pool", {"ip": "192.168.16.200"})
+        deadline = time.time() + 2
+        while time.time() < deadline and not ctl.external_config:
+            time.sleep(0.02)
+        assert EXTERNAL_CONFIG_PREFIX + "nat/pool" in ctl.external_config
+        assert ctl.external_config[EXTERNAL_CONFIG_PREFIX + "nat/pool"]["ip"] == "192.168.16.200"
+    finally:
+        watcher.stop()
+        ctl.stop()
+
+
+def test_snapshot_survives_restart(tmp_path):
+    db_path = os.path.join(tmp_path, "grpc.db")
+    store = KVStore()
+    p = ExternalConfigPlugin(store, db_path=db_path, port=0)
+    target = f"127.0.0.1:{p.start()}"
+    ext_config_put(target, "keep/me", {"v": 42})
+    p.stop()
+
+    # Restart: no client reconnects, but the snapshot pre-seeds the store.
+    store2 = KVStore()
+    p2 = ExternalConfigPlugin(store2, db_path=db_path, port=0)
+    p2.preseed_store()
+    assert store2.get(EXTERNAL_CONFIG_PREFIX + "keep/me") == {"v": 42}
+    assert p2.get_config_snapshot() == {EXTERNAL_CONFIG_PREFIX + "keep/me": {"v": 42}}
+    p2.stop()

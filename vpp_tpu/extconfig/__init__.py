@@ -1,0 +1,15 @@
+"""External (non-K8s) configuration source — gRPC NB API."""
+
+from .plugin import (
+    ExternalConfigPlugin,
+    ext_config_get,
+    ext_config_put,
+    ext_config_resync,
+)
+
+__all__ = [
+    "ExternalConfigPlugin",
+    "ext_config_get",
+    "ext_config_put",
+    "ext_config_resync",
+]

@@ -1,0 +1,210 @@
+"""External-config gRPC plugin.
+
+Analog of ``plugins/grpc`` (contiv-grpc): a gRPC server through which
+an external agent injects non-K8s config — arbitrary data-plane KVs
+merged with the K8s-derived config by the controller.  Behaviors pinned
+to the reference:
+
+- ``ChangeSvc.Put`` / ``Delete`` (grpc_plugin.go :135): incremental
+  changes, applied to the cluster store under the external-config
+  prefix (the controller turns them into ExternalConfigChange events);
+- ``ResyncSvc.Resync`` (:183): full replacement of the external config;
+- the current snapshot is persisted locally — sqlite standing in for
+  the reference's Bolt ``/var/bolt/grpc.db`` (:74-128) — so a restart
+  can reload external config before any client reconnects
+  (``GetConfigSnapshot``, the ExternalConfigSource contract used at
+  plugin_controller.go:248);
+- values are JSON documents (the proto-message analog at this
+  boundary).
+
+The wire protocol mirrors vpp_tpu.cni.rpc: gRPC with JSON-encoded
+messages through generic method handlers.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sqlite3
+import threading
+from concurrent import futures
+from typing import Any, Dict, Optional
+
+import grpc
+
+from ..controller.dbwatcher import EXTERNAL_CONFIG_PREFIX
+from ..kvstore import KVStore
+
+log = logging.getLogger(__name__)
+
+SERVICE_NAME = "config.ExternalConfig"
+DEFAULT_PORT = 9112
+
+
+def _encode(msg: dict) -> bytes:
+    return json.dumps(msg).encode()
+
+
+def _decode(data: bytes) -> dict:
+    return json.loads(data.decode())
+
+
+class SnapshotDB:
+    """Local persistence of the external-config snapshot (Bolt analog)."""
+
+    def __init__(self, path: str = ":memory:"):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS extconfig (key TEXT PRIMARY KEY, value TEXT)"
+            )
+            self._conn.commit()
+
+    def put(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO extconfig (key, value) VALUES (?, ?)",
+                (key, json.dumps(value)),
+            )
+            self._conn.commit()
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM extconfig WHERE key = ?", (key,))
+            self._conn.commit()
+
+    def replace_all(self, values: Dict[str, Any]) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM extconfig")
+            self._conn.executemany(
+                "INSERT INTO extconfig (key, value) VALUES (?, ?)",
+                [(k, json.dumps(v)) for k, v in values.items()],
+            )
+            self._conn.commit()
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            rows = self._conn.execute("SELECT key, value FROM extconfig").fetchall()
+        return {k: json.loads(v) for k, v in rows}
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+class ExternalConfigPlugin:
+    """The gRPC NB config server + ExternalConfigSource."""
+
+    def __init__(self, store: KVStore, db_path: str = ":memory:",
+                 port: int = DEFAULT_PORT, host: str = "127.0.0.1"):
+        self.store = store
+        self.db = SnapshotDB(db_path)
+        self.port = port
+        self.host = host
+        self._server: Optional[grpc.Server] = None
+
+    # ----------------------------------------------- ExternalConfigSource
+
+    def get_config_snapshot(self) -> Dict[str, Any]:
+        """The persisted external config (GetConfigSnapshot :97) — used to
+        pre-seed the store before the first resync after a restart."""
+        return {EXTERNAL_CONFIG_PREFIX + k: v for k, v in self.db.snapshot().items()}
+
+    def preseed_store(self) -> None:
+        """Load the persisted snapshot into the cluster store (the restart
+        path: external config survives even if no client reconnects)."""
+        for key, value in self.get_config_snapshot().items():
+            self.store.put(key, value)
+
+    # ------------------------------------------------------------ handlers
+
+    def _put(self, request: dict, context=None) -> dict:
+        key, value = request.get("key", ""), request.get("value")
+        if not key or value is None:
+            return {"ok": False, "error": "key and value required"}
+        self.db.put(key, value)
+        self.store.put(EXTERNAL_CONFIG_PREFIX + key, value)
+        return {"ok": True}
+
+    def _delete(self, request: dict, context=None) -> dict:
+        key = request.get("key", "")
+        if not key:
+            return {"ok": False, "error": "key required"}
+        self.db.delete(key)
+        self.store.delete(EXTERNAL_CONFIG_PREFIX + key)
+        return {"ok": True}
+
+    def _resync(self, request: dict, context=None) -> dict:
+        """Full replacement (ResyncSvc.Resync :183): stale keys deleted."""
+        values = request.get("values", {})
+        if not isinstance(values, dict):
+            return {"ok": False, "error": "values must be an object"}
+        old = set(self.db.snapshot())
+        self.db.replace_all(values)
+        for key in old - set(values):
+            self.store.delete(EXTERNAL_CONFIG_PREFIX + key)
+        for key, value in values.items():
+            self.store.put(EXTERNAL_CONFIG_PREFIX + key, value)
+        return {"ok": True, "count": len(values)}
+
+    def _get(self, request: dict, context=None) -> dict:
+        return {"ok": True, "values": self.db.snapshot()}
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> int:
+        handlers = {
+            name: grpc.unary_unary_rpc_method_handler(
+                fn, request_deserializer=_decode, response_serializer=_encode
+            )
+            for name, fn in [
+                ("Put", self._put),
+                ("Delete", self._delete),
+                ("Resync", self._resync),
+                ("Get", self._get),
+            ]
+        }
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+        )
+        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        self._server.start()
+        log.info("external-config gRPC server on %s:%d", self.host, self.port)
+        return self.port
+
+    def stop(self, grace: float = 1.0) -> None:
+        if self._server is not None:
+            self._server.stop(grace)
+            self._server = None
+        self.db.close()
+
+
+# ------------------------------------------------------------------ client
+
+
+def _call(target: str, method: str, request: dict, timeout: float = 10.0) -> dict:
+    with grpc.insecure_channel(target) as channel:
+        rpc = channel.unary_unary(
+            f"/{SERVICE_NAME}/{method}",
+            request_serializer=_encode,
+            response_deserializer=_decode,
+        )
+        return rpc(request, timeout=timeout)
+
+
+def ext_config_put(target: str, key: str, value: Any) -> dict:
+    return _call(target, "Put", {"key": key, "value": value})
+
+
+def ext_config_delete(target: str, key: str) -> dict:
+    return _call(target, "Delete", {"key": key})
+
+
+def ext_config_resync(target: str, values: Dict[str, Any]) -> dict:
+    return _call(target, "Resync", {"values": values})
+
+
+def ext_config_get(target: str) -> dict:
+    return _call(target, "Get", {})
