@@ -1,0 +1,134 @@
+"""Agent REST API + netctl CLI tests against a mini running agent."""
+
+import io
+import json
+import time
+import urllib.request
+
+import pytest
+from prometheus_client import CollectorRegistry
+
+from vpp_tpu.conf import NetworkConfig
+from vpp_tpu.controller.api import DBResync
+from vpp_tpu.controller.dbwatcher import DBWatcher
+from vpp_tpu.controller.eventloop import Controller
+from vpp_tpu.ipv4net import IPv4Net
+from vpp_tpu.kvstore import KVStore
+from vpp_tpu.models import VppNode
+from vpp_tpu.models.registry import NODESYNC_PREFIX
+from vpp_tpu.netctl import main as netctl_main
+from vpp_tpu.nodesync import NodeSync
+from vpp_tpu.podmanager import PodManager
+from vpp_tpu.rest import AgentRestServer
+from vpp_tpu.scheduler import TxnScheduler
+from vpp_tpu.statscollector import InterfaceStats, StatsCollector
+
+
+@pytest.fixture()
+def agent():
+    store = KVStore()
+    nodesync = NodeSync(store, node_name="node-1")
+    podmanager = PodManager()
+    ipv4net = IPv4Net(NetworkConfig(), nodesync, podmanager=podmanager)
+    scheduler = TxnScheduler()
+    registry = CollectorRegistry()
+    stats = StatsCollector(registry=registry)
+    ctl = Controller(handlers=[nodesync, podmanager, ipv4net, stats], sink=scheduler)
+    podmanager.event_loop = ctl
+    nodesync.event_loop = ctl
+    ctl.start()
+    watcher = DBWatcher(ctl, store)
+    watcher.start()
+    for _ in range(100):
+        if ipv4net.ipam is not None:
+            break
+        time.sleep(0.02)
+    assert ipv4net.ipam is not None
+
+    rest = AgentRestServer(
+        node_name="node-1",
+        controller=ctl,
+        dbwatcher=watcher,
+        ipam=ipv4net.ipam,
+        nodesync=nodesync,
+        podmanager=podmanager,
+        scheduler=scheduler,
+        stats_registry=registry,
+    )
+    port = rest.start()
+    yield store, podmanager, stats, f"127.0.0.1:{port}"
+    rest.stop()
+    watcher.stop()
+    ctl.stop()
+
+
+def _get(server, path):
+    with urllib.request.urlopen(f"http://{server}{path}", timeout=5) as r:
+        return json.loads(r.read().decode())
+
+
+def test_liveness_ipam_and_history(agent):
+    store, podmanager, stats, server = agent
+    assert _get(server, "/liveness") == {"alive": True, "node": "node-1"}
+    ipam = _get(server, "/contiv/v1/ipam")
+    assert ipam["nodeId"] == 1
+    assert ipam["podSubnetThisNode"].startswith("10.1.1.")
+    history = _get(server, "/controller/event-history")
+    assert any("Resync" in rec["name"] for rec in history)
+
+
+def test_pods_and_scheduler_dump_after_cni_add(agent):
+    store, podmanager, stats, server = agent
+    podmanager.add_pod(name="web-1", container_id="c1",
+                       network_namespace="/proc/1/ns/net")
+    pods = _get(server, "/contiv/v1/pods")
+    assert pods and pods[0]["id"]["name"] == "web-1"
+    dump = _get(server, "/scheduler/dump?prefix=")
+    assert any("web-1" in v["key"] for v in dump)
+
+
+def test_nodes_endpoint_lists_cluster(agent):
+    store, _, _, server = agent
+    store.put(NODESYNC_PREFIX + "vppnode/2",
+              VppNode(id=2, name="node-b", ip_addresses=("192.168.16.2/24",)))
+    time.sleep(0.3)
+    nodes = _get(server, "/contiv/v1/nodes")
+    names = {n["name"] for n in nodes}
+    assert {"node-1", "node-b"} <= names
+
+
+def test_metrics_exposition(agent):
+    _, _, stats, server = agent
+    stats.put("tap-default-web-1", InterfaceStats(in_packets=42))
+    with urllib.request.urlopen(f"http://{server}/metrics", timeout=5) as r:
+        text = r.read().decode()
+    assert 'inPackets{interfaceName="tap-default-web-1"' in text
+
+
+def test_resync_trigger(agent):
+    _, _, _, server = agent
+    req = urllib.request.Request(f"http://{server}/controller/resync", method="POST")
+    with urllib.request.urlopen(req, timeout=5) as r:
+        assert json.loads(r.read().decode()) == {"resync": "scheduled"}
+
+
+class TestNetctl:
+    def test_nodes_pods_ipam_dump_history(self, agent):
+        store, podmanager, _, server = agent
+        podmanager.add_pod(name="web-1", container_id="c1")
+        for command, needle in [
+            (["nodes"], "node-1"),
+            (["pods"], "web-1"),
+            (["ipam"], "podSubnetThisNode"),
+            (["dump"], "APPLIED"),
+            (["history"], "Resync"),
+            (["resync"], "scheduled"),
+        ]:
+            out = io.StringIO()
+            rc = netctl_main(command + ["--server", server], out=out)
+            assert rc == 0, command
+            assert needle in out.getvalue(), (command, out.getvalue())
+
+    def test_unreachable_server(self):
+        rc = netctl_main(["nodes", "--server", "127.0.0.1:1"], out=io.StringIO())
+        assert rc == 1

@@ -1,0 +1,5 @@
+"""netctl — CLI for cluster runtime state."""
+
+from .cli import main
+
+__all__ = ["main"]

@@ -1,0 +1,130 @@
+"""netctl CLI.
+
+Analog of ``plugins/netctl`` + ``cmd/contiv-netctl`` (cmd/root.go
+:55-134): subcommands reading each agent's REST API —
+
+- ``nodes``      cluster nodes and their data-plane IPs
+- ``pods``       local pods of an agent
+- ``ipam``       the agent's IPAM state
+- ``dump``       data-plane config dump from the txn scheduler
+                 (the ``vppdump`` analog)
+- ``history``    controller event history
+- ``resync``     trigger an on-demand full resync
+- ``metrics``    Prometheus metrics passthrough
+
+Run: ``python -m vpp_tpu.netctl <command> [--server host:port]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+from typing import Any, List, Optional
+
+
+def _fetch(server: str, path: str, method: str = "GET") -> Any:
+    req = urllib.request.Request(f"http://{server}{path}", method=method)
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        body = resp.read().decode()
+        if resp.headers.get_content_type() == "application/json":
+            return json.loads(body)
+        return body
+
+
+def _table(rows: List[List[str]], header: List[str]) -> str:
+    all_rows = [header] + [[str(c) for c in r] for r in rows]
+    widths = [max(len(r[i]) for r in all_rows) for i in range(len(header))]
+    lines = []
+    for idx, row in enumerate(all_rows):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip())
+        if idx == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def cmd_nodes(server: str, out) -> int:
+    nodes = _fetch(server, "/contiv/v1/nodes")
+    rows = [
+        [n.get("id", ""), n.get("name", ""),
+         ",".join(n.get("ip_addresses", []) or [])]
+        for n in sorted(nodes, key=lambda n: n.get("id", 0))
+    ]
+    print(_table(rows, ["ID", "NAME", "DATA-PLANE-IPS"]), file=out)
+    return 0
+
+
+def cmd_pods(server: str, out) -> int:
+    pods = _fetch(server, "/contiv/v1/pods")
+    rows = []
+    for p in pods:
+        pid = p.get("id", {})
+        rows.append([pid.get("namespace", ""), pid.get("name", ""),
+                     p.get("container_id", ""), p.get("network_namespace", "")])
+    print(_table(sorted(rows), ["NAMESPACE", "NAME", "CONTAINER", "NETNS"]), file=out)
+    return 0
+
+
+def cmd_ipam(server: str, out) -> int:
+    print(json.dumps(_fetch(server, "/contiv/v1/ipam"), indent=1), file=out)
+    return 0
+
+
+def cmd_dump(server: str, out, prefix: str = "") -> int:
+    values = _fetch(server, f"/scheduler/dump?prefix={prefix}")
+    rows = [
+        [v.get("key", ""), v.get("state", ""), v.get("last_error", "")]
+        for v in values
+    ]
+    print(_table(sorted(rows), ["KEY", "STATE", "ERROR"]), file=out)
+    return 0
+
+
+def cmd_history(server: str, out) -> int:
+    for rec in _fetch(server, "/controller/event-history"):
+        handlers = ",".join(h.get("handler", "") for h in rec.get("handlers", []))
+        print(f"#{rec.get('seq_num')} {rec.get('description')} "
+              f"[{handlers}] {rec.get('duration_ms', 0):.1f}ms", file=out)
+    return 0
+
+
+def cmd_resync(server: str, out) -> int:
+    print(json.dumps(_fetch(server, "/controller/resync", method="POST")), file=out)
+    return 0
+
+
+def cmd_metrics(server: str, out) -> int:
+    print(_fetch(server, "/metrics"), file=out)
+    return 0
+
+
+def main(argv: Optional[List[str]] = None, out=None) -> int:
+    out = out if out is not None else sys.stdout
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--server", default="127.0.0.1:9999",
+                        help="agent REST endpoint (host:port)")
+    parser = argparse.ArgumentParser(
+        prog="netctl", description="vpp-tpu cluster runtime state CLI"
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+    for name in ("nodes", "pods", "ipam", "history", "resync", "metrics"):
+        sub.add_parser(name, parents=[common])
+    dump = sub.add_parser("dump", parents=[common])
+    dump.add_argument("prefix", nargs="?", default="")
+    args = parser.parse_args(argv)
+
+    try:
+        if args.command == "dump":
+            return cmd_dump(args.server, out, args.prefix)
+        return {
+            "nodes": cmd_nodes,
+            "pods": cmd_pods,
+            "ipam": cmd_ipam,
+            "history": cmd_history,
+            "resync": cmd_resync,
+            "metrics": cmd_metrics,
+        }[args.command](args.server, out)
+    except Exception as err:  # noqa: BLE001
+        print(f"netctl: {err}", file=sys.stderr)
+        return 1

@@ -1,0 +1,5 @@
+"""Agent REST API."""
+
+from .server import AgentRestServer
+
+__all__ = ["AgentRestServer"]

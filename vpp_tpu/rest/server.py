@@ -1,0 +1,213 @@
+"""Per-agent REST API.
+
+Analog of the reference's per-node REST surfaces (SURVEY.md §5.5):
+
+- ``GET /controller/event-history`` + ``POST /controller/resync``
+  (plugins/controller/rest.go :58-186);
+- ``GET /contiv/v1/ipam`` (plugins/ipv4net/rest.go :23-69);
+- ``GET /scheduler/dump`` (vendored kvscheduler REST dumps, consumed by
+  CRD telemetry and netctl);
+- ``GET /contiv/v1/nodes`` / ``/contiv/v1/pods`` (netctl's per-node
+  data sources);
+- ``GET /metrics`` — Prometheus text exposition (cn-infra prometheus
+  plugin analog);
+- ``GET /liveness`` — the statuscheck probe.
+
+Implemented on the stdlib threading HTTP server; components are
+injected and every endpoint degrades to 404 when its component is
+absent (agents can run partial stacks, e.g. in tests).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from dataclasses import asdict, is_dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+log = logging.getLogger(__name__)
+
+
+def _jsonable(obj: Any):
+    import enum
+
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, enum.Enum):
+        return obj.name
+    if is_dataclass(obj) and not isinstance(obj, type):
+        return {k: _jsonable(v) for k, v in asdict(obj).items()}
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set)):
+        return [_jsonable(v) for v in obj]
+    if hasattr(obj, "__dict__"):
+        return {k: _jsonable(v) for k, v in vars(obj).items() if not k.startswith("_")}
+    return str(obj)
+
+
+class AgentRestServer:
+    """REST facade over the agent's components."""
+
+    def __init__(
+        self,
+        node_name: str = "",
+        controller=None,
+        dbwatcher=None,
+        ipam=None,
+        nodesync=None,
+        podmanager=None,
+        scheduler=None,
+        stats_registry=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.node_name = node_name
+        self.controller = controller
+        self.dbwatcher = dbwatcher
+        self.ipam = ipam
+        self.nodesync = nodesync
+        self.podmanager = podmanager
+        self.scheduler = scheduler
+        self.stats_registry = stats_registry
+        self.host = host
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ endpoints
+
+    def get_liveness(self) -> dict:
+        return {"alive": True, "node": self.node_name}
+
+    def get_event_history(self) -> list:
+        if self.controller is None:
+            raise LookupError("no controller")
+        return [_jsonable(rec) for rec in self.controller.event_history]
+
+    def post_resync(self) -> dict:
+        """On-demand full resync (controller/rest.go resync trigger)."""
+        if self.dbwatcher is None:
+            raise LookupError("no dbwatcher")
+        self.dbwatcher.resync()
+        return {"resync": "scheduled"}
+
+    def get_ipam(self) -> dict:
+        if self.ipam is None:
+            raise LookupError("no ipam")
+        ipam = self.ipam
+        return {
+            "nodeId": ipam.node_id,
+            "nodeIP": str(ipam.node_ip()),
+            "podSubnetAllNodes": str(ipam.pod_subnet_all_nodes),
+            "podSubnetThisNode": str(ipam.pod_subnet_this_node),
+            "podGatewayIP": str(ipam.pod_gateway_ip),
+            "hostSubnetThisNode": str(ipam.host_subnet_this_node),
+            "natLoopbackIP": str(ipam.nat_loopback_ip()),
+            "serviceCIDR": str(ipam.service_network()),
+            "allocatedPodIPs": {
+                str(pod): str(ip) for pod, ip in sorted(ipam.assigned_pods().items())
+            },
+        }
+
+    def get_nodes(self) -> list:
+        if self.nodesync is None:
+            raise LookupError("no nodesync")
+        out = []
+        for node in self.nodesync.get_all_nodes().values():
+            out.append(_jsonable(node))
+        return out
+
+    def get_pods(self) -> list:
+        if self.podmanager is None:
+            raise LookupError("no podmanager")
+        return [_jsonable(p) for p in self.podmanager.local_pods.values()]
+
+    def get_scheduler_dump(self, prefix: str = "") -> list:
+        if self.scheduler is None:
+            raise LookupError("no scheduler")
+        return [_jsonable(v) for v in self.scheduler.dump(prefix)]
+
+    def get_metrics(self) -> str:
+        from prometheus_client import generate_latest
+
+        if self.stats_registry is None:
+            raise LookupError("no stats registry")
+        return generate_latest(self.stats_registry).decode()
+
+    # ------------------------------------------------------------ http glue
+
+    def _route(self, method: str, path: str, query: dict):
+        routes = {
+            ("GET", "/liveness"): self.get_liveness,
+            ("GET", "/controller/event-history"): self.get_event_history,
+            ("POST", "/controller/resync"): self.post_resync,
+            ("GET", "/contiv/v1/ipam"): self.get_ipam,
+            ("GET", "/contiv/v1/nodes"): self.get_nodes,
+            ("GET", "/contiv/v1/pods"): self.get_pods,
+        }
+        if (method, path) in routes:
+            return routes[(method, path)]()
+        if method == "GET" and path == "/scheduler/dump":
+            return self.get_scheduler_dump(query.get("prefix", ""))
+        if method == "GET" and path == "/metrics":
+            return self.get_metrics()
+        raise FileNotFoundError(path)
+
+    def start(self) -> int:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _handle(self, method: str):
+                from urllib.parse import parse_qsl, urlparse
+
+                parsed = urlparse(self.path)
+                query = dict(parse_qsl(parsed.query))
+                try:
+                    result = server._route(method, parsed.path, query)
+                except FileNotFoundError:
+                    self.send_error(404)
+                    return
+                except LookupError as err:
+                    self.send_error(404, str(err))
+                    return
+                except Exception as err:  # noqa: BLE001
+                    self.send_error(500, str(err))
+                    return
+                if isinstance(result, str):
+                    body = result.encode()
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    body = json.dumps(result, indent=1).encode()
+                    ctype = "application/json"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                self._handle("GET")
+
+            def do_POST(self):
+                self._handle("POST")
+
+            def log_message(self, fmt, *args):
+                log.debug("REST: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_port
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="agent-rest", daemon=True
+        )
+        self._thread.start()
+        log.info("agent REST on %s:%d", self.host, self.port)
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
