@@ -1,0 +1,193 @@
+"""CRD plugin tests: two-node telemetry validation over real REST, plus
+validator negative cases and NodeConfig events."""
+
+import time
+
+import pytest
+
+from vpp_tpu.conf import NetworkConfig
+from vpp_tpu.controller.dbwatcher import DBWatcher
+from vpp_tpu.controller.eventloop import Controller
+from vpp_tpu.crd import (
+    CRDPlugin,
+    L2Validator,
+    L3Validator,
+    NodeConfig,
+    NodeConfigChange,
+    NodeInterfaceConfig,
+    NodeSnapshot,
+    TelemetryCache,
+)
+from vpp_tpu.ipv4net import IPv4Net
+from vpp_tpu.kvstore import KVStore
+from vpp_tpu.nodesync import NodeSync
+from vpp_tpu.podmanager import PodManager
+from vpp_tpu.rest import AgentRestServer
+from vpp_tpu.scheduler import TxnScheduler
+
+
+def _mini_agent(store, node_name):
+    nodesync = NodeSync(store, node_name=node_name)
+    podmanager = PodManager()
+    ipv4net = IPv4Net(NetworkConfig(), nodesync, podmanager=podmanager)
+    scheduler = TxnScheduler()
+    ctl = Controller(handlers=[nodesync, podmanager, ipv4net], sink=scheduler)
+    podmanager.event_loop = ctl
+    nodesync.event_loop = ctl
+    ctl.start()
+    watcher = DBWatcher(ctl, store)
+    watcher.start()
+    for _ in range(200):
+        if ipv4net.ipam is not None:
+            break
+        time.sleep(0.02)
+    rest = AgentRestServer(
+        node_name=node_name, controller=ctl, dbwatcher=watcher,
+        ipam=ipv4net.ipam, nodesync=nodesync, podmanager=podmanager,
+        scheduler=scheduler,
+    )
+    port = rest.start()
+    return {
+        "ctl": ctl, "watcher": watcher, "rest": rest, "scheduler": scheduler,
+        "podmanager": podmanager, "ipv4net": ipv4net,
+        "server": f"127.0.0.1:{port}",
+    }
+
+
+@pytest.fixture()
+def cluster():
+    store = KVStore()
+    a = _mini_agent(store, "node-1")
+    b = _mini_agent(store, "node-2")
+    # Let the cross-node NodeUpdate events settle (vxlan mesh rendering).
+    time.sleep(0.5)
+    yield store, a, b
+    for agent in (a, b):
+        agent["rest"].stop()
+        agent["watcher"].stop()
+        agent["ctl"].stop()
+
+
+def test_two_node_cluster_validates_clean(cluster):
+    store, a, b = cluster
+    crd = CRDPlugin(store, collection_interval=3600)
+    crd.register_agent("node-1", a["server"])
+    crd.register_agent("node-2", b["server"])
+    report = crd.run_validation()
+    all_errors = [e for r in report.reports for e in r.errors]
+    assert all_errors == [], all_errors
+    assert report.error_count == 0
+    assert crd.latest_report() is not None
+    assert {r.category for r in report.reports} == {"l2", "l3"}
+
+
+def test_validation_detects_missing_pod_wiring(cluster):
+    store, a, b = cluster
+    # A pod added through CNI then its route surgically removed from the
+    # applied state must show up as an L3 finding.
+    a["podmanager"].add_pod(name="web-1", container_id="c1")
+    crd = CRDPlugin(store, collection_interval=3600)
+    crd.register_agent("node-1", a["server"])
+    crd.register_agent("node-2", b["server"])
+    clean = crd.run_validation()
+    assert clean.error_count == 0
+
+    cache = TelemetryCache()
+    snapshots = cache.collect(crd.agents)
+    snap = snapshots["node-1"]
+    pod_ip = snap.ipam["allocatedPodIPs"]["default/web-1"]
+    snap.dump = [v for v in snap.dump
+                 if "web-1" not in v.get("key", "") and pod_ip not in v.get("key", "")]
+    findings = [e for r in L3Validator().validate(snapshots) for e in r.errors]
+    assert any("/32 route" in e for e in findings)
+    assert any("TAP interface" in e for e in findings)
+
+
+class TestValidatorUnits:
+    def _snaps(self):
+        """Hand-built consistent 2-node snapshots."""
+        def node(nid, other_id):
+            ifp = "/vpp-tpu/config/interface/"
+            return NodeSnapshot(
+                name=f"node-{nid}",
+                ipam={"nodeId": nid, "nodeIP": f"192.168.16.{nid}",
+                      "podSubnetThisNode": f"10.1.{nid}.0/24",
+                      "allocatedPodIPs": {}},
+                nodes=[{"name": "node-1"}, {"name": "node-2"}],
+                dump=[
+                    {"key": ifp + "vxlanBVI", "state": "APPLIED",
+                     "applied": {"name": "vxlanBVI",
+                                 "physical_address": f"12:fe:c0:a8:10:0{nid}",
+                                 "ip_addresses": [f"10.2.0.{nid}/24"]}},
+                    {"key": ifp + f"vxlan{other_id}", "state": "APPLIED",
+                     "applied": {"name": f"vxlan{other_id}",
+                                 "vxlan_dst": f"192.168.16.{other_id}"}},
+                    {"key": "/vpp-tpu/config/bd/vxlanBD", "state": "APPLIED",
+                     "applied": {"name": "vxlanBD", "bvi_interface": "vxlanBVI",
+                                 "interfaces": [f"vxlan{other_id}"]}},
+                    {"key": f"/vpp-tpu/config/l2fib/vxlanBD/12:fe:c0:a8:10:0{other_id}",
+                     "state": "APPLIED",
+                     "applied": {"outgoing_interface": f"vxlan{other_id}"}},
+                    {"key": f"/vpp-tpu/config/arp/vxlanBVI/10.2.0.{other_id}",
+                     "state": "APPLIED",
+                     "applied": {"physical_address": f"12:fe:c0:a8:10:0{other_id}"}},
+                    {"key": f"/vpp-tpu/config/route/vrf0/10.1.{other_id}.0/24",
+                     "state": "APPLIED",
+                     "applied": {"dst_network": f"10.1.{other_id}.0/24"}},
+                ],
+            )
+        return {"node-1": node(1, 2), "node-2": node(2, 1)}
+
+    def test_consistent_snapshots_pass(self):
+        snaps = self._snaps()
+        assert not [e for r in L2Validator().validate(snaps) for e in r.errors]
+        assert not [e for r in L3Validator().validate(snaps) for e in r.errors]
+
+    def test_mac_mismatch_detected(self):
+        snaps = self._snaps()
+        # node-1's ARP for node-2 disagrees with node-2's own BVI MAC.
+        for v in snaps["node-1"].dump:
+            if v["key"].startswith("/vpp-tpu/config/arp/"):
+                v["applied"]["physical_address"] = "de:ad:be:ef:00:00"
+        errors = [e for r in L2Validator().validate(snaps) for e in r.errors]
+        assert any("ARP MAC" in e for e in errors)
+
+    def test_missing_tunnel_and_route_detected(self):
+        snaps = self._snaps()
+        snaps["node-1"].dump = [
+            v for v in snaps["node-1"].dump
+            if "vxlan2" not in v["key"] and "route" not in v["key"]
+        ]
+        l2 = [e for r in L2Validator().validate(snaps) for e in r.errors]
+        l3 = [e for r in L3Validator().validate(snaps) for e in r.errors]
+        assert any("missing vxlan tunnel" in e for e in l2)
+        assert any("no route to node" in e for e in l3)
+
+    def test_unreachable_agent_is_a_finding(self):
+        cache = TelemetryCache()
+        snaps = cache.collect({"node-9": "127.0.0.1:1"})
+        errors = [e for r in L2Validator().validate(snaps) for e in r.errors]
+        assert errors and "collecting" in errors[0]
+
+
+def test_node_config_events():
+    store = KVStore()
+
+    class Loop:
+        def __init__(self):
+            self.events = []
+
+        def push_event(self, ev):
+            self.events.append(ev)
+
+    loop = Loop()
+    crd = CRDPlugin(store, event_loop=loop, node_name="node-1")
+    cfg = NodeConfig(name="node-1",
+                     main_interface=NodeInterfaceConfig(name="eth1", ip="192.168.1.5/24"),
+                     gateway="192.168.1.1")
+    crd.apply_node_config(cfg)
+    crd.apply_node_config(NodeConfig(name="node-2"))  # other node: filtered
+    crd.delete_node_config("node-1")
+    kinds = [(e.node, e.prev is None, e.new is None) for e in loop.events]
+    assert kinds == [("node-1", True, False), ("node-1", False, True)]
+    assert all(isinstance(e, NodeConfigChange) for e in loop.events)

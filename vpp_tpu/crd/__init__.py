@@ -1,0 +1,19 @@
+"""CRD plugin: NodeConfig + TelemetryReport, cluster-wide validation."""
+
+from .models import NodeConfig, NodeInterfaceConfig, TelemetryReport, ValidationReport
+from .telemetry import NodeSnapshot, TelemetryCache
+from .validator import L2Validator, L3Validator
+from .plugin import CRDPlugin, NodeConfigChange
+
+__all__ = [
+    "CRDPlugin",
+    "L2Validator",
+    "L3Validator",
+    "NodeConfig",
+    "NodeConfigChange",
+    "NodeInterfaceConfig",
+    "NodeSnapshot",
+    "TelemetryCache",
+    "TelemetryReport",
+    "ValidationReport",
+]

@@ -1,0 +1,68 @@
+"""CRD data models.
+
+Analogs of the reference's two CRDs
+(``plugins/crd/pkg/apis/{nodeconfig,telemetry}/v1/types.go``):
+
+- ``NodeConfig`` — per-node configuration overrides consumed by the
+  config merge (file < NodeConfig CRD < STN-reported < runtime);
+- ``TelemetryReport`` — the output of periodic cluster validation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Mapping, Tuple
+
+from ..models.common import freeze_mapping
+
+
+@dataclass(frozen=True)
+class NodeInterfaceConfig:
+    """One data-plane interface override (nodeconfig/v1 InterfaceConfig)."""
+
+    name: str
+    ip: str = ""                 # CIDR; empty = from IPAM arithmetic
+    use_dhcp: bool = False
+
+
+@dataclass(frozen=True)
+class NodeConfig:
+    """Per-node config override (nodeconfig/v1 NodeConfigSpec)."""
+
+    name: str                     # node name (CRD object name)
+    main_interface: NodeInterfaceConfig = NodeInterfaceConfig(name="")
+    other_interfaces: Tuple[NodeInterfaceConfig, ...] = ()
+    gateway: str = ""
+    nat_external_traffic: bool = False
+    stealth_interface: str = ""   # StealInterface (STN mode)
+
+
+@dataclass(frozen=True)
+class ValidationReport:
+    """One validator's findings for one node (telemetry/v1 NodeReport)."""
+
+    node: str
+    category: str                 # "l2" | "l3" | ...
+    errors: Tuple[str, ...] = ()
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+
+@dataclass(frozen=True)
+class TelemetryReport:
+    """Cluster-wide validation outcome (telemetry/v1 TelemetryReport)."""
+
+    revision: int = 0
+    reports: Tuple[ValidationReport, ...] = ()
+
+    @property
+    def error_count(self) -> int:
+        return sum(len(r.errors) for r in self.reports)
+
+    def summary(self) -> Mapping[str, int]:
+        per_category: dict = {}
+        for r in self.reports:
+            per_category[r.category] = per_category.get(r.category, 0) + len(r.errors)
+        return freeze_mapping(per_category)

@@ -1,0 +1,131 @@
+"""CRD plugin — NodeConfig reflection + periodic telemetry validation.
+
+Analog of ``plugins/crd/plugin_impl_crd.go`` (:53) with the two
+controllers (``controller/{nodeconfig,telemetry}``): NodeConfig objects
+are applied into the cluster store (consumed by the config merge, which
+sees them as ``NodeConfigChange`` events — contivconf_api.go :273), and
+a periodic cycle collects every agent's telemetry, runs the L2/L3
+validators and publishes a ``TelemetryReport``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Optional
+
+from ..controller.api import UpdateEvent
+from ..kvstore import KVStore
+from .models import NodeConfig, TelemetryReport
+from .telemetry import TelemetryCache
+from .validator import L2Validator, L3Validator
+
+log = logging.getLogger(__name__)
+
+NODECONFIG_PREFIX = "/vpp-tpu/crd/nodeconfig/"
+TELEMETRY_KEY = "/vpp-tpu/crd/telemetry-report"
+
+
+class NodeConfigChange(UpdateEvent):
+    """A node's config override changed (contivconf_api.go :273)."""
+
+    name = "Node Config Change"
+
+    def __init__(self, node: str, prev: Optional[NodeConfig], new: Optional[NodeConfig]):
+        super().__init__()
+        self.node = node
+        self.prev = prev
+        self.new = new
+
+    def __str__(self) -> str:
+        op = "update"
+        if self.prev is None:
+            op = "add"
+        elif self.new is None:
+            op = "delete"
+        return f"{self.name} [{op} {self.node}]"
+
+
+class CRDPlugin:
+    """NodeConfig store access + the telemetry collection cycle."""
+
+    def __init__(
+        self,
+        store: KVStore,
+        cache: Optional[TelemetryCache] = None,
+        collection_interval: float = 60.0,
+        event_loop=None,
+        node_name: str = "",
+    ):
+        self.store = store
+        self.cache = cache if cache is not None else TelemetryCache()
+        self.collection_interval = collection_interval
+        self.event_loop = event_loop
+        self.node_name = node_name
+        self.validators = [L2Validator(), L3Validator()]
+        self.agents: Dict[str, str] = {}  # node name -> REST "host:port"
+        self._revision = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ NodeConfig
+
+    def apply_node_config(self, config: NodeConfig) -> None:
+        """CRD create/update → cluster store (nodeconfig controller)."""
+        prev = self.store.get(NODECONFIG_PREFIX + config.name)
+        self.store.put(NODECONFIG_PREFIX + config.name, config)
+        self._emit_nodeconfig(config.name, prev, config)
+
+    def delete_node_config(self, name: str) -> None:
+        prev = self.store.get(NODECONFIG_PREFIX + name)
+        if self.store.delete(NODECONFIG_PREFIX + name):
+            self._emit_nodeconfig(name, prev, None)
+
+    def get_node_config(self, name: str) -> Optional[NodeConfig]:
+        return self.store.get(NODECONFIG_PREFIX + name)
+
+    def _emit_nodeconfig(self, name, prev, new) -> None:
+        # Only this node's override matters to the local event loop
+        # (the reference filters by ServiceLabel).
+        if self.event_loop is not None and (not self.node_name or name == self.node_name):
+            self.event_loop.push_event(NodeConfigChange(name, prev, new))
+
+    # ------------------------------------------------------------- telemetry
+
+    def register_agent(self, node_name: str, server: str) -> None:
+        self.agents[node_name] = server
+
+    def run_validation(self) -> TelemetryReport:
+        """One collection + validation cycle (telemetry controller tick)."""
+        snapshots = self.cache.collect(self.agents)
+        reports = []
+        for validator in self.validators:
+            reports.extend(validator.validate(snapshots))
+        self._revision += 1
+        report = TelemetryReport(revision=self._revision, reports=tuple(reports))
+        self.store.put(TELEMETRY_KEY, report)
+        if report.error_count:
+            log.warning("telemetry validation: %d errors %s",
+                        report.error_count, dict(report.summary()))
+        return report
+
+    def latest_report(self) -> Optional[TelemetryReport]:
+        return self.store.get(TELEMETRY_KEY)
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="crd-telemetry", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.collection_interval):
+            try:
+                self.run_validation()
+            except Exception:  # noqa: BLE001
+                log.exception("telemetry cycle failed")

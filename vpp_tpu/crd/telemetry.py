@@ -1,0 +1,74 @@
+"""Telemetry cache — cluster-wide state collection.
+
+Analog of ``plugins/crd/cache/telemetry_cache.go`` (:109-515): on every
+collection cycle each agent's REST API is crawled (``collectAgentInfo``
+:257 — ipam, scheduler dump, node/pod registries) and the snapshots are
+handed to the validators (``validateCluster`` :229).
+
+The HTTP fetch is injectable so tests can wire snapshots directly (the
+reference tests use datastore fixtures the same way).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class NodeSnapshot:
+    """Everything collected from one agent (vpp_data_store analog)."""
+
+    name: str
+    ipam: Dict[str, Any] = field(default_factory=dict)
+    dump: List[Dict[str, Any]] = field(default_factory=list)  # scheduler dump
+    nodes: List[Dict[str, Any]] = field(default_factory=list)
+    pods: List[Dict[str, Any]] = field(default_factory=list)
+    errors: List[str] = field(default_factory=list)  # collection failures
+
+    # -------------------------------------------------------- dump helpers
+
+    def applied(self, prefix: str) -> Dict[str, Dict[str, Any]]:
+        """key -> applied value for all APPLIED dump entries under prefix."""
+        out = {}
+        for v in self.dump:
+            if v.get("state") == "APPLIED" and v.get("key", "").startswith(prefix):
+                out[v["key"]] = v.get("applied") or {}
+        return out
+
+
+def _http_fetch(server: str, path: str) -> Any:
+    with urllib.request.urlopen(f"http://{server}{path}", timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+class TelemetryCache:
+    """Collects per-node snapshots from agent REST endpoints."""
+
+    def __init__(self, fetch: Optional[Callable[[str, str], Any]] = None):
+        self.fetch = fetch if fetch is not None else _http_fetch
+        self.snapshots: Dict[str, NodeSnapshot] = {}
+
+    def collect(self, agents: Dict[str, str]) -> Dict[str, NodeSnapshot]:
+        """Crawl every agent (name -> "host:port"); collection failures
+        are recorded per node, not raised (a down node is a finding)."""
+        self.snapshots = {}
+        for name, server in sorted(agents.items()):
+            snap = NodeSnapshot(name=name)
+            for attr, path in (
+                ("ipam", "/contiv/v1/ipam"),
+                ("dump", "/scheduler/dump"),
+                ("nodes", "/contiv/v1/nodes"),
+                ("pods", "/contiv/v1/pods"),
+            ):
+                try:
+                    setattr(snap, attr, self.fetch(server, path))
+                except Exception as err:  # noqa: BLE001
+                    snap.errors.append(f"collecting {path}: {err}")
+            self.snapshots[name] = snap
+        return self.snapshots

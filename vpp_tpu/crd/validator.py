@@ -1,0 +1,165 @@
+"""Cluster-wide L2/L3 validators.
+
+Analogs of ``plugins/crd/validator/l2/l2_validator.go`` (:49 — ARP/BD/
+L2FIB cross-node checks) and ``validator/l3/l3_validator.go`` (:78 —
+VRF route checks), operating on the telemetry snapshots.
+
+The checks are *cross-node consistency* invariants of the full-mesh
+overlay (SURVEY.md §2.4): every node must have exactly one vxlan bridge
+domain with a BVI, one vxlan tunnel + L2FIB + ARP entry per other node
+— and the MAC/IP in node A's entries for node B must match what node B
+itself configured.  L3: a route to every other node's pod subnet, and a
+/32 + TAP pair for every locally allocated pod IP.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..ipv4net.model import (
+    ARP_PREFIX,
+    BD_PREFIX,
+    IF_PREFIX,
+    L2FIB_PREFIX,
+    ROUTE_PREFIX,
+)
+from ..ipv4net.plugin import VXLAN_BD_NAME, VXLAN_BVI_NAME
+from .models import ValidationReport
+from .telemetry import NodeSnapshot
+
+
+def _node_id(snap: NodeSnapshot) -> int:
+    return int(snap.ipam.get("nodeId", 0))
+
+
+def _bvi_iface(snap: NodeSnapshot) -> Dict:
+    return snap.applied(IF_PREFIX).get(IF_PREFIX + VXLAN_BVI_NAME, {})
+
+
+class L2Validator:
+    """Bridge-domain / VXLAN / L2FIB / ARP mesh validation."""
+
+    category = "l2"
+
+    def validate(self, snapshots: Dict[str, NodeSnapshot]) -> List[ValidationReport]:
+        reports = []
+        for name, snap in sorted(snapshots.items()):
+            errors: List[str] = list(snap.errors)
+            if not snap.errors:
+                errors += self._validate_node(snap, snapshots)
+            reports.append(ValidationReport(node=name, category=self.category,
+                                            errors=tuple(errors)))
+        return reports
+
+    def _validate_node(self, snap: NodeSnapshot,
+                       all_snaps: Dict[str, NodeSnapshot]) -> List[str]:
+        errors: List[str] = []
+        ifaces = snap.applied(IF_PREFIX)
+        bds = snap.applied(BD_PREFIX)
+        fibs = snap.applied(L2FIB_PREFIX)
+        arps = snap.applied(ARP_PREFIX)
+
+        # Exactly one vxlan BD, with the BVI attached (l2_validator.go :166).
+        bd = bds.get(BD_PREFIX + VXLAN_BD_NAME)
+        if bd is None or len(bds) != 1:
+            errors.append(f"expected exactly one bridge domain {VXLAN_BD_NAME!r}, "
+                          f"have {sorted(bds)}")
+            return errors
+        if bd.get("bvi_interface") != VXLAN_BVI_NAME:
+            errors.append(f"bridge domain BVI is {bd.get('bvi_interface')!r}, "
+                          f"expected {VXLAN_BVI_NAME!r}")
+
+        others = {n: s for n, s in all_snaps.items()
+                  if n != snap.name and not s.errors}
+        for other_name, other in sorted(others.items()):
+            oid = _node_id(other)
+            vxlan_name = f"vxlan{oid}"
+            # Tunnel interface present, pointing at the other node's IP
+            # (vxlanIfToOtherNode analog).
+            tunnel = ifaces.get(IF_PREFIX + vxlan_name)
+            if tunnel is None:
+                errors.append(f"missing vxlan tunnel to node {other_name} (id {oid})")
+                continue
+            expect_dst = other.ipam.get("nodeIP", "")
+            if tunnel.get("vxlan_dst") != expect_dst:
+                errors.append(
+                    f"vxlan{oid} dst {tunnel.get('vxlan_dst')} != node "
+                    f"{other_name} IP {expect_dst}")
+            if vxlan_name not in tuple(bd.get("interfaces", ())):
+                errors.append(f"vxlan{oid} not attached to {VXLAN_BD_NAME}")
+
+            # The other node's BVI identity, as IT configured it.
+            other_bvi = _bvi_iface(other)
+            other_mac = other_bvi.get("physical_address", "")
+            other_ips = other_bvi.get("ip_addresses") or []
+            other_ip = str(other_ips[0]).split("/")[0] if other_ips else ""
+
+            # L2FIB entry for the other node's BVI MAC via the tunnel
+            # (ValidateL2FibEntries :441 remote-entry check).
+            fib = fibs.get(f"{L2FIB_PREFIX}{VXLAN_BD_NAME}/{other_mac}")
+            if fib is None:
+                errors.append(f"missing L2FIB entry for node {other_name} "
+                              f"BVI MAC {other_mac}")
+            elif fib.get("outgoing_interface") != vxlan_name:
+                errors.append(f"L2FIB for {other_name} exits "
+                              f"{fib.get('outgoing_interface')}, expected {vxlan_name}")
+
+            # ARP entry binding the other BVI IP to its MAC
+            # (ValidateArpTables cross-node check).
+            arp = arps.get(f"{ARP_PREFIX}{VXLAN_BVI_NAME}/{other_ip}")
+            if arp is None:
+                errors.append(f"missing ARP for node {other_name} BVI IP {other_ip}")
+            elif arp.get("physical_address") != other_mac:
+                errors.append(
+                    f"ARP MAC for {other_name} is {arp.get('physical_address')}, "
+                    f"node itself uses {other_mac}")
+
+        # K8s view vs collected view (ValidateK8sNodeInfo :525).
+        known = {n.get("name") for n in snap.nodes}
+        expected = set(all_snaps)
+        if not expected <= known:
+            errors.append(f"node registry out of sync: missing {sorted(expected - known)}")
+        return errors
+
+
+class L3Validator:
+    """VRF route validation (routes to remote subnets + local pod /32s)."""
+
+    category = "l3"
+
+    def validate(self, snapshots: Dict[str, NodeSnapshot]) -> List[ValidationReport]:
+        reports = []
+        for name, snap in sorted(snapshots.items()):
+            errors: List[str] = list(snap.errors)
+            if not snap.errors:
+                errors += self._validate_node(snap, snapshots)
+            reports.append(ValidationReport(node=name, category=self.category,
+                                            errors=tuple(errors)))
+        return reports
+
+    def _validate_node(self, snap: NodeSnapshot,
+                       all_snaps: Dict[str, NodeSnapshot]) -> List[str]:
+        errors: List[str] = []
+        routes = snap.applied(ROUTE_PREFIX)
+        route_dsts = {r.get("dst_network") for r in routes.values()}
+        ifaces = snap.applied(IF_PREFIX)
+
+        # Route to every other node's pod subnet (l3_validator.go remote
+        # pod-subnet route check).
+        for other_name, other in sorted(all_snaps.items()):
+            if other_name == snap.name or other.errors:
+                continue
+            subnet = other.ipam.get("podSubnetThisNode", "")
+            if subnet and subnet not in route_dsts:
+                errors.append(f"no route to node {other_name} pod subnet {subnet}")
+
+        # Every locally allocated pod IP has a /32 route and a TAP
+        # (ValidatePodInfo analog).
+        for pod, ip in sorted((snap.ipam.get("allocatedPodIPs") or {}).items()):
+            if f"{ip}/32" not in route_dsts:
+                errors.append(f"no /32 route for pod {pod} ({ip})")
+            ns, _, pname = pod.partition("/")
+            tap_key = IF_PREFIX + f"tap-{ns}-{pname}"
+            if tap_key not in ifaces:
+                errors.append(f"no TAP interface for pod {pod}")
+        return errors
