@@ -1,0 +1,117 @@
+"""Bootstrap tests: STN steal/revert/watchdog + config merge + local
+snapshot pre-seed."""
+
+import os
+
+from vpp_tpu.bootstrap import (
+    STNDaemon,
+    bootstrap_config,
+    load_local_snapshot,
+    preseed_local_snapshot,
+)
+from vpp_tpu.conf.config import InterfaceConfig, NetworkConfig
+from vpp_tpu.crd.models import NodeConfig, NodeInterfaceConfig
+from vpp_tpu.kvstore import KVStore
+from vpp_tpu.models import Pod
+from vpp_tpu.models.registry import key_for
+from vpp_tpu.testing.netlink import FakeHostNetwork
+
+
+def _host():
+    net = FakeHostNetwork()
+    net.add_interface("eth0", addresses=("192.168.1.5/24",), mac="aa:bb:cc:00:00:01")
+    net.add_route("0.0.0.0/0", gateway="192.168.1.1", interface="eth0")
+    net.add_route("10.8.0.0/16", gateway="192.168.1.254", interface="eth0")
+    return net
+
+
+class TestSTN:
+    def test_steal_flushes_and_saves(self):
+        net = _host()
+        stn = STNDaemon(net)
+        saved = stn.steal_interface("eth0")
+        assert saved.addresses == ("192.168.1.5/24",)
+        assert len(saved.routes) == 2
+        assert net.get_interface("eth0").addresses == ()
+        assert not net.get_interface("eth0").up
+        assert net.interface_routes("eth0") == []
+        # Idempotent: a second steal returns the same saved identity.
+        assert stn.steal_interface("eth0").addresses == ("192.168.1.5/24",)
+        assert stn.stolen_interface_info("eth0").mac == "aa:bb:cc:00:00:01"
+
+    def test_release_restores(self):
+        net = _host()
+        stn = STNDaemon(net)
+        stn.steal_interface("eth0")
+        stn.release_interface("eth0")
+        iface = net.get_interface("eth0")
+        assert iface.addresses == ("192.168.1.5/24",) and iface.up
+        assert len(net.interface_routes("eth0")) == 2
+        assert stn.stolen_interface_info("eth0") is None
+
+    def test_watchdog_reverts_after_agent_death(self):
+        net = _host()
+        alive = {"v": True}
+        stn = STNDaemon(net, agent_alive=lambda: alive["v"], revert_timeout=5.0)
+        stn.steal_interface("eth0")
+        assert stn.check_agent(now=100.0) is True
+        alive["v"] = False
+        assert stn.check_agent(now=101.0) is False   # down, not yet timed out
+        assert net.get_interface("eth0").addresses == ()
+        stn.check_agent(now=107.0)                   # past timeout -> revert
+        assert net.get_interface("eth0").addresses == ("192.168.1.5/24",)
+        # Agent returning later does not re-steal anything by itself.
+        alive["v"] = True
+        assert stn.check_agent(now=108.0) is True
+
+
+class TestBootstrapConfig:
+    def test_plain_config_passthrough(self):
+        cfg = NetworkConfig(interface=InterfaceConfig(main_interface="eth1"))
+        merged, stn = bootstrap_config(cfg)
+        assert merged.interface.main_interface == "eth1"
+        assert stn is None
+
+    def test_node_config_overrides_file(self):
+        cfg = NetworkConfig(interface=InterfaceConfig(main_interface="eth1"))
+        merged, _ = bootstrap_config(
+            cfg, NodeConfig(name="n1", main_interface=NodeInterfaceConfig(name="eth7"))
+        )
+        assert merged.interface.main_interface == "eth7"
+
+    def test_stn_mode_steals_and_reports(self):
+        net = _host()
+        stn_daemon = STNDaemon(net)
+        cfg = NetworkConfig(
+            interface=InterfaceConfig(main_interface="eth0", stn_mode=True)
+        )
+        merged, stn_cfg = bootstrap_config(cfg, stn_daemon=stn_daemon)
+        assert merged.interface.stn_mode
+        assert stn_cfg.interface == "eth0"
+        assert stn_cfg.ip_addresses == ("192.168.1.5/24",)
+        assert stn_cfg.gateway == "192.168.1.1"
+        assert net.get_interface("eth0").addresses == ()  # actually stolen
+
+    def test_nodeconfig_stealth_interface_triggers_stn(self):
+        net = _host()
+        merged, stn_cfg = bootstrap_config(
+            NetworkConfig(),
+            NodeConfig(name="n1", stealth_interface="eth0"),
+            stn_daemon=STNDaemon(net),
+        )
+        assert stn_cfg is not None and merged.interface.main_interface == "eth0"
+
+
+def test_local_snapshot_roundtrip(tmp_path):
+    path = os.path.join(tmp_path, "local.db")
+    remote = KVStore()
+    pod = Pod(name="web-1", ip_address="10.1.1.2")
+    remote.put(key_for(pod), pod)
+    remote.put("/vpp-tpu/external-config/x", {"v": 1})
+    remote.put("/other/ignored", "nope")
+    assert preseed_local_snapshot(remote, path) == 2
+
+    local = KVStore()
+    assert load_local_snapshot(local, path) == 2
+    assert local.get(key_for(pod)).ip_address == "10.1.1.2"
+    assert local.get("/other/ignored") is None

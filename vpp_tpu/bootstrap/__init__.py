@@ -1,0 +1,13 @@
+"""Agent bootstrap: STN daemon + contiv-init analog."""
+
+from .stn import STNDaemon, StolenInterface
+from .init import STNConfig, bootstrap_config, preseed_local_snapshot, load_local_snapshot
+
+__all__ = [
+    "STNConfig",
+    "STNDaemon",
+    "StolenInterface",
+    "bootstrap_config",
+    "load_local_snapshot",
+    "preseed_local_snapshot",
+]

@@ -1,0 +1,126 @@
+"""Agent bootstrap — the contiv-init analog.
+
+Mirrors ``cmd/contiv-init/main.go``:
+
+- the **config priority merge** of the reference's ContivConf
+  (docs/dev-guide/CORE_PLUGINS.md:160-178, contivconf.go :275-446):
+  file config < NodeConfig CRD override < STN-reported config;
+- STN mode: steal the NIC through the STN daemon and feed its saved
+  identity into the merged config (``stealNIC`` :77);
+- ``prepareForLocalResync`` (:231): snapshot the remote store into a
+  local file so a restart can resync locally while the remote store is
+  unreachable (the Bolt pre-seed analog; DBResync(local=True)).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sqlite3
+from dataclasses import dataclass, replace
+from typing import Optional, Tuple
+
+from ..conf.config import InterfaceConfig, NetworkConfig
+from ..crd.models import NodeConfig
+from ..kvstore import KVStore
+
+log = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class STNConfig:
+    """What the STN daemon reported for the stolen NIC
+    (contivconf_api.go STNConfig :194)."""
+
+    interface: str
+    ip_addresses: Tuple[str, ...] = ()
+    gateway: str = ""
+
+
+def bootstrap_config(
+    file_config: NetworkConfig,
+    node_config: Optional[NodeConfig] = None,
+    stn_daemon=None,
+) -> Tuple[NetworkConfig, Optional[STNConfig]]:
+    """Resolve the effective config by the reference's priority order.
+
+    Returns (merged config, STN-reported config or None).  STN mode is
+    entered when the file config requests it or the NodeConfig names a
+    stealth interface.
+    """
+    cfg = file_config
+
+    # NodeConfig CRD overrides the file (priority 2).
+    if node_config is not None and node_config.main_interface.name:
+        cfg = replace(
+            cfg,
+            interface=replace(cfg.interface,
+                              main_interface=node_config.main_interface.name),
+        )
+
+    stn_iface = ""
+    if node_config is not None and node_config.stealth_interface:
+        stn_iface = node_config.stealth_interface
+    elif cfg.interface.stn_mode:
+        stn_iface = cfg.interface.main_interface
+
+    stn_config: Optional[STNConfig] = None
+    if stn_iface:
+        if stn_daemon is None:
+            raise RuntimeError("STN mode requested but no STN daemon available")
+        saved = stn_daemon.steal_interface(stn_iface)
+        stn_config = STNConfig(
+            interface=stn_iface,
+            ip_addresses=tuple(saved.addresses),
+            gateway=next((r.gateway for r in saved.routes
+                          if r.dst in ("0.0.0.0/0", "default") and r.gateway), ""),
+        )
+        # STN-reported config overrides everything (priority 3): the data
+        # plane takes over the NIC with its host identity.
+        cfg = replace(
+            cfg,
+            interface=replace(cfg.interface, main_interface=stn_iface,
+                              stn_mode=True),
+        )
+    return cfg, stn_config
+
+
+# ------------------------------------------------------- local pre-seed
+
+
+def preseed_local_snapshot(store: KVStore, path: str,
+                           prefixes: Tuple[str, ...] = ("/vpp-tpu/",)) -> int:
+    """Snapshot the remote store into a local sqlite file
+    (prepareForLocalResync :231). Returns the number of keys saved."""
+    snap = store.snapshot(prefixes)
+    conn = sqlite3.connect(path)
+    try:
+        conn.execute("CREATE TABLE IF NOT EXISTS snapshot (key TEXT PRIMARY KEY, value BLOB)")
+        conn.execute("DELETE FROM snapshot")
+        import pickle
+
+        conn.executemany(
+            "INSERT INTO snapshot (key, value) VALUES (?, ?)",
+            [(k, pickle.dumps(v)) for k, v in snap.items()],
+        )
+        conn.commit()
+    finally:
+        conn.close()
+    log.info("pre-seeded local snapshot: %d keys -> %s", len(snap), path)
+    return len(snap)
+
+
+def load_local_snapshot(store: KVStore, path: str) -> int:
+    """Load a pre-seeded snapshot into a (fresh) store for a local
+    startup resync while the remote store is down."""
+    import pickle
+
+    conn = sqlite3.connect(path)
+    try:
+        rows = conn.execute("SELECT key, value FROM snapshot").fetchall()
+    finally:
+        conn.close()
+    for key, blob in rows:
+        store.put(key, pickle.loads(blob))
+    log.info("loaded local snapshot: %d keys from %s", len(rows), path)
+    return len(rows)

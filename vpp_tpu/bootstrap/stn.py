@@ -1,0 +1,127 @@
+"""Steal-the-NIC daemon.
+
+Analog of ``cmd/contiv-stn/main.go``: on single-NIC hosts the data
+plane takes over the host's interface.  The daemon
+
+- ``steal_interface`` (:95 + ``unconfigureInterface`` :150): records the
+  interface's addresses/routes, flushes them from the host, and returns
+  the saved config (the data plane configures the same identity);
+- ``release_interface`` (:117 + ``revertInterface`` :187): restores the
+  saved config onto the host;
+- ``stolen_interface_info`` (:132): returns the saved config without
+  touching state (used by the agent after restart);
+- **watchdog** (:343-434, ``checkStatusAfterTimeout``): if the agent's
+  health check stays down past a timeout, all stolen interfaces are
+  reverted so the host regains connectivity.
+
+The host-network access is injected (tests: FakeHostNetwork; production
+would bind rtnetlink).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class StolenInterface:
+    """Saved identity of a stolen interface (interfaceData analog)."""
+
+    name: str
+    addresses: Tuple[str, ...]
+    routes: List  # HostRoute-like objects
+    mac: str = ""
+    stolen_at: float = field(default_factory=time.time)
+
+
+class STNDaemon:
+    def __init__(self, host_network, agent_alive: Optional[Callable[[], bool]] = None,
+                 revert_timeout: float = 10.0):
+        self.net = host_network
+        self.agent_alive = agent_alive
+        self.revert_timeout = revert_timeout
+        self._stolen: Dict[str, StolenInterface] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._watchdog: Optional[threading.Thread] = None
+        self._agent_down_since: Optional[float] = None
+
+    # -------------------------------------------------------------- service
+
+    def steal_interface(self, name: str) -> StolenInterface:
+        with self._lock:
+            if name in self._stolen:
+                return self._stolen[name]  # idempotent re-steal
+            iface = self.net.get_interface(name)
+            saved = StolenInterface(
+                name=name,
+                addresses=tuple(iface.addresses),
+                routes=list(self.net.interface_routes(name)),
+                mac=iface.mac,
+            )
+            self.net.flush_interface(name)
+            self._stolen[name] = saved
+            log.info("stole interface %s (%s)", name, ", ".join(saved.addresses))
+            return saved
+
+    def release_interface(self, name: str) -> None:
+        with self._lock:
+            saved = self._stolen.pop(name, None)
+            if saved is None:
+                return
+            self.net.configure_interface(name, saved.addresses, saved.routes, up=True)
+            log.info("released interface %s", name)
+
+    def stolen_interface_info(self, name: str) -> Optional[StolenInterface]:
+        with self._lock:
+            return self._stolen.get(name)
+
+    def revert_all(self) -> None:
+        with self._lock:
+            names = list(self._stolen)
+        for name in names:
+            self.release_interface(name)
+
+    # ------------------------------------------------------------- watchdog
+
+    def check_agent(self, now: Optional[float] = None) -> bool:
+        """One watchdog tick: reverts everything if the agent has been
+        down longer than ``revert_timeout``.  Returns agent liveness."""
+        if self.agent_alive is None:
+            return True
+        now = now if now is not None else time.time()
+        try:
+            alive = bool(self.agent_alive())
+        except Exception:  # noqa: BLE001
+            alive = False
+        if alive:
+            self._agent_down_since = None
+            return True
+        if self._agent_down_since is None:
+            self._agent_down_since = now
+        elif now - self._agent_down_since >= self.revert_timeout:
+            log.warning("agent down for %.1fs — reverting stolen interfaces",
+                        now - self._agent_down_since)
+            self.revert_all()
+            self._agent_down_since = None
+        return False
+
+    def start_watchdog(self, interval: float = 1.0) -> None:
+        self._watchdog = threading.Thread(
+            target=self._watchdog_loop, args=(interval,),
+            name="stn-watchdog", daemon=True,
+        )
+        self._watchdog.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _watchdog_loop(self, interval: float) -> None:
+        while not self._stop.wait(interval):
+            self.check_agent()

@@ -3,7 +3,7 @@
 from __future__ import annotations
 
 import enum
-import types
+
 from dataclasses import dataclass
 
 
@@ -65,10 +65,23 @@ def labels_to_dict(labels) -> dict:
     return out
 
 
-def freeze_mapping(m) -> types.MappingProxyType:
-    """Wrap a mapping in a read-only view so frozen dataclasses holding it
-    are genuinely immutable snapshots (KV-store values are shared across
-    watchers)."""
-    if isinstance(m, types.MappingProxyType):
+class FrozenDict(dict):
+    """An immutable dict (picklable, unlike MappingProxyType — local
+    snapshots serialize KV values)."""
+
+    def _blocked(self, *a, **k):
+        raise TypeError("FrozenDict is immutable")
+
+    __setitem__ = __delitem__ = _blocked
+    clear = pop = popitem = setdefault = update = _blocked
+
+    def __reduce__(self):
+        return (FrozenDict, (dict(self),))
+
+
+def freeze_mapping(m) -> FrozenDict:
+    """Freeze a mapping so frozen dataclasses holding it are genuinely
+    immutable snapshots (KV-store values are shared across watchers)."""
+    if isinstance(m, FrozenDict):
         return m
-    return types.MappingProxyType(dict(m or {}))
+    return FrozenDict(dict(m or {}))

@@ -1,0 +1,65 @@
+"""Fake host network (netlink mock) for STN/bootstrap tests.
+
+Plays the role the real netlink layer plays for ``cmd/contiv-stn``:
+interfaces with addresses and routes that can be read, removed and
+restored.  Tests drive failures by raising from injected hooks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class HostRoute:
+    dst: str                      # CIDR ("0.0.0.0/0" for default)
+    gateway: str = ""
+    interface: str = ""
+
+
+@dataclass
+class HostInterface:
+    name: str
+    up: bool = True
+    addresses: Tuple[str, ...] = ()     # CIDR notation
+    mac: str = ""
+
+
+class FakeHostNetwork:
+    """The host's links + routing table."""
+
+    def __init__(self):
+        self.interfaces: Dict[str, HostInterface] = {}
+        self.routes: List[HostRoute] = []
+
+    # ---------------------------------------------------------------- setup
+
+    def add_interface(self, name: str, addresses=(), mac="", up=True) -> None:
+        self.interfaces[name] = HostInterface(
+            name=name, addresses=tuple(addresses), mac=mac, up=up
+        )
+
+    def add_route(self, dst: str, gateway: str = "", interface: str = "") -> None:
+        self.routes.append(HostRoute(dst=dst, gateway=gateway, interface=interface))
+
+    # ------------------------------------------------------- netlink-like API
+
+    def get_interface(self, name: str) -> HostInterface:
+        if name not in self.interfaces:
+            raise LookupError(f"no such interface {name}")
+        return self.interfaces[name]
+
+    def interface_routes(self, name: str) -> List[HostRoute]:
+        return [r for r in self.routes if r.interface == name]
+
+    def flush_interface(self, name: str) -> None:
+        """Remove all addresses + routes (the 'steal' operation)."""
+        iface = self.get_interface(name)
+        self.interfaces[name] = replace(iface, addresses=(), up=False)
+        self.routes = [r for r in self.routes if r.interface != name]
+
+    def configure_interface(self, name: str, addresses, routes, up=True) -> None:
+        iface = self.get_interface(name)
+        self.interfaces[name] = replace(iface, addresses=tuple(addresses), up=up)
+        self.routes = [r for r in self.routes if r.interface != name] + list(routes)
