@@ -1,0 +1,424 @@
+"""Session-rule renderer tests.
+
+Mirrors the reference's vpptcp renderer suite
+(plugins/policy/renderer/vpptcp/vpptcp_renderer_test.go): single
+ingress/egress rule scenarios, ANY-protocol and deny-all splitting,
+incremental data changes with minimal diffs, multi-pod port
+intersection, and resync against preinstalled state — all against the
+mock session engine (mock/sessionrules analog).
+"""
+
+import ipaddress
+
+import pytest
+
+from vpp_tpu.models import PodID, ProtocolType
+from vpp_tpu.policy.renderer.api import Action, ContivRule
+from vpp_tpu.policy.renderer.cache import (
+    Orientation,
+    RendererCache,
+    compare_rules,
+)
+from vpp_tpu.policy.renderer.session import (
+    SCOPE_GLOBAL,
+    SCOPE_LOCAL,
+    TAG_PREFIX,
+    SessionRuleRenderer,
+    export_session_rules,
+    import_session_rules,
+)
+from vpp_tpu.testing.sessionengine import MockSessionEngine
+
+
+def net(cidr: str) -> ipaddress.IPv4Network:
+    return ipaddress.IPv4Network(cidr, strict=False)
+
+
+POD1 = PodID("pod1", "default")
+POD2 = PodID("pod2", "default")
+POD1_IP = "10.1.1.2"
+POD2_IP = "10.1.1.3"
+POD1_NS = 7
+POD2_NS = 8
+
+NS_INDEX = {POD1: POD1_NS, POD2: POD2_NS}
+NS_REVERSE = {v: k for k, v in NS_INDEX.items()}
+
+
+@pytest.fixture()
+def engine():
+    return MockSessionEngine()
+
+
+@pytest.fixture()
+def renderer(engine):
+    return SessionRuleRenderer(
+        channel=engine,
+        ns_index_for=NS_INDEX.get,
+        pod_by_ns_index=NS_REVERSE.get,
+    )
+
+
+def render(renderer, pod, ip, ingress, egress, resync=False, removed=False):
+    txn = renderer.new_txn(resync)
+    txn.render(pod, net(ip + "/32") if ip else None, ingress, egress, removed=removed)
+    txn.commit()
+
+
+def test_rule_total_order():
+    subset = ContivRule(action=Action.PERMIT, dst_network=net("10.0.1.0/24"))
+    superset = ContivRule(action=Action.PERMIT, dst_network=net("10.0.0.0/8"))
+    match_all = ContivRule(action=Action.PERMIT)
+    assert compare_rules(subset, superset) < 0
+    assert compare_rules(superset, match_all) < 0
+    assert compare_rules(match_all, match_all) == 0
+    # Specific protocols sort before ANY: a first-match walk must hit a
+    # TCP rule before the appended ANY allow-all.
+    deny_tcp = ContivRule(action=Action.DENY, protocol=ProtocolType.TCP, dst_port=22)
+    assert compare_rules(deny_tcp, match_all) < 0
+
+
+def test_local_table_is_first_match_ordered():
+    # A pod with one TCP deny: the cache appends allow-all, which must
+    # sort AFTER the deny for the table to be first-match usable.
+    from vpp_tpu.policy.renderer.cache import PodConfig
+
+    cache = RendererCache(Orientation.INGRESS)
+    txn = cache.new_txn()
+    txn.update(
+        POD1,
+        PodConfig(
+            pod_ip=net(POD1_IP + "/32"),
+            ingress=(
+                ContivRule(
+                    action=Action.DENY, protocol=ProtocolType.TCP, dst_port=22
+                ),
+            ),
+        ),
+    )
+    txn.commit()
+    table = cache.get_local_table_by_pod(POD1)
+    deny_idx = next(i for i, r in enumerate(table) if r.action is Action.DENY)
+    allow_all_idx = next(
+        i
+        for i, r in enumerate(table)
+        if r.action is Action.PERMIT and r.protocol is ProtocolType.ANY
+        and r.src_network is None and r.dst_network is None
+    )
+    assert deny_idx < allow_all_idx
+
+
+def test_single_ingress_rule_single_pod(renderer, engine):
+    # TestSingleIngressRuleSinglePod: one DENY in the pod's local table.
+    ingress = [
+        ContivRule(
+            action=Action.DENY,
+            dst_network=net("10.0.0.0/8"),
+            protocol=ProtocolType.TCP,
+            dst_port=22,
+        )
+    ]
+    render(renderer, POD1, POD1_IP, ingress, [], resync=True)
+    assert engine.local_table(POD1_NS).num_rules() == 1
+    assert engine.local_table(POD1_NS).has_rule("", 0, "10.0.0.0/8", 22, "TCP", "DENY")
+    assert engine.global_table().num_rules() == 0
+
+
+def test_single_egress_rule_single_pod(renderer, engine):
+    # TestSingleEgressRuleSinglePod: one DENY narrowed to the pod IP in
+    # the global table; nothing installed locally.
+    egress = [
+        ContivRule(
+            action=Action.DENY,
+            src_network=net("192.168.2.0/24"),
+            protocol=ProtocolType.TCP,
+            dst_port=80,
+        )
+    ]
+    render(renderer, POD1, POD1_IP, [], egress, resync=True)
+    assert engine.local_table(POD1_NS).num_rules() == 0
+    assert engine.global_table().num_rules() == 1
+    assert engine.global_table().has_rule(POD1_IP, 80, "192.168.2.0/24", 0, "TCP", "DENY")
+
+
+def test_any_protocol_and_deny_all_split(renderer, engine):
+    # An isolating policy: permit TCP:23 from one subnet, deny the rest.
+    egress = [
+        ContivRule(
+            action=Action.PERMIT,
+            src_network=net("192.168.2.0/24"),
+            protocol=ProtocolType.TCP,
+            dst_port=23,
+        ),
+        ContivRule(action=Action.DENY),  # ANY proto, match-all src
+    ]
+    render(renderer, POD1, POD1_IP, [], egress, resync=True)
+    gt = engine.global_table()
+    # permit + (deny-all -> TCP/UDP pair x two /1 halves) = 5 rules.
+    assert gt.num_rules() == 5
+    assert gt.has_rule(POD1_IP, 23, "192.168.2.0/24", 0, "TCP", "ALLOW")
+    for proto in ("TCP", "UDP"):
+        assert gt.has_rule(POD1_IP, 0, "0.0.0.0/1", 0, proto, "DENY")
+        assert gt.has_rule(POD1_IP, 0, "128.0.0.0/1", 0, proto, "DENY")
+
+
+def test_incremental_update_minimal_diff(renderer, engine):
+    ingress = [
+        ContivRule(
+            action=Action.DENY,
+            dst_network=net("10.0.0.0/8"),
+            protocol=ProtocolType.TCP,
+            dst_port=22,
+        )
+    ]
+    render(renderer, POD1, POD1_IP, ingress, [], resync=True)
+    reqs_before = engine.req_count
+
+    # Add one more ingress rule: exactly one new session rule shipped.
+    ingress.append(
+        ContivRule(
+            action=Action.DENY,
+            dst_network=net("10.1.0.0/16"),
+            protocol=ProtocolType.TCP,
+            dst_port=80,
+        )
+    )
+    render(renderer, POD1, POD1_IP, ingress, [])
+    assert engine.req_count == reqs_before + 1
+    assert engine.err_count == 0
+    assert engine.local_table(POD1_NS).num_rules() == 2
+    assert engine.local_table(POD1_NS).has_rule("", 0, "10.1.0.0/16", 80, "TCP", "DENY")
+
+    # Re-committing identical state ships nothing.
+    render(renderer, POD1, POD1_IP, ingress, [])
+    assert engine.req_count == reqs_before + 1
+
+
+def test_two_pod_port_intersection(renderer, engine):
+    # pod1's egress allows only TCP:8000 to reach it; pod2's ingress
+    # would allow TCP:8000 and TCP:9000 towards pod1.  The renderer
+    # cache intersects: pod2 may reach pod1 only on TCP:8000.
+    pod1_egress = [
+        ContivRule(action=Action.PERMIT, protocol=ProtocolType.TCP, dst_port=8000),
+        ContivRule(action=Action.DENY),
+    ]
+    pod2_ingress = [
+        ContivRule(
+            action=Action.PERMIT,
+            dst_network=net(POD1_IP + "/32"),
+            protocol=ProtocolType.TCP,
+            dst_port=8000,
+        ),
+        ContivRule(
+            action=Action.PERMIT,
+            dst_network=net(POD1_IP + "/32"),
+            protocol=ProtocolType.TCP,
+            dst_port=9000,
+        ),
+        ContivRule(action=Action.DENY),
+    ]
+    txn = renderer.new_txn(True)
+    txn.render(POD1, net(POD1_IP + "/32"), [], pod1_egress)
+    txn.render(POD2, net(POD2_IP + "/32"), pod2_ingress, [])
+    txn.commit()
+
+    lt = engine.local_table(POD2_NS)
+    # Only the intersected port survives towards pod1.
+    assert lt.has_rule("", 0, POD1_IP, 8000, "TCP", "ALLOW")
+    assert not lt.has_rule("", 0, POD1_IP, 9000, "TCP", "ALLOW")
+    # Deny-the-rest towards pod1 (ANY proto -> TCP+UDP pair).
+    assert lt.has_rule("", 0, POD1_IP, 0, "TCP", "DENY")
+    assert lt.has_rule("", 0, POD1_IP, 0, "UDP", "DENY")
+
+
+def test_pod_removal(renderer, engine):
+    ingress = [
+        ContivRule(
+            action=Action.DENY,
+            dst_network=net("10.0.0.0/8"),
+            protocol=ProtocolType.TCP,
+            dst_port=22,
+        )
+    ]
+    render(renderer, POD1, POD1_IP, ingress, [], resync=True)
+    assert engine.local_table(POD1_NS).num_rules() == 1
+
+    # Removal carries no pod IP (like a DeletePod event): the installed
+    # rules must still be removed exactly, using the committed config.
+    render(renderer, POD1, None, [], [], removed=True)
+    assert engine.local_table(POD1_NS).num_rules() == 0
+    assert engine.err_count == 0
+
+
+def test_resync_sweeps_orphaned_namespaces(renderer, engine):
+    # Rules installed for an app namespace that maps to no known pod
+    # (pod vanished while the agent was down) must be swept on resync.
+    orphan_ns = 99
+    for rule in export_session_rules(
+        [
+            ContivRule(
+                action=Action.DENY,
+                dst_network=net("10.0.0.0/8"),
+                protocol=ProtocolType.TCP,
+                dst_port=22,
+            )
+        ],
+        None,
+        orphan_ns,
+        SCOPE_LOCAL,
+    ):
+        engine.preinstall(rule)
+
+    render(renderer, POD1, POD1_IP, [], [], resync=True)
+    assert engine.local_table(orphan_ns).num_rules() == 0
+    assert engine.err_count == 0
+
+
+def test_resync_removes_stale_rules(renderer, engine):
+    # Pre-install a stale rule the renderer does not know about...
+    ingress = [
+        ContivRule(
+            action=Action.DENY,
+            dst_network=net("10.0.0.0/8"),
+            protocol=ProtocolType.TCP,
+            dst_port=22,
+        )
+    ]
+    stale = export_session_rules(
+        [
+            ContivRule(
+                action=Action.DENY,
+                dst_network=net("172.16.0.0/12"),
+                protocol=ProtocolType.UDP,
+                dst_port=53,
+            )
+        ],
+        net(POD1_IP + "/32"),
+        POD1_NS,
+        SCOPE_LOCAL,
+    )
+    # ...plus the rules that SHOULD exist.
+    good = export_session_rules(ingress, net(POD1_IP + "/32"), POD1_NS, SCOPE_LOCAL)
+    for rule in stale + good:
+        engine.preinstall(rule)
+
+    render(renderer, POD1, POD1_IP, ingress, [], resync=True)
+    lt = engine.local_table(POD1_NS)
+    assert lt.num_rules() == 1
+    assert lt.has_rule("", 0, "10.0.0.0/8", 22, "TCP", "DENY")
+    assert not lt.has_rule("", 0, "172.16.0.0/12", 53, "UDP", "DENY")
+    # Minimal resync: one delete, zero adds, no errors.
+    assert engine.req_count == 1
+    assert engine.err_count == 0
+
+
+def test_resync_removes_unknown_pods(renderer, engine):
+    # Rules of a pod that no longer exists must be swept on resync.
+    for rule in export_session_rules(
+        [
+            ContivRule(
+                action=Action.DENY,
+                dst_network=net("10.0.0.0/8"),
+                protocol=ProtocolType.TCP,
+                dst_port=22,
+            )
+        ],
+        net(POD2_IP + "/32"),
+        POD2_NS,
+        SCOPE_LOCAL,
+    ):
+        engine.preinstall(rule)
+
+    render(renderer, POD1, POD1_IP, [], [], resync=True)
+    assert engine.local_table(POD2_NS).num_rules() == 0
+    assert engine.err_count == 0
+
+
+def test_export_import_roundtrip():
+    rules = [
+        ContivRule(
+            action=Action.PERMIT,
+            src_network=net("192.168.2.0/24"),
+            protocol=ProtocolType.TCP,
+            dst_port=23,
+        ),
+        ContivRule(action=Action.DENY),  # ANY + match-all: split twice
+    ]
+    # Global-table roundtrip (dst narrowed to a pod IP first, as the
+    # renderer cache would).
+    narrowed = [
+        ContivRule(
+            action=r.action,
+            src_network=r.src_network,
+            dst_network=net(POD1_IP + "/32"),
+            protocol=r.protocol,
+            src_port=r.src_port,
+            dst_port=r.dst_port,
+        )
+        for r in rules
+    ]
+    exported = export_session_rules(narrowed, None, 0, SCOPE_GLOBAL)
+    assert all(r.tag.startswith(TAG_PREFIX) for r in exported)
+    local, global_table = import_session_rules(exported, NS_REVERSE.get)
+    assert not local
+    assert sorted(map(str, global_table)) == sorted(map(str, narrowed))
+
+    # Local-table roundtrip.
+    local_rules = [
+        ContivRule(
+            action=Action.DENY,
+            dst_network=net("10.0.0.0/8"),
+            protocol=ProtocolType.TCP,
+            dst_port=22,
+        )
+    ]
+    exported = export_session_rules(local_rules, net(POD1_IP + "/32"), POD1_NS, SCOPE_LOCAL)
+    local, global_table = import_session_rules(exported, NS_REVERSE.get)
+    assert not global_table
+    assert sorted(map(str, local[POD1])) == sorted(map(str, local_rules))
+
+
+def test_missing_ns_index_skips_rules(engine):
+    renderer = SessionRuleRenderer(
+        channel=engine, ns_index_for=lambda pod: None, pod_by_ns_index=lambda ns: None
+    )
+    render(
+        renderer,
+        POD1,
+        POD1_IP,
+        [
+            ContivRule(
+                action=Action.DENY,
+                dst_network=net("10.0.0.0/8"),
+                protocol=ProtocolType.TCP,
+                dst_port=22,
+            )
+        ],
+        [],
+        resync=True,
+    )
+    assert engine.dump() == []
+    assert engine.err_count == 0
+
+
+def test_cache_table_sharing():
+    # Pods with identical rule sets share one table content.
+    cache = RendererCache(Orientation.INGRESS)
+    from vpp_tpu.policy.renderer.cache import PodConfig
+
+    ingress = (
+        ContivRule(
+            action=Action.DENY,
+            dst_network=net("10.0.0.0/8"),
+            protocol=ProtocolType.TCP,
+            dst_port=22,
+        ),
+    )
+    txn = cache.new_txn()
+    txn.update(POD1, PodConfig(pod_ip=net(POD1_IP + "/32"), ingress=ingress))
+    txn.update(POD2, PodConfig(pod_ip=net(POD2_IP + "/32"), ingress=ingress))
+    txn.commit()
+    shared = cache.shared_tables()
+    assert len(shared) == 1
+    assert set(next(iter(shared.values()))) == {POD1, POD2}
+    assert cache.get_isolated_pods() == {POD1, POD2}

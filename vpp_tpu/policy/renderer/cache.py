@@ -1,0 +1,493 @@
+"""Renderer cache — orientation-normalised local/global rule tables.
+
+Analog of ``plugins/policy/renderer/cache/cache_impl.go``: renderers
+that can only apply rules on ONE side of a connection (the session
+renderer filters at connect()/accept() time, the reference's VPPTCP and
+ACL renderers at one interface direction) feed per-pod ingress+egress
+ContivRules through this cache, which re-orients them into
+
+- one **local table** per pod, holding rules in the cache orientation
+  (EGRESS: the pod's egress rules; INGRESS: the pod's ingress rules),
+  with the opposite-direction rules of every other pod on the node
+  *combined in* via allowed-port intersection
+  (cache_impl.go installLocalRules :519), and
+- one **global table** holding every pod's opposite-orientation rules
+  narrowed to the pod's IP (installGlobalRules :638).
+
+Local tables with identical content are shared between pods (the
+reference's table sharing, docs/dev-guide/POLICIES.md:394-400), and
+commits yield a minimal changeset (GetChanges :217).
+
+Rules inside a table follow the ContivRule total order
+(renderer/api.go Compare :110): a rule matching a subset of another's
+traffic sorts first, so tables are directly usable for first-match.
+"""
+
+from __future__ import annotations
+
+import functools
+import ipaddress
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ...models import PodID, ProtocolType
+from .api import Action, ContivRule
+
+ANY_PORT = 0
+
+
+class Orientation:
+    EGRESS = "egress"
+    INGRESS = "ingress"
+
+
+# ---------------------------------------------------------------- rule order
+
+
+def compare_ints(a: int, b: int) -> int:
+    return -1 if a < b else (1 if a > b else 0)
+
+
+def compare_ports(a: int, b: int) -> int:
+    """Specific ports sort before ANY (utils.ComparePorts)."""
+    if a == b:
+        return 0
+    if a == ANY_PORT:
+        return 1
+    if b == ANY_PORT:
+        return -1
+    return -1 if a < b else 1
+
+
+def compare_ip_nets(
+    a: Optional[ipaddress.IPv4Network], b: Optional[ipaddress.IPv4Network]
+) -> int:
+    """Total order on networks: subnets sort before their supernets;
+    match-all (None) sorts last (utils.CompareIPNets)."""
+    if a is None:
+        return 0 if b is None else 1
+    if b is None:
+        return -1
+    common = min(a.prefixlen, b.prefixlen)
+    mask = (0xFFFFFFFF << (32 - common)) & 0xFFFFFFFF if common else 0
+    if (int(a.network_address) & mask) == (int(b.network_address) & mask):
+        # Same prefix: longer (more specific) mask first.
+        return compare_ints(b.prefixlen, a.prefixlen)
+    # Disjoint: longer mask first, then address bytes.
+    order = compare_ints(b.prefixlen, a.prefixlen)
+    if order != 0:
+        return order
+    return compare_ints(int(a.network_address), int(b.network_address))
+
+
+# ProtocolType is IANA-numbered (ANY=0); the total order needs specific
+# protocols before ANY (the reference enum has ANY last, api.go:170).
+_PROTO_RANK = {
+    ProtocolType.TCP: 0,
+    ProtocolType.UDP: 1,
+    ProtocolType.OTHER: 2,
+    ProtocolType.ANY: 3,
+}
+
+
+def compare_rules(a: ContivRule, b: ContivRule) -> int:
+    """The ContivRule total order (renderer/api.go Compare :110)."""
+    order = compare_ip_nets(a.src_network, b.src_network)
+    if order != 0:
+        return order
+    order = compare_ip_nets(a.dst_network, b.dst_network)
+    if order != 0:
+        return order
+    order = compare_ints(_PROTO_RANK[a.protocol], _PROTO_RANK[b.protocol])
+    if order != 0:
+        return order
+    if a.protocol is not ProtocolType.ANY:
+        order = compare_ports(a.src_port, b.src_port)
+        if order != 0:
+            return order
+        order = compare_ports(a.dst_port, b.dst_port)
+        if order != 0:
+            return order
+    return compare_ints(int(a.action), int(b.action))
+
+
+_RULE_KEY = functools.cmp_to_key(compare_rules)
+
+
+def insert_rule_ordered(rules: List[ContivRule], rule: ContivRule) -> bool:
+    """Insert preserving the total order; duplicates are dropped
+    (ContivRuleTable.InsertRule)."""
+    if rule in rules:
+        return False
+    rules.append(rule)
+    rules.sort(key=_RULE_KEY)
+    return True
+
+
+# ----------------------------------------------------------------- port sets
+
+
+def ports_has(ports: Set[int], port: int) -> bool:
+    return ANY_PORT in ports or port in ports
+
+
+def ports_is_subset(p1: Set[int], p2: Set[int]) -> bool:
+    if ANY_PORT in p2:
+        return True
+    if ANY_PORT in p1:
+        return False
+    return all(ports_has(p2, port) for port in p1)
+
+
+def ports_intersection(p1: Set[int], p2: Set[int]) -> Set[int]:
+    if ANY_PORT in p1:
+        return p2
+    if ANY_PORT in p2:
+        return p1
+    return p1 & p2
+
+
+def _allowed_ports(
+    ip: Optional[ipaddress.IPv4Network],
+    rules: Sequence[ContivRule],
+    network_of,
+) -> Tuple[Set[int], Set[int], bool]:
+    """Allowed destination (tcp, udp, any) ports for traffic involving
+    ``ip``, per the rule list (cache/ports.go getAllowed*Ports: assumes
+    configurator output — PERMITs plus at most one final deny-all)."""
+    tcp: Set[int] = set()
+    udp: Set[int] = set()
+    any_proto = False
+    has_deny = False
+    for rule in rules:
+        if rule.action is Action.DENY:
+            has_deny = True
+            continue
+        net = network_of(rule)
+        if net is not None and (ip is None or ip.network_address not in net):
+            continue
+        if rule.protocol is ProtocolType.TCP:
+            tcp.add(rule.dst_port)
+        elif rule.protocol is ProtocolType.UDP:
+            udp.add(rule.dst_port)
+        else:
+            tcp.add(ANY_PORT)
+            udp.add(ANY_PORT)
+            any_proto = True
+    if not has_deny:
+        return {ANY_PORT}, {ANY_PORT}, True
+    return tcp, udp, any_proto
+
+
+def allowed_egress_ports(src_ip, egress):
+    """Ports a source at ``src_ip`` may reach per these egress rules."""
+    return _allowed_ports(src_ip, egress, lambda r: r.src_network)
+
+
+def allowed_ingress_ports(dst_ip, ingress):
+    """Ports reachable at ``dst_ip`` per these ingress rules."""
+    return _allowed_ports(dst_ip, ingress, lambda r: r.dst_network)
+
+
+# -------------------------------------------------------------------- tables
+
+
+_ALLOW_ALL = ContivRule(action=Action.PERMIT)
+
+
+@dataclass
+class PodConfig:
+    """Snapshot of one pod's rendered configuration (cache_impl.go
+    PodConfig)."""
+
+    pod_ip: Optional[ipaddress.IPv4Network] = None  # host /32
+    ingress: Tuple[ContivRule, ...] = ()
+    egress: Tuple[ContivRule, ...] = ()
+    removed: bool = False
+
+
+@dataclass
+class CacheChanges:
+    """Minimal changeset of one committed transaction."""
+
+    # pod -> (original local-table rules, new local-table rules)
+    local: Dict[PodID, Tuple[Tuple[ContivRule, ...], Tuple[ContivRule, ...]]] = field(
+        default_factory=dict
+    )
+    global_table: Optional[
+        Tuple[Tuple[ContivRule, ...], Tuple[ContivRule, ...]]
+    ] = None
+
+
+class RendererCache:
+    """Committed state: pod configs + derived local/global tables."""
+
+    def __init__(self, orientation: str = Orientation.INGRESS):
+        self.orientation = orientation
+        self.pod_configs: Dict[PodID, PodConfig] = {}
+        self.local_tables: Dict[PodID, Tuple[ContivRule, ...]] = {}
+        self.global_table: Tuple[ContivRule, ...] = ()
+
+    def flush(self) -> None:
+        self.pod_configs.clear()
+        self.local_tables.clear()
+        self.global_table = ()
+
+    # ---------------------------------------------------------------- access
+
+    def get_pod_config(self, pod: PodID) -> Optional[PodConfig]:
+        return self.pod_configs.get(pod)
+
+    def get_all_pods(self) -> Set[PodID]:
+        return set(self.pod_configs)
+
+    def get_isolated_pods(self) -> Set[PodID]:
+        """Pods with a (non-empty) local table — K8s "isolated" pods."""
+        return {pod for pod, rules in self.local_tables.items() if rules}
+
+    def get_local_table_by_pod(self, pod: PodID) -> Optional[Tuple[ContivRule, ...]]:
+        return self.local_tables.get(pod)
+
+    def shared_tables(self) -> Dict[Tuple[ContivRule, ...], Set[PodID]]:
+        """Distinct table contents -> pods sharing them."""
+        shared: Dict[Tuple[ContivRule, ...], Set[PodID]] = {}
+        for pod, rules in self.local_tables.items():
+            shared.setdefault(rules, set()).add(pod)
+        return shared
+
+    def resync(
+        self,
+        local_tables: Dict[PodID, Tuple[ContivRule, ...]],
+        global_table: Tuple[ContivRule, ...],
+    ) -> None:
+        """Replace cache content with state imported from the data plane
+        (cache_impl.go Resync :99: configs cannot be reconstructed, but
+        the pod set and tables can)."""
+        self.flush()
+        for pod, rules in local_tables.items():
+            if rules:
+                self.local_tables[pod] = tuple(rules)
+            self.pod_configs[pod] = PodConfig()
+        self.global_table = tuple(global_table)
+
+    def new_txn(self) -> "CacheTxn":
+        return CacheTxn(self)
+
+
+class CacheTxn:
+    """One cache transaction: buffered pod updates, tables rebuilt and
+    diffed on commit."""
+
+    def __init__(self, cache: RendererCache):
+        self.cache = cache
+        self.updated: Dict[PodID, PodConfig] = {}
+
+    def update(self, pod: PodID, config: PodConfig) -> "CacheTxn":
+        self.updated[pod] = config
+        return self
+
+    # ----------------------------------------------------------- txn queries
+
+    def get_updated_pods(self) -> Set[PodID]:
+        return set(self.updated)
+
+    def get_pod_config(self, pod: PodID) -> Optional[PodConfig]:
+        if pod in self.updated:
+            return self.updated[pod]
+        return self.cache.get_pod_config(pod)
+
+    def get_all_pods(self) -> Set[PodID]:
+        pods = self.cache.get_all_pods()
+        for pod, cfg in self.updated.items():
+            if cfg.removed:
+                pods.discard(pod)
+            else:
+                pods.add(pod)
+        return pods
+
+    # ------------------------------------------------------- table building
+
+    def _build_local_table(self, dst_pod: PodID) -> Tuple[ContivRule, ...]:
+        """cache_impl.go buildLocalTable :469."""
+        cfg = self.get_pod_config(dst_pod)
+        if cfg is None or cfg.removed:
+            return ()
+
+        rules: List[ContivRule] = []
+        own = cfg.egress if self.cache.orientation == Orientation.EGRESS else cfg.ingress
+        for rule in own:
+            insert_rule_ordered(rules, rule)
+
+        for src_pod in self.get_all_pods():
+            src_cfg = self.get_pod_config(src_pod)
+            if src_cfg is not None:
+                self._install_local_rules(rules, cfg, src_cfg)
+
+        # Allow traffic not matched by any rule, unless an all-matching
+        # rule is already present.
+        if rules and not any(
+            r.protocol is ProtocolType.ANY
+            and r.dst_port == ANY_PORT
+            and r.src_network is None
+            and r.dst_network is None
+            for r in rules
+        ):
+            insert_rule_ordered(rules, _ALLOW_ALL)
+        return tuple(rules)
+
+    def _install_local_rules(
+        self, rules: List[ContivRule], dst_cfg: PodConfig, src_cfg: PodConfig
+    ) -> None:
+        """Combine the opposite-direction rules of ``src_cfg``'s pod into
+        the local table of ``dst_cfg``'s pod via allowed-port
+        intersection (cache_impl.go installLocalRules :519)."""
+        egress_o = self.cache.orientation == Orientation.EGRESS
+        if egress_o:
+            src_tcp, src_udp, src_any = allowed_ingress_ports(
+                dst_cfg.pod_ip, src_cfg.ingress
+            )
+            dst_tcp, dst_udp, dst_any = allowed_egress_ports(
+                src_cfg.pod_ip, dst_cfg.egress
+            )
+        else:
+            src_tcp, src_udp, src_any = allowed_egress_ports(
+                dst_cfg.pod_ip, src_cfg.egress
+            )
+            dst_tcp, dst_udp, dst_any = allowed_ingress_ports(
+                src_cfg.pod_ip, dst_cfg.ingress
+            )
+
+        if src_any:
+            return
+
+        if dst_any or not ports_is_subset(dst_tcp, src_tcp) or not ports_is_subset(
+            dst_udp, src_udp
+        ):
+            src_ip = src_cfg.pod_ip
+            if src_ip is None:
+                return
+            # Drop the rule subtree rooted at the source pod's /32.
+            side = (lambda r: r.src_network) if egress_o else (lambda r: r.dst_network)
+            rules[:] = [
+                r
+                for r in rules
+                if not (
+                    side(r) is not None
+                    and side(r).prefixlen == 32
+                    and side(r).network_address == src_ip.network_address
+                )
+            ]
+            self._install_allowed_ports(
+                rules, src_ip, ports_intersection(dst_tcp, src_tcp), ProtocolType.TCP
+            )
+            self._install_allowed_ports(
+                rules, src_ip, ports_intersection(dst_udp, src_udp), ProtocolType.UDP
+            )
+            deny = ContivRule(
+                action=Action.DENY,
+                src_network=src_ip if egress_o else None,
+                dst_network=None if egress_o else src_ip,
+            )
+            insert_rule_ordered(rules, deny)
+
+    def _install_allowed_ports(
+        self,
+        rules: List[ContivRule],
+        src_ip: ipaddress.IPv4Network,
+        allowed: Set[int],
+        protocol: ProtocolType,
+    ) -> None:
+        """cache_impl.go installAllowedPorts :590."""
+        egress_o = self.cache.orientation == Orientation.EGRESS
+        if ANY_PORT in allowed:
+            insert_rule_ordered(
+                rules,
+                ContivRule(
+                    action=Action.PERMIT,
+                    src_network=src_ip if egress_o else None,
+                    dst_network=None if egress_o else src_ip,
+                    protocol=protocol,
+                ),
+            )
+            return
+        for port in allowed:
+            insert_rule_ordered(
+                rules,
+                ContivRule(
+                    action=Action.PERMIT,
+                    src_network=src_ip if egress_o else None,
+                    dst_network=None if egress_o else src_ip,
+                    protocol=protocol,
+                    dst_port=port,
+                ),
+            )
+
+    def _rebuild_global_table(self) -> Tuple[ContivRule, ...]:
+        """cache_impl.go rebuildGlobalTable :622."""
+        rules: List[ContivRule] = []
+        egress_o = self.cache.orientation == Orientation.EGRESS
+        for pod in self.get_all_pods():
+            cfg = self.get_pod_config(pod)
+            if cfg is None or cfg.pod_ip is None:
+                continue
+            opposite = cfg.ingress if egress_o else cfg.egress
+            for rule in opposite:
+                if egress_o:
+                    narrowed = ContivRule(
+                        action=rule.action,
+                        src_network=cfg.pod_ip,
+                        dst_network=rule.dst_network,
+                        protocol=rule.protocol,
+                        src_port=rule.src_port,
+                        dst_port=rule.dst_port,
+                    )
+                else:
+                    narrowed = ContivRule(
+                        action=rule.action,
+                        src_network=rule.src_network,
+                        dst_network=cfg.pod_ip,
+                        protocol=rule.protocol,
+                        src_port=rule.src_port,
+                        dst_port=rule.dst_port,
+                    )
+                insert_rule_ordered(rules, narrowed)
+        if rules:
+            insert_rule_ordered(rules, _ALLOW_ALL)
+        return tuple(rules)
+
+    # ----------------------------------------------------------------- commit
+
+    def get_changes(self) -> CacheChanges:
+        """Minimal changeset of this txn (cache_impl.go GetChanges)."""
+        changes = CacheChanges()
+        affected = set(self.updated)
+        # A pod's local table also depends on every other pod's opposite
+        # rules; rebuild all to catch combination fallout.
+        for pod in self.get_all_pods() | affected:
+            old = self.cache.local_tables.get(pod, ())
+            new = self._build_local_table(pod)
+            if old != new:
+                changes.local[pod] = (old, new)
+        new_global = self._rebuild_global_table()
+        if new_global != self.cache.global_table:
+            changes.global_table = (self.cache.global_table, new_global)
+        return changes
+
+    def commit(self, changes: Optional[CacheChanges] = None) -> CacheChanges:
+        if changes is None:
+            changes = self.get_changes()
+        for pod, (_, new) in changes.local.items():
+            if new:
+                self.cache.local_tables[pod] = new
+            else:
+                self.cache.local_tables.pop(pod, None)
+        if changes.global_table is not None:
+            self.cache.global_table = changes.global_table[1]
+        for pod, cfg in self.updated.items():
+            if cfg.removed:
+                self.cache.pod_configs.pop(pod, None)
+                self.cache.local_tables.pop(pod, None)
+            else:
+                self.cache.pod_configs[pod] = cfg
+        self.updated.clear()
+        return changes

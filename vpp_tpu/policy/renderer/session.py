@@ -1,0 +1,365 @@
+"""Session-rule policy renderer — the host-stack (L4) alternative.
+
+Analog of ``plugins/policy/renderer/vpptcp/`` (vpptcp_renderer.go:35,
+rule/session_rule.go:73): instead of compiling rule tensors for the
+TPU classify kernel, this renderer programs **session rules** into the
+host-stack session layer of the batch shim — filtering at
+connect()/accept() time rather than per packet, exactly like the
+reference's VPPTCP renderer programmed VPP's session layer over the
+GoVPP binary API.
+
+Orientation and table assembly come from the shared RendererCache in
+INGRESS orientation (vpptcp_renderer.go Init :61): each pod's local
+table (applied in the pod's application namespace at connect time)
+holds its ingress-oriented rules, and the global table (applied at
+accept time) holds every pod's egress rules narrowed to the pod IP.
+
+Wire fidelity with the reference export rules
+(rule/session_rule.go ExportSessionRules :214):
+- allow-all destination rules are not installed — allowing is the
+  stack's default behaviour;
+- local rules whose destination is the pod's own IP are skipped;
+- ANY-protocol rules split into a TCP + UDP pair (tag ``-ANY``);
+- match-all remote networks split into the two /1 halves of the IPv4
+  space (tag ``-SPLIT``) to avoid colliding with stack proxy rules;
+- every rule is tagged so a resync dump can identify (and a foreign
+  agent can ignore) rules owned by this renderer.
+
+Commits send minimal add/delete batches over a ``SessionRuleChannel``
+(the GoVPP channel analog — implemented by the host shim, and by
+``vpp_tpu.testing.sessionengine.MockSessionEngine`` in tests); resync
+dumps the installed rules, imports them back into ContivRule tables
+(ImportSessionRules :358) and removes stale state.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import logging
+from dataclasses import dataclass, replace
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from ...models import PodID, ProtocolType
+from .api import Action, ContivRule, PolicyRendererAPI, RendererTxn
+from .cache import CacheTxn, Orientation, PodConfig, RendererCache
+
+log = logging.getLogger(__name__)
+
+# Rule ownership tags (rule/session_rule.go :33-44).
+TAG_PREFIX = "vpp-tpu/policy"
+ANY_PROTOCOL_TAG = "-ANY"
+SPLIT_TAG = "-SPLIT"
+
+SCOPE_LOCAL = "local"
+SCOPE_GLOBAL = "global"
+
+ACTION_ALLOW = "allow"
+ACTION_DENY = "deny"
+
+_HALF1 = ipaddress.IPv4Network("0.0.0.0/1")
+_HALF2 = ipaddress.IPv4Network("128.0.0.0/1")
+
+
+@dataclass(frozen=True)
+class SessionRule:
+    """One host-stack session-layer rule (session_rule.go SessionRule
+    :73-86, minus the IPv6/raw-bytes wire framing)."""
+
+    scope: str                                     # SCOPE_LOCAL / SCOPE_GLOBAL
+    appns_index: int                               # 0 for global scope
+    transport_proto: ProtocolType                  # TCP or UDP only
+    lcl_ip: Optional[ipaddress.IPv4Network]        # None = 0/0
+    lcl_port: int
+    rmt_ip: Optional[ipaddress.IPv4Network]        # None = 0/0
+    rmt_port: int
+    action: str                                    # ACTION_ALLOW / ACTION_DENY
+    tag: str = TAG_PREFIX
+
+    def __str__(self) -> str:
+        lcl = str(self.lcl_ip) if self.lcl_ip else "0.0.0.0/0"
+        rmt = str(self.rmt_ip) if self.rmt_ip else "0.0.0.0/0"
+        return (
+            f"SessionRule <ns:{self.appns_index} {self.scope} {self.action} "
+            f"lcl:{lcl}[{self.transport_proto.name}:{self.lcl_port}] "
+            f"rmt:{rmt}[{self.transport_proto.name}:{self.rmt_port}] "
+            f"tag:{self.tag}>"
+        )
+
+
+class SessionRuleChannel:
+    """Transport to the session layer (the GoVPP channel analog)."""
+
+    def apply(
+        self, added: Sequence[SessionRule], removed: Sequence[SessionRule]
+    ) -> None:
+        """Install/uninstall rules; must raise on failure."""
+        raise NotImplementedError
+
+    def dump(self) -> List[SessionRule]:
+        """All currently installed session rules (any owner)."""
+        raise NotImplementedError
+
+
+# ------------------------------------------------------------------- export
+
+
+def _convert_rule(
+    rule: ContivRule, scope: str, ns_index: int, tag_prefix: str
+) -> List[SessionRule]:
+    """session_rule.go convertContivRule :263 for one TCP/UDP rule."""
+    is_global = scope == SCOPE_GLOBAL
+    if is_global:
+        lcl_ip, lcl_port = rule.dst_network, rule.dst_port
+        rmt_ip, rmt_port = rule.src_network, rule.src_port
+    else:
+        # Local tables leave lcl at 0/0: they are already namespace-scoped.
+        lcl_ip, lcl_port = None, rule.src_port
+        rmt_ip, rmt_port = rule.dst_network, rule.dst_port
+    action = ACTION_DENY if rule.action is Action.DENY else ACTION_ALLOW
+    base = SessionRule(
+        scope=scope,
+        appns_index=0 if is_global else ns_index,
+        transport_proto=rule.protocol,
+        lcl_ip=lcl_ip,
+        lcl_port=lcl_port,
+        rmt_ip=rmt_ip,
+        rmt_port=rmt_port,
+        action=action,
+        tag=tag_prefix,
+    )
+    if rmt_ip is None:
+        # Match-all remote: split the IPv4 space in two halves to avoid
+        # collision with the stack's proxy rules.
+        tag = tag_prefix + SPLIT_TAG
+        return [
+            replace(base, rmt_ip=_HALF1, tag=tag),
+            replace(base, rmt_ip=_HALF2, tag=tag),
+        ]
+    return [base]
+
+
+def export_session_rules(
+    rules: Sequence[ContivRule],
+    pod_ip: Optional[ipaddress.IPv4Network],
+    ns_index: int,
+    scope: str,
+) -> List[SessionRule]:
+    """ContivRules (one table) -> session rules
+    (session_rule.go ExportSessionRules :214).  ``scope`` is GLOBAL for
+    the global table, LOCAL for a pod's table (then ``pod_ip`` and
+    ``ns_index`` identify the pod)."""
+    out: List[SessionRule] = []
+    is_global = scope == SCOPE_GLOBAL
+    for rule in rules:
+        all_net = rule.src_network if is_global else rule.dst_network
+        if rule.dst_port == 0 and rule.action is not Action.DENY and all_net is None:
+            # Allow-all destination: the stack's default, don't install.
+            continue
+        if (
+            not is_global
+            and rule.dst_network is not None
+            and pod_ip is not None
+            and rule.dst_network.prefixlen == 32
+            and rule.dst_network.network_address == pod_ip.network_address
+        ):
+            # Same source as destination.
+            continue
+        if rule.protocol is ProtocolType.ANY:
+            # The session layer only knows TCP and UDP: filter ANY as a pair.
+            tag = TAG_PREFIX + ANY_PROTOCOL_TAG
+            for proto in (ProtocolType.TCP, ProtocolType.UDP):
+                out.extend(
+                    _convert_rule(
+                        replace_protocol(rule, proto), scope, ns_index, tag
+                    )
+                )
+        else:
+            out.extend(_convert_rule(rule, scope, ns_index, TAG_PREFIX))
+    return out
+
+
+def replace_protocol(rule: ContivRule, protocol: ProtocolType) -> ContivRule:
+    return ContivRule(
+        action=rule.action,
+        src_network=rule.src_network,
+        dst_network=rule.dst_network,
+        protocol=protocol,
+        src_port=rule.src_port,
+        dst_port=rule.dst_port,
+    )
+
+
+# ------------------------------------------------------------------- import
+
+
+def import_session_rules(
+    rules: Sequence[SessionRule],
+    pod_by_ns_index: Callable[[int], Optional[PodID]],
+) -> Tuple[Dict[PodID, List[ContivRule]], List[ContivRule]]:
+    """Installed session rules -> (local tables by pod, global table),
+    merging -SPLIT halves and -ANY pairs back into single ContivRules
+    (session_rule.go ImportSessionRules :358).  Rules without this
+    renderer's tag prefix must be filtered by the caller."""
+    local: Dict[PodID, List[ContivRule]] = {}
+    global_table: List[ContivRule] = []
+    for rule in rules:
+        tag = rule.tag
+        rmt_ip = rule.rmt_ip
+        if tag.endswith(SPLIT_TAG):
+            if rmt_ip == _HALF2:
+                continue  # merged into the 0.0.0.0/1 half
+            rmt_ip = None
+            tag = tag[: -len(SPLIT_TAG)]
+        if tag.endswith(ANY_PROTOCOL_TAG):
+            if rule.transport_proto is ProtocolType.UDP:
+                continue  # merged into the TCP half
+            protocol = ProtocolType.ANY
+        else:
+            protocol = rule.transport_proto
+        if rule.scope == SCOPE_GLOBAL:
+            contiv = ContivRule(
+                action=Action.DENY if rule.action == ACTION_DENY else Action.PERMIT,
+                src_network=rmt_ip,
+                dst_network=rule.lcl_ip,
+                protocol=protocol,
+                src_port=rule.rmt_port,
+                dst_port=rule.lcl_port,
+            )
+            global_table.append(contiv)
+        else:
+            pod = pod_by_ns_index(rule.appns_index)
+            if pod is None:
+                log.warning("no pod for appns %d; dropping %s", rule.appns_index, rule)
+                continue
+            contiv = ContivRule(
+                action=Action.DENY if rule.action == ACTION_DENY else Action.PERMIT,
+                src_network=rule.lcl_ip,
+                dst_network=rmt_ip,
+                protocol=protocol,
+                src_port=rule.lcl_port,
+                dst_port=rule.rmt_port,
+            )
+            local.setdefault(pod, []).append(contiv)
+    return local, global_table
+
+
+# ----------------------------------------------------------------- renderer
+
+
+class SessionRuleRenderer(PolicyRendererAPI):
+    """Renders ContivRules into host-stack session rules
+    (vpptcp_renderer.go Renderer :35).
+
+    Deps (vpptcp_renderer.go Deps :43):
+    - ``channel``: the session-layer transport;
+    - ``ns_index_for``: pod -> application-namespace index (the
+      reference's IPv4Net.GetNsIndex);
+    - ``pod_by_ns_index``: the reverse lookup, for resync import.
+    """
+
+    def __init__(
+        self,
+        channel: SessionRuleChannel,
+        ns_index_for: Callable[[PodID], Optional[int]],
+        pod_by_ns_index: Callable[[int], Optional[PodID]],
+    ):
+        self.channel = channel
+        self.ns_index_for = ns_index_for
+        self.pod_by_ns_index = pod_by_ns_index
+        self.cache = RendererCache(Orientation.INGRESS)
+
+    def new_txn(self, resync: bool) -> "SessionRendererTxn":
+        return SessionRendererTxn(self, resync)
+
+    # ----------------------------------------------------------------- export
+
+    def _export_local(
+        self,
+        pod: PodID,
+        rules: Sequence[ContivRule],
+        pod_ip: Optional[ipaddress.IPv4Network],
+    ) -> List[SessionRule]:
+        ns_index = self.ns_index_for(pod)
+        if ns_index is None:
+            log.warning("no app namespace for pod %s; skipping its rules", pod)
+            return []
+        return export_session_rules(rules, pod_ip, ns_index, SCOPE_LOCAL)
+
+
+class SessionRendererTxn(RendererTxn):
+    """vpptcp_renderer.go RendererTxn: buffers Render() calls, then
+    Commit() computes table diffs and ships minimal add/del batches."""
+
+    def __init__(self, renderer: SessionRuleRenderer, resync: bool):
+        self.renderer = renderer
+        self.resync = resync
+        self.cache_txn: CacheTxn = renderer.cache.new_txn()
+
+    def render(self, pod, pod_ip, ingress, egress, removed=False):
+        self.cache_txn.update(
+            pod,
+            PodConfig(
+                pod_ip=pod_ip,
+                ingress=tuple(ingress),
+                egress=tuple(egress),
+                removed=removed,
+            ),
+        )
+        return self
+
+    def commit(self) -> None:
+        renderer = self.renderer
+        added: List[SessionRule] = []
+        removed: List[SessionRule] = []
+        if self.resync:
+            # Re-synchronize against the actually installed rules first.
+            installed = [
+                r for r in renderer.channel.dump() if r.tag.startswith(TAG_PREFIX)
+            ]
+            # Our local-scope rules whose app namespace maps to no known
+            # pod are orphans (pod gone while we were down): the diff
+            # below can never attribute them, so sweep them here.
+            orphans = [
+                r
+                for r in installed
+                if r.scope == SCOPE_LOCAL
+                and renderer.pod_by_ns_index(r.appns_index) is None
+            ]
+            removed.extend(orphans)
+            local, global_table = import_session_rules(
+                [r for r in installed if r not in orphans],
+                renderer.pod_by_ns_index,
+            )
+            renderer.cache.resync(
+                {pod: tuple(rules) for pod, rules in local.items()},
+                tuple(global_table),
+            )
+            # Pods known to the data plane but absent from the txn are gone.
+            txn_pods = self.cache_txn.get_updated_pods()
+            for pod in renderer.cache.get_all_pods() - txn_pods:
+                self.cache_txn.update(pod, PodConfig(removed=True))
+
+        changes = self.cache_txn.get_changes()
+        for pod, (old, new) in changes.local.items():
+            # The OLD table must be exported with the config it was
+            # installed under (the committed one), the NEW with the
+            # txn's — a removed pod has pod_ip=None in the txn, but its
+            # installed rules were exported against its former IP.
+            old_cfg = renderer.cache.get_pod_config(pod)
+            new_cfg = self.cache_txn.get_pod_config(pod)
+            old_ip = old_cfg.pod_ip if old_cfg is not None else None
+            new_ip = new_cfg.pod_ip if new_cfg is not None else None
+            old_rules = set(renderer._export_local(pod, old, old_ip))
+            new_rules = set(renderer._export_local(pod, new, new_ip))
+            added.extend(new_rules - old_rules)
+            removed.extend(old_rules - new_rules)
+        if changes.global_table is not None:
+            old, new = changes.global_table
+            old_rules = set(export_session_rules(old, None, 0, SCOPE_GLOBAL))
+            new_rules = set(export_session_rules(new, None, 0, SCOPE_GLOBAL))
+            added.extend(new_rules - old_rules)
+            removed.extend(old_rules - new_rules)
+
+        if added or removed:
+            renderer.channel.apply(added, removed)
+        self.cache_txn.commit(changes)

@@ -1,0 +1,140 @@
+"""Mock session-layer engine — the SessionRuleChannel test double.
+
+Analog of ``mock/sessionrules/sessionrules_mock.go``: stands in for the
+host shim's session layer, accepting add/delete batches from the
+session renderer and maintaining the rule tables the way the real
+stack would — one global table plus one table per application
+namespace.  Exposes the same assertion surface as the reference mock
+(LocalTable(ns).NumOfRules/HasRule :99-135, GetReqCount/GetErrCount
+:89-96) plus ``preinstall`` and ``dump`` for resync scenarios.
+
+Error accounting mirrors addDelRule :344: removing a rule that is not
+installed (exact match including tag) or adding a duplicate counts as
+an error and leaves the tables unchanged.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from typing import Dict, List, Optional, Sequence, Set
+
+from ..models import ProtocolType
+from ..policy.renderer.session import (
+    ACTION_ALLOW,
+    ACTION_DENY,
+    SCOPE_GLOBAL,
+    SCOPE_LOCAL,
+    SessionRule,
+    SessionRuleChannel,
+)
+
+
+def _net(cidr: str) -> Optional[ipaddress.IPv4Network]:
+    if not cidr:
+        return None
+    if "/" not in cidr:
+        cidr += "/32"
+    return ipaddress.IPv4Network(cidr, strict=False)
+
+
+class _TableCheck:
+    """Assertion helpers over one rule set (sessionrules_mock.go
+    LocalTableCheck/GlobalTableCheck)."""
+
+    def __init__(self, rules: Set[SessionRule]):
+        self._rules = rules
+
+    def num_rules(self) -> int:
+        return len(self._rules)
+
+    def has_rule(
+        self,
+        lcl_ip: str,
+        lcl_port: int,
+        rmt_ip: str,
+        rmt_port: int,
+        proto: str,
+        action: str,
+    ) -> bool:
+        """Presence check by value; ``""`` means 0/0, a bare IP means
+        /32; tag is ignored (hasRule :137)."""
+        lcl = _net(lcl_ip)
+        rmt = _net(rmt_ip)
+        want_proto = ProtocolType[proto]
+        want_action = ACTION_ALLOW if action.upper() == "ALLOW" else ACTION_DENY
+        return any(
+            r.lcl_ip == lcl
+            and r.lcl_port == lcl_port
+            and r.rmt_ip == rmt
+            and r.rmt_port == rmt_port
+            and r.transport_proto is want_proto
+            and r.action == want_action
+            for r in self._rules
+        )
+
+
+class MockSessionEngine(SessionRuleChannel):
+    """In-memory session-rule tables with reference-mock semantics."""
+
+    def __init__(self):
+        self._global: Set[SessionRule] = set()
+        self._local: Dict[int, Set[SessionRule]] = {}
+        self.req_count = 0
+        self.err_count = 0
+
+    # ------------------------------------------------------------- channel
+
+    def apply(
+        self, added: Sequence[SessionRule], removed: Sequence[SessionRule]
+    ) -> None:
+        for rule in removed:
+            self.req_count += 1
+            table = self._table_for(rule)
+            if rule in table:
+                table.discard(rule)
+            else:
+                self.err_count += 1
+        for rule in added:
+            self.req_count += 1
+            table = self._table_for(rule)
+            if rule in table:
+                self.err_count += 1
+            else:
+                table.add(rule)
+
+    def dump(self) -> List[SessionRule]:
+        rules = list(self._global)
+        for table in self._local.values():
+            rules.extend(table)
+        return rules
+
+    # -------------------------------------------------------------- helpers
+
+    def _table_for(self, rule: SessionRule) -> Set[SessionRule]:
+        if rule.scope == SCOPE_GLOBAL:
+            return self._global
+        return self._local.setdefault(rule.appns_index, set())
+
+    def preinstall(self, rule: SessionRule) -> None:
+        """Install a rule behind the renderer's back (resync tests)."""
+        self._table_for(rule).add(rule)
+
+    def clear(self) -> None:
+        self._global.clear()
+        self._local.clear()
+        self.req_count = 0
+        self.err_count = 0
+
+    # --------------------------------------------------------------- checks
+
+    def local_table(self, ns_index: int) -> _TableCheck:
+        return _TableCheck(self._local.get(ns_index, set()))
+
+    def global_table(self) -> _TableCheck:
+        return _TableCheck(self._global)
+
+    def num_tables(self) -> int:
+        """Namespaces with at least one rule, plus the global table if
+        non-empty."""
+        n = sum(1 for t in self._local.values() if t)
+        return n + (1 if self._global else 0)
