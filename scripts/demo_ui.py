@@ -1,0 +1,89 @@
+"""Stand up a mini single-node agent + UI backend for a manual look.
+
+Usage: python scripts/demo_ui.py [--port N]
+Serves the dashboard at http://127.0.0.1:<port>/ until interrupted.
+"""
+
+import argparse
+import time
+
+from prometheus_client import CollectorRegistry
+
+from vpp_tpu.conf import NetworkConfig
+from vpp_tpu.controller.dbwatcher import DBWatcher
+from vpp_tpu.controller.eventloop import Controller
+from vpp_tpu.ipv4net import IPv4Net
+from vpp_tpu.kvstore import KVStore
+from vpp_tpu.models import VppNode
+from vpp_tpu.models.registry import NODESYNC_PREFIX
+from vpp_tpu.nodesync import NodeSync
+from vpp_tpu.podmanager import PodManager
+from vpp_tpu.rest import AgentRestServer
+from vpp_tpu.scheduler import TxnScheduler
+from vpp_tpu.statscollector import StatsCollector
+from vpp_tpu.uibackend import UIBackend
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=8900)
+    args = parser.parse_args()
+
+    store = KVStore()
+    nodesync = NodeSync(store, node_name="node-1")
+    podmanager = PodManager()
+    ipv4net = IPv4Net(NetworkConfig(), nodesync, podmanager=podmanager)
+    scheduler = TxnScheduler()
+    registry = CollectorRegistry()
+    stats = StatsCollector(registry=registry)
+    ctl = Controller(handlers=[nodesync, podmanager, ipv4net, stats], sink=scheduler)
+    podmanager.event_loop = ctl
+    nodesync.event_loop = ctl
+    ctl.start()
+    watcher = DBWatcher(ctl, store)
+    watcher.start()
+    while ipv4net.ipam is None:
+        time.sleep(0.02)
+
+    # A couple of local pods and one remote node for the topology view.
+    podmanager.add_pod(name="web-1", container_id="c1")
+    podmanager.add_pod(name="db-1", container_id="c2")
+    store.put(
+        f"{NODESYNC_PREFIX}node-2",
+        VppNode(id=2, name="node-2", ip_addresses=["192.168.16.2"]),
+    )
+
+    rest = AgentRestServer(
+        node_name="node-1",
+        controller=ctl,
+        dbwatcher=watcher,
+        ipam=ipv4net.ipam,
+        nodesync=nodesync,
+        podmanager=podmanager,
+        scheduler=scheduler,
+        stats_registry=registry,
+    )
+    agent_port = rest.start()
+
+    directory = {"node-1": f"127.0.0.1:{agent_port}"}
+    backend = UIBackend(
+        node_directory=directory.get,
+        list_nodes=lambda: list(directory),
+        port=args.port,
+    )
+    backend.start()
+    print(f"dashboard: http://127.0.0.1:{backend.port}/  (agent on :{agent_port})")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        backend.stop()
+        rest.stop()
+        watcher.stop()
+        ctl.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""PCI bind/unbind tests against a fake sysfs tree."""
+
+import os
+
+import pytest
+
+from vpp_tpu.pci import DeviceInfo, device_info, driver_bind, driver_unbind
+from vpp_tpu.pci.pci import PCIError
+
+ADDR = "0000:00:08.0"
+
+
+@pytest.fixture()
+def sysfs(tmp_path):
+    """A minimal /sys/bus/pci with one device bound to 'vmxnet3' and a
+    loaded 'vfio-pci' driver."""
+    dev = tmp_path / "devices" / ADDR
+    dev.mkdir(parents=True)
+    (dev / "vendor").write_text("0x15ad\n")
+    (dev / "device").write_text("0x07b0\n")
+
+    for name in ("vmxnet3", "vfio-pci"):
+        drv = tmp_path / "drivers" / name
+        drv.mkdir(parents=True)
+        (drv / "new_id").write_text("")
+        (drv / "bind").write_text("")
+        (drv / "unbind").write_text("")
+
+    # Bind the device to vmxnet3: driver symlink + reverse dir entry.
+    (dev / "driver").symlink_to(tmp_path / "drivers" / "vmxnet3")
+    (tmp_path / "drivers" / "vmxnet3" / ADDR).mkdir()
+    return tmp_path
+
+
+def test_device_info(sysfs):
+    info = device_info(ADDR, str(sysfs))
+    assert info == DeviceInfo(
+        address=ADDR, vendor_id=0x15AD, device_id=0x07B0, driver="vmxnet3"
+    )
+
+
+def test_driver_unbind_writes_address(sysfs):
+    driver_unbind(ADDR, str(sysfs))
+    assert (sysfs / "drivers" / "vmxnet3" / "unbind").read_text() == ADDR
+
+
+def test_driver_bind_flow(sysfs):
+    driver_bind(ADDR, "vfio-pci", str(sysfs))
+    drv = sysfs / "drivers" / "vfio-pci"
+    assert drv.joinpath("new_id").read_text() == "15ad  7b0"
+    assert drv.joinpath("bind").read_text() == ADDR
+    # Unbound from the previous driver first.
+    assert (sysfs / "drivers" / "vmxnet3" / "unbind").read_text() == ADDR
+
+
+def test_driver_bind_already_bound_is_noop(sysfs):
+    (sysfs / "drivers" / "vfio-pci" / ADDR).mkdir()
+    driver_bind(ADDR, "vfio-pci", str(sysfs))
+    # Nothing written: no unbind, no new_id.
+    assert (sysfs / "drivers" / "vmxnet3" / "unbind").read_text() == ""
+    assert (sysfs / "drivers" / "vfio-pci" / "new_id").read_text() == ""
+
+
+def test_driver_bind_missing_driver_raises(sysfs):
+    with pytest.raises(PCIError, match="not loaded"):
+        driver_bind(ADDR, "nosuchdrv", str(sysfs))
+
+
+def test_missing_device_raises(sysfs):
+    with pytest.raises(PCIError):
+        device_info("0000:ff:ff.f", str(sysfs))

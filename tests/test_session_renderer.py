@@ -231,6 +231,20 @@ def test_two_pod_port_intersection(renderer, engine):
     assert lt.has_rule("", 0, POD1_IP, 0, "UDP", "DENY")
 
 
+def test_protocol_specific_permit_all_is_installed(renderer, engine):
+    # "allow all TCP, deny the rest": the TCP permit-all MUST be
+    # installed or the deny-all splits would over-block TCP.
+    ingress = [
+        ContivRule(action=Action.PERMIT, protocol=ProtocolType.TCP),
+        ContivRule(action=Action.DENY),
+    ]
+    render(renderer, POD1, POD1_IP, ingress, [], resync=True)
+    lt = engine.local_table(POD1_NS)
+    assert lt.has_rule("", 0, "0.0.0.0/1", 0, "TCP", "ALLOW")
+    assert lt.has_rule("", 0, "128.0.0.0/1", 0, "TCP", "ALLOW")
+    assert lt.has_rule("", 0, "0.0.0.0/1", 0, "UDP", "DENY")
+
+
 def test_pod_removal(renderer, engine):
     ingress = [
         ContivRule(

@@ -1,0 +1,156 @@
+"""UI backend reverse-proxy tests (cmd/contiv-ui-backend analog)."""
+
+import base64
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from vpp_tpu.uibackend import UIBackend
+
+
+class FakeAgent:
+    """A tiny HTTP server standing in for an AgentRestServer."""
+
+    def __init__(self):
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                payload = json.dumps({"path": self.path}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture()
+def agent():
+    a = FakeAgent()
+    yield a
+    a.stop()
+
+
+@pytest.fixture()
+def backend(agent):
+    directory = {"node1": f"127.0.0.1:{agent.port}"}
+    b = UIBackend(
+        node_directory=directory.get,
+        list_nodes=lambda: list(directory),
+        netctl_runner=lambda args: (0, f"ran: {' '.join(args)}"),
+    )
+    b.start()
+    yield b
+    b.stop()
+
+
+def get(backend, path, auth=None):
+    req = urllib.request.Request(f"http://127.0.0.1:{backend.port}{path}")
+    if auth:
+        req.add_header(
+            "Authorization", "Basic " + base64.b64encode(auth.encode()).decode()
+        )
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return resp.status, resp.read()
+
+
+def test_contiv_route_proxies_to_agent(backend):
+    status, body = get(backend, "/api/contiv/node1/contiv/v1/ipam")
+    assert status == 200
+    assert json.loads(body) == {"path": "/contiv/v1/ipam"}
+
+
+def test_contiv_route_forwards_query_string(backend):
+    status, body = get(backend, "/api/contiv/node1/scheduler/dump?prefix=/foo")
+    assert status == 200
+    assert json.loads(body) == {"path": "/scheduler/dump?prefix=/foo"}
+
+
+def test_unknown_node_404(backend):
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        get(backend, "/api/contiv/ghost/contiv/v1/ipam")
+    assert exc.value.code == 404
+
+
+def test_nodes_directory(backend):
+    status, body = get(backend, "/api/nodes-directory")
+    assert status == 200
+    assert json.loads(body) == ["node1"]
+
+
+def test_netctl_route(backend):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{backend.port}/api/netctl",
+        data=json.dumps({"args": ["nodes"]}).encode(),
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        out = json.loads(resp.read())
+    assert out == {"exit_code": 0, "output": "ran: nodes"}
+
+
+def test_dashboard_served(backend):
+    status, body = get(backend, "/")
+    assert status == 200
+    assert b"vpp-tpu cluster" in body
+
+
+def test_static_path_traversal_blocked(backend):
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        get(backend, "/../proxy.py")
+    assert exc.value.code == 404
+
+
+def test_basic_auth(agent):
+    directory = {"node1": f"127.0.0.1:{agent.port}"}
+    b = UIBackend(node_directory=directory.get, basic_auth={"admin": "pw"})
+    b.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            get(b, "/")
+        assert exc.value.code == 401
+        assert exc.value.headers.get("WWW-Authenticate", "").startswith("Basic")
+
+        status, _ = get(b, "/", auth="admin:pw")
+        assert status == 200
+
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            get(b, "/", auth="admin:wrong")
+        assert exc.value.code == 401
+    finally:
+        b.stop()
+
+
+def test_k8s_route_unconfigured_502(backend):
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        get(backend, "/api/k8s/api/v1/pods")
+    assert exc.value.code == 502
+
+
+def test_k8s_route_proxies_with_token(agent):
+    b = UIBackend(
+        node_directory=lambda n: None,
+        k8s_base_url=f"http://127.0.0.1:{agent.port}",
+        k8s_token="sekret",
+    )
+    b.start()
+    try:
+        status, body = get(b, "/api/k8s/api/v1/pods")
+        assert status == 200
+        assert json.loads(body) == {"path": "/api/v1/pods"}
+    finally:
+        b.stop()

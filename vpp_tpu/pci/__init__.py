@@ -1,0 +1,3 @@
+from .pci import DeviceInfo, device_info, driver_bind, driver_unbind
+
+__all__ = ["DeviceInfo", "device_info", "driver_bind", "driver_unbind"]

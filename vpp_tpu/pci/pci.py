@@ -1,0 +1,108 @@
+"""sysfs PCI driver bind/unbind.
+
+Analog of ``pkg/pci/pci.go`` (DriverBind :40, DriverUnbind :96): moves
+a NIC between kernel drivers through the sysfs PCI interface, used by
+the bootstrap path to hand the uplink to a kernel-bypass driver before
+the batch shim takes it over (the reference binds vmxnet3 uplinks to
+vfio-pci before giving them to DPDK, cmd/contiv-init/main.go:359).
+
+The sysfs root is injectable so tests (and containerised agents with
+an alternate /sys mount) can point elsewhere.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+SYS_BUS_PCI = "/sys/bus/pci"
+
+
+class PCIError(RuntimeError):
+    pass
+
+
+@dataclass(frozen=True)
+class DeviceInfo:
+    """Identity of one PCI device."""
+
+    address: str        # e.g. "0000:00:08.0"
+    vendor_id: int
+    device_id: int
+    driver: Optional[str]  # currently bound driver, if any
+
+
+def _read(path: Path) -> str:
+    try:
+        return path.read_text().strip()
+    except OSError as exc:
+        raise PCIError(f"error reading {path}: {exc}") from exc
+
+
+def _write(path: Path, content: str) -> None:
+    log.debug("writing %r into %s", content, path)
+    try:
+        with open(path, "w") as f:
+            f.write(content)
+    except OSError as exc:
+        raise PCIError(f"error writing to {path}: {exc}") from exc
+
+
+def device_info(pci_addr: str, sys_bus_pci: str = SYS_BUS_PCI) -> DeviceInfo:
+    """Read a device's vendor/device IDs and current driver binding."""
+    dev = Path(sys_bus_pci) / "devices" / pci_addr
+    vendor = int(_read(dev / "vendor"), 16)
+    device = int(_read(dev / "device"), 16)
+    driver_link = dev / "driver"
+    driver = None
+    if driver_link.exists():
+        driver = os.path.basename(os.path.realpath(driver_link))
+    return DeviceInfo(address=pci_addr, vendor_id=vendor, device_id=device, driver=driver)
+
+
+def driver_unbind(pci_addr: str, sys_bus_pci: str = SYS_BUS_PCI) -> None:
+    """Unbind the device from its current driver (DriverUnbind :96)."""
+    log.info("unbinding %s from its current driver", pci_addr)
+    unbind = Path(sys_bus_pci) / "devices" / pci_addr / "driver" / "unbind"
+    _write(unbind, pci_addr)
+
+
+def driver_bind(pci_addr: str, driver: str, sys_bus_pci: str = SYS_BUS_PCI) -> None:
+    """Bind the device to ``driver`` (DriverBind :40).
+
+    Mirrors the reference's tolerances: binding to the already-bound
+    driver is a no-op; a failed unbind is ignored (the device may be
+    unbound already); new_id/bind write failures are non-fatal (some
+    kernels report an error even when the bind takes effect).
+    """
+    root = Path(sys_bus_pci)
+    driver_dir = root / "drivers" / driver
+    if not driver_dir.exists():
+        raise PCIError(f"{driver} driver is not loaded")
+
+    if (driver_dir / pci_addr).exists():
+        log.info("%s already bound to driver %s", pci_addr, driver)
+        return
+
+    try:
+        driver_unbind(pci_addr, sys_bus_pci)
+    except PCIError:
+        pass  # may not be bound to anything
+
+    log.info("binding %s to driver %s", pci_addr, driver)
+    info = device_info(pci_addr, sys_bus_pci)
+
+    # Teach the driver the (vendor, device) pair, then bind explicitly.
+    try:
+        _write(driver_dir / "new_id", f"{info.vendor_id:4x} {info.device_id:4x}")
+    except PCIError as exc:
+        log.warning("(non-fatal) %s", exc)
+    try:
+        _write(driver_dir / "bind", pci_addr)
+    except PCIError as exc:
+        log.warning("(non-fatal) %s", exc)

@@ -114,14 +114,18 @@ def compare_rules(a: ContivRule, b: ContivRule) -> int:
 _RULE_KEY = functools.cmp_to_key(compare_rules)
 
 
-def insert_rule_ordered(rules: List[ContivRule], rule: ContivRule) -> bool:
-    """Insert preserving the total order; duplicates are dropped
-    (ContivRuleTable.InsertRule)."""
-    if rule in rules:
-        return False
-    rules.append(rule)
-    rules.sort(key=_RULE_KEY)
-    return True
+def finalize_table(rules: List[ContivRule]) -> Tuple[ContivRule, ...]:
+    """Dedup (first occurrence wins) and order by the rule total order —
+    the collect-then-sort equivalent of the reference's per-insert
+    ordered ContivRuleTable.InsertRule."""
+    seen = set()
+    out = []
+    for rule in rules:
+        if rule not in seen:
+            seen.add(rule)
+            out.append(rule)
+    out.sort(key=_RULE_KEY)
+    return tuple(out)
 
 
 # ----------------------------------------------------------------- port sets
@@ -316,7 +320,7 @@ class CacheTxn:
         rules: List[ContivRule] = []
         own = cfg.egress if self.cache.orientation == Orientation.EGRESS else cfg.ingress
         for rule in own:
-            insert_rule_ordered(rules, rule)
+            rules.append(rule)
 
         for src_pod in self.get_all_pods():
             src_cfg = self.get_pod_config(src_pod)
@@ -332,8 +336,8 @@ class CacheTxn:
             and r.dst_network is None
             for r in rules
         ):
-            insert_rule_ordered(rules, _ALLOW_ALL)
-        return tuple(rules)
+            rules.append(_ALLOW_ALL)
+        return finalize_table(rules)
 
     def _install_local_rules(
         self, rules: List[ContivRule], dst_cfg: PodConfig, src_cfg: PodConfig
@@ -388,7 +392,7 @@ class CacheTxn:
                 src_network=src_ip if egress_o else None,
                 dst_network=None if egress_o else src_ip,
             )
-            insert_rule_ordered(rules, deny)
+            rules.append(deny)
 
     def _install_allowed_ports(
         self,
@@ -400,26 +404,24 @@ class CacheTxn:
         """cache_impl.go installAllowedPorts :590."""
         egress_o = self.cache.orientation == Orientation.EGRESS
         if ANY_PORT in allowed:
-            insert_rule_ordered(
-                rules,
+            rules.append(
                 ContivRule(
                     action=Action.PERMIT,
                     src_network=src_ip if egress_o else None,
                     dst_network=None if egress_o else src_ip,
                     protocol=protocol,
-                ),
+                )
             )
             return
         for port in allowed:
-            insert_rule_ordered(
-                rules,
+            rules.append(
                 ContivRule(
                     action=Action.PERMIT,
                     src_network=src_ip if egress_o else None,
                     dst_network=None if egress_o else src_ip,
                     protocol=protocol,
                     dst_port=port,
-                ),
+                )
             )
 
     def _rebuild_global_table(self) -> Tuple[ContivRule, ...]:
@@ -450,10 +452,10 @@ class CacheTxn:
                         src_port=rule.src_port,
                         dst_port=rule.dst_port,
                     )
-                insert_rule_ordered(rules, narrowed)
+                rules.append(narrowed)
         if rules:
-            insert_rule_ordered(rules, _ALLOW_ALL)
-        return tuple(rules)
+            rules.append(_ALLOW_ALL)
+        return finalize_table(rules)
 
     # ----------------------------------------------------------------- commit
 

@@ -152,8 +152,18 @@ def export_session_rules(
     is_global = scope == SCOPE_GLOBAL
     for rule in rules:
         all_net = rule.src_network if is_global else rule.dst_network
-        if rule.dst_port == 0 and rule.action is not Action.DENY and all_net is None:
+        if (
+            rule.dst_port == 0
+            and rule.action is not Action.DENY
+            and all_net is None
+            and rule.protocol is ProtocolType.ANY
+        ):
             # Allow-all destination: the stack's default, don't install.
+            # (Restricted to ANY-protocol rules: a protocol-specific
+            # permit-all must be installed, or a sibling deny-all's
+            # split rules would over-block that protocol.  The
+            # reference skips those too but leans on the session
+            # layer's specificity matching; first-match needs them.)
             continue
         if (
             not is_global

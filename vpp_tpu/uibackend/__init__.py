@@ -1,0 +1,3 @@
+from .proxy import UIBackend
+
+__all__ = ["UIBackend"]

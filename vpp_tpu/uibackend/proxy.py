@@ -1,0 +1,247 @@
+"""UI backend — authenticated reverse proxy for the web dashboard.
+
+Analog of ``cmd/contiv-ui-backend/main.go`` (329 LoC): a single
+entry point the browser UI talks to, with basic auth and three proxied
+route families (k8sHandler :118, contivHandler :149, netctlHandler
+:192):
+
+- ``/api/k8s/<path>``          -> the K8s API server (bearer token
+                                  appended, like the service-account
+                                  token mount);
+- ``/api/contiv/<node>/<path>``-> the named node agent's REST API
+                                  (AgentRestServer), resolved through
+                                  an injectable node directory;
+- ``/api/netctl``              -> POST {"args": [...]} executes a
+                                  netctl command and returns its
+                                  output (the reference shells out to
+                                  the netctl binary via the CRD pod);
+- ``/`` and ``/static/...``    -> the bundled dashboard
+                                  (vpp_tpu/uibackend/static/), the
+                                  Angular-SPA replacement.
+
+Auth follows the reference: an empty credential map disables basic
+auth (Config.IsBasicAuthOK :93).  TLS is delegated to the deployment
+(terminate in front, e.g. k8s ingress) rather than in-process.
+"""
+
+from __future__ import annotations
+
+import base64
+import hmac
+import json
+import logging
+import os
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Callable, Dict, Optional
+
+log = logging.getLogger(__name__)
+
+_STATIC_DIR = Path(__file__).parent / "static"
+_CONTENT_TYPES = {
+    ".html": "text/html; charset=utf-8",
+    ".js": "application/javascript",
+    ".css": "text/css",
+    ".svg": "image/svg+xml",
+}
+
+
+class UIBackend:
+    """The proxy server.
+
+    ``node_directory`` maps node name -> "host:port" of its agent REST
+    server; ``k8s_base_url``/``k8s_token`` configure the K8s API proxy;
+    ``netctl_runner(args) -> (exit_code, output)`` executes netctl
+    commands (defaults to the in-process netctl CLI).
+    """
+
+    def __init__(
+        self,
+        node_directory: Callable[[str], Optional[str]],
+        list_nodes: Optional[Callable[[], list]] = None,
+        k8s_base_url: str = "",
+        k8s_token: str = "",
+        basic_auth: Optional[Dict[str, str]] = None,
+        netctl_runner: Optional[Callable[[list], tuple]] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.node_directory = node_directory
+        self.list_nodes = list_nodes
+        self.k8s_base_url = k8s_base_url.rstrip("/")
+        self.k8s_token = k8s_token
+        self.basic_auth = basic_auth or {}
+        self.netctl_runner = netctl_runner or self._run_netctl
+        self.host = host
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ----------------------------------------------------------------- auth
+
+    def check_auth(self, header: Optional[str]) -> bool:
+        """Empty credential map = auth disabled (main.go :93-96)."""
+        if not self.basic_auth:
+            return True
+        if not header or not header.startswith("Basic "):
+            return False
+        try:
+            user, _, pw = (
+                base64.b64decode(header[len("Basic "):]).decode().partition(":")
+            )
+        except Exception:
+            return False
+        return hmac.compare_digest(self.basic_auth.get(user, ""), pw)
+
+    # --------------------------------------------------------------- routes
+
+    @staticmethod
+    def _run_netctl(args: list) -> tuple:
+        import contextlib
+        import io
+
+        from ..netctl.cli import main as netctl_main
+
+        out = io.StringIO()
+        try:
+            with contextlib.redirect_stderr(out):
+                code = netctl_main([str(a) for a in args], out=out)
+        except SystemExit as exc:  # argparse error paths
+            code = int(exc.code or 0)
+        return code, out.getvalue()
+
+    def _proxy(
+        self,
+        url: str,
+        method: str,
+        body: Optional[bytes],
+        token: str = "",
+        content_type: str = "",
+    ):
+        req = urllib.request.Request(url, data=body, method=method)
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
+        if content_type:
+            req.add_header("Content-Type", content_type)
+        try:
+            with urllib.request.urlopen(req, timeout=15) as resp:
+                return resp.status, resp.headers.get_content_type(), resp.read()
+        except urllib.error.HTTPError as exc:
+            return exc.code, "text/plain", exc.read()
+        except OSError as exc:
+            return 502, "text/plain", str(exc).encode()
+
+    def handle(
+        self,
+        path: str,
+        method: str,
+        body: Optional[bytes],
+        auth_header,
+        query: str = "",
+        content_type: str = "",
+    ):
+        """Route one request; returns (status, content_type, payload)."""
+        if not self.check_auth(auth_header):
+            return 401, "text/plain", b"Unauthorized."
+
+        suffix = f"?{query}" if query else ""
+        if path.startswith("/api/k8s/"):
+            if not self.k8s_base_url:
+                return 502, "text/plain", b"k8s API proxy not configured"
+            target = f"{self.k8s_base_url}/{path[len('/api/k8s/'):]}{suffix}"
+            return self._proxy(target, method, body, self.k8s_token, content_type)
+
+        if path.startswith("/api/contiv/"):
+            rest = path[len("/api/contiv/"):]
+            node, _, agent_path = rest.partition("/")
+            server = self.node_directory(node)
+            if server is None:
+                return 404, "text/plain", f"unknown node {node!r}".encode()
+            return self._proxy(
+                f"http://{server}/{agent_path}{suffix}", method, body,
+                content_type=content_type,
+            )
+
+        if path == "/api/nodes-directory":
+            names = sorted(self.list_nodes()) if self.list_nodes else []
+            return 200, "application/json", json.dumps(names).encode()
+
+        if path == "/api/netctl":
+            if method != "POST":
+                return 405, "text/plain", b"POST {\"args\": [...]}"
+            try:
+                args = json.loads(body or b"{}").get("args", [])
+            except json.JSONDecodeError:
+                return 400, "text/plain", b"invalid JSON"
+            code, output = self.netctl_runner(args)
+            payload = json.dumps({"exit_code": code, "output": output}).encode()
+            return 200, "application/json", payload
+
+        return self._serve_static(path)
+
+    def _serve_static(self, path: str):
+        name = "index.html" if path in ("", "/") else path.lstrip("/")
+        target = (_STATIC_DIR / name).resolve()
+        static_root = _STATIC_DIR.resolve()
+        if not (target == static_root or str(target).startswith(str(static_root) + os.sep)) or not target.is_file():
+            return 404, "text/plain", b"not found"
+        ctype = _CONTENT_TYPES.get(target.suffix, "application/octet-stream")
+        return 200, ctype, target.read_bytes()
+
+    # --------------------------------------------------------------- server
+
+    def start(self) -> int:
+        backend = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _dispatch(self, method):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else None
+                path, _, query = self.path.partition("?")
+                status, ctype, payload = backend.handle(
+                    path,
+                    method,
+                    body,
+                    self.headers.get("Authorization"),
+                    query=query,
+                    content_type=self.headers.get("Content-Type") or "",
+                )
+                self.send_response(status)
+                if status == 401:
+                    self.send_header(
+                        "WWW-Authenticate", "Basic realm=vpp-tpu-ui"
+                    )
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def log_message(self, fmt, *args):
+                log.debug("ui-backend: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="ui-backend", daemon=True
+        )
+        self._thread.start()
+        log.info("ui-backend listening on %s:%d", self.host, self.port)
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
