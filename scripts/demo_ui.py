@@ -14,8 +14,7 @@ from vpp_tpu.controller.dbwatcher import DBWatcher
 from vpp_tpu.controller.eventloop import Controller
 from vpp_tpu.ipv4net import IPv4Net
 from vpp_tpu.kvstore import KVStore
-from vpp_tpu.models import VppNode
-from vpp_tpu.models.registry import NODESYNC_PREFIX
+from vpp_tpu.models import VppNode, key_for
 from vpp_tpu.nodesync import NodeSync
 from vpp_tpu.podmanager import PodManager
 from vpp_tpu.rest import AgentRestServer
@@ -48,10 +47,8 @@ def main():
     # A couple of local pods and one remote node for the topology view.
     podmanager.add_pod(name="web-1", container_id="c1")
     podmanager.add_pod(name="db-1", container_id="c2")
-    store.put(
-        f"{NODESYNC_PREFIX}node-2",
-        VppNode(id=2, name="node-2", ip_addresses=["192.168.16.2"]),
-    )
+    remote = VppNode(id=2, name="node-2", ip_addresses=["192.168.16.2"])
+    store.put(key_for(remote), remote)
 
     rest = AgentRestServer(
         node_name="node-1",

@@ -131,8 +131,26 @@ def test_basic_auth(agent):
         with pytest.raises(urllib.error.HTTPError) as exc:
             get(b, "/", auth="admin:wrong")
         assert exc.value.code == 401
+
+        # Unknown user with an empty password must NOT authenticate.
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            get(b, "/", auth="ghost:")
+        assert exc.value.code == 401
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            get(b, "/", auth="ghost")
+        assert exc.value.code == 401
     finally:
         b.stop()
+
+
+def test_netctl_malformed_body_400(backend):
+    for bad in (b"[1,2]", b'"x"', b'{"args": "nodes"}'):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{backend.port}/api/netctl", data=bad, method="POST"
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=5)
+        assert exc.value.code == 400
 
 
 def test_k8s_route_unconfigured_502(backend):

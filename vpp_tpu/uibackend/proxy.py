@@ -94,7 +94,13 @@ class UIBackend:
             )
         except Exception:
             return False
-        return hmac.compare_digest(self.basic_auth.get(user, ""), pw)
+        expected = self.basic_auth.get(user)
+        if expected is None:
+            # Burn comparable time for unknown users; never authenticate
+            # them (an empty-string fallback would let "ghost:" in).
+            hmac.compare_digest(pw, pw)
+            return False
+        return hmac.compare_digest(expected, pw)
 
     # --------------------------------------------------------------- routes
 
@@ -173,9 +179,14 @@ class UIBackend:
             if method != "POST":
                 return 405, "text/plain", b"POST {\"args\": [...]}"
             try:
-                args = json.loads(body or b"{}").get("args", [])
+                payload_in = json.loads(body or b"{}")
             except json.JSONDecodeError:
                 return 400, "text/plain", b"invalid JSON"
+            if not isinstance(payload_in, dict) or not isinstance(
+                payload_in.get("args", []), list
+            ):
+                return 400, "text/plain", b'expected {"args": [...]}'
+            args = payload_in.get("args", [])
             code, output = self.netctl_runner(args)
             payload = json.dumps({"exit_code": code, "output": output}).encode()
             return 200, "application/json", payload
@@ -224,6 +235,15 @@ class UIBackend:
 
             def do_POST(self):
                 self._dispatch("POST")
+
+            def do_PUT(self):
+                self._dispatch("PUT")
+
+            def do_PATCH(self):
+                self._dispatch("PATCH")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
 
             def log_message(self, fmt, *args):
                 log.debug("ui-backend: " + fmt, *args)
