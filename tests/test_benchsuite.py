@@ -1,0 +1,39 @@
+"""Bench-harness smoke tests (CPU): state builders produce valid
+pipeline inputs at small scale; the measurement loop itself runs on
+real hardware via ``python benchsuite.py``."""
+
+import jax.numpy as jnp
+
+import benchsuite
+import bench
+from vpp_tpu.ops.nat import NatMapping, empty_sessions
+from vpp_tpu.ops.packets import make_batch
+from vpp_tpu.ops.pipeline import pipeline_step
+
+
+def test_base_state_no_rules():
+    ipam, pod_ips, acl, nat, route = benchsuite._base_state()
+    res = pipeline_step(
+        acl, nat, route, empty_sessions(64),
+        make_batch([(pod_ips[0], pod_ips[1], 6, 1234, 5201)]), jnp.int32(0),
+    )
+    assert bool(res.allowed[0])
+
+
+def test_base_state_with_mapping():
+    mapping = NatMapping("10.96.0.10", 80, 6, [("10.1.1.2", 8080, 1)])
+    ipam, pod_ips, acl, nat, route = benchsuite._base_state(mappings=[mapping])
+    res = pipeline_step(
+        acl, nat, route, empty_sessions(64),
+        make_batch([(pod_ips[1], "10.96.0.10", 6, 1234, 80)]), jnp.int32(0),
+    )
+    assert bool(res.dnat_hit[0]) and bool(res.allowed[0])
+
+
+def test_stress_state_small():
+    acl, nat, route, sessions, pod_ips, mappings = bench.build_stress_state(
+        n_rules=64, n_services=8, n_pods=4
+    )
+    batch = bench.build_traffic(pod_ips, mappings, 32)
+    res = pipeline_step(acl, nat, route, empty_sessions(256), batch, jnp.int32(0))
+    assert res.allowed.shape == (32,)
