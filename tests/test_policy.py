@@ -406,3 +406,21 @@ def test_pod_label_change_reprocesses():
     old = plugin.cache.update_pod(relabeled)
     plugin.processor.on_pod_change(old, relabeled)
     assert eng.connection_pod_to_pod(relabeled.id, WEB.id) is ALLOWED
+
+
+def test_allowed_ports_ignores_other_protocol():
+    """An OTHER-protocol PERMIT must not wildcard the port intersection
+    (reference cache/ports.go getAllowed*Ports has no case for OTHER)."""
+    from vpp_tpu.policy.renderer.cache import allowed_ingress_ports
+    from vpp_tpu.policy.renderer.api import ContivRule
+
+    ip = ipaddress.ip_network("10.1.1.2/32")
+    rules = (
+        ContivRule(action=Action.PERMIT, protocol=ProtocolType.TCP, dst_port=80),
+        ContivRule(action=Action.PERMIT, protocol=ProtocolType.OTHER),
+        ContivRule(action=Action.DENY),
+    )
+    tcp, udp, any_proto = allowed_ingress_ports(ip, rules)
+    assert tcp == {80}
+    assert udp == set()
+    assert not any_proto

@@ -143,6 +143,22 @@ def test_basic_auth(agent):
         b.stop()
 
 
+def test_basic_auth_non_ascii_password(agent):
+    # A non-ASCII password must yield a clean 401/200, not a crashed
+    # handler thread (compare_digest on str raises for non-ASCII).
+    directory = {"node1": f"127.0.0.1:{agent.port}"}
+    b = UIBackend(node_directory=directory.get, basic_auth={"admin": "pässwörd"})
+    b.start()
+    try:
+        status, _ = get(b, "/", auth="admin:pässwörd")
+        assert status == 200
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            get(b, "/", auth="admin:wröng")
+        assert exc.value.code == 401
+    finally:
+        b.stop()
+
+
 def test_netctl_malformed_body_400(backend):
     for bad in (b"[1,2]", b'"x"', b'{"args": "nodes"}'):
         req = urllib.request.Request(

@@ -174,10 +174,13 @@ def _allowed_ports(
             tcp.add(rule.dst_port)
         elif rule.protocol is ProtocolType.UDP:
             udp.add(rule.dst_port)
-        else:
+        elif rule.protocol is ProtocolType.ANY:
             tcp.add(ANY_PORT)
             udp.add(ANY_PORT)
             any_proto = True
+        # OTHER-protocol permits are ignored, matching the reference's
+        # getAllowed*Ports switch (cache/ports.go), which has no case for
+        # them — they must not wildcard the port intersection.
     if not has_deny:
         return {ANY_PORT}, {ANY_PORT}, True
     return tcp, udp, any_proto

@@ -221,8 +221,16 @@ class SimCluster:
         if not bool(res.allowed[0]):
             return False
         if int(res.route[0]) == ROUTE_REMOTE:
+            # Re-evaluate with the tuple the wire would carry: the source
+            # node's pipeline may have NAT-rewritten the packet (service
+            # DNAT/SNAT), and the destination node judges what arrives.
+            wire_flow = (
+                int(res.batch.src_ip[0]), int(res.batch.dst_ip[0]),
+                int(res.batch.protocol[0]),
+                int(res.batch.src_port[0]), int(res.batch.dst_port[0]),
+            )
             dst_node = self.nodes[self._pod_nodes[dst_id]]
-            res2 = dst_node.send([flow])
+            res2 = dst_node.send([wire_flow])
             return bool(res2.allowed[0])
         return True
 

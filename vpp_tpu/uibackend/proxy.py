@@ -98,9 +98,11 @@ class UIBackend:
         if expected is None:
             # Burn comparable time for unknown users; never authenticate
             # them (an empty-string fallback would let "ghost:" in).
-            hmac.compare_digest(pw, pw)
+            hmac.compare_digest(pw.encode(), pw.encode())
             return False
-        return hmac.compare_digest(expected, pw)
+        # Compare UTF-8 bytes: compare_digest on str raises TypeError for
+        # non-ASCII input, which would crash the handler thread.
+        return hmac.compare_digest(expected.encode(), pw.encode())
 
     # --------------------------------------------------------------- routes
 
