@@ -211,3 +211,217 @@ def test_randomized_oracle_parity(seed):
             assert int(got.dst_ip[i]) == expected.flow.dst_ip, label
             assert int(got.src_port[i]) == expected.flow.src_port, label
             assert int(got.dst_port[i]) == expected.flow.dst_port, label
+
+
+# ---------------------------------------------------------------------------
+# Session-table collision adversaries (round-2 VERDICT item 3): W-way
+# probing must never misroute replies — overflow/ambiguous flows punt to
+# the host slow path instead of evicting or aliasing live sessions.
+# ---------------------------------------------------------------------------
+
+from vpp_tpu.ops.nat import PROBE_WAYS, flow_hash, session_occupancy  # noqa: E402
+from vpp_tpu.ops.slowpath import HostSlowPath  # noqa: E402
+from vpp_tpu.testing.natengine import flow_hash_py  # noqa: E402
+
+
+def _batch_dicts(batch):
+    return {
+        "src_ip": np.asarray(batch.src_ip), "dst_ip": np.asarray(batch.dst_ip),
+        "protocol": np.asarray(batch.protocol),
+        "src_port": np.asarray(batch.src_port), "dst_port": np.asarray(batch.dst_port),
+    }
+
+
+def _colliding_dnat_flows(n, cap, backend="10.1.1.2"):
+    """Find n client flows to the VIP whose *reply keys* share one base
+    slot of a cap-entry table (reply = backend -> client)."""
+    b_ip = ip_to_u32(backend)
+    target = None
+    found = []
+    client = ip_to_u32("10.1.7.1")
+    port = 1025
+    while len(found) < n:
+        rk = (b_ip, client, 6, 8080, port)
+        slot = flow_hash_py(*rk) & (cap - 1)
+        if target is None:
+            target = slot
+            found.append((client, port))
+        elif slot == target:
+            found.append((client, port))
+        port += 1
+        if port >= 65535:
+            port = 1025
+            client += 1
+    return found
+
+
+def test_colliding_sessions_punt_instead_of_evict():
+    cap = 1024
+    tables = simple_tables(backends=[("10.1.1.2", 8080, 1)])
+    flows = _colliding_dnat_flows(PROBE_WAYS + 2, cap)
+    batch_flows = [(u32_to_ip(c), CLUSTER_IP, 6, p, 80) for c, p in flows]
+    res = run_nat(tables, empty_sessions(cap), batch_flows)
+    assert bool(res.dnat_hit.all())
+    punts = int(np.asarray(res.punt).sum())
+    # The bucket holds at most PROBE_WAYS sessions; every flow either
+    # owns a device slot or was punted — nothing is silently evicted.
+    assert punts >= 2
+    assert session_occupancy(res.sessions) == len(batch_flows) - punts
+    assert session_occupancy(res.sessions) <= PROBE_WAYS
+
+    # Every non-punted flow's reply restores exactly; punted flows go
+    # through the host slow path — ZERO misrouted replies.
+    slow = HostSlowPath()
+    outcome = slow.record_punts(
+        _batch_dicts(make_batch(batch_flows)), _batch_dicts(res.batch),
+        np.asarray(res.punt), np.asarray(res.snat_hit), timestamp=0,
+    )
+    # DNAT punts need no port rewrites and stay forwardable.
+    assert outcome.fixups == [] and outcome.drops == []
+    reply_flows = [("10.1.1.2", u32_to_ip(c), 6, 8080, p) for c, p in flows]
+    rep = run_nat(tables, res.sessions, reply_flows, ts=1)
+    rep_np = _batch_dicts(rep.batch)
+    device_hits = np.asarray(rep.reply_hit)
+    restored = slow.restore_replies(
+        _batch_dicts(make_batch(reply_flows)), ~device_hits, timestamp=1
+    )
+    assert len(restored) == punts
+    host_rows = {i for i, _ in restored}
+    for i, (client, port) in enumerate(flows):
+        if i in host_rows:
+            fix = dict(restored)[i]
+            src_ip, src_port, dst_ip, dst_port = fix
+        else:
+            assert bool(device_hits[i]), f"flow {i} restored nowhere"
+            src_ip, src_port = int(rep_np["src_ip"][i]), int(rep_np["src_port"][i])
+            dst_ip, dst_port = int(rep_np["dst_ip"][i]), int(rep_np["dst_port"][i])
+        assert src_ip == ip_to_u32(CLUSTER_IP) and src_port == 80
+        assert dst_ip == client and dst_port == port, f"flow {i} misrouted"
+
+
+def _colliding_snat_pair():
+    """Two distinct pod flows to the same remote endpoint whose
+    hash-allocated SNAT ports collide (identical reply keys)."""
+    dst = ip_to_u32("93.184.216.34")
+    base_src = ip_to_u32("10.1.3.1")
+    seen = {}
+    sport = 1025
+    src = base_src
+    while True:
+        h = flow_hash_py(src, dst, 6, sport, 443)
+        port = (h % 32768) + 32768
+        if port in seen and seen[port] != (src, sport):
+            return seen[port], (src, sport), port
+        seen[port] = (src, sport)
+        sport += 1
+        if sport >= 65535:
+            sport = 1025
+            src += 1
+
+
+def test_snat_port_collision_detected_and_reallocated():
+    tables = simple_tables()
+    (s1, p1), (s2, p2), snat_port = _colliding_snat_pair()
+    flows = [
+        (u32_to_ip(s1), "93.184.216.34", 6, p1, 443),
+        (u32_to_ip(s2), "93.184.216.34", 6, p2, 443),
+    ]
+    res = run_nat(tables, empty_sessions(1 << 14), flows)
+    assert bool(res.snat_hit.all())
+    # Both hash to the same external port -> identical reply keys; the
+    # second insert must punt, never alias.
+    assert int(np.asarray(res.punt).sum()) == 1
+    assert int(res.batch.src_port[0]) == int(res.batch.src_port[1]) == snat_port
+
+    slow = HostSlowPath()
+    outcome = slow.record_punts(
+        _batch_dicts(make_batch(flows)), _batch_dicts(res.batch),
+        np.asarray(res.punt), np.asarray(res.snat_hit), timestamp=0,
+    )
+    assert len(outcome.fixups) == 1 and outcome.drops == []
+    row, new_port = outcome.fixups[0]
+    assert bool(res.punt[row])
+    assert new_port != snat_port  # moved off the collided port
+
+    # Replies to BOTH external ports now restore unambiguously.
+    kept_row = 1 - row
+    kept_flow = flows[kept_row]
+    rep_dev = run_nat(
+        tables, res.sessions,
+        [("93.184.216.34", "192.168.16.1", 6, 443, snat_port)], ts=1,
+    )
+    assert bool(rep_dev.reply_hit[0])
+    assert int(rep_dev.batch.dst_ip[0]) == ip_to_u32(kept_flow[0])
+    assert int(rep_dev.batch.dst_port[0]) == kept_flow[3]
+
+    host_reply = {
+        "src_ip": np.array([ip_to_u32("93.184.216.34")], dtype=np.uint32),
+        "dst_ip": np.array([ip_to_u32("192.168.16.1")], dtype=np.uint32),
+        "protocol": np.array([6]), "src_port": np.array([443]),
+        "dst_port": np.array([new_port]),
+    }
+    restored = slow.restore_replies(host_reply, np.array([True]), timestamp=1)
+    assert len(restored) == 1
+    _, (rs_ip, rs_port, rd_ip, rd_port) = restored[0]
+    punted_flow = flows[row]
+    assert rd_ip == ip_to_u32(punted_flow[0]) and rd_port == punted_flow[3]
+    # SNAT reply restore keeps the remote endpoint as the source.
+    assert rs_ip == ip_to_u32("93.184.216.34") and rs_port == 443
+
+
+def test_intra_batch_slot_race_reports_loser():
+    cap = 1024
+    tables = simple_tables(backends=[("10.1.1.2", 8080, 1)])
+    flows = _colliding_dnat_flows(2, cap)
+    batch_flows = [(u32_to_ip(c), CLUSTER_IP, 6, p, 80) for c, p in flows]
+    # Same batch, same bucket: either the rotated way-preference spreads
+    # them onto distinct slots, or the loser is punted — never lost.
+    res = run_nat(tables, empty_sessions(cap), batch_flows)
+    punts = int(np.asarray(res.punt).sum())
+    assert session_occupancy(res.sessions) == 2 - punts
+
+
+def test_oracle_reports_punts_too():
+    from vpp_tpu.testing.natengine import Flow, MockNatEngine
+
+    cap = 1024
+    oracle = MockNatEngine(
+        nat_loopback="10.1.1.254", snat_ip="192.168.16.1", snat_enabled=True,
+        pod_subnet="10.1.0.0/16", session_capacity=cap,
+    )
+    oracle.set_mappings([NatMapping(CLUSTER_IP, 80, 6, [("10.1.1.2", 8080, 1)])])
+    flows = _colliding_dnat_flows(PROBE_WAYS + 1, cap)
+    results = [
+        oracle.process(Flow.make(u32_to_ip(c), CLUSTER_IP, 6, p, 80))
+        for c, p in flows
+    ]
+    assert [r.punt for r in results] == [False] * PROBE_WAYS + [True]
+
+
+def test_slowpath_capacity_drops_snat_but_forwards_dnat():
+    slow = HostSlowPath(max_sessions=0)
+    headers = {
+        "src_ip": np.array([1, 2], dtype=np.uint32),
+        "dst_ip": np.array([9, 9], dtype=np.uint32),
+        "protocol": np.array([6, 6]),
+        "src_port": np.array([1000, 1001]),
+        "dst_port": np.array([80, 443]),
+    }
+    rewritten = {
+        "src_ip": np.array([1, 7], dtype=np.uint32),
+        "dst_ip": np.array([5, 9], dtype=np.uint32),
+        "protocol": np.array([6, 6]),
+        "src_port": np.array([1000, 40000]),
+        "dst_port": np.array([8080, 443]),
+    }
+    outcome = slow.record_punts(
+        headers, rewritten, np.array([True, True]),
+        np.array([False, True]), timestamp=0,
+    )
+    # At capacity: the DNAT punt still forwards (just no fast restore);
+    # the SNAT punt must be dropped — no port fix-up was recorded, so
+    # transmitting would alias another flow's reply key.
+    assert outcome.fixups == []
+    assert outcome.drops == [1]
+    assert slow.counters.drops == 1
+    assert len(slow) == 0

@@ -18,9 +18,15 @@ jit-compiled rewrite over header batches:
 - **SNAT (in2out)**: pod traffic leaving the cluster is source-NATted
   to the node IP with a hash-allocated ephemeral port.
 - **sessions**: a device-resident open-addressed hash table keyed by
-  the *reply* flow 5-tuple; the forward pass scatters new sessions in,
-  the reply pass restores original addresses.  The host sweeps stale
-  entries by age (the reference's idle-session GC goroutine,
+  the *reply* flow 5-tuple with ``PROBE_WAYS``-way linear probing; the
+  forward pass scatters new sessions in, the reply pass restores
+  original addresses.  Insertion never evicts an established flow:
+  a full bucket or an ambiguous reply key (two distinct flows whose
+  translated reply tuples collide — the SNAT port-collision case)
+  raises the per-packet ``punt`` flag and the flow is handed to the
+  host slow path (:mod:`vpp_tpu.ops.slowpath`), mirroring how VPP
+  punts NAT misses to the slow path.  The host sweeps stale entries
+  by age (the reference's idle-session GC goroutine,
   nat44_renderer.go ~:691, becomes a host-side sweep of ``last_seen``).
 
 All state lives in device arrays; updates are functional (the caller
@@ -30,6 +36,7 @@ XLA program.
 
 from __future__ import annotations
 
+import dataclasses
 import ipaddress
 from dataclasses import dataclass
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
@@ -45,6 +52,12 @@ from .packets import PacketBatch, ip_to_u32
 TWICE_NAT_NONE = 0
 TWICE_NAT_SELF = 1
 TWICE_NAT_ENABLED = 2
+
+# Session-table probe width: each flow may live in any of the W
+# linearly-probed slots after its hash slot (VPP's bihash has 2-entry
+# buckets + overflow; W=4 keeps the gather cheap while making
+# same-batch evictions impossible until a bucket truly fills).
+PROBE_WAYS = 4
 
 
 @dataclass
@@ -270,7 +283,7 @@ class NatResult(NamedTuple):
     dnat_hit: jnp.ndarray     # bool [B] forward DNAT applied
     reply_hit: jnp.ndarray    # bool [B] reply restoration applied
     snat_hit: jnp.ndarray     # bool [B] egress SNAT applied
-    dropped: jnp.ndarray      # bool [B] (DNAT matched but no backend)
+    punt: jnp.ndarray         # bool [B] flow needs the host slow path
 
 
 class NatRewrite(NamedTuple):
@@ -280,7 +293,26 @@ class NatRewrite(NamedTuple):
     dnat_hit: jnp.ndarray
     reply_hit: jnp.ndarray
     snat_hit: jnp.ndarray
-    reply_slot: jnp.ndarray  # int32 [B] session slot of reply hits
+    reply_slot: jnp.ndarray  # int32 [B] resolved session slot of reply hits
+
+
+def _probe_slots(base: jnp.ndarray, cap: int) -> jnp.ndarray:
+    """[B, W] candidate slots: linear probe ring from the hash slot."""
+    return (base[:, None] + jnp.arange(PROBE_WAYS, dtype=jnp.int32)[None, :]) & jnp.int32(cap - 1)
+
+
+def _reply_key_match(
+    sessions: NatSessions, cand: jnp.ndarray, batch: PacketBatch
+) -> jnp.ndarray:
+    """[B, W] — does slot cand[b, w] hold batch row b's reply key?"""
+    return (
+        sessions.valid[cand]
+        & (sessions.r_src_ip[cand] == batch.src_ip[:, None])
+        & (sessions.r_dst_ip[cand] == batch.dst_ip[:, None])
+        & (sessions.r_proto[cand] == batch.protocol[:, None])
+        & (sessions.r_src_port[cand] == batch.src_port[:, None])
+        & (sessions.r_dst_port[cand] == batch.dst_port[:, None])
+    )
 
 
 def nat_rewrite(
@@ -300,17 +332,12 @@ def nat_rewrite(
 
     # ---------------------------------------------------- 1. reply restore
     rhash = flow_hash(batch.src_ip, batch.dst_ip, batch.protocol, batch.src_port, batch.dst_port)
-    slot = (rhash & slot_mask).astype(jnp.int32)
-    s_valid = sessions.valid[slot]
-    key_match = (
-        s_valid
-        & (sessions.r_src_ip[slot] == batch.src_ip)
-        & (sessions.r_dst_ip[slot] == batch.dst_ip)
-        & (sessions.r_proto[slot] == batch.protocol)
-        & (sessions.r_src_port[slot] == batch.src_port)
-        & (sessions.r_dst_port[slot] == batch.dst_port)
-    )
-    reply_hit = key_match
+    base = (rhash & slot_mask).astype(jnp.int32)
+    cand = _probe_slots(base, cap)                      # [B, W]
+    key_match = _reply_key_match(sessions, cand, batch)  # [B, W]
+    reply_hit = jnp.any(key_match, axis=1)
+    w = jnp.argmax(key_match, axis=1)
+    slot = jnp.take_along_axis(cand, w[:, None], axis=1)[:, 0]
     # Restore: src <- original dst (VIP), dst <- original src (client).
     src_ip1 = jnp.where(reply_hit, sessions.orig_dst_ip[slot], batch.src_ip)
     src_port1 = jnp.where(reply_hit, sessions.orig_dst_port[slot], batch.src_port)
@@ -386,51 +413,102 @@ def nat_commit_sessions(
     reply_hit: jnp.ndarray,
     reply_slot: jnp.ndarray,
     timestamp: jnp.ndarray,
-) -> NatSessions:
+) -> Tuple[NatSessions, jnp.ndarray]:
     """Scatter new sessions in and refresh reply keep-alives.
 
     ``record`` (bool [B]) marks flows allowed to create a session —
     the pipeline's (translated ∧ ACL-permitted) mask.  Sessions are
     keyed by the hash of the expected *reply* tuple (src=server,
-    dst=translated client).
+    dst=translated client) and inserted with W-way linear probing.
+
+    Returns ``(sessions, punt)`` — ``punt`` (bool [B]) marks flows
+    whose session could NOT be recorded and must go to the host slow
+    path: (a) the probe bucket is full (no eviction of live flows),
+    (b) another flow already owns the identical reply key (a SNAT
+    port collision — replies would be indistinguishable), or (c) the
+    flow lost an intra-batch scatter race for its slot.
     """
     cap = sessions.capacity
     slot_mask = jnp.uint32(cap - 1)
-    reply_key_hash = flow_hash(
-        rewritten.dst_ip, rewritten.src_ip, rewritten.protocol,
-        rewritten.dst_port, rewritten.src_port,
+    # The reply key as a PacketBatch view (src/dst swapped).
+    reply_view = PacketBatch(
+        src_ip=rewritten.dst_ip, dst_ip=rewritten.src_ip,
+        protocol=rewritten.protocol,
+        src_port=rewritten.dst_port, dst_port=rewritten.src_port,
     )
-    ins_slot = (reply_key_hash & slot_mask).astype(jnp.int32)
-    # Collision policy: newest flow wins the slot (the evicted flow's
-    # replies fall back to the host slow path); duplicate slots within a
-    # batch resolve to the last writer — same-flow packets write equal
-    # values so the race is benign.
+    rkh = flow_hash(
+        reply_view.src_ip, reply_view.dst_ip, reply_view.protocol,
+        reply_view.src_port, reply_view.dst_port,
+    )
+    base = (rkh & slot_mask).astype(jnp.int32)
+    cand = _probe_slots(base, cap)                           # [B, W]
+    same_key = _reply_key_match(sessions, cand, reply_view)  # [B, W]
+    same_orig = (
+        same_key
+        & (sessions.orig_src_ip[cand] == orig.src_ip[:, None])
+        & (sessions.orig_src_port[cand] == orig.src_port[:, None])
+        & (sessions.orig_dst_ip[cand] == orig.dst_ip[:, None])
+        & (sessions.orig_dst_port[cand] == orig.dst_port[:, None])
+    )
+    # Another live flow already owns this reply key -> ambiguous replies.
+    collision = jnp.any(same_key & ~same_orig, axis=1)
+    free = ~sessions.valid[cand]
+    has_same = jnp.any(same_orig, axis=1)
+    has_free = jnp.any(free, axis=1)
+    # Free-slot choice rotates per flow (hash bits above the slot mask):
+    # concurrent same-bucket inserters in ONE batch cannot see each
+    # other's scatter writes, so a shared "first free" would let only
+    # one win per batch — rotated preferences spread them across the W
+    # ways and up to W colliding flows insert in a single batch.
+    pref = ((rkh >> jnp.uint32(16)) % jnp.uint32(PROBE_WAYS)).astype(jnp.int32)
+    rank = (jnp.arange(PROBE_WAYS, dtype=jnp.int32)[None, :] - pref[:, None]) % PROBE_WAYS
+    free_rank = jnp.where(free, rank, PROBE_WAYS)
+    w_pick = jnp.where(
+        has_same, jnp.argmax(same_orig, axis=1), jnp.argmin(free_rank, axis=1)
+    )
+    ins_slot = jnp.take_along_axis(cand, w_pick[:, None], axis=1)[:, 0]
+    can_insert = record & (has_same | has_free) & ~collision
+
     drop_sentinel = jnp.int32(cap)  # out-of-range -> scatter drops the write
-    w = jnp.where(record, ins_slot, drop_sentinel)
-    sessions = NatSessions(
+    w = jnp.where(can_insert, ins_slot, drop_sentinel)
+    new_sessions = NatSessions(
         valid=sessions.valid.at[w].set(True, mode="drop"),
-        r_src_ip=sessions.r_src_ip.at[w].set(rewritten.dst_ip, mode="drop"),
-        r_dst_ip=sessions.r_dst_ip.at[w].set(rewritten.src_ip, mode="drop"),
-        r_proto=sessions.r_proto.at[w].set(rewritten.protocol, mode="drop"),
-        r_src_port=sessions.r_src_port.at[w].set(rewritten.dst_port, mode="drop"),
-        r_dst_port=sessions.r_dst_port.at[w].set(rewritten.src_port, mode="drop"),
+        r_src_ip=sessions.r_src_ip.at[w].set(reply_view.src_ip, mode="drop"),
+        r_dst_ip=sessions.r_dst_ip.at[w].set(reply_view.dst_ip, mode="drop"),
+        r_proto=sessions.r_proto.at[w].set(reply_view.protocol, mode="drop"),
+        r_src_port=sessions.r_src_port.at[w].set(reply_view.src_port, mode="drop"),
+        r_dst_port=sessions.r_dst_port.at[w].set(reply_view.dst_port, mode="drop"),
         orig_src_ip=sessions.orig_src_ip.at[w].set(orig.src_ip, mode="drop"),
         orig_src_port=sessions.orig_src_port.at[w].set(orig.src_port, mode="drop"),
         orig_dst_ip=sessions.orig_dst_ip.at[w].set(orig.dst_ip, mode="drop"),
         orig_dst_port=sessions.orig_dst_port.at[w].set(orig.dst_port, mode="drop"),
         last_seen=sessions.last_seen.at[w].set(timestamp, mode="drop"),
     )
+    # Post-write verify: two distinct flows in one batch can pick the
+    # same free slot; the scatter's last writer wins.  Re-read the slot
+    # and flag losers (their written-back orig differs) for the slow
+    # path instead of silently losing their session.
+    wrote = (
+        (new_sessions.r_src_ip[ins_slot] == reply_view.src_ip)
+        & (new_sessions.r_dst_ip[ins_slot] == reply_view.dst_ip)
+        & (new_sessions.r_proto[ins_slot] == reply_view.protocol)
+        & (new_sessions.r_src_port[ins_slot] == reply_view.src_port)
+        & (new_sessions.r_dst_port[ins_slot] == reply_view.dst_port)
+        & (new_sessions.orig_src_ip[ins_slot] == orig.src_ip)
+        & (new_sessions.orig_src_port[ins_slot] == orig.src_port)
+        & (new_sessions.orig_dst_ip[ins_slot] == orig.dst_ip)
+        & (new_sessions.orig_dst_port[ins_slot] == orig.dst_port)
+    )
+    punt = record & ~(can_insert & wrote)
+
     # Touch last_seen for reply hits too (keep-alive for the GC sweep).
     touch = jnp.where(reply_hit, reply_slot, drop_sentinel)
-    return NatSessions(
-        **{
-            **{f: getattr(sessions, f) for f in (
-                "valid", "r_src_ip", "r_dst_ip", "r_proto", "r_src_port",
-                "r_dst_port", "orig_src_ip", "orig_src_port", "orig_dst_ip",
-                "orig_dst_port",
-            )},
-            "last_seen": sessions.last_seen.at[touch].set(timestamp, mode="drop"),
-        }
+    return (
+        dataclasses.replace(
+            new_sessions,
+            last_seen=new_sessions.last_seen.at[touch].set(timestamp, mode="drop"),
+        ),
+        punt,
     )
 
 
@@ -453,7 +531,7 @@ def nat_step(
     record = rw.dnat_hit | rw.snat_hit
     if permit is not None:
         record = record & permit
-    new_sessions = nat_commit_sessions(
+    new_sessions, punt = nat_commit_sessions(
         sessions, batch, rw.batch, record, rw.reply_hit, rw.reply_slot, timestamp
     )
     return NatResult(
@@ -462,24 +540,20 @@ def nat_step(
         dnat_hit=rw.dnat_hit,
         reply_hit=rw.reply_hit,
         snat_hit=rw.snat_hit,
-        dropped=jnp.zeros_like(rw.dnat_hit),
+        punt=punt,
     )
 
 
 nat_step_jit = jax.jit(nat_step, donate_argnums=(1,))
 
 
+def session_occupancy(sessions: NatSessions) -> int:
+    """Live session count (for /metrics; host-side read)."""
+    return int(jnp.sum(sessions.valid))
+
+
 def sweep_sessions(sessions: NatSessions, now: int, max_age: int) -> NatSessions:
     """Host-side idle-session GC: invalidate entries not seen for
     ``max_age`` batches (the reference's cleanup goroutine analog)."""
     stale = sessions.valid & ((now - sessions.last_seen) > max_age)
-    return NatSessions(
-        **{
-            **{f: getattr(sessions, f) for f in (
-                "r_src_ip", "r_dst_ip", "r_proto", "r_src_port", "r_dst_port",
-                "orig_src_ip", "orig_src_port", "orig_dst_ip", "orig_dst_port",
-                "last_seen",
-            )},
-            "valid": sessions.valid & ~stale,
-        }
-    )
+    return dataclasses.replace(sessions, valid=sessions.valid & ~stale)

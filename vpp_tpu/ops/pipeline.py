@@ -96,6 +96,7 @@ class PipelineResult(NamedTuple):
     dnat_hit: jnp.ndarray   # bool [B]
     snat_hit: jnp.ndarray   # bool [B]
     reply_hit: jnp.ndarray  # bool [B]
+    punt: jnp.ndarray       # bool [B] flow needs the host slow path
 
 
 def pipeline_step(
@@ -126,7 +127,7 @@ def pipeline_step(
     # Commit sessions for translated AND permitted flows only: a denied
     # flow must never seed a session a crafted "reply" could ride.
     record = (rw.dnat_hit | rw.snat_hit) & allowed
-    new_sessions = nat_commit_sessions(
+    new_sessions, punt = nat_commit_sessions(
         sessions, batch, rewritten, record, rw.reply_hit, rw.reply_slot, timestamp
     )
 
@@ -155,6 +156,7 @@ def pipeline_step(
         dnat_hit=rw.dnat_hit,
         snat_hit=rw.snat_hit,
         reply_hit=rw.reply_hit,
+        punt=punt,
     )
 
 

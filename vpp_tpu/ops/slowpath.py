@@ -1,0 +1,254 @@
+"""Host slow path — exact sessions for flows the device table punts.
+
+The TPU session table (:mod:`vpp_tpu.ops.nat`) never evicts a live
+flow: a full probe bucket, an ambiguous reply key (SNAT port
+collision), or a lost intra-batch scatter race raises ``punt`` for
+that packet and the flow is handled here, in exact host-side Python —
+the analog of VPP's NAT slow path (nat44 in2out/out2in slowpath nodes
+handle session-table misses in C before fast-path entries exist).
+
+Responsibilities:
+
+- **record** punted forward flows so their replies can be restored
+  (the device has no session for them);
+- **re-allocate SNAT ports** for collided flows from a host-side
+  reservation set, returning fix-ups the datapath runner applies to
+  the outgoing frames;
+- **restore replies** that miss the device table but match a
+  host-recorded session;
+- expose punt/restore/occupancy counters for /metrics.
+
+The slow path only touches punted flows (rare by construction), so the
+dict-based implementation is never on the fast path; the runner skips
+the restore scan entirely while no host sessions exist.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+ReplyKey = Tuple[int, int, int, int, int]  # src_ip, dst_ip, proto, sport, dport
+Restore = Tuple[int, int, int, int]        # orig src_ip, src_port, dst_ip, dst_port
+
+
+@dataclass
+class SlowSession:
+    restore: Restore
+    last_seen: int
+    # For SNAT-collision flows: the host-reserved source port that
+    # replaces the hash-allocated one on every forward packet.
+    snat_port_override: Optional[int] = None
+    # Forward-direction key (pre-NAT) for flows needing port fix-ups.
+    fwd_key: Optional[ReplyKey] = None
+
+
+@dataclass
+class SlowPathCounters:
+    punts: int = 0
+    snat_reallocs: int = 0
+    restores: int = 0
+    expired: int = 0
+    drops: int = 0
+
+    def as_dict(self) -> Dict[str, int]:
+        return {
+            "slowpath_punts_total": self.punts,
+            "slowpath_snat_reallocs_total": self.snat_reallocs,
+            "slowpath_restores_total": self.restores,
+            "slowpath_expired_total": self.expired,
+            "slowpath_drops_total": self.drops,
+        }
+
+
+class PuntOutcome(NamedTuple):
+    """What the runner must do with this batch's punted rows."""
+
+    # (row, new_src_port): patch the frame's source port before TX.
+    fixups: List[Tuple[int, int]]
+    # Rows that must NOT be transmitted: sending them would misroute
+    # (their hash port aliases another flow and no substitute session
+    # could be recorded).
+    drops: List[int]
+
+
+class HostSlowPath:
+    """Exact host-side session table for punted flows."""
+
+    def __init__(self, max_sessions: int = 65536):
+        self.max_sessions = max_sessions
+        self.sessions: Dict[ReplyKey, SlowSession] = {}
+        # Forward-key -> reply-key index for flows with port overrides.
+        self._by_fwd: Dict[ReplyKey, ReplyKey] = {}
+        # Reserved (remote_ip, remote_port, proto, snat_ip, port) tuples.
+        self._reserved_ports: Dict[Tuple[int, int, int, int], int] = {}
+        self.counters = SlowPathCounters()
+
+    def __len__(self) -> int:
+        return len(self.sessions)
+
+    # ------------------------------------------------------------ recording
+
+    def record_punts(
+        self,
+        orig: Dict[str, np.ndarray],
+        rewritten: Dict[str, np.ndarray],
+        punt: np.ndarray,
+        snat_hit: np.ndarray,
+        timestamp: int,
+    ) -> PuntOutcome:
+        """Record sessions for punted rows of one batch.
+
+        ``orig`` / ``rewritten`` are SoA header dicts with keys
+        src_ip/dst_ip/protocol/src_port/dst_port (host numpy arrays).
+        Returns the fix-ups (SNAT port rewrites) and drops the runner
+        must apply before transmitting.
+        """
+        fixups: List[Tuple[int, int]] = []
+        drops: List[int] = []
+        rows = np.nonzero(punt)[0]
+        for i in rows.tolist():
+            self.counters.punts += 1
+            o = (int(orig["src_ip"][i]), int(orig["src_port"][i]),
+                 int(orig["dst_ip"][i]), int(orig["dst_port"][i]))
+            proto = int(orig["protocol"][i])
+            r_src = int(rewritten["dst_ip"][i])
+            r_sport = int(rewritten["dst_port"][i])
+            r_dst = int(rewritten["src_ip"][i])
+            r_dport = int(rewritten["src_port"][i])
+            is_snat = bool(snat_hit[i])
+
+            fwd_key: ReplyKey = (o[0], o[2], proto, o[1], o[3])
+            existing_rk = self._by_fwd.get(fwd_key)
+            if existing_rk is not None:
+                sess = self.sessions.get(existing_rk)
+                if sess is not None:
+                    sess.last_seen = timestamp
+                    if sess.snat_port_override is not None:
+                        fixups.append((i, sess.snat_port_override))
+                    continue
+
+            if len(self.sessions) >= self.max_sessions:
+                # No session can be recorded.  A DNAT punt is still
+                # safe to forward (translation was deterministic; only
+                # its replies lose the fast restore), but a SNAT punt
+                # would transmit a port that aliases another flow.
+                if is_snat:
+                    drops.append(i)
+                    self.counters.drops += 1
+                continue
+
+            override: Optional[int] = None
+            if is_snat:
+                # A SNAT punt can mean the hash port collided with a
+                # flow whose session lives on-device (ambiguous reply
+                # key) — the host cannot see that table, so always move
+                # off the hash-chosen port and onto a host-reserved one.
+                endpoint = (r_src, r_sport, proto, r_dst)
+                port = self._alloc_port(endpoint, r_dport)
+                if port is None:
+                    # Port space for this endpoint truly exhausted:
+                    # transmitting would misroute — drop instead.
+                    drops.append(i)
+                    self.counters.drops += 1
+                    continue
+                override = port
+                r_dport = port
+                fixups.append((i, port))
+                self.counters.snat_reallocs += 1
+
+            reply_key: ReplyKey = (r_src, r_dst, proto, r_sport, r_dport)
+            self.sessions[reply_key] = SlowSession(
+                restore=o, last_seen=timestamp,
+                snat_port_override=override, fwd_key=fwd_key,
+            )
+            self._by_fwd[fwd_key] = reply_key
+        return PuntOutcome(fixups=fixups, drops=drops)
+
+    def _alloc_port(
+        self, endpoint: Tuple[int, int, int, int], wanted: int
+    ) -> Optional[int]:
+        """First free ephemeral port for (remote, proto, snat_ip),
+        probing from just past the hash-chosen (collided) one.
+
+        Residual risk: the new port could collide with a different
+        device-resident session's reply key the host cannot see; the
+        device insert for such a flow punts again and re-enters here,
+        converging on a free port.
+        """
+        for k in range(1, 32768):
+            port = 32768 + ((wanted - 32768 + k) % 32768)
+            key = endpoint + (port,)
+            if key not in self._reserved_ports:
+                self._reserved_ports[key] = port
+                return port
+        return None
+
+    # ---------------------------------------------------------- restoration
+
+    def fixup_forward(
+        self, headers: Dict[str, np.ndarray], mask: np.ndarray
+    ) -> List[Tuple[int, int]]:
+        """Port fix-ups for forward packets of flows with overrides.
+
+        Called per batch only while overrides exist; ``mask`` limits the
+        scan to rows the device SNATted (candidates for an override).
+        """
+        fixups: List[Tuple[int, int]] = []
+        for i in np.nonzero(mask)[0].tolist():
+            fwd_key = (int(headers["src_ip"][i]), int(headers["dst_ip"][i]),
+                       int(headers["protocol"][i]),
+                       int(headers["src_port"][i]), int(headers["dst_port"][i]))
+            rk = self._by_fwd.get(fwd_key)
+            if rk is None:
+                continue
+            sess = self.sessions.get(rk)
+            if sess is not None and sess.snat_port_override is not None:
+                fixups.append((i, sess.snat_port_override))
+        return fixups
+
+    def restore_replies(
+        self,
+        headers: Dict[str, np.ndarray],
+        candidates: np.ndarray,
+        timestamp: int,
+    ) -> List[Tuple[int, Restore]]:
+        """Match candidate rows (device misses) against host sessions.
+
+        Returns ``[(row, (src_ip, src_port, dst_ip, dst_port))]`` where
+        the returned tuple is the RESTORED header: src becomes the
+        original destination (VIP/SNAT addr), dst the original source.
+        """
+        if not self.sessions:
+            return []
+        out: List[Tuple[int, Restore]] = []
+        for i in np.nonzero(candidates)[0].tolist():
+            key = (int(headers["src_ip"][i]), int(headers["dst_ip"][i]),
+                   int(headers["protocol"][i]),
+                   int(headers["src_port"][i]), int(headers["dst_port"][i]))
+            sess = self.sessions.get(key)
+            if sess is None:
+                continue
+            sess.last_seen = timestamp
+            o_src_ip, o_src_port, o_dst_ip, o_dst_port = sess.restore
+            # Restore: src <- original dst, dst <- original src.
+            out.append((i, (o_dst_ip, o_dst_port, o_src_ip, o_src_port)))
+            self.counters.restores += 1
+        return out
+
+    # ----------------------------------------------------------------- GC
+
+    def sweep(self, now: int, max_age: int) -> int:
+        """Expire idle sessions (mirror of ops.nat.sweep_sessions)."""
+        stale = [k for k, s in self.sessions.items() if now - s.last_seen > max_age]
+        for k in stale:
+            sess = self.sessions.pop(k)
+            if sess.fwd_key is not None:
+                self._by_fwd.pop(sess.fwd_key, None)
+            if sess.snat_port_override is not None:
+                endpoint = (k[0], k[3], k[2], k[1], sess.snat_port_override)
+                self._reserved_ports.pop(endpoint, None)
+        self.counters.expired += len(stale)
+        return len(stale)

@@ -20,6 +20,7 @@ import numpy as np
 
 from ..ops.nat import (
     NatMapping,
+    PROBE_WAYS,
     TWICE_NAT_ENABLED,
     TWICE_NAT_SELF,
 )
@@ -73,6 +74,7 @@ class FlowResult:
     dnat: bool = False
     reply: bool = False
     snat: bool = False
+    punt: bool = False  # session not recordable -> host slow path
 
 
 class MockNatEngine:
@@ -132,15 +134,16 @@ class MockNatEngine:
         result = FlowResult(flow=Flow(*flow.key()))
         f = result.flow
 
-        # 1. Reply restoration.
-        slot = flow_hash_py(*f.key()) & (self.session_capacity - 1)
-        entry = self.sessions.get(slot)
-        if entry is not None and entry[0] == f.key():
-            orig_src_ip, orig_src_port, orig_dst_ip, orig_dst_port = entry[1]
-            f.src_ip, f.src_port = orig_dst_ip, orig_dst_port
-            f.dst_ip, f.dst_port = orig_src_ip, orig_src_port
-            result.reply = True
-            return result
+        # 1. Reply restoration (W-way probe ring, matching the kernel).
+        base = flow_hash_py(*f.key()) & (self.session_capacity - 1)
+        for w in range(PROBE_WAYS):
+            entry = self.sessions.get((base + w) & (self.session_capacity - 1))
+            if entry is not None and entry[0] == f.key():
+                orig_src_ip, orig_src_port, orig_dst_ip, orig_dst_port = entry[1]
+                f.src_ip, f.src_port = orig_dst_ip, orig_dst_port
+                f.dst_ip, f.dst_port = orig_src_ip, orig_src_port
+                result.reply = True
+                return result
 
         orig = flow.key()
 
@@ -179,13 +182,29 @@ class MockNatEngine:
                 f.src_port = (h % 32768) + 32768
                 result.snat = True
 
-        # 4. Session recording, keyed by the expected reply tuple.
+        # 4. Session recording, keyed by the expected reply tuple, with
+        # W-way probed insertion (no eviction; collision/overflow punts).
         if result.dnat or result.snat:
             reply_key = (f.dst_ip, f.src_ip, f.proto, f.dst_port, f.src_port)
-            ins = flow_hash_py(*reply_key) & (self.session_capacity - 1)
+            base = flow_hash_py(*reply_key) & (self.session_capacity - 1)
             orig_src_ip, orig_dst_ip, _, orig_src_port, orig_dst_port = orig
-            self.sessions[ins] = (
-                reply_key,
-                (orig_src_ip, orig_src_port, orig_dst_ip, orig_dst_port),
-            )
+            restore = (orig_src_ip, orig_src_port, orig_dst_ip, orig_dst_port)
+            chosen = None
+            collision = False
+            for w in range(PROBE_WAYS):
+                slot = (base + w) & (self.session_capacity - 1)
+                entry = self.sessions.get(slot)
+                if entry is None:
+                    if chosen is None:
+                        chosen = slot
+                elif entry[0] == reply_key:
+                    if entry[1] == restore:
+                        chosen = slot  # refresh own session
+                        break
+                    collision = True  # another flow owns this reply key
+                    break
+            if collision or chosen is None:
+                result.punt = True
+            else:
+                self.sessions[chosen] = (reply_key, restore)
         return result
