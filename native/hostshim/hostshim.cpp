@@ -190,4 +190,162 @@ int32_t hs_apply_batch(uint8_t* buf, const uint64_t* offsets,
   return forwarded;
 }
 
+// ---------------------------------------------------------------------------
+// VXLAN encap / decap — the full-mesh overlay data path.
+//
+// The reference interconnects nodes with a full mesh of VXLAN tunnels
+// into one bridge domain (plugins/ipv4net/node.go vxlanIfToOtherNode
+// :524, vxlanBridgeDomain :482, VNI 10, port 4789).  Here the pipeline
+// tags ROUTE_REMOTE packets with the destination node ID and this shim
+// wraps them: outer Ethernet + IPv4 + UDP(4789) + VXLAN, outer source
+// port derived from the inner flow for ECMP entropy (RFC 7348 §5).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+constexpr uint16_t kVxlanPort = 4789;
+constexpr uint32_t kVxlanHdrBytes = 8;
+constexpr uint32_t kOuterBytes = 14 + 20 + 8 + kVxlanHdrBytes;  // 50
+
+// Node-ID-derived locally-administered MAC (the BVI-MAC convention:
+// a fixed OUI-style prefix + the node ID).
+inline void node_mac(uint32_t node_id, uint8_t* mac) {
+  mac[0] = 0x02;
+  mac[1] = 0x76;
+  mac[2] = 0x70;
+  mac[3] = 0x70;
+  mac[4] = (node_id >> 8) & 0xff;
+  mac[5] = node_id & 0xff;
+}
+
+// Full (non-incremental) IPv4 header checksum over 20 bytes.
+inline uint16_t ip_header_csum(const uint8_t* hdr) {
+  uint32_t sum = 0;
+  for (int i = 0; i < 20; i += 2) {
+    if (i == 10) continue;  // checksum field itself
+    sum += load_be16(hdr + i);
+  }
+  while (sum >> 16) sum = (sum & 0xffff) + (sum >> 16);
+  return static_cast<uint16_t>(~sum);
+}
+
+}  // namespace
+
+// Encapsulate the ROUTE_REMOTE forwarded frames of a batch.
+//
+// For each frame i with fwd[i] != 0 and is_remote[i] != 0, writes
+//   [outer eth][outer ip][udp 4789][vxlan vni][inner frame]
+// into out_buf and records (out_offsets, out_lens, out_rows) where
+// out_rows[j] = i.  Returns the number of encapped frames, or -1 if
+// out_buf (capacity out_cap bytes) is too small.  remote_ips maps
+// node_id -> outer destination IP (host-order u32, 0 = unknown ->
+// frame skipped and counted in *unroutable).
+int32_t hs_vxlan_encap_batch(const uint8_t* buf, const uint64_t* offsets,
+                             const uint32_t* lens, int32_t n,
+                             const uint8_t* fwd, const uint8_t* is_remote,
+                             const int32_t* node_ids,
+                             const uint32_t* remote_ips, int32_t max_node_id,
+                             uint32_t local_ip, uint32_t local_node_id,
+                             uint32_t vni, uint8_t* out_buf, uint64_t out_cap,
+                             uint64_t* out_offsets, uint32_t* out_lens,
+                             int32_t* out_rows, int32_t* unroutable) {
+  int32_t emitted = 0;
+  uint64_t used = 0;
+  int32_t skipped = 0;
+  for (int32_t i = 0; i < n; ++i) {
+    if (!fwd[i] || !is_remote[i]) continue;
+    int32_t nid = node_ids[i];
+    uint32_t dst_ip = (nid >= 0 && nid <= max_node_id) ? remote_ips[nid] : 0;
+    if (dst_ip == 0) {
+      ++skipped;
+      continue;
+    }
+    uint32_t inner_len = lens[i];
+    uint32_t total = kOuterBytes + inner_len;
+    if (used + total > out_cap) return -1;
+    uint8_t* p = out_buf + used;
+
+    // Outer Ethernet.
+    node_mac(static_cast<uint32_t>(nid), p);            // dst MAC
+    node_mac(local_node_id, p + 6);                     // src MAC
+    store_be16(p + 12, kEthertypeIPv4);
+
+    // Outer IPv4 (no options, DF, TTL 64).
+    uint8_t* ip = p + 14;
+    ip[0] = 0x45;
+    ip[1] = 0;
+    store_be16(ip + 2, static_cast<uint16_t>(20 + 8 + kVxlanHdrBytes + inner_len));
+    store_be16(ip + 4, 0);        // identification
+    store_be16(ip + 6, 0x4000);   // DF
+    ip[8] = 64;                   // TTL
+    ip[9] = kProtoUDP;
+    store_be16(ip + 10, 0);
+    store_be32(ip + 12, local_ip);
+    store_be32(ip + 16, dst_ip);
+    store_be16(ip + 10, ip_header_csum(ip));
+
+    // Outer UDP: source port from the inner flow for ECMP entropy
+    // (hash the inner IPv4 addresses + ports if present).
+    const uint8_t* inner = buf + offsets[i];
+    FrameView v = parse_frame(const_cast<uint8_t*>(inner), inner_len);
+    uint32_t h = 0;
+    if (v.valid) {
+      h = load_be32(v.ip + 12) ^ (load_be32(v.ip + 16) * 2654435761u);
+      if (v.has_ports) h ^= load_be32(v.l4);
+      h ^= h >> 16;
+    }
+    uint8_t* udp = ip + 20;
+    store_be16(udp, static_cast<uint16_t>(49152 + (h % 16384)));
+    store_be16(udp + 2, kVxlanPort);
+    store_be16(udp + 4, static_cast<uint16_t>(8 + kVxlanHdrBytes + inner_len));
+    store_be16(udp + 6, 0);  // UDP checksum optional for v4 (RFC 7348 §5)
+
+    // VXLAN header: flags (I bit), reserved, VNI, reserved.
+    uint8_t* vx = udp + 8;
+    vx[0] = 0x08;
+    vx[1] = vx[2] = vx[3] = 0;
+    store_be32(vx + 4, (vni << 8) & 0xffffff00);
+
+    std::memcpy(vx + 4 + 4, inner, inner_len);
+    out_offsets[emitted] = used;
+    out_lens[emitted] = total;
+    out_rows[emitted] = i;
+    used += total;
+    ++emitted;
+  }
+  if (unroutable != nullptr) *unroutable = skipped;
+  return emitted;
+}
+
+// Classify + de-encapsulate VXLAN frames IN PLACE (offset adjustment,
+// no copy).  For each frame: if it is a well-formed
+// eth/IPv4/UDP(4789)/VXLAN frame, inner_offsets[i]/inner_lens[i]
+// describe the inner Ethernet frame inside the same buffer and vnis[i]
+// holds the VNI; otherwise inner_offsets[i] = offsets[i],
+// inner_lens[i] = lens[i], vnis[i] = -1 (native frame, passthrough).
+// Returns the number of decapped frames.
+int32_t hs_vxlan_decap_batch(const uint8_t* buf, const uint64_t* offsets,
+                             const uint32_t* lens, int32_t n,
+                             uint64_t* inner_offsets, uint32_t* inner_lens,
+                             int32_t* vnis) {
+  int32_t decapped = 0;
+  for (int32_t i = 0; i < n; ++i) {
+    inner_offsets[i] = offsets[i];
+    inner_lens[i] = lens[i];
+    vnis[i] = -1;
+    FrameView v = parse_frame(const_cast<uint8_t*>(buf + offsets[i]), lens[i]);
+    if (!v.valid || v.proto != kProtoUDP || !v.has_ports) continue;
+    if (load_be16(v.l4 + 2) != kVxlanPort) continue;
+    const uint8_t* vx = v.l4 + 8;
+    uint64_t l4_off = static_cast<uint64_t>(v.l4 - (buf + offsets[i]));
+    if (lens[i] < l4_off + 8 + kVxlanHdrBytes + 14) continue;  // need inner eth
+    if ((vx[0] & 0x08) == 0) continue;  // VNI bit not set
+    inner_offsets[i] = offsets[i] + l4_off + 8 + kVxlanHdrBytes;
+    inner_lens[i] = lens[i] - static_cast<uint32_t>(l4_off + 8 + kVxlanHdrBytes);
+    vnis[i] = static_cast<int32_t>(load_be32(vx + 4) >> 8);
+    ++decapped;
+  }
+  return decapped;
+}
+
 }  // extern "C"

@@ -1,0 +1,326 @@
+"""Datapath runner e2e — real Ethernet frames through the TPU pipeline.
+
+The round-2 "actually runs on packets" suite (VERDICT item 1): frames
+in → decap → classify/NAT on the jit pipeline → native verdict apply →
+VXLAN encap / local delivery, across a 2-node FrameCluster, with the
+host slow path engaged for punted NAT flows.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from vpp_tpu.ops.packets import ip_to_u32, u32_to_ip
+from vpp_tpu.shim.hostshim import HostShim
+from vpp_tpu.testing.cluster import wait_for
+from vpp_tpu.testing.frames import build_frame, frame_tuple, verify_checksums
+from vpp_tpu.testing.framecluster import FrameCluster, _outer_dst_ip
+
+WEB_LABELS = {"app": "web"}
+
+
+@pytest.fixture()
+def cluster():
+    c = FrameCluster()
+    yield c
+    c.stop()
+
+
+def _vxlan_outer(frame):
+    """(outer_src_ip, outer_dst_ip, udp_dst, vni) of an encapped frame."""
+    ip = frame[14:]
+    src = u32_to_ip(int.from_bytes(ip[12:16], "big"))
+    dst = u32_to_ip(int.from_bytes(ip[16:20], "big"))
+    udp = ip[20:]
+    dport = struct.unpack("!H", udp[2:4])[0]
+    vni = int.from_bytes(udp[8 + 4:8 + 7], "big")
+    return src, dst, dport, vni
+
+
+# --------------------------------------------------------------- single node
+
+
+def test_local_pod_to_pod_frames(cluster):
+    cluster.add_node("node-1")
+    ip1 = cluster.deploy_pod("node-1", "client")
+    ip2 = cluster.deploy_pod("node-1", "server")
+
+    frames = [build_frame(ip1, ip2, 6, 40000 + i, 80) for i in range(8)]
+    cluster.inject("node-1", frames)
+    cluster.run_datapaths()
+
+    out = cluster.delivered_frames("node-1")
+    assert len(out) == 8
+    for i, f in enumerate(out):
+        assert frame_tuple(f) == (ip1, ip2, 6, 40000 + i, 80)
+        assert verify_checksums(f)
+
+
+def test_policy_denied_frames_dropped(cluster):
+    cluster.add_node("node-1")
+    ip1 = cluster.deploy_pod("node-1", "web-1", labels=WEB_LABELS)
+    ip2 = cluster.deploy_pod("node-1", "web-2", labels=WEB_LABELS)
+    cluster.apply_policy({
+        "metadata": {"name": "deny-all", "namespace": "default"},
+        "spec": {"podSelector": {"matchLabels": WEB_LABELS},
+                 "policyTypes": ["Ingress"], "ingress": []},
+    })
+    assert wait_for(
+        lambda: cluster.nodes["node-1"].policy_renderer.tables is not None
+        and int(cluster.nodes["node-1"].policy_renderer.tables.rule_valid.sum()) > 0
+    )
+    cluster.inject("node-1", [build_frame(ip1, ip2, 6, 40000, 80)])
+    cluster.run_datapaths()
+    assert cluster.delivered_frames("node-1") == []
+    counters = cluster.frame_nodes["node-1"].runner.counters
+    assert counters.dropped_denied == 1
+
+
+def test_service_dnat_frames_and_reply(cluster):
+    n1 = cluster.add_node("node-1")
+    client_ip = cluster.deploy_pod("node-1", "client")
+    backend_ip = cluster.deploy_pod("node-1", "web-1", labels=WEB_LABELS)
+
+    cluster.apply_service({
+        "metadata": {"name": "web", "namespace": "default"},
+        "spec": {"clusterIP": "10.96.0.10", "selector": WEB_LABELS,
+                 "ports": [{"name": "http", "protocol": "TCP", "port": 80,
+                            "targetPort": 8080}]},
+    })
+    cluster.apply_endpoints({
+        "metadata": {"name": "web", "namespace": "default"},
+        "subsets": [{
+            "addresses": [{"ip": backend_ip, "nodeName": "node-1",
+                           "targetRef": {"kind": "Pod", "name": "web-1",
+                                          "namespace": "default"}}],
+            "ports": [{"name": "http", "port": 8080, "protocol": "TCP"}],
+        }],
+    })
+    assert wait_for(lambda: len(n1.nat_renderer.mappings()) > 0)
+
+    cluster.inject("node-1", [build_frame(client_ip, "10.96.0.10", 6, 40000, 80)])
+    cluster.run_datapaths()
+    out = cluster.delivered_frames("node-1")
+    assert len(out) == 1
+    # DNAT rewrote the VIP to the backend, checksums incrementally fixed.
+    assert frame_tuple(out[0]) == (client_ip, backend_ip, 6, 40000, 8080)
+    assert verify_checksums(out[0])
+
+    # Reply through the same runner's session table restores the VIP.
+    cluster.inject("node-1", [build_frame(backend_ip, client_ip, 6, 8080, 40000)])
+    cluster.run_datapaths()
+    rep = cluster.delivered_frames("node-1")
+    assert len(rep) == 1
+    assert frame_tuple(rep[0]) == ("10.96.0.10", client_ip, 6, 80, 40000)
+    assert verify_checksums(rep[0])
+
+
+def test_snat_egress_to_host(cluster):
+    cluster.add_node("node-1")
+    ip1 = cluster.deploy_pod("node-1", "client")
+    cluster.inject("node-1", [build_frame(ip1, "93.184.216.34", 6, 40000, 443)])
+    cluster.run_datapaths()
+    out = cluster.host_frames("node-1")
+    assert len(out) == 1
+    src, dst, proto, sport, dport = frame_tuple(out[0])
+    assert src == "192.168.16.1" and dst == "93.184.216.34"
+    assert 32768 <= sport < 65536 and dport == 443
+    assert verify_checksums(out[0])
+
+
+# ----------------------------------------------------------------- two nodes
+
+
+def test_cross_node_vxlan_encap_decap_delivery(cluster):
+    cluster.add_node("node-1")
+    cluster.add_node("node-2")
+    ip1 = cluster.deploy_pod("node-1", "client")
+    ip2 = cluster.deploy_pod("node-2", "server")
+
+    frames = [build_frame(ip1, ip2, 6, 41000 + i, 80) for i in range(4)]
+    cluster.inject("node-1", frames)
+
+    # Drive only node-1 first so we can inspect the wire format.
+    fn1 = cluster.frame_nodes["node-1"]
+    fn1.sync_tables()
+    fn1.runner.drain()
+    assert fn1.runner.counters.tx_remote == 4
+
+    # Frames crossed the wire into node-2's rx ring, VXLAN-encapped.
+    fn2 = cluster.frame_nodes["node-2"]
+    staged = fn2.rx.recv_batch(16)
+    assert len(staged) == 4
+    for f in staged:
+        o_src, o_dst, udp_dst, vni = _vxlan_outer(f)
+        assert (o_src, o_dst) == ("192.168.16.1", "192.168.16.2")
+        assert udp_dst == 4789 and vni == 10
+    fn2.rx.send(staged)  # put them back
+
+    cluster.run_datapaths()
+    out = cluster.delivered_frames("node-2")
+    assert len(out) == 4
+    for i, f in enumerate(out):
+        assert frame_tuple(f) == (ip1, ip2, 6, 41000 + i, 80)
+        assert verify_checksums(f)
+    assert fn2.runner.counters.rx_decapped == 4
+
+
+def test_cross_node_policy_enforced_at_destination(cluster):
+    cluster.add_node("node-1")
+    cluster.add_node("node-2")
+    ip_db = cluster.deploy_pod("node-1", "db-1", labels={"app": "db"})
+    ip_web = cluster.deploy_pod("node-2", "web-1", labels=WEB_LABELS)
+
+    cluster.apply_policy({
+        "metadata": {"name": "web-only", "namespace": "default"},
+        "spec": {"podSelector": {"matchLabels": WEB_LABELS},
+                 "policyTypes": ["Ingress"],
+                 "ingress": [{"from": [{"podSelector": {"matchLabels": WEB_LABELS}}]}]},
+    })
+    assert wait_for(
+        lambda: all(
+            n.policy_renderer.tables is not None
+            and int(n.policy_renderer.tables.rule_valid.sum()) > 0
+            for n in cluster.nodes.values()
+        )
+    )
+    cluster.inject("node-1", [build_frame(ip_db, ip_web, 6, 40000, 80)])
+    cluster.run_datapaths()
+    # The destination node's ingress table denies db -> web.
+    assert cluster.delivered_frames("node-2") == []
+
+
+# ------------------------------------------------------- slow-path on frames
+
+
+def test_snat_collision_fixed_up_on_frames(cluster):
+    from vpp_tpu.testing.natengine import flow_hash_py
+
+    cluster.add_node("node-1")
+    # Deploy enough pods to find two whose SNAT hash ports collide for
+    # the same remote endpoint.
+    ips = [cluster.deploy_pod("node-1", f"p{i}") for i in range(8)]
+    dst = ip_to_u32("93.184.216.34")
+    seen = {}
+    pair = None
+    for ip in ips:
+        if pair:
+            break
+        for sport in range(1025, 22000):
+            h = flow_hash_py(ip_to_u32(ip), dst, 6, sport, 443)
+            port = (h % 32768) + 32768
+            if port in seen and seen[port][0] != ip:
+                pair = (seen[port], (ip, sport), port)
+                break
+            seen.setdefault(port, (ip, sport))
+    assert pair, "no collision pair found in search budget"
+    (ip_a, p_a), (ip_b, p_b), snat_port = pair
+
+    cluster.inject("node-1", [
+        build_frame(ip_a, "93.184.216.34", 6, p_a, 443),
+        build_frame(ip_b, "93.184.216.34", 6, p_b, 443),
+    ])
+    cluster.run_datapaths()
+    out = cluster.host_frames("node-1")
+    assert len(out) == 2
+    ports = sorted(frame_tuple(f)[3] for f in out)
+    # The colliding flow was punted and re-ported by the host slow path:
+    # the two frames leave with DISTINCT source ports, checksums valid.
+    assert ports[0] != ports[1]
+    assert snat_port in ports
+    for f in out:
+        assert verify_checksums(f)
+    runner = cluster.frame_nodes["node-1"].runner
+    assert runner.counters.punts == 1
+    assert runner.slow.counters.snat_reallocs == 1
+
+    # Replies to BOTH external ports come back to the right pods.
+    by_port = {frame_tuple(f)[3]: frame_tuple(f) for f in out}
+    reply_frames = [
+        build_frame("93.184.216.34", "192.168.16.1", 6, 443, port)
+        for port in by_port
+    ]
+    cluster.inject("node-1", reply_frames)
+    cluster.run_datapaths()
+    restored = cluster.delivered_frames("node-1")
+    assert len(restored) == 2
+    got = {frame_tuple(f)[1]: frame_tuple(f) for f in restored}
+    assert set(got) == {ip_a, ip_b}
+    for f in restored:
+        assert verify_checksums(f)
+    assert runner.counters.host_restores == 1
+
+
+# -------------------------------------------------------------- shim units
+
+
+def test_vxlan_encap_decap_roundtrip_unit():
+    shim = HostShim()
+    inner = build_frame("10.1.1.2", "10.1.2.3", 6, 1234, 80)
+    fb = shim.parse([inner], pad_to=None)
+    fwd = np.array([1], dtype=np.uint8)
+    remote = np.array([1], dtype=np.uint8)
+    node_ids = np.array([2], dtype=np.int32)
+    remote_ips = np.zeros(8, dtype=np.uint32)
+    remote_ips[2] = ip_to_u32("192.168.16.2")
+    buf, off, lens, rows, unroutable = shim.vxlan_encap(
+        fb, fwd, remote, node_ids, remote_ips,
+        local_ip=ip_to_u32("192.168.16.1"), local_node_id=1, vni=10,
+    )
+    assert unroutable == 0 and len(rows) == 1
+    encapped = buf[int(off[0]):int(off[0]) + int(lens[0])].tobytes()
+    assert len(encapped) == len(inner) + 50
+    assert verify_checksums(encapped)  # outer IP csum; UDP csum 0 is legal
+    assert _outer_dst_ip(encapped) == ip_to_u32("192.168.16.2")
+
+    inner_out, vnis = shim.vxlan_decap([encapped, inner])
+    assert vnis == [10, -1]
+    assert inner_out[0] == inner       # bit-exact round trip
+    assert inner_out[1] == inner       # native passthrough
+
+
+def test_vxlan_encap_unknown_node_counted():
+    shim = HostShim()
+    inner = build_frame("10.1.1.2", "10.1.9.3", 6, 1234, 80)
+    fb = shim.parse([inner], pad_to=None)
+    buf, off, lens, rows, unroutable = shim.vxlan_encap(
+        fb, np.array([1], dtype=np.uint8), np.array([1], dtype=np.uint8),
+        np.array([9], dtype=np.int32), np.zeros(4, dtype=np.uint32),
+        local_ip=ip_to_u32("192.168.16.1"), local_node_id=1,
+    )
+    assert len(rows) == 0 and unroutable == 1
+
+
+def test_foreign_vni_dropped(cluster):
+    cluster.add_node("node-1")
+    ip1 = cluster.deploy_pod("node-1", "client")
+    ip2 = cluster.deploy_pod("node-1", "server")
+    shim = HostShim()
+    inner = build_frame(ip1, ip2, 6, 40000, 80)
+    fb = shim.parse([inner], pad_to=None)
+    remote_ips = np.zeros(4, dtype=np.uint32)
+    remote_ips[1] = ip_to_u32("192.168.16.1")
+    buf, off, lens, rows, _ = shim.vxlan_encap(
+        fb, np.array([1], dtype=np.uint8), np.array([1], dtype=np.uint8),
+        np.array([1], dtype=np.int32), remote_ips,
+        local_ip=ip_to_u32("192.168.16.9"), local_node_id=9, vni=99,
+    )
+    foreign = buf[int(off[0]):int(off[0]) + int(lens[0])].tobytes()
+    cluster.inject("node-1", [foreign])
+    cluster.run_datapaths()
+    # VNI 99 is not this overlay's segment: dropped, never classified.
+    assert cluster.delivered_frames("node-1") == []
+    runner = cluster.frame_nodes["node-1"].runner
+    assert runner.counters.dropped_foreign_vni == 1
+    assert runner.counters.rx_decapped == 0
+
+
+def test_non_ipv4_counted_unparseable_not_denied(cluster):
+    cluster.add_node("node-1")
+    arp = b"\xff" * 6 + b"\x02\x00\x00\x00\x00\x01" + b"\x08\x06" + b"\x00" * 28
+    cluster.inject("node-1", [arp])
+    cluster.run_datapaths()
+    runner = cluster.frame_nodes["node-1"].runner
+    assert runner.counters.dropped_unparseable == 1
+    assert runner.counters.dropped_denied == 0

@@ -1,0 +1,23 @@
+"""Packet datapath — the runner that turns the jit pipeline into a
+dataplane (frames in → classify/NAT on TPU → frames out).
+
+The analog of the reference's DPDK→VPP fast path (vpp.env:1-3,
+docker/vpp-vswitch/dev/Dockerfile:1-16): continuous frame ingest,
+double-buffered batches through the TPU program, native verdict
+application + VXLAN overlay encap, and a host slow path for NAT punts.
+"""
+
+from .io import AfPacketIO, FrameSink, FrameSource, InMemoryRing, PcapReader, PcapWriter
+from .runner import DataplaneRunner, RunnerCounters, VxlanOverlay
+
+__all__ = [
+    "AfPacketIO",
+    "DataplaneRunner",
+    "FrameSink",
+    "FrameSource",
+    "InMemoryRing",
+    "PcapReader",
+    "PcapWriter",
+    "RunnerCounters",
+    "VxlanOverlay",
+]

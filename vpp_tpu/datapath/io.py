@@ -1,0 +1,181 @@
+"""Frame sources and sinks for the datapath runner.
+
+The reference ingests packets through DPDK NIC queues bound via
+pkg/pci (pci.go:40) into VPP's dpdk-input node.  The TPU-native runner
+abstracts ingest/egress behind two tiny interfaces so the same loop
+drives: an in-memory ring (tests, benchmarks), pcap replay (offline),
+or an AF_PACKET raw socket on a real interface (veth/NIC).
+"""
+
+from __future__ import annotations
+
+import collections
+import socket
+import struct
+import threading
+from typing import Iterable, List, Optional, Protocol, Sequence
+
+
+class FrameSource(Protocol):
+    def recv_batch(self, max_frames: int) -> List[bytes]:
+        """Up to ``max_frames`` raw Ethernet frames; empty list = idle."""
+        ...
+
+
+class FrameSink(Protocol):
+    def send(self, frames: Sequence[bytes]) -> None:
+        ...
+
+
+class InMemoryRing:
+    """Thread-safe frame ring — both a source and a sink.
+
+    The unit-test / benchmark transport, and the rx queue the virtual
+    wire of the cluster harness delivers into.
+    """
+
+    def __init__(self, capacity: int = 1 << 16):
+        self._dq: "collections.deque[bytes]" = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self.dropped = 0
+
+    def __len__(self) -> int:
+        return len(self._dq)
+
+    def send(self, frames: Sequence[bytes]) -> None:
+        with self._lock:
+            maxlen = self._dq.maxlen or 0
+            for f in frames:
+                if len(self._dq) >= maxlen:
+                    self.dropped += 1
+                else:
+                    self._dq.append(bytes(f))
+
+    def recv_batch(self, max_frames: int) -> List[bytes]:
+        out: List[bytes] = []
+        with self._lock:
+            while self._dq and len(out) < max_frames:
+                out.append(self._dq.popleft())
+        return out
+
+
+# ---------------------------------------------------------------------------
+# pcap replay / capture (classic pcap, linktype EN10MB)
+# ---------------------------------------------------------------------------
+
+_PCAP_MAGIC_LE = 0xA1B2C3D4
+_PCAP_MAGIC_BE = 0xD4C3B2A1
+
+
+class PcapReader:
+    """Replay frames from a classic pcap file (a deterministic traffic
+    source, the TRex/pcap-replay analog of tests/policy/perf)."""
+
+    def __init__(self, path: str, loop: bool = False):
+        self.path = path
+        self.loop = loop
+        self._frames = self._load(path)
+        self._pos = 0
+
+    @staticmethod
+    def _load(path: str) -> List[bytes]:
+        frames: List[bytes] = []
+        with open(path, "rb") as fh:
+            hdr = fh.read(24)
+            if len(hdr) < 24:
+                return frames
+            magic = struct.unpack("<I", hdr[:4])[0]
+            if magic == _PCAP_MAGIC_LE:
+                endian = "<"
+            elif magic == _PCAP_MAGIC_BE:
+                endian = ">"
+            else:
+                raise ValueError(f"{path}: not a classic pcap file")
+            while True:
+                rec = fh.read(16)
+                if len(rec) < 16:
+                    break
+                _, _, incl, _ = struct.unpack(f"{endian}IIII", rec)
+                data = fh.read(incl)
+                if len(data) < incl:
+                    break
+                frames.append(data)
+        return frames
+
+    def recv_batch(self, max_frames: int) -> List[bytes]:
+        if self._pos >= len(self._frames):
+            if not self.loop or not self._frames:
+                return []
+            self._pos = 0
+        out = self._frames[self._pos:self._pos + max_frames]
+        self._pos += len(out)
+        return out
+
+
+class PcapWriter:
+    """Capture sink writing a classic pcap file."""
+
+    def __init__(self, path: str, snaplen: int = 65535):
+        self._fh = open(path, "wb")
+        self._snaplen = snaplen
+        self._fh.write(struct.pack("<IHHiIII", _PCAP_MAGIC_LE, 2, 4, 0, 0, snaplen, 1))
+        self._ts = 0
+
+    def send(self, frames: Sequence[bytes]) -> None:
+        for f in frames:
+            self._ts += 1
+            incl = min(len(f), self._snaplen)
+            self._fh.write(struct.pack("<IIII", self._ts // 1000000, self._ts % 1000000, incl, len(f)))
+            self._fh.write(f[:incl])
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+# ---------------------------------------------------------------------------
+# AF_PACKET raw socket (real interfaces / veth pairs)
+# ---------------------------------------------------------------------------
+
+
+class AfPacketIO:
+    """Raw-socket source+sink bound to one interface.
+
+    The kernel-path stand-in for the reference's DPDK NIC binding
+    (pkg/pci/pci.go DriverBind :40) — zero-dependency, works on veth
+    pairs for e2e tests and on a real NIC for small deployments.
+    Requires CAP_NET_RAW; construction raises PermissionError without.
+    """
+
+    ETH_P_ALL = 0x0003
+
+    def __init__(self, ifname: str, blocking_ms: int = 0):
+        self.ifname = ifname
+        self._sock = socket.socket(
+            socket.AF_PACKET, socket.SOCK_RAW, socket.htons(self.ETH_P_ALL)
+        )
+        self._sock.bind((ifname, 0))
+        if blocking_ms:
+            self._sock.settimeout(blocking_ms / 1000.0)
+        else:
+            self._sock.setblocking(False)
+
+    def recv_batch(self, max_frames: int) -> List[bytes]:
+        out: List[bytes] = []
+        while len(out) < max_frames:
+            try:
+                frame = self._sock.recv(65535)
+            except (BlockingIOError, socket.timeout):
+                break
+            if frame:
+                out.append(frame)
+        return out
+
+    def send(self, frames: Sequence[bytes]) -> None:
+        for f in frames:
+            try:
+                self._sock.send(f)
+            except BlockingIOError:
+                pass  # TX queue full — kernel drop semantics
+
+    def close(self) -> None:
+        self._sock.close()

@@ -58,6 +58,19 @@ def _load() -> ctypes.CDLL:
         _u8p, _u64p, _u32p, ctypes.c_int32,
         _u8p, _u32p, _u32p, _i32p, _i32p, _u8p,
     ]
+    lib.hs_vxlan_encap_batch.restype = ctypes.c_int32
+    lib.hs_vxlan_encap_batch.argtypes = [
+        _u8p, _u64p, _u32p, ctypes.c_int32,
+        _u8p, _u8p, _i32p,
+        _u32p, ctypes.c_int32,
+        ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32,
+        _u8p, ctypes.c_uint64, _u64p, _u32p, _i32p, _i32p,
+    ]
+    lib.hs_vxlan_decap_batch.restype = ctypes.c_int32
+    lib.hs_vxlan_decap_batch.argtypes = [
+        _u8p, _u64p, _u32p, ctypes.c_int32,
+        _u64p, _u32p, _i32p,
+    ]
     return lib
 
 
@@ -94,6 +107,20 @@ class HostShim:
         if n:
             np.cumsum(lens[:-1], dtype=np.uint64, out=offsets[1:])
         buf = np.frombuffer(b"".join(frames), dtype=np.uint8).copy()
+        return self.parse_view(buf, offsets, lens, pad_to=pad_to)
+
+    def parse_view(
+        self,
+        buf: np.ndarray,
+        offsets: np.ndarray,
+        lens: np.ndarray,
+        pad_to: Optional[int] = VECTOR_SIZE,
+    ) -> FrameBatch:
+        """Parse frames already packed in one buffer (zero extra copies
+        — the decap path hands its adjusted offsets straight in here)."""
+        n = len(offsets)
+        offsets = np.ascontiguousarray(offsets, dtype=np.uint64)
+        lens = np.ascontiguousarray(lens, dtype=np.uint32)
 
         size = n
         if pad_to:
@@ -129,12 +156,18 @@ class HostShim:
 
     def apply(self, fb: FrameBatch, allowed, rewritten: PacketBatch) -> List[bytes]:
         """Apply pipeline verdicts + rewrites; returns forwarded frames."""
+        fwd = self.apply_masked(fb, allowed, rewritten)
+        return [fb.frame(i) for i in range(fb.n) if fwd[i]]
+
+    def apply_masked(self, fb: FrameBatch, allowed, rewritten: PacketBatch) -> np.ndarray:
+        """Like :meth:`apply` but returns the forwarded mask instead of
+        materialising frame copies (the runner splits by route next)."""
         n = fb.n
-        allowed = np.asarray(allowed).astype(np.uint8)[:n].copy()
-        new_src = np.asarray(rewritten.src_ip).astype(np.uint32)[:n].copy()
-        new_dst = np.asarray(rewritten.dst_ip).astype(np.uint32)[:n].copy()
-        new_sport = np.asarray(rewritten.src_port).astype(np.int32)[:n].copy()
-        new_dport = np.asarray(rewritten.dst_port).astype(np.int32)[:n].copy()
+        allowed = np.ascontiguousarray(np.asarray(allowed).astype(np.uint8)[:n])
+        new_src = np.ascontiguousarray(np.asarray(rewritten.src_ip).astype(np.uint32)[:n])
+        new_dst = np.ascontiguousarray(np.asarray(rewritten.dst_ip).astype(np.uint32)[:n])
+        new_sport = np.ascontiguousarray(np.asarray(rewritten.src_port).astype(np.int32)[:n])
+        new_dport = np.ascontiguousarray(np.asarray(rewritten.dst_port).astype(np.int32)[:n])
         fwd = np.zeros(n, dtype=np.uint8)
         if n:
             self._lib.hs_apply_batch(
@@ -149,4 +182,107 @@ class HostShim:
                 new_dport.ctypes.data_as(_i32p),
                 fwd.ctypes.data_as(_u8p),
             )
-        return [fb.frame(i) for i in range(n) if fwd[i]]
+        return fwd
+
+    # --------------------------------------------------------------- vxlan
+
+    def vxlan_encap(
+        self,
+        fb: FrameBatch,
+        fwd: np.ndarray,
+        is_remote: np.ndarray,
+        node_ids: np.ndarray,
+        remote_ips: np.ndarray,
+        local_ip: int,
+        local_node_id: int,
+        vni: int = 10,
+    ):
+        """Encap forwarded ROUTE_REMOTE frames for the overlay.
+
+        ``remote_ips`` is indexed by node ID (0 = unknown).  Returns
+        ``(out_buf, out_offsets, out_lens, out_rows, unroutable)`` where
+        ``out_rows[j]`` is the batch row the j-th encapped frame came
+        from.  Mirrors the reference's per-node VXLAN tunnels
+        (plugins/ipv4net/node.go vxlanIfToOtherNode :524).
+        """
+        n = fb.n
+        fwd = np.ascontiguousarray(fwd.astype(np.uint8)[:n])
+        is_remote = np.ascontiguousarray(is_remote.astype(np.uint8)[:n])
+        node_ids = np.ascontiguousarray(node_ids.astype(np.int32)[:n])
+        remote_ips = np.ascontiguousarray(remote_ips.astype(np.uint32))
+        out_cap = int(fb.buf.size + 50 * max(n, 1))
+        out_buf = np.empty(out_cap, dtype=np.uint8)
+        out_offsets = np.zeros(max(n, 1), dtype=np.uint64)
+        out_lens = np.zeros(max(n, 1), dtype=np.uint32)
+        out_rows = np.zeros(max(n, 1), dtype=np.int32)
+        unroutable = ctypes.c_int32(0)
+        count = 0
+        if n:
+            count = self._lib.hs_vxlan_encap_batch(
+                fb.buf.ctypes.data_as(_u8p),
+                fb.offsets.ctypes.data_as(_u64p),
+                fb.lens.ctypes.data_as(_u32p),
+                n,
+                fwd.ctypes.data_as(_u8p),
+                is_remote.ctypes.data_as(_u8p),
+                node_ids.ctypes.data_as(_i32p),
+                remote_ips.ctypes.data_as(_u32p),
+                len(remote_ips) - 1,
+                ctypes.c_uint32(local_ip),
+                ctypes.c_uint32(local_node_id),
+                ctypes.c_uint32(vni),
+                out_buf.ctypes.data_as(_u8p),
+                ctypes.c_uint64(out_cap),
+                out_offsets.ctypes.data_as(_u64p),
+                out_lens.ctypes.data_as(_u32p),
+                out_rows.ctypes.data_as(_i32p),
+                ctypes.byref(unroutable),
+            )
+            if count < 0:
+                raise RuntimeError("vxlan encap output buffer overflow")
+        return (
+            out_buf, out_offsets[:count], out_lens[:count],
+            out_rows[:count], int(unroutable.value),
+        )
+
+    def vxlan_decap_view(
+        self, buf: np.ndarray, offsets: np.ndarray, lens: np.ndarray
+    ):
+        """De-encapsulate in place: returns ``(inner_offsets,
+        inner_lens, vnis)`` describing the inner frames *within the same
+        buffer* (offset math only, zero copies); non-VXLAN frames pass
+        through with vni -1."""
+        n = len(offsets)
+        offsets = np.ascontiguousarray(offsets, dtype=np.uint64)
+        lens = np.ascontiguousarray(lens, dtype=np.uint32)
+        inner_off = np.zeros(n, dtype=np.uint64)
+        inner_len = np.zeros(n, dtype=np.uint32)
+        vnis = np.zeros(n, dtype=np.int32)
+        if n:
+            self._lib.hs_vxlan_decap_batch(
+                buf.ctypes.data_as(_u8p),
+                offsets.ctypes.data_as(_u64p),
+                lens.ctypes.data_as(_u32p),
+                n,
+                inner_off.ctypes.data_as(_u64p),
+                inner_len.ctypes.data_as(_u32p),
+                vnis.ctypes.data_as(_i32p),
+            )
+        return inner_off, inner_len, vnis
+
+    def vxlan_decap(self, frames: Sequence[bytes]):
+        """Convenience wrapper over :meth:`vxlan_decap_view` returning
+        materialised inner frames (tests / non-hot-path callers)."""
+        n = len(frames)
+        if not n:
+            return [], []
+        lens = np.array([len(f) for f in frames], dtype=np.uint32)
+        offsets = np.zeros(n, dtype=np.uint64)
+        np.cumsum(lens[:-1], dtype=np.uint64, out=offsets[1:])
+        buf = np.frombuffer(b"".join(frames), dtype=np.uint8).copy()
+        inner_off, inner_len, vnis = self.vxlan_decap_view(buf, offsets, lens)
+        out = [
+            buf[int(inner_off[i]):int(inner_off[i]) + int(inner_len[i])].tobytes()
+            for i in range(n)
+        ]
+        return out, vnis.tolist()
