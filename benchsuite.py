@@ -45,29 +45,45 @@ def _net(cidr):
 
 
 def _measure(acl, nat, route, batch, iters, rounds=3):
-    """Steady-state pipelined Mpps for one jitted pipeline config.
+    """Steady-state pipelined Mpps for one pipeline config, using the
+    production dispatch discipline (datapath/runner.py): the flat batch
+    is split into 256-packet vectors and scanned on device, sessions
+    threading vector-to-vector.  Returns (best_mpps, flat_result).
 
     Best-of-``rounds``: the shared-TPU tunnel shows high run-to-run
     variance, and the max is the honest estimate of what the pipeline
     sustains when the link is not the bottleneck."""
+    import jax
+
+    from vpp_tpu.ops.pipeline import (
+        VECTOR_SIZE,
+        flatten_scan_result,
+        pipeline_scan_jit,
+    )
+
+    n = batch.src_ip.shape[0]
+    assert n % VECTOR_SIZE == 0, "bench batches must be vector multiples"
+    k = n // VECTOR_SIZE
+    batches = jax.tree_util.tree_map(lambda a: a.reshape(k, VECTOR_SIZE), batch)
     sessions = empty_sessions(1 << 16)
-    result = pipeline_step_jit(acl, nat, route, sessions, batch, jnp.int32(0))
+    result = pipeline_scan_jit(
+        acl, nat, route, sessions, batches, jnp.arange(k, dtype=jnp.int32)
+    )
     result.allowed.block_until_ready()
     sessions = result.sessions
     best = 0.0
-    ts = 0
+    ts = k
     for _ in range(rounds):
         t0 = time.perf_counter()
         for _ in range(iters):
-            ts += 1
-            result = pipeline_step_jit(
-                acl, nat, route, sessions, batch, jnp.int32(ts)
-            )
+            tss = jnp.arange(ts, ts + k, dtype=jnp.int32)
+            ts += k
+            result = pipeline_scan_jit(acl, nat, route, sessions, batches, tss)
             sessions = result.sessions
         result.allowed.block_until_ready()
         dt = (time.perf_counter() - t0) / iters
-        best = max(best, batch.src_ip.shape[0] / dt / 1e6)
-    return best, result
+        best = max(best, n / dt / 1e6)
+    return best, flatten_scan_result(result)
 
 
 def _report(config, metric, mpps):
@@ -118,6 +134,10 @@ def config1(batch_size, iters):
     mpps, res = _measure(acl, nat, route, make_batch(flows), iters)
     _report(1, "pod-to-pod single node, no policies", mpps)
 
+    def verify():
+        assert bool(res.allowed.all()), "pod-to-pod with no policies must pass"
+    return verify
+
 
 def config2(batch_size, iters):
     """~20-rule policy suite on the ACL path (tests/policy analog)."""
@@ -153,6 +173,10 @@ def config2(batch_size, iters):
     mpps, res = _measure(acl, nat, route, make_batch(flows), iters)
     _report(2, "policy suite (~20 ACL rules)", mpps)
 
+    def verify():
+        assert bool(res.allowed.any()), "some flows match PERMIT rules"
+    return verify
+
 
 def config3(batch_size, iters):
     """ClusterIP with 8 backends through the NAT44 LB (lb-perf analog)."""
@@ -165,8 +189,11 @@ def config3(batch_size, iters):
         for _ in range(batch_size)
     ]
     mpps, res = _measure(acl, nat, route, make_batch(flows), iters)
-    assert bool(res.dnat_hit.all()), "all service flows must DNAT"
     _report(3, "ClusterIP, 8 backends, NAT44 LB", mpps)
+
+    def verify():
+        assert bool(res.dnat_hit.all()), "all service flows must DNAT"
+    return verify
 
 
 def config4(batch_size, iters):
@@ -184,23 +211,90 @@ def config4(batch_size, iters):
             flows.append((src, f"{rng.randrange(20, 200)}.2.3.4", 6,
                           rng.randrange(1024, 65535), 443))
     mpps, res = _measure(acl, nat, route, make_batch(flows), iters)
-    import numpy as np
-
-    tags = np.asarray(res.route)
-    assert (tags == ROUTE_REMOTE).sum() > 0, "expected VXLAN-bound flows"
-    assert bool(res.snat_hit.any()), "expected SNAT egress flows"
     _report(4, "2-node VXLAN overlay + SNAT egress", mpps)
+
+    def verify():
+        assert bool((res.route == ROUTE_REMOTE).any()), "expected VXLAN-bound flows"
+        assert bool(res.snat_hit.any()), "expected SNAT egress flows"
+    return verify
 
 
 def config5(batch_size, iters):
     """The bench.py headline: 10k rules + 1k services stress."""
     acl, nat, route, sessions, pod_ips, mappings = bench.build_stress_state()
     batch = bench.build_traffic(pod_ips, mappings, batch_size)
-    mpps, _ = _measure(acl, nat, route, batch, iters)
+    mpps, res = _measure(acl, nat, route, batch, iters)
     _report(5, "10k ACL rules + 1k services stress", mpps)
+
+    def verify():
+        assert bool(res.dnat_hit.any()) and bool(res.snat_hit.any())
+    return verify
 
 
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
+
+
+def sweep(iters):
+    """Mpps vs dispatch size on the config-5 stress state, comparing the
+    flat single-batch dispatch against the production vector-scan
+    dispatch (K 256-pkt vectors per device program).  Answers the
+    round-1 question "what does the 256-packet regime cost?":
+    the scan dispatch recovers small-vector semantics at large-batch
+    throughput because sessions thread on device instead of bouncing
+    through per-dispatch host round-trips."""
+    import jax
+
+    from vpp_tpu.ops.pipeline import VECTOR_SIZE, pipeline_scan_jit
+
+    acl, nat, route, _, pod_ips, mappings = bench.build_stress_state()
+    for n in (256, 1024, 4096, 16384, 65536):
+        batch = bench.build_traffic(pod_ips, mappings, n)
+        # Flat dispatch: one n-wide batch per device call.
+        sessions = empty_sessions(1 << 16)
+        r = pipeline_step_jit(acl, nat, route, sessions, batch, jnp.int32(0))
+        r.allowed.block_until_ready()
+        sessions = r.sessions
+        it = max(20, min(400, 16384 * iters // n))
+        flat_best, ts = 0.0, 0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(it):
+                ts += 1
+                r = pipeline_step_jit(acl, nat, route, sessions, batch, jnp.int32(ts))
+                sessions = r.sessions
+            r.allowed.block_until_ready()
+            flat_best = max(flat_best, n / ((time.perf_counter() - t0) / it) / 1e6)
+        # Vector-scan dispatch: n/256 vectors per device call.
+        k = n // VECTOR_SIZE
+        batches = jax.tree_util.tree_map(lambda a: a.reshape(k, VECTOR_SIZE), batch)
+        sessions = empty_sessions(1 << 16)
+        r = pipeline_scan_jit(
+            acl, nat, route, sessions, batches, jnp.arange(k, dtype=jnp.int32)
+        )
+        r.allowed.block_until_ready()
+        sessions = r.sessions
+        scan_best, ts = 0.0, k
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(it):
+                tss = jnp.arange(ts, ts + k, dtype=jnp.int32)
+                ts += k
+                r = pipeline_scan_jit(acl, nat, route, sessions, batches, tss)
+                sessions = r.sessions
+            r.allowed.block_until_ready()
+            scan_best = max(scan_best, n / ((time.perf_counter() - t0) / it) / 1e6)
+        print(
+            json.dumps(
+                {
+                    "sweep": "config5",
+                    "dispatch_pkts": n,
+                    "vectors": k,
+                    "flat_mpps": round(flat_best, 2),
+                    "scan_mpps": round(scan_best, 2),
+                }
+            ),
+            flush=True,
+        )
 
 
 def main():
@@ -208,29 +302,49 @@ def main():
     parser.add_argument("--config", type=int, choices=sorted(CONFIGS))
     parser.add_argument("--batch", type=int, default=16384)
     parser.add_argument("--iters", type=int, default=50)
+    parser.add_argument("--sweep", action="store_true",
+                        help="Mpps vs dispatch size, flat vs vector-scan")
+    parser.add_argument("--isolate", action="store_true",
+                        help="one subprocess per config")
     args = parser.parse_args()
-    if args.config:
-        CONFIGS[args.config](args.batch, args.iters)
+    if args.sweep:
+        sweep(args.iters)
         return
-    # One subprocess per configuration.  The experimental remote-TPU
-    # runtime degrades process-wide (~30x, permanently) after sustained
-    # full-batch DNAT scatter workloads — measured: any config run after
-    # config 3 in the same process drops from ~100 to ~1.5 Mpps, while
-    # every config is fast standalone.  Process isolation keeps each
-    # measurement honest.
-    import subprocess
-    import sys
+    if args.config:
+        verify = CONFIGS[args.config](args.batch, args.iters)
+        verify()
+        return
+    if args.isolate:
+        # --isolate remains for diagnosing runtime regressions like the
+        # one below; in-process is the default.
+        import subprocess
+        import sys
 
-    for key in sorted(CONFIGS):
-        subprocess.run(
-            [
-                sys.executable, __file__,
-                "--config", str(key),
-                "--batch", str(args.batch),
-                "--iters", str(args.iters),
-            ],
-            check=False,
-        )
+        for key in sorted(CONFIGS):
+            subprocess.run(
+                [
+                    sys.executable, __file__,
+                    "--config", str(key),
+                    "--batch", str(args.batch),
+                    "--iters", str(args.iters),
+                ],
+                check=False,
+            )
+        return
+    # Measure every config FIRST, verify afterwards.  Root cause of round
+    # 1's "process-wide ~30x collapse after sustained DNAT workloads"
+    # (diagnosed round 2, see scripts/tunnel_d2h_probe.py): on the
+    # experimental axon-tunnel runtime, the FIRST device-to-host value
+    # transfer of ANY kind — a 0-d bool(x.any()) scalar included —
+    # permanently switches the process into a degraded dispatch mode
+    # (~60 Mpps -> ~1 Mpps).  Only block_until_ready() and H2D transfers
+    # are safe.  It was never a leak in this framework: the trigger was
+    # the configs' result-verification fetches, which are therefore
+    # deferred until every measurement is done.
+    verifies = [(key, CONFIGS[key](args.batch, args.iters)) for key in sorted(CONFIGS)]
+    for key, verify in verifies:
+        verify()
+    print(json.dumps({"verified_configs": [k for k, _ in verifies]}), flush=True)
 
 
 if __name__ == "__main__":

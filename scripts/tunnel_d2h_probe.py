@@ -1,0 +1,100 @@
+"""Probe for the axon-tunnel D2H degradation (diagnosed round 2).
+
+Round 1 observed a permanent ~30x process-wide throughput collapse
+"after sustained DNAT-scatter workloads" and worked around it with
+one-subprocess-per-config.  This probe bisected the real trigger:
+
+    the FIRST device-to-host VALUE TRANSFER of any kind — any array
+    size, 0-d scalars included, even from an unrelated computation —
+    permanently degrades subsequent dispatch throughput.
+
+Measured on TPU v5e over the axon tunnel (2026-07-30), pipelined
+config-1 state, Mpps before -> after the probe action:
+
+    action                                   before    after
+    -----------------------------------------------------------
+    np.asarray(result.route[:8])   (8 B)      21.1      0.9
+    np.asarray(route[:1024])       (4 KB)     67.9      0.9
+    np.asarray(jnp.arange(16384)*2)
+      (unrelated computation)                 69.7      1.0
+    np.asarray(jnp.arange(1<<20))  (4 MB)     71.4      0.9
+    jax.device_get(result.route)   (64 KB)    47.8      1.0
+    bool(result.snat_hit.any())    (0-d!)     72.5      0.9
+    int(result.route.sum())        (0-d!)     53.2      1.0
+    H2D only: jnp.asarray(np.arange(16384))   55.6     68.6   (no effect)
+    block_until_ready() only                  67.2     53.0   (no effect)
+    no-op control                             55.2     76.4   (no effect)
+
+Conclusions:
+- The degradation is a property of the experimental tunnel runtime,
+  NOT a leak in this framework (it reproduces with jnp.arange).
+- ONLY synchronisation (block_until_ready) and H2D transfers are safe;
+  every read-back poisons, so benchmarks must defer ALL result
+  verification until after the last measurement.
+- A real dataplane must read verdicts back, so on this tunnel the
+  harvest path always runs in the degraded transfer mode; a local
+  PCIe-attached TPU does not behave this way.  Kernel-throughput
+  numbers (no read-back) remain the honest device-capability metric.
+
+Run: python scripts/tunnel_d2h_probe.py [variant]
+Variants: small unrelated batcharg h2d_only route_1k unrelated_big
+"""
+
+import pathlib
+import random
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def main(variant: str) -> None:
+    from benchsuite import _base_state, _measure
+    from vpp_tpu.ops.packets import make_batch
+
+    rng = random.Random(1)
+    batch_size = 16384
+    _, pod_ips, acl, nat, route = _base_state()
+    flows = [
+        (rng.choice(pod_ips), rng.choice(pod_ips), 6,
+         rng.randrange(1024, 65535), 5201)
+        for _ in range(batch_size)
+    ]
+    mpps, res = _measure(acl, nat, route, make_batch(flows), 40)
+    print(f"[{variant}] before: {mpps:.1f} Mpps", flush=True)
+
+    if variant == "small":
+        np.asarray(res.route[:8])
+    elif variant == "unrelated":
+        np.asarray(jnp.arange(16384) * 2)
+    elif variant == "batcharg":
+        np.asarray(res.batch.dst_ip)
+    elif variant == "h2d_only":
+        jnp.asarray(np.arange(16384, dtype=np.int32)).block_until_ready()
+    elif variant == "route_1k":
+        np.asarray(res.route[:1024])
+    elif variant == "unrelated_big":
+        np.asarray(jnp.arange(1 << 20))
+    elif variant == "device_get":
+        jax.device_get(res.route)
+    elif variant == "scalar_bool":
+        bool(res.snat_hit.any())
+    elif variant == "scalar_item":
+        int(res.route.sum())
+    elif variant == "block_only":
+        res.allowed.block_until_ready()
+    elif variant == "noop":
+        pass
+    else:
+        raise SystemExit(f"unknown variant {variant!r}")
+
+    mpps, _ = _measure(acl, nat, route, make_batch(flows), 40)
+    print(f"[{variant}] after:  {mpps:.1f} Mpps", flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "small")

@@ -324,3 +324,54 @@ def test_non_ipv4_counted_unparseable_not_denied(cluster):
     runner = cluster.frame_nodes["node-1"].runner
     assert runner.counters.dropped_unparseable == 1
     assert runner.counters.dropped_denied == 0
+
+
+def test_multi_vector_scan_dispatch(cluster):
+    """max_vectors>1 coalesces queued vectors into one scan dispatch;
+    sessions thread between vectors ON DEVICE, so a DNAT forward flow in
+    an early vector serves its reply arriving in a later vector of the
+    SAME dispatch."""
+    n1 = cluster.add_node("node-1")
+    client_ip = cluster.deploy_pod("node-1", "client")
+    backend_ip = cluster.deploy_pod("node-1", "web-1", labels=WEB_LABELS)
+    cluster.apply_service({
+        "metadata": {"name": "web", "namespace": "default"},
+        "spec": {"clusterIP": "10.96.0.10", "selector": WEB_LABELS,
+                 "ports": [{"name": "http", "protocol": "TCP", "port": 80,
+                            "targetPort": 8080}]},
+    })
+    cluster.apply_endpoints({
+        "metadata": {"name": "web", "namespace": "default"},
+        "subsets": [{
+            "addresses": [{"ip": backend_ip, "nodeName": "node-1",
+                           "targetRef": {"kind": "Pod", "name": "web-1",
+                                          "namespace": "default"}}],
+            "ports": [{"name": "http", "port": 8080, "protocol": "TCP"}],
+        }],
+    })
+    assert wait_for(lambda: len(n1.nat_renderer.mappings()) > 0)
+
+    fn = cluster.frame_nodes["node-1"]
+    fn.runner.batch_size = 8
+    fn.runner.max_vectors = 4
+
+    # 8 forward service flows fill vector 0; their replies land in
+    # vectors 1-2 of the same 4-vector dispatch (session visibility
+    # requires the on-device scan threading, not a host round-trip).
+    frames = [build_frame(client_ip, "10.96.0.10", 6, 40000 + i, 80)
+              for i in range(8)]
+    frames += [build_frame(backend_ip, client_ip, 6, 8080, 40000 + i)
+               for i in range(8)]
+    cluster.inject("node-1", frames)
+    cluster.run_datapaths()
+
+    out = cluster.delivered_frames("node-1")
+    assert len(out) == 16
+    assert fn.runner.counters.batches == 1  # ONE coalesced dispatch
+    fwd = [frame_tuple(f) for f in out[:8]]
+    rep = [frame_tuple(f) for f in out[8:]]
+    for i in range(8):
+        assert fwd[i] == (client_ip, backend_ip, 6, 40000 + i, 8080)
+        assert rep[i] == ("10.96.0.10", client_ip, 6, 80, 40000 + i)
+    for f in out:
+        assert verify_checksums(f)

@@ -176,3 +176,64 @@ def test_mesh_sharded_pipeline_matches_single_device():
     np.testing.assert_array_equal(np.asarray(single.allowed), np.asarray(sharded.allowed))
     np.testing.assert_array_equal(np.asarray(single.batch.dst_ip), np.asarray(sharded.batch.dst_ip))
     np.testing.assert_array_equal(np.asarray(single.route), np.asarray(sharded.route))
+
+
+def test_scan_matches_sequential_steps():
+    """pipeline_scan over K vectors == K sequential pipeline_step calls,
+    including the session state threaded between vectors (a session
+    created by vector i must serve replies in vector i+1)."""
+    import jax
+
+    from vpp_tpu.ops.pipeline import (
+        VECTOR_SIZE,
+        flatten_scan_result,
+        pipeline_scan,
+    )
+
+    mapping = NatMapping("10.96.0.10", 80, 6, [("10.1.1.2", 8080, 1)])
+    _, pods, acl, nat, route = build_world(mappings=[mapping])
+    k = 4
+    flows = []
+    for v in range(k):
+        for i in range(VECTOR_SIZE):
+            if (v * VECTOR_SIZE + i) % 3 == 0:  # service traffic
+                flows.append(("10.1.1.3", "10.96.0.10", 6, 1000 + i, 80))
+            elif (v * VECTOR_SIZE + i) % 3 == 1:  # pod-to-pod
+                flows.append((f"10.1.1.{2 + i % 4}", f"10.1.1.{2 + (i + 1) % 4}", 6, 2000 + i, 8080))
+            else:  # replies to the service flows of the previous vector
+                flows.append(("10.1.1.2", "10.1.1.3", 6, 8080, 1000 + i - 2))
+    flat = make_batch(flows)
+
+    # Sequential reference.
+    sessions = empty_sessions(1024)
+    seq = []
+    for v in range(k):
+        vec = jax.tree_util.tree_map(
+            lambda a: a[v * VECTOR_SIZE:(v + 1) * VECTOR_SIZE], flat
+        )
+        res = pipeline_step(acl, nat, route, sessions, vec, jnp.int32(v + 1))
+        sessions = res.sessions
+        seq.append(res)
+
+    # One scan dispatch.
+    batches = jax.tree_util.tree_map(lambda a: a.reshape(k, VECTOR_SIZE), flat)
+    scanned = flatten_scan_result(
+        pipeline_scan(acl, nat, route, empty_sessions(1024), batches,
+                      jnp.arange(1, k + 1, dtype=jnp.int32))
+    )
+
+    seq_allowed = np.concatenate([np.asarray(r.allowed) for r in seq])
+    seq_dst = np.concatenate([np.asarray(r.batch.dst_ip) for r in seq])
+    seq_route = np.concatenate([np.asarray(r.route) for r in seq])
+    seq_reply = np.concatenate([np.asarray(r.reply_hit) for r in seq])
+    np.testing.assert_array_equal(seq_allowed, np.asarray(scanned.allowed))
+    np.testing.assert_array_equal(seq_dst, np.asarray(scanned.batch.dst_ip))
+    np.testing.assert_array_equal(seq_route, np.asarray(scanned.route))
+    np.testing.assert_array_equal(seq_reply, np.asarray(scanned.reply_hit))
+    np.testing.assert_array_equal(
+        np.asarray(sessions.valid), np.asarray(scanned.sessions.valid)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sessions.r_src_ip), np.asarray(scanned.sessions.r_src_ip)
+    )
+    assert bool(np.asarray(scanned.reply_hit).any())
