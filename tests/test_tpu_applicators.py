@@ -1,0 +1,211 @@
+"""Scheduler-routed TPU table updates (round-1 verdict item 4).
+
+The reference guarantee under test: ALL southbound state of one event —
+host FIB and TPU device tables alike — commits as ONE atomic, retried
+transaction (plugins/controller/txn.go:28-83).  Renderers emit KVs;
+TpuAclApplicator / TpuNatApplicator own the compile + swap.
+"""
+
+import ipaddress
+
+import numpy as np
+import pytest
+
+from vpp_tpu.controller.txn import RecordedTxn
+from vpp_tpu.models import ProtocolType
+from vpp_tpu.ops.packets import ip_to_u32
+from vpp_tpu.policy.renderer.api import Action, ContivRule
+from vpp_tpu.scheduler import TxnScheduler
+from vpp_tpu.scheduler.tpu_applicators import (
+    ACL_POD_PREFIX,
+    NAT_GLOBAL_KEY,
+    NAT_SERVICE_PREFIX,
+    NatGlobalConfig,
+    TpuAclApplicator,
+    TpuNatApplicator,
+)
+from vpp_tpu.ops.nat import NatMapping
+from vpp_tpu.testing.cluster import SimCluster, wait_for
+
+
+def _entry(ip, rules=()):
+    return (ip_to_u32(ip), tuple(rules), ())
+
+
+DENY_ALL = ContivRule(action=Action.DENY)
+
+
+# ----------------------------------------------------------- unit: applicator
+
+
+def test_acl_applicator_one_compile_per_txn():
+    app = TpuAclApplicator()
+    sched = TxnScheduler()
+    sched.register_applicator(app)
+
+    txn = RecordedTxn(seq_num=1, is_resync=True, values={
+        f"{ACL_POD_PREFIX}default/a": _entry("10.1.1.2", [DENY_ALL]),
+        f"{ACL_POD_PREFIX}default/b": _entry("10.1.1.3", [DENY_ALL]),
+        f"{ACL_POD_PREFIX}default/c": _entry("10.1.1.4"),
+    })
+    sched.commit(txn)
+    assert app.compile_count == 1  # three creates, ONE swap
+    tables = app.tables
+    assert tables is not None and tables.num_pods == 3
+    # Table sharing: a and b have identical rule lists -> one table.
+    assert tables.num_tables == 1
+
+    # An unrelated-key txn must not recompile.
+    sched.commit(RecordedTxn(seq_num=2, is_resync=False,
+                             values={"hostfib/route/x": "r"}))
+    assert app.compile_count == 1
+
+
+def test_acl_applicator_resync_removes_unmentioned_pods():
+    app = TpuAclApplicator()
+    sched = TxnScheduler()
+    sched.register_applicator(app)
+    key_a = f"{ACL_POD_PREFIX}default/a"
+    key_b = f"{ACL_POD_PREFIX}default/b"
+    sched.commit(RecordedTxn(seq_num=1, is_resync=True, values={
+        key_a: _entry("10.1.1.2", [DENY_ALL]),
+        key_b: _entry("10.1.1.3", [DENY_ALL]),
+    }))
+    assert app.tables.num_pods == 2
+    # Resync that only mentions b: a's device assignment must disappear.
+    sched.commit(RecordedTxn(seq_num=2, is_resync=True, values={
+        key_b: _entry("10.1.1.3", [DENY_ALL]),
+    }))
+    assert app.tables.num_pods == 1
+    assert app.compile_count == 2
+
+
+def test_nat_applicator_compiles_global_and_services():
+    app = TpuNatApplicator()
+    sched = TxnScheduler()
+    sched.register_applicator(app)
+    m = NatMapping("10.96.0.10", 80, 6, [("10.1.1.2", 8080, 1)])
+    sched.commit(RecordedTxn(seq_num=1, is_resync=True, values={
+        NAT_GLOBAL_KEY: NatGlobalConfig(snat_ip="192.168.16.1", snat_enabled=True),
+        f"{NAT_SERVICE_PREFIX}default/web": (m,),
+    }))
+    assert app.compile_count == 1
+    assert app.mappings() == [m]
+    assert app.tables is not None
+
+    # Delete the service in an update txn: mapping gone, one more swap.
+    sched.commit(RecordedTxn(seq_num=2, is_resync=False, values={
+        f"{NAT_SERVICE_PREFIX}default/web": None,
+    }))
+    assert app.mappings() == []
+    assert app.compile_count == 2
+
+
+def test_compile_failure_marks_keys_failed_and_retries():
+    """A failed device compile is absorbed into the scheduler's ordinary
+    FAILED/retry machinery: the applicator's keys go FAILED, and the
+    scheduled retry re-attempts the compile (which succeeds once the
+    fault clears) — no stale table, no controller-killing error."""
+
+    class Flaky(TpuAclApplicator):
+        broken = True
+
+        def _compile(self, state):
+            if self.broken:
+                raise RuntimeError("device compile failed")
+            return super()._compile(state)
+
+    app = Flaky()
+    pending = []
+    sched = TxnScheduler(
+        retry_delay=0.01, schedule_retry=lambda fn, delay: pending.append(fn)
+    )
+    sched.register_applicator(app)
+    key = f"{ACL_POD_PREFIX}default/a"
+    sched.commit(RecordedTxn(seq_num=1, is_resync=True, values={
+        key: _entry("10.1.1.2", [DENY_ALL]),
+    }))
+    assert app.tables is None  # compile failed, no swap
+    (status,) = sched.dump(prefix=key)
+    assert status.state.value == "failed"
+    assert "device compile failed" in status.last_error
+    assert pending  # a retry is scheduled
+
+    app.broken = False
+    while pending:
+        pending.pop(0)()
+    (status,) = sched.dump(prefix=key)
+    assert status.state.value == "applied"
+    assert app.tables is not None and app.tables.num_pods == 1
+
+
+# ------------------------------------------------------------ e2e: SimCluster
+
+
+def test_event_txns_drive_device_tables_atomically():
+    """e2e: every event that changes policy/service state produces exactly
+    one ACL (and/or NAT) table swap, and the swapped tables enforce the
+    new state in the data plane."""
+    c = SimCluster()
+    try:
+        node = c.add_node("node-1")
+        ip1 = c.deploy_pod("node-1", "client")
+        ip2 = c.deploy_pod("node-1", "server", labels={"app": "web"})
+        assert wait_for(lambda: node.acl_applicator.tables is not None)
+
+        # Pods with no policies: traffic allowed.
+        res = node.send([(ip1, ip2, 6, 40000, 80)])
+        assert bool(np.asarray(res.allowed)[0])
+
+        swaps_before = node.acl_applicator.compile_count
+        c.apply_policy({
+            "metadata": {"name": "deny-all", "namespace": "default"},
+            "spec": {"podSelector": {"matchLabels": {"app": "web"}},
+                     "policyTypes": ["Ingress"], "ingress": []},
+        })
+        assert wait_for(
+            lambda: node.acl_applicator.compile_count > swaps_before
+        )
+        res = node.send([(ip1, ip2, 6, 40000, 80)])
+        assert not bool(np.asarray(res.allowed)[0])
+
+        # The device swap came from the scheduler: the ACL keys are
+        # tracked (and dumped) like any other southbound value.
+        # Only policy-affected pods are rendered (pods without policies
+        # have no ACL, like the reference).
+        dump = node.scheduler.dump(prefix="tpu/acl/pod/")
+        assert "tpu/acl/pod/default/server" in {d.key for d in dump}
+        for d in dump:
+            assert d.state.value == "applied"
+    finally:
+        c.stop()
+
+
+def test_service_txn_drives_nat_tables():
+    c = SimCluster()
+    try:
+        node = c.add_node("node-1")
+        c.deploy_pod("node-1", "client")
+        backend_ip = c.deploy_pod("node-1", "web-1", labels={"app": "web"})
+        c.apply_service({
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"clusterIP": "10.96.0.10", "selector": {"app": "web"},
+                     "ports": [{"name": "http", "protocol": "TCP",
+                                "port": 80, "targetPort": 8080}]},
+        })
+        c.apply_endpoints({
+            "metadata": {"name": "web", "namespace": "default"},
+            "subsets": [{
+                "addresses": [{"ip": backend_ip, "nodeName": "node-1",
+                               "targetRef": {"kind": "Pod", "name": "web-1",
+                                             "namespace": "default"}}],
+                "ports": [{"name": "http", "port": 8080, "protocol": "TCP"}],
+            }],
+        })
+        assert wait_for(lambda: len(node.nat_applicator.mappings()) > 0)
+        dump = node.scheduler.dump(prefix="tpu/nat/")
+        keys = {d.key for d in dump}
+        assert NAT_GLOBAL_KEY in keys
+        assert f"{NAT_SERVICE_PREFIX}default/web" in keys
+    finally:
+        c.stop()

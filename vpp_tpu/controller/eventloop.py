@@ -111,6 +111,9 @@ class Controller:
         self._resync_count = 0
         self._event_seq = 0
         self._txn_seq = 0
+        # The transaction of the event being processed right now, while
+        # handlers run (scheduler-routed renderers emit KVs into it).
+        self.current_txn: Optional[Txn] = None
         self._shutdown = False
         self._thread: Optional[threading.Thread] = None
         self._loop_thread_id: Optional[int] = None
@@ -279,6 +282,7 @@ class Controller:
     def _process_resync(self, event: Event, record: EventRecord) -> Optional[Exception]:
         self._resync_count += 1
         txn = Txn(is_resync=True)
+        self.current_txn = txn
         first_err: Optional[Exception] = None
         for handler in self.handlers:
             if not handler.handles_event(event):
@@ -296,6 +300,7 @@ class Controller:
                     first_err = e
                 # Resync is best-effort across handlers (reference continues
                 # and reports, scheduling healing afterwards).
+        self.current_txn = None
         commit_err = self._commit(txn, record)
         return first_err or commit_err
 
@@ -308,6 +313,7 @@ class Controller:
 
         ordered = self.handlers if direction is UpdateDirection.FORWARD else list(reversed(self.handlers))
         txn = Txn(is_resync=False)
+        self.current_txn = txn
         executed: List[EventHandler] = []
         err: Optional[Exception] = None
         aborted = False
@@ -334,6 +340,7 @@ class Controller:
                 if txn_type is UpdateTxnType.REVERT_ON_FAILURE:
                     break
 
+        self.current_txn = None
         if err is not None and txn_type is UpdateTxnType.REVERT_ON_FAILURE and not aborted:
             # 9. Revert plugin-internal changes in reverse order; the txn is
             # dropped (never committed), reverting the would-be data-plane

@@ -1,0 +1,80 @@
+"""Scheduler-routed TPU policy renderer.
+
+Instead of recompiling device tables inside its own commit (the round-1
+short-cut), this renderer emits each pod's rendered rule lists as plain
+KVs into the CURRENT EVENT TRANSACTION; the ``TpuAclApplicator``
+registered with the TxnScheduler owns the compile + atomic device swap.
+That restores the reference's contract: all southbound state of one
+event — host FIB and TPU tables alike — lands in one atomic, retried
+kvscheduler transaction (plugins/controller/txn.go:28-83).
+
+``txn_provider`` returns the transaction of the event being processed
+(the controller exposes it as ``Controller.current_txn``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+from ...models import PodID
+from ...ops.packets import ip_to_u32
+from ...scheduler.tpu_applicators import ACL_POD_PREFIX, TpuAclApplicator
+from .api import ContivRule, PolicyRendererAPI, RendererTxn
+
+
+def acl_pod_key(pod: PodID) -> str:
+    return f"{ACL_POD_PREFIX}{pod.namespace}/{pod.name}"
+
+
+class SchedPolicyRenderer(PolicyRendererAPI):
+    """Emits rendered pod tables into the event txn as tpu/acl/pod/* KVs."""
+
+    def __init__(
+        self,
+        txn_provider: Callable[[], object],
+        applicator: Optional[TpuAclApplicator] = None,
+    ):
+        self._txn_provider = txn_provider
+        # Kept so callers can reach the compiled tables through the
+        # renderer (the applicator owns them now).
+        self.applicator = applicator
+
+    @property
+    def tables(self):
+        return self.applicator.tables if self.applicator else None
+
+    def stats(self) -> Dict[str, int]:
+        return self.applicator.stats() if self.applicator else {}
+
+    def new_txn(self, resync: bool) -> "SchedRendererTxn":
+        return SchedRendererTxn(self, resync)
+
+
+class SchedRendererTxn(RendererTxn):
+    def __init__(self, renderer: SchedPolicyRenderer, resync: bool):
+        self.renderer = renderer
+        self.resync = resync
+        self._changes: Dict[PodID, Optional[Tuple[int, Tuple[ContivRule, ...], Tuple[ContivRule, ...]]]] = {}
+
+    def render(self, pod, pod_ip, ingress, egress, removed=False):
+        if removed or pod_ip is None:
+            self._changes[pod] = None
+            return self
+        ip_u32 = ip_to_u32(pod_ip.network_address)
+        self._changes[pod] = (ip_u32, tuple(ingress), tuple(egress))
+        return self
+
+    def commit(self) -> None:
+        txn = self.renderer._txn_provider()
+        if txn is None:
+            raise RuntimeError(
+                "SchedPolicyRenderer.commit outside an event transaction"
+            )
+        for pod, entry in self._changes.items():
+            key = acl_pod_key(pod)
+            if entry is None:
+                if not txn.is_resync:
+                    txn.delete(key)
+                # In a resync txn, simply not Put()ing the key removes it.
+            else:
+                txn.put(key, entry)

@@ -28,6 +28,34 @@ from .api import ContivRule, PolicyRendererAPI, RendererTxn
 log = logging.getLogger(__name__)
 
 
+PodEntry = Tuple[int, Tuple[ContivRule, ...], Tuple[ContivRule, ...]]
+
+
+def compile_pod_tables(pods: Dict[object, PodEntry]) -> RuleTables:
+    """Compile pod→(ingress, egress) rule lists into device tensors with
+    table sharing: identical rule lists intern to one table id (the
+    reference ACL renderer's sharing, docs/dev-guide/POLICIES.md:394-400)."""
+    table_ids: Dict[Tuple[ContivRule, ...], int] = {}
+    tables: List[Tuple[ContivRule, ...]] = []
+
+    def intern(rules: Tuple[ContivRule, ...]) -> int:
+        if not rules:
+            return NO_TABLE  # no rules = allow: skip table entirely
+        tid = table_ids.get(rules)
+        if tid is None:
+            tid = len(tables)
+            table_ids[rules] = tid
+            tables.append(rules)
+        return tid
+
+    pod_assignments: Dict[int, Tuple[int, int]] = {}
+    for _pod, (ip_u32, ingress, egress) in sorted(
+        pods.items(), key=lambda kv: str(kv[0])
+    ):
+        pod_assignments[ip_u32] = (intern(ingress), intern(egress))
+    return build_rule_tables(tables, pod_assignments)
+
+
 class TpuPolicyRenderer(PolicyRendererAPI):
     """Keeps rendered pod tables; compiles tensors on commit."""
 
@@ -81,25 +109,7 @@ class TpuPolicyRenderer(PolicyRendererAPI):
             self._on_compiled(compiled)
 
     def _compile(self) -> RuleTables:
-        # Table sharing: identical rule lists compile to one table id.
-        table_ids: Dict[Tuple[ContivRule, ...], int] = {}
-        tables: List[Tuple[ContivRule, ...]] = []
-
-        def intern(rules: Tuple[ContivRule, ...]) -> int:
-            if not rules:
-                return NO_TABLE  # no rules = allow: skip table entirely
-            tid = table_ids.get(rules)
-            if tid is None:
-                tid = len(tables)
-                table_ids[rules] = tid
-                tables.append(rules)
-            return tid
-
-        pod_assignments: Dict[int, Tuple[int, int]] = {}
-        for pod, (ip_u32, ingress, egress) in self._pods.items():
-            pod_assignments[ip_u32] = (intern(ingress), intern(egress))
-
-        compiled = build_rule_tables(tables, pod_assignments)
+        compiled = compile_pod_tables(self._pods)
         log.debug(
             "compiled %d rules in %d tables for %d pods",
             compiled.num_rules, compiled.num_tables, compiled.num_pods,

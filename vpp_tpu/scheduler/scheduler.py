@@ -89,6 +89,18 @@ class Applicator:
     def delete(self, key: str, value: Any) -> None:
         raise NotImplementedError
 
+    # Transaction boundaries.  The scheduler brackets every commit (and
+    # every retry/replay batch) with begin_txn()/end_txn() so applicators
+    # that compile state into an atomic artifact — the TPU device tables —
+    # can coalesce all of a transaction's CRUD calls into ONE swap
+    # (the reference's one-kvscheduler-txn-per-event contract,
+    # plugins/controller/txn.go:28-83).
+    def begin_txn(self) -> None:
+        pass
+
+    def end_txn(self) -> None:
+        pass
+
 
 @dataclass
 class _ValueRecord:
@@ -159,10 +171,34 @@ class TxnScheduler(TxnSink):
         per-value CRUD failures are absorbed into FAILED state + retries."""
         with self._lock:
             self._txn_log.append(txn)
-            if txn.is_resync:
-                self._commit_resync(txn)
-            else:
-                self._commit_update(txn)
+            for a in self._applicators:
+                a.begin_txn()
+            try:
+                if txn.is_resync:
+                    self._commit_resync(txn)
+                else:
+                    self._commit_update(txn)
+            finally:
+                # One atomic swap per transaction for compiling applicators.
+                self._end_txns()
+
+    def _end_txns(self) -> None:
+        """Close the transaction bracket on every applicator.  A failed
+        end_txn (e.g. a device-table compile error) is absorbed into the
+        ordinary FAILED/retry machinery: every value owned by that
+        applicator is marked FAILED and retried with backoff — the retry's
+        create() re-marks the state dirty and its own end_txn re-attempts
+        the compile.  Other applicators still get their end_txn."""
+        for a in self._applicators:
+            try:
+                a.end_txn()
+            except Exception as e:  # noqa: BLE001 - backend errors become state
+                log.warning("end_txn of %s failed: %s", type(a).__name__, e)
+                for key, rec in self._values.items():
+                    if self._applicator_for(key) is a and rec.desired is not None:
+                        rec.state = ValueState.FAILED
+                        rec.last_error = str(e)
+                        self._schedule_retry_for(key)
 
     def _commit_resync(self, txn: RecordedTxn) -> None:
         desired = txn.values
@@ -315,16 +351,21 @@ class TxnScheduler(TxnSink):
                 r = self._values.get(key)
                 if r is None or r.state is not ValueState.FAILED:
                     return
-                if r.desired is None:
-                    # Unfinished removal: retry the backend delete.
-                    self._unapply(key, r)
-                    if r.applied is None:
-                        self._values.pop(key, None)
-                    else:
-                        self._schedule_retry_for(key)
-                    return
-                self._try_apply(key, r)
-                self._resolve_pending()
+                for a in self._applicators:
+                    a.begin_txn()
+                try:
+                    if r.desired is None:
+                        # Unfinished removal: retry the backend delete.
+                        self._unapply(key, r)
+                        if r.applied is None:
+                            self._values.pop(key, None)
+                        else:
+                            self._schedule_retry_for(key)
+                        return
+                    self._try_apply(key, r)
+                    self._resolve_pending()
+                finally:
+                    self._end_txns()
 
         self._schedule_retry(retry, delay)
 
@@ -342,35 +383,40 @@ class TxnScheduler(TxnSink):
         keep waiting for their dependencies — replay must not bypass the
         dependency gating."""
         with self._lock:
-            for key, rec in list(self._values.items()):
-                if rec.desired is None:
-                    # An unfinished removal: retry the backend delete.
-                    if rec.applied is not None:
-                        self._unapply(key, rec)
-                        if rec.applied is None:
-                            self._values.pop(key, None)
-                    continue
-                if rec.state is ValueState.FAILED:
-                    # Replay is the recovery point for values that exhausted
-                    # their retries: give them a fresh budget and re-try.
-                    rec.retries = 0
-                    self._try_apply(key, rec)
-                    continue
-                if rec.state is not ValueState.APPLIED:
-                    continue
-                applicator = self._applicator_for(key)
-                if applicator is None:
-                    continue
-                try:
-                    applicator.update(key, rec.applied, rec.desired)
-                    rec.applied = rec.desired
-                except Exception as e:  # noqa: BLE001
-                    if applicator.update_destroys_on_failure:
-                        rec.applied = None
-                    rec.state = ValueState.FAILED
-                    rec.last_error = str(e)
-                    self._schedule_retry_for(key)
-            self._resolve_pending()
+            for a in self._applicators:
+                a.begin_txn()
+            try:
+                for key, rec in list(self._values.items()):
+                    if rec.desired is None:
+                        # An unfinished removal: retry the backend delete.
+                        if rec.applied is not None:
+                            self._unapply(key, rec)
+                            if rec.applied is None:
+                                self._values.pop(key, None)
+                        continue
+                    if rec.state is ValueState.FAILED:
+                        # Replay is the recovery point for values that exhausted
+                        # their retries: give them a fresh budget and re-try.
+                        rec.retries = 0
+                        self._try_apply(key, rec)
+                        continue
+                    if rec.state is not ValueState.APPLIED:
+                        continue
+                    applicator = self._applicator_for(key)
+                    if applicator is None:
+                        continue
+                    try:
+                        applicator.update(key, rec.applied, rec.desired)
+                        rec.applied = rec.desired
+                    except Exception as e:  # noqa: BLE001
+                        if applicator.update_destroys_on_failure:
+                            rec.applied = None
+                        rec.state = ValueState.FAILED
+                        rec.last_error = str(e)
+                        self._schedule_retry_for(key)
+                self._resolve_pending()
+            finally:
+                self._end_txns()
 
     # ------------------------------------------------------------------ dump
 

@@ -1,0 +1,138 @@
+"""Scheduler-routed TPU service renderer.
+
+The txn-emitting counterpart of ``TpuNatRenderer`` (VERDICT round-1
+item 4): instead of compiling NAT tensors inside its own methods, it
+exports each service's DNAT mappings (export logic shared with the
+direct renderer, nat44_renderer.go:421-513) and puts them — plus the
+NAT global config — as plain KVs into the CURRENT EVENT TRANSACTION.
+The ``TpuNatApplicator`` owns the compile + atomic device swap, with
+scheduler retries and resync-diff semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Set
+
+from ...models import ServiceID
+from ...scheduler.tpu_applicators import (
+    NAT_GLOBAL_KEY,
+    NAT_SERVICE_PREFIX,
+    NatGlobalConfig,
+    TpuNatApplicator,
+)
+from .api import ContivService, ServiceRendererAPI
+from .tpu import export_service_mappings
+
+
+def nat_service_key(sid: ServiceID) -> str:
+    return f"{NAT_SERVICE_PREFIX}{sid.namespace}/{sid.name}"
+
+
+class SchedNatRenderer(ServiceRendererAPI):
+    """Emits tpu/nat/* KVs into the event txn; the applicator compiles."""
+
+    def __init__(
+        self,
+        txn_provider: Callable[[], object],
+        nat_loopback: str = "0.0.0.0",
+        snat_ip: str = "0.0.0.0",
+        snat_enabled: bool = False,
+        pod_subnet: str = "10.1.0.0/16",
+        local_weight: int = 1,
+        applicator: Optional[TpuNatApplicator] = None,
+    ):
+        self._txn_provider = txn_provider
+        self.global_config = NatGlobalConfig(
+            nat_loopback=nat_loopback,
+            snat_ip=snat_ip,
+            snat_enabled=snat_enabled,
+            pod_subnet=pod_subnet,
+        )
+        self.local_weight = max(1, local_weight)
+        self.applicator = applicator
+        # Control-plane state needed to re-export mappings (node IPs for
+        # NodePorts); rendered services are tracked so NodePort changes
+        # can re-emit and so delete_service knows what to remove.
+        self._services: Dict[ServiceID, ContivService] = {}
+        self._node_ips: List[str] = []
+
+    # --------------------------------------------------------------- queries
+
+    @property
+    def tables(self):
+        return self.applicator.tables if self.applicator else None
+
+    def mappings(self):
+        return self.applicator.mappings() if self.applicator else []
+
+    # ------------------------------------------------------------------ txn
+
+    def _txn(self):
+        txn = self._txn_provider()
+        if txn is None:
+            raise RuntimeError("SchedNatRenderer used outside an event transaction")
+        return txn
+
+    def _emit_service(self, txn, svc: ContivService) -> None:
+        mappings = tuple(
+            export_service_mappings(svc, self._node_ips, self.local_weight)
+        )
+        key = nat_service_key(svc.id)
+        if mappings:
+            txn.put(key, mappings)
+        elif not txn.is_resync:
+            # No eligible backends: mapping must not be installed.
+            txn.delete(key)
+
+    def _emit_global(self, txn) -> None:
+        txn.put(NAT_GLOBAL_KEY, self.global_config)
+
+    # ------------------------------------------------------------- renderer
+
+    def add_service(self, service: ContivService) -> None:
+        self._services[service.id] = service
+        txn = self._txn()
+        self._emit_global(txn)
+        self._emit_service(txn, service)
+
+    def update_service(self, old: ContivService, new: ContivService) -> None:
+        self._services[new.id] = new
+        txn = self._txn()
+        self._emit_global(txn)
+        self._emit_service(txn, new)
+
+    def delete_service(self, service: ContivService) -> None:
+        self._services.pop(service.id, None)
+        txn = self._txn()
+        if not txn.is_resync:
+            txn.delete(nat_service_key(service.id))
+
+    def update_node_port_services(
+        self, node_ips: Sequence[str], np_services: Sequence[ContivService]
+    ) -> None:
+        self._node_ips = list(node_ips)
+        txn = self._txn()
+        self._emit_global(txn)
+        for svc in np_services:
+            self._services[svc.id] = svc
+            self._emit_service(txn, svc)
+
+    def update_local_frontends(self, frontends: Set[str]) -> None:
+        pass
+
+    def update_local_backends(self, backends: Set[str]) -> None:
+        pass
+
+    def resync(
+        self,
+        services: Sequence[ContivService],
+        node_ips: Sequence[str],
+        frontends: Set[str],
+        backends: Set[str],
+    ) -> None:
+        self._services = {s.id: s for s in services}
+        self._node_ips = list(node_ips)
+        txn = self._txn()
+        self._emit_global(txn)
+        for svc in self._services.values():
+            self._emit_service(txn, svc)

@@ -33,6 +33,62 @@ from .api import ContivService, ServiceRendererAPI, TrafficPolicy
 log = logging.getLogger(__name__)
 
 
+def export_service_mappings(
+    svc: ContivService, node_ips: Sequence[str], local_weight: int
+) -> List[NatMapping]:
+    """exportDNATMappings for one service (nat44_renderer.go:421-513)."""
+    out: List[NatMapping] = []
+
+    def backends_for(port_name: str) -> List[Tuple[str, int, int]]:
+        chosen: List[Tuple[str, int, int]] = []
+        for b in svc.backends.get(port_name, []):
+            if svc.traffic_policy is not TrafficPolicy.CLUSTER_WIDE and not b.local:
+                continue  # do not LB to remote backends (node-local policy)
+            weight = local_weight if b.local else 1
+            chosen.append((b.ip, b.port, weight))
+        if len(chosen) == 1:
+            # Single backend: weight is irrelevant (reference sets
+            # probability 0 = unconfigured).
+            chosen = [(chosen[0][0], chosen[0][1], 1)]
+        return chosen
+
+    def add(ip: str, port: int, proto: ProtocolType, twice_nat: int, port_name: str):
+        if port == 0:
+            return
+        backends = backends_for(port_name)
+        if not backends:
+            return
+        out.append(
+            NatMapping(
+                external_ip=ip,
+                external_port=port,
+                protocol=int(proto),
+                backends=backends,
+                twice_nat=twice_nat,
+                session_affinity_timeout=svc.session_affinity_timeout,
+            )
+        )
+
+    for port_name, spec in svc.ports.items():
+        # NodePort mappings on every node IP.
+        if spec.node_port:
+            for node_ip in node_ips:
+                add(node_ip, spec.node_port, spec.protocol, TWICE_NAT_SELF, port_name)
+        # Cluster IPs.
+        for ip in svc.cluster_ips:
+            add(ip, spec.port, spec.protocol, TWICE_NAT_SELF, port_name)
+        # External IPs: cluster-wide services rewrite the client source
+        # so replies return through this node (twice-NAT ENABLED).
+        twice = (
+            TWICE_NAT_ENABLED
+            if svc.traffic_policy is TrafficPolicy.CLUSTER_WIDE
+            else TWICE_NAT_SELF
+        )
+        for ip in svc.external_ips:
+            add(ip, spec.port, spec.protocol, twice, port_name)
+    return out
+
+
 class TpuNatRenderer(ServiceRendererAPI):
     """Keeps rendered services; compiles NAT tensors on every change."""
 
@@ -113,57 +169,7 @@ class TpuNatRenderer(ServiceRendererAPI):
     # ---------------------------------------------------------------- export
 
     def _export_service(self, svc: ContivService) -> List[NatMapping]:
-        """exportDNATMappings for one service."""
-        out: List[NatMapping] = []
-
-        def backends_for(port_name: str) -> List[Tuple[str, int, int]]:
-            chosen: List[Tuple[str, int, int]] = []
-            for b in svc.backends.get(port_name, []):
-                if svc.traffic_policy is not TrafficPolicy.CLUSTER_WIDE and not b.local:
-                    continue  # do not LB to remote backends (node-local policy)
-                weight = self.local_weight if b.local else 1
-                chosen.append((b.ip, b.port, weight))
-            if len(chosen) == 1:
-                # Single backend: weight is irrelevant (reference sets
-                # probability 0 = unconfigured).
-                chosen = [(chosen[0][0], chosen[0][1], 1)]
-            return chosen
-
-        def add(ip: str, port: int, proto: ProtocolType, twice_nat: int, port_name: str):
-            if port == 0:
-                return
-            backends = backends_for(port_name)
-            if not backends:
-                return
-            out.append(
-                NatMapping(
-                    external_ip=ip,
-                    external_port=port,
-                    protocol=int(proto),
-                    backends=backends,
-                    twice_nat=twice_nat,
-                    session_affinity_timeout=svc.session_affinity_timeout,
-                )
-            )
-
-        for port_name, spec in svc.ports.items():
-            # NodePort mappings on every node IP.
-            if spec.node_port:
-                for node_ip in self._node_ips:
-                    add(node_ip, spec.node_port, spec.protocol, TWICE_NAT_SELF, port_name)
-            # Cluster IPs.
-            for ip in svc.cluster_ips:
-                add(ip, spec.port, spec.protocol, TWICE_NAT_SELF, port_name)
-            # External IPs: cluster-wide services rewrite the client source
-            # so replies return through this node (twice-NAT ENABLED).
-            twice = (
-                TWICE_NAT_ENABLED
-                if svc.traffic_policy is TrafficPolicy.CLUSTER_WIDE
-                else TWICE_NAT_SELF
-            )
-            for ip in svc.external_ips:
-                add(ip, spec.port, spec.protocol, twice, port_name)
-        return out
+        return export_service_mappings(svc, self._node_ips, self.local_weight)
 
     def _export_all(self) -> List[NatMapping]:
         mappings: List[NatMapping] = []

@@ -38,10 +38,11 @@ from ..ops.packets import make_batch
 from ..ops.pipeline import ROUTE_REMOTE, make_route_config, pipeline_step
 from ..podmanager import PodManager
 from ..policy import PolicyPlugin
-from ..policy.renderer.tpu import TpuPolicyRenderer
+from ..policy.renderer.sched import SchedPolicyRenderer
 from ..scheduler import TxnScheduler
+from ..scheduler.tpu_applicators import TpuAclApplicator, TpuNatApplicator
 from ..service import ServicePlugin
-from ..service.renderer.tpu import TpuNatRenderer
+from ..service.renderer.sched import SchedNatRenderer
 from .aclengine import MockACLEngine, Verdict
 from .hostfib import MockHostFIB
 from .k8s import FakeK8sCluster
@@ -75,23 +76,34 @@ class SimNode:
             podmanager=self.podmanager,
         )
 
-        self.policy_renderer = TpuPolicyRenderer()
+        # TPU device tables go through the txn scheduler (VERDICT r1 #4):
+        # renderers emit KVs into the event txn, applicators own the
+        # atomic compile+swap per transaction.
+        self.acl_applicator = TpuAclApplicator()
+        self.policy_renderer = SchedPolicyRenderer(
+            lambda: self.controller.current_txn, applicator=self.acl_applicator
+        )
         self.oracle = MockACLEngine()
         self.policy = PolicyPlugin(ipam=self.ipam)
         self.policy.register_renderer(self.policy_renderer)
         self.policy.register_renderer(self.oracle)
 
-        self.nat_renderer = TpuNatRenderer(
+        self.nat_applicator = TpuNatApplicator()
+        self.nat_renderer = SchedNatRenderer(
+            lambda: self.controller.current_txn,
             nat_loopback=str(self.ipam.nat_loopback_ip()),
             snat_ip=f"192.168.16.{self.nodesync.node_id}",
             snat_enabled=True,
             pod_subnet=str(self.ipam.pod_subnet_all_nodes),
+            applicator=self.nat_applicator,
         )
         self.service = ServicePlugin(name, ipam=self.ipam, nodesync=self.nodesync)
         self.service.register_renderer(self.nat_renderer)
 
         self.scheduler = TxnScheduler()
         self.scheduler.register_applicator(self.fib)
+        self.scheduler.register_applicator(self.acl_applicator)
+        self.scheduler.register_applicator(self.nat_applicator)
         self.controller = Controller(
             handlers=[
                 self.nodesync, self.podmanager, self.ipv4net,
@@ -112,6 +124,14 @@ class SimNode:
         """Run a batch of 5-tuples through this node's pipeline."""
         acl = self.policy_renderer.tables
         nat = self.nat_renderer.tables
+        if acl is None:  # before the first committed resync
+            from ..ops.classify import build_rule_tables
+
+            acl = build_rule_tables([], {})
+        if nat is None:
+            from ..ops.nat import build_nat_tables
+
+            nat = build_nat_tables([])
         route = make_route_config(self.ipam)
         sessions = sessions if sessions is not None else empty_sessions(1024)
         return pipeline_step(

@@ -74,10 +74,14 @@ class FrameNode:
             host=self.to_host,
             shim=shim,
         )
+        # The scheduler's TPU applicators push each transaction's atomic
+        # table swap straight into the runner (VERDICT r1 #4).
+        sim.acl_applicator.on_compiled = lambda t: self.runner.update_tables(acl=t)
+        sim.nat_applicator.on_compiled = lambda t: self.runner.update_tables(nat=t)
 
     def sync_tables(self) -> None:
-        """Pull the renderers' current compiled tables into the runner
-        (the txn-applicator hook will own this in production)."""
+        """Refresh tables not owned by the scheduler applicators (route
+        config from IPAM) plus any swap that predated hook attachment."""
         self.runner.update_tables(
             acl=self.sim.policy_renderer.tables,
             nat=self.sim.nat_renderer.tables,
