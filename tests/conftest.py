@@ -30,3 +30,9 @@ jax.config.update("jax_platforms", os.environ.get("VPP_TPU_TEST_PLATFORM", "cpu"
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_vpp_tpu")
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: spawns OS processes / long-running e2e"
+    )

@@ -16,7 +16,18 @@ import threading
 from typing import Optional
 
 from ..kvstore import KVStore, WatchEvent
+from ..kvstore.mirror import LocalMirror
 from ..models import registry
+
+# Errors meaning "the remote store is unreachable" (fall back to the
+# local mirror).  Anything else — codec bugs, malformed responses —
+# must propagate, not masquerade as an outage.
+try:
+    import grpc as _grpc
+
+    STORE_UNAVAILABLE_ERRORS: tuple = (ConnectionError, _grpc.RpcError)
+except ImportError:  # pragma: no cover - grpc is in the base image
+    STORE_UNAVAILABLE_ERRORS = (ConnectionError,)
 from .api import DBResync, ExternalConfigChange, KubeStateChange
 from .eventloop import Controller
 
@@ -28,19 +39,33 @@ EXTERNAL_CONFIG_PREFIX = "/vpp-tpu/external-config/"
 class DBWatcher:
     """Watches the cluster KV store and feeds the event loop."""
 
-    def __init__(self, controller: Controller, store: KVStore):
+    def __init__(
+        self,
+        controller: Controller,
+        store: KVStore,
+        mirror_path: Optional[str] = None,
+    ):
         self.controller = controller
         self.store = store
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._prefixes = [r.key_prefix for r in registry.DB_RESOURCES] + [EXTERNAL_CONFIG_PREFIX]
+        # Local sqlite mirror (the Bolt analog, dbwatcher.go:111-137):
+        # updated on every snapshot/change, used as resync fallback while
+        # the remote store is unreachable.
+        self._mirror = LocalMirror(mirror_path) if mirror_path else None
         self._watcher = self.store.watch(self._prefixes)
+        # A networked store signals watch-stream recovery: resync from the
+        # remote DB on every reconnect (dbwatcher.go:252-267).
+        if hasattr(self.store, "on_reconnect"):
+            self.store.on_reconnect(self.resync)
         # Serializes resync() against the watch thread's event pushes, so a
         # DBResync snapshot can never be overtaken by a change event that it
         # does not contain (and stale pre-snapshot events are dropped by
         # revision).
         self._order_lock = threading.Lock()
         self._resync_revision = -1
+        self.resynced_from_mirror = 0  # observability for tests/telemetry
 
     # ------------------------------------------------------------------ life
 
@@ -50,8 +75,12 @@ class DBWatcher:
         The watch is registered before the snapshot is taken (in
         __init__/here respectively), so no change can fall between
         snapshot and stream; duplicates are resolved by the snapshot
-        being authoritative at resync time.
+        being authoritative at resync time.  For a networked store the
+        registration is asynchronous — wait for the server's
+        subscribe-ack before snapshotting, or the guarantee breaks.
         """
+        if hasattr(self._watcher, "wait_subscribed"):
+            self._watcher.wait_subscribed(timeout=5.0)
         self.resync()
         self._thread = threading.Thread(target=self._watch_loop, name="db-watcher", daemon=True)
         self._thread.start()
@@ -74,19 +103,46 @@ class DBWatcher:
         watch loop afterwards (they are already inside the snapshot).
         """
         with self._order_lock:
-            snap, self._resync_revision = self.store.snapshot_with_revision(self._prefixes)
-            kube_state = {r.keyword: {} for r in registry.DB_RESOURCES}
-            external = {}
-            for key, value in snap.items():
-                if key.startswith(EXTERNAL_CONFIG_PREFIX):
-                    external[key] = value
-                    continue
-                resource = registry.resource_for_key(key)
-                if resource is not None:
-                    kube_state[resource.keyword][key] = value
-            event = DBResync(kube_state=kube_state, external_config=external)
-            self.controller.push_event(event)
+            try:
+                snap, revision = self.store.snapshot_with_revision(self._prefixes)
+            except STORE_UNAVAILABLE_ERRORS as e:
+                return self._resync_from_mirror(e)
+            self._resync_revision = revision
+            if self._mirror is not None:
+                self._mirror.save_snapshot(snap, revision)
+            event = self._push_resync(snap)
         return event
+
+    def _push_resync(self, snap) -> DBResync:
+        kube_state = {r.keyword: {} for r in registry.DB_RESOURCES}
+        external = {}
+        for key, value in snap.items():
+            if key.startswith(EXTERNAL_CONFIG_PREFIX):
+                external[key] = value
+                continue
+            resource = registry.resource_for_key(key)
+            if resource is not None:
+                kube_state[resource.keyword][key] = value
+        event = DBResync(kube_state=kube_state, external_config=external)
+        self.controller.push_event(event)
+        return event
+
+    def _resync_from_mirror(self, cause: Exception) -> Optional[DBResync]:
+        """Local fallback resync (runResyncFromLocalDB :309): serve the
+        last mirrored snapshot; the reconnect hook re-resyncs from the
+        remote DB once it is reachable again."""
+        loaded = self._mirror.load() if self._mirror is not None else None
+        if loaded is None:
+            log.warning("remote store unreachable and no local mirror: %s", cause)
+            return None
+        snap, revision = loaded
+        log.warning(
+            "remote store unreachable (%s): resyncing from local mirror "
+            "(%d keys @ revision %d)", cause, len(snap), revision,
+        )
+        self._resync_revision = revision
+        self.resynced_from_mirror += 1
+        return self._push_resync(snap)
 
     # ----------------------------------------------------------------- watch
 
@@ -102,6 +158,8 @@ class DBWatcher:
             if ev.revision <= self._resync_revision:
                 # Already covered by the last resync snapshot.
                 return
+            if self._mirror is not None:
+                self._mirror.apply_event(ev)
             self._push_change(ev)
 
     def _push_change(self, ev: WatchEvent) -> None:

@@ -1,3 +1,8 @@
 from .store import KVStore, WatchEvent, Watcher, TxnFailed
+from .mirror import LocalMirror
+from .remote import KVStoreServer, RemoteKVStore
 
-__all__ = ["KVStore", "WatchEvent", "Watcher", "TxnFailed"]
+__all__ = [
+    "KVStore", "WatchEvent", "Watcher", "TxnFailed",
+    "LocalMirror", "KVStoreServer", "RemoteKVStore",
+]

@@ -1,0 +1,135 @@
+"""Typed JSON codec for cluster-store values.
+
+The networked store (remote.py) and the sqlite mirror need to carry the
+framework's model dataclasses over the wire — the role protobuf plays
+for the reference's etcd values (plugins/ksr/model/*).  This codec
+round-trips them through tagged JSON with full fidelity (tuples stay
+tuples, enums stay enums, frozen dataclasses compare equal after a
+round trip — dbwatcher's prev/new comparisons depend on it).
+
+Decoding resolves classes by qualified name but ONLY from ``vpp_tpu.*``
+modules: unlike pickle, a malicious store payload cannot name arbitrary
+constructors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import importlib
+import ipaddress
+import json
+from typing import Any
+
+_TAG_DC = "__dc__"
+_TAG_ENUM = "__enum__"
+_TAG_TUPLE = "__tuple__"
+_TAG_SET = "__set__"
+_TAG_FROZENSET = "__frozenset__"
+_TAG_IP = "__ip__"
+_TAG_MAP = "__map__"  # escape hatch for plain dicts using a reserved key
+
+_RESERVED_KEYS = {
+    _TAG_DC, _TAG_ENUM, _TAG_TUPLE, _TAG_SET, _TAG_FROZENSET, _TAG_IP, _TAG_MAP,
+}
+
+_ALLOWED_MODULE_PREFIX = "vpp_tpu."
+
+_IP_TYPES = {
+    "IPv4Address": ipaddress.IPv4Address,
+    "IPv6Address": ipaddress.IPv6Address,
+    "IPv4Network": ipaddress.IPv4Network,
+    "IPv6Network": ipaddress.IPv6Network,
+    "IPv4Interface": ipaddress.IPv4Interface,
+    "IPv6Interface": ipaddress.IPv6Interface,
+}
+
+
+def _qualname(tp: type) -> str:
+    return f"{tp.__module__}:{tp.__qualname__}"
+
+
+def _resolve(qual: str) -> type:
+    module_name, _, cls_path = qual.partition(":")
+    if not (module_name.startswith(_ALLOWED_MODULE_PREFIX) or module_name == "vpp_tpu"):
+        raise ValueError(f"refusing to resolve type outside vpp_tpu: {qual!r}")
+    obj: Any = importlib.import_module(module_name)
+    for part in cls_path.split("."):
+        obj = getattr(obj, part)
+    if not isinstance(obj, type):
+        raise ValueError(f"{qual!r} does not name a class")
+    return obj
+
+
+def to_jsonable(value: Any) -> Any:
+    """Encode ``value`` into JSON-serialisable tagged structures."""
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    if isinstance(value, enum.Enum):
+        return {_TAG_ENUM: _qualname(type(value)), "name": value.name}
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        fields = {
+            f.name: to_jsonable(getattr(value, f.name))
+            for f in dataclasses.fields(value)
+        }
+        return {_TAG_DC: _qualname(type(value)), "fields": fields}
+    if isinstance(value, tuple):
+        return {_TAG_TUPLE: [to_jsonable(v) for v in value]}
+    if isinstance(value, frozenset):
+        return {_TAG_FROZENSET: sorted((to_jsonable(v) for v in value), key=repr)}
+    if isinstance(value, set):
+        return {_TAG_SET: sorted((to_jsonable(v) for v in value), key=repr)}
+    if isinstance(value, list):
+        return [to_jsonable(v) for v in value]
+    if isinstance(value, dict):
+        for k in value:
+            if not isinstance(k, str):
+                raise TypeError(f"non-string dict key not supported: {k!r}")
+        if any(k in _RESERVED_KEYS for k in value):
+            # A user dict colliding with a tag key: encode as a pair list.
+            return {_TAG_MAP: [[k, to_jsonable(v)] for k, v in value.items()]}
+        return {k: to_jsonable(v) for k, v in value.items()}
+    for name, tp in _IP_TYPES.items():
+        if type(value) is tp:
+            return {_TAG_IP: name, "value": str(value)}
+    raise TypeError(f"cannot encode {type(value).__name__}: {value!r}")
+
+
+def from_jsonable(data: Any) -> Any:
+    """Decode the output of :func:`to_jsonable`."""
+    if data is None or isinstance(data, (bool, int, float, str)):
+        return data
+    if isinstance(data, list):
+        return [from_jsonable(v) for v in data]
+    if isinstance(data, dict):
+        if _TAG_DC in data:
+            cls = _resolve(data[_TAG_DC])
+            if not dataclasses.is_dataclass(cls):
+                raise ValueError(f"{data[_TAG_DC]!r} is not a dataclass")
+            kwargs = {k: from_jsonable(v) for k, v in data["fields"].items()}
+            return cls(**kwargs)
+        if _TAG_ENUM in data:
+            cls = _resolve(data[_TAG_ENUM])
+            if not issubclass(cls, enum.Enum):
+                raise ValueError(f"{data[_TAG_ENUM]!r} is not an Enum")
+            return cls[data["name"]]
+        if _TAG_TUPLE in data:
+            return tuple(from_jsonable(v) for v in data[_TAG_TUPLE])
+        if _TAG_SET in data:
+            return {from_jsonable(v) for v in data[_TAG_SET]}
+        if _TAG_FROZENSET in data:
+            return frozenset(from_jsonable(v) for v in data[_TAG_FROZENSET])
+        if _TAG_IP in data:
+            return _IP_TYPES[data[_TAG_IP]](data["value"])
+        if _TAG_MAP in data:
+            return {k: from_jsonable(v) for k, v in data[_TAG_MAP]}
+        return {k: from_jsonable(v) for k, v in data.items()}
+    raise TypeError(f"cannot decode {data!r}")
+
+
+def encode(value: Any) -> bytes:
+    return json.dumps(to_jsonable(value), sort_keys=True).encode()
+
+
+def decode(data: bytes) -> Any:
+    return from_jsonable(json.loads(data.decode()))

@@ -1,0 +1,83 @@
+"""Local sqlite mirror of the cluster store — the Bolt analog.
+
+The reference mirrors every watched etcd key into a per-node Bolt DB so
+an agent can resync from local state while etcd is unreachable
+(plugins/controller/dbwatcher.go:111-137, runResyncFromLocalDB :309).
+This is that component: the dbwatcher saves each remote snapshot here,
+applies every streamed change, and falls back to :meth:`load` when the
+remote store cannot be reached.
+"""
+
+from __future__ import annotations
+
+import logging
+import sqlite3
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from . import codec
+from .store import WatchEvent
+
+log = logging.getLogger(__name__)
+
+
+class LocalMirror:
+    """A revisioned key/value mirror in one sqlite file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        with self._lock:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS mirror (key TEXT PRIMARY KEY, value BLOB)"
+            )
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS meta (name TEXT PRIMARY KEY, value INTEGER)"
+            )
+            self._conn.commit()
+
+    def save_snapshot(self, snap: Dict[str, Any], revision: int) -> None:
+        """Replace the mirror contents with one consistent snapshot."""
+        rows = [(k, codec.encode(v)) for k, v in snap.items()]
+        with self._lock:
+            self._conn.execute("DELETE FROM mirror")
+            self._conn.executemany(
+                "INSERT INTO mirror (key, value) VALUES (?, ?)", rows
+            )
+            self._conn.execute(
+                "INSERT OR REPLACE INTO meta (name, value) VALUES ('revision', ?)",
+                (revision,),
+            )
+            self._conn.commit()
+
+    def apply_event(self, ev: WatchEvent) -> None:
+        """Mirror one streamed change."""
+        with self._lock:
+            if ev.is_delete:
+                self._conn.execute("DELETE FROM mirror WHERE key = ?", (ev.key,))
+            else:
+                self._conn.execute(
+                    "INSERT OR REPLACE INTO mirror (key, value) VALUES (?, ?)",
+                    (ev.key, codec.encode(ev.value)),
+                )
+            self._conn.execute(
+                "INSERT OR REPLACE INTO meta (name, value) VALUES ('revision', ?)",
+                (ev.revision,),
+            )
+            self._conn.commit()
+
+    def load(self) -> Optional[Tuple[Dict[str, Any], int]]:
+        """The mirrored (snapshot, revision), or None if never populated."""
+        with self._lock:
+            rev = self._conn.execute(
+                "SELECT value FROM meta WHERE name = 'revision'"
+            ).fetchone()
+            if rev is None:
+                return None
+            rows = self._conn.execute("SELECT key, value FROM mirror").fetchall()
+        return {k: codec.decode(v) for k, v in rows}, int(rev[0])
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()

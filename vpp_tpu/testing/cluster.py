@@ -60,7 +60,8 @@ def wait_for(cond, timeout: float = 5.0, interval: float = 0.02) -> bool:
 class SimNode:
     """One simulated vswitch node: the full agent plugin stack."""
 
-    def __init__(self, cluster: "SimCluster", name: str):
+    def __init__(self, cluster: "SimCluster", name: str,
+                 mirror_path: Optional[str] = None):
         self.cluster = cluster
         self.name = name
         store = cluster.store
@@ -115,7 +116,7 @@ class SimNode:
         self.podmanager.event_loop = self.controller
         self.nodesync.event_loop = self.controller
         self.controller.start()
-        self.watcher = DBWatcher(self.controller, store)
+        self.watcher = DBWatcher(self.controller, store, mirror_path=mirror_path)
         self.watcher.start()
 
     # ----------------------------------------------------------- data plane

@@ -1,0 +1,93 @@
+"""Separate-process agent — the OS-process SPMD harness.
+
+Round-1 verdict item 5: SimCluster nodes shared one Python store object,
+so the per-node-agent SPMD story (docs/ARCHITECTURE.md:51-56 — identical
+agents, zero direct agent↔agent communication, all coordination through
+the cluster store) never crossed a process/socket boundary.  This module
+runs ONE full agent stack in its own OS process, connected to the
+cluster's KVStoreServer over gRPC:
+
+    python -m vpp_tpu.testing.procnode --store 127.0.0.1:PORT \\
+        --name node-2 [--mirror /tmp/node-2.db] [--heartbeat-prefix P]
+
+The agent is the same plugin wiring as SimNode (controller, dbwatcher
+with sqlite mirror, nodesync ID allocation through atomic store ops,
+policy/service stacks with scheduler-routed TPU tables).  A heartbeat
+key is written back to the store every interval carrying what the agent
+currently believes (resync count, known pods, table swap counts), which
+is how tests observe cross-process convergence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import time
+import types
+
+from ..kvstore.remote import RemoteKVStore
+
+HEARTBEAT_PREFIX = "/vpp-tpu/test/heartbeat/"
+
+
+def run_agent(
+    store_address: str,
+    name: str,
+    mirror_path: str = "",
+    heartbeat_prefix: str = HEARTBEAT_PREFIX,
+    heartbeat_interval: float = 0.1,
+    stop_event=None,
+) -> None:
+    from .cluster import SimNode
+
+    store = RemoteKVStore(store_address)
+    # SimNode only consumes ``cluster.store`` — a remote client slots in
+    # where the in-process store object sat.
+    shim = types.SimpleNamespace(store=store)
+    node = SimNode(shim, name, mirror_path=mirror_path or None)
+
+    seq = 0
+    try:
+        while stop_event is None or not stop_event.is_set():
+            seq += 1
+            beat = {
+                "name": name,
+                "seq": seq,
+                "node_id": node.nodesync.node_id,
+                "resync_count": node.controller._resync_count,
+                "mirror_resyncs": node.watcher.resynced_from_mirror,
+                "pods": sorted(
+                    f"{p.namespace}/{p.name}" for p in node.policy.cache._pods
+                ),
+                "acl_swaps": node.acl_applicator.compile_count,
+                "nat_mappings": len(node.nat_applicator.mappings()),
+            }
+            try:
+                store.put(heartbeat_prefix + name, beat)
+            except Exception:  # noqa: BLE001 - store outage: keep beating
+                pass
+            time.sleep(heartbeat_interval)
+    finally:
+        node.stop()
+        store.close()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--store", required=True, help="host:port of KVStoreServer")
+    parser.add_argument("--name", required=True)
+    parser.add_argument("--mirror", default="")
+    parser.add_argument("--heartbeat-prefix", default=HEARTBEAT_PREFIX)
+    args = parser.parse_args(argv)
+
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+    print(json.dumps({"agent": args.name, "store": args.store}), flush=True)
+    run_agent(args.store, args.name, mirror_path=args.mirror,
+              heartbeat_prefix=args.heartbeat_prefix)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
