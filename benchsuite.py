@@ -297,6 +297,89 @@ def sweep(iters):
         )
 
 
+def scale(iters):
+    """Classify scale (VERDICT r1 #6): 64k ACL rules + 4k pods + 1k
+    services through the FULL pipeline, Pallas-tiled first-match vs the
+    dense [B, N] path (VPP_TPU_FORCE_DENSE A/B), production vector-scan
+    dispatch."""
+    import ipaddress
+    import os
+
+    import jax
+
+    from vpp_tpu.ops.pipeline import make_route_config
+
+    rng = random.Random(6)
+    ipam = IPAM(IPAMConfig(), node_id=1)
+    rules = []
+    for _ in range(65535):
+        net = ipaddress.ip_network(
+            f"10.{rng.randrange(256)}.{rng.randrange(256)}.0/{rng.choice([16, 20, 24, 28])}",
+            strict=False,
+        )
+        rules.append(
+            ContivRule(
+                action=Action.PERMIT if rng.random() < 0.9 else Action.DENY,
+                src_network=net,
+                protocol=ProtocolType.TCP if rng.random() < 0.7 else ProtocolType.UDP,
+                dst_port=rng.choice([0, 80, 443, 8080, 53]),
+            )
+        )
+    rules.append(ContivRule(action=Action.DENY))
+    pod_ips = set()
+    while len(pod_ips) < 4096:
+        pod_ips.add(f"10.1.{rng.randrange(1, 64)}.{rng.randrange(2, 250)}")
+    pod_ips = sorted(pod_ips)
+    acl = build_rule_tables([rules], {ip_to_u32(ip): (0, 0) for ip in pod_ips})
+    _, _, _, nat, _ = _base_state()
+    route = make_route_config(ipam)
+    flows = [
+        (rng.choice(pod_ips), rng.choice(pod_ips), 6,
+         rng.randrange(1024, 65535), rng.choice([80, 443]))
+        for _ in range(16384)
+    ]
+    batch = make_batch(flows)
+
+    def report(variant, mpps):
+        print(
+            json.dumps(
+                {
+                    "scale": "64k rules, 4k pods, full pipeline",
+                    "variant": variant,
+                    "value": round(mpps, 1),
+                    "unit": "Mpps",
+                    "vs_baseline": round(mpps / 40.0, 2),
+                }
+            ),
+            flush=True,
+        )
+
+    # Production dispatch (64x256 vector scan; dense in-vector classify —
+    # pallas is gated to wide batches where it measures faster).
+    mpps, _ = _measure(acl, nat, route, batch, iters)
+    report("vector-scan", mpps)
+
+    # Wide flat dispatch: pallas vs dense A/B at [16384, 64k].
+    for label, force in (("flat-pallas", ""), ("flat-dense", "1")):
+        os.environ["VPP_TPU_FORCE_DENSE"] = force
+        jax.clear_caches()
+        sessions = empty_sessions(1 << 16)
+        r = pipeline_step_jit(acl, nat, route, sessions, batch, jnp.int32(0))
+        r.allowed.block_until_ready()
+        sessions = r.sessions
+        best, ts = 0.0, 0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                ts += 1
+                r = pipeline_step_jit(acl, nat, route, sessions, batch, jnp.int32(ts))
+                sessions = r.sessions
+            r.allowed.block_until_ready()
+            best = max(best, len(flows) / ((time.perf_counter() - t0) / iters) / 1e6)
+        report(label, best)
+    os.environ.pop("VPP_TPU_FORCE_DENSE", None)
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", type=int, choices=sorted(CONFIGS))
@@ -304,11 +387,16 @@ def main():
     parser.add_argument("--iters", type=int, default=50)
     parser.add_argument("--sweep", action="store_true",
                         help="Mpps vs dispatch size, flat vs vector-scan")
+    parser.add_argument("--scale", action="store_true",
+                        help="64k-rule / 4k-pod scale, pallas vs dense")
     parser.add_argument("--isolate", action="store_true",
                         help="one subprocess per config")
     args = parser.parse_args()
     if args.sweep:
         sweep(args.iters)
+        return
+    if args.scale:
+        scale(args.iters)
         return
     if args.config:
         verify = CONFIGS[args.config](args.batch, args.iters)

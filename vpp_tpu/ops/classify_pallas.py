@@ -1,0 +1,137 @@
+"""Pallas-tiled first-match classify for large rule tables.
+
+The dense XLA path materialises a [B, N] predicate matrix; at N = 64k
+rules and a 16k-packet dispatch that is a gigabyte-scale intermediate
+streamed through HBM.  This kernel tiles the evaluation over
+[TILE_B, TILE_N] blocks held in VMEM and reduces each packet's
+first-match rule index ACROSS rule tiles with a running minimum, so the
+full matrix never exists (SURVEY §7.3: "10k rules x 256 pkts is a
+2.5M-lane predicate eval — needs Pallas tiling").
+
+Semantics are identical to classify._first_match_action: lowest-index
+matching rule within the packet's side table wins; the caller maps the
+index to an action (no match -> DENY, NO_TABLE side -> PERMIT).
+
+All uint32 inputs are bitcast to int32 before entering the kernel:
+masking and equality are bit-pattern operations, and int32 keeps the
+kernel inside the best-supported TPU vector types.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+TILE_B = 256   # packets per block (the VPP vector size)
+TILE_N = 2048  # rules per block
+
+# "No match" sentinel: larger than any rule index (plain int so the
+# kernel sees a compile-time constant, not a captured traced value).
+_NO_MATCH = 2**31 - 1
+
+
+def _first_match_kernel(
+    side_tid_ref, src_ip_ref, dst_ip_ref, proto_ref, sport_ref, dport_ref,
+    rule_valid_ref, rule_tid_ref,
+    rule_src_base_ref, rule_src_mask_ref, rule_dst_base_ref, rule_dst_mask_ref,
+    rule_proto_ref, rule_src_port_ref, rule_dst_port_ref,
+    best_ref,
+):
+    # Blocks arrive as [1, TILE] rows of the 2-D-reshaped arrays (TPU
+    # layouts want >=2-D, 128-aligned last dims).
+    j = pl.program_id(1)
+
+    src_ip = src_ip_ref[0, :]     # [TILE_B] int32 (bitcast uint32)
+    dst_ip = dst_ip_ref[0, :]
+    proto = proto_ref[0, :]
+    sport = sport_ref[0, :]
+    dport = dport_ref[0, :]
+    side_tid = side_tid_ref[0, :]
+
+    rsm = rule_src_mask_ref[0, :]  # [TILE_N]
+    rsb = rule_src_base_ref[0, :]
+    rdm = rule_dst_mask_ref[0, :]
+    rdb = rule_dst_base_ref[0, :]
+    rproto = rule_proto_ref[0, :]
+    rsp = rule_src_port_ref[0, :]
+    rdp = rule_dst_port_ref[0, :]
+    rtid = rule_tid_ref[0, :]
+    rvalid = rule_valid_ref[0, :]
+
+    # [TILE_B, TILE_N] block predicate, all in VMEM.
+    src_ok = (src_ip[:, None] & rsm[None, :]) == rsb[None, :]
+    dst_ok = (dst_ip[:, None] & rdm[None, :]) == rdb[None, :]
+    proto_any = rproto[None, :] == 0
+    proto_ok = proto[:, None] == rproto[None, :]
+    sport_ok = (rsp[None, :] == 0) | (sport[:, None] == rsp[None, :])
+    dport_ok = (rdp[None, :] == 0) | (dport[:, None] == rdp[None, :])
+    l4_ok = proto_any | (proto_ok & sport_ok & dport_ok)
+    in_table = (
+        (rvalid[None, :] != 0)
+        & src_ok & dst_ok & l4_ok
+        & (rtid[None, :] == side_tid[:, None])
+    )
+
+    col = jax.lax.broadcasted_iota(jnp.int32, in_table.shape, dimension=1)
+    local = jnp.min(jnp.where(in_table, col, _NO_MATCH), axis=1)
+    cand = jnp.where(local == _NO_MATCH, _NO_MATCH, j * TILE_N + local)
+
+    @pl.when(j == 0)
+    def _init():
+        best_ref[0, :] = cand
+
+    @pl.when(j > 0)
+    def _accum():
+        best_ref[0, :] = jnp.minimum(best_ref[0, :], cand)
+
+
+def _bitcast_i32(a: jnp.ndarray) -> jnp.ndarray:
+    if a.dtype == jnp.uint32:
+        return jax.lax.bitcast_convert_type(a, jnp.int32)
+    return a.astype(jnp.int32)
+
+
+def first_match_index_pallas(tables, batch, side_tid, *, interpret: bool = False):
+    """[B] first-match rule index (``_NO_MATCH`` when none) for each
+    packet against its side table.  Requires B % TILE_B == 0 and
+    N % TILE_N == 0 (the pow2 bucketing guarantees the latter once the
+    table crosses the pallas threshold)."""
+    b = batch.src_ip.shape[0]
+    n = tables.rule_valid.shape[0]
+    assert b % TILE_B == 0 and n % TILE_N == 0, (b, n)
+
+    def brows(a):  # [B] -> [1, B]; blocks slice the last dim
+        return _bitcast_i32(a).reshape(1, b)
+
+    def rrows(a):  # [N] -> [1, N]
+        return _bitcast_i32(a).reshape(1, n)
+
+    batch_spec = pl.BlockSpec((1, TILE_B), lambda i, j: (0, i))
+    rule_spec = pl.BlockSpec((1, TILE_N), lambda i, j: (0, j))
+
+    best = pl.pallas_call(
+        _first_match_kernel,
+        grid=(b // TILE_B, n // TILE_N),
+        in_specs=[batch_spec] * 6 + [rule_spec] * 9,
+        out_specs=pl.BlockSpec((1, TILE_B), lambda i, j: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, b), jnp.int32),
+        interpret=interpret,
+    )(
+        brows(side_tid),
+        brows(batch.src_ip),
+        brows(batch.dst_ip),
+        brows(batch.protocol),
+        brows(batch.src_port),
+        brows(batch.dst_port),
+        rrows(tables.rule_valid),
+        rrows(tables.rule_tid),
+        rrows(tables.rule_src_base),
+        rrows(tables.rule_src_mask),
+        rrows(tables.rule_dst_base),
+        rrows(tables.rule_dst_mask),
+        rrows(tables.rule_proto),
+        rrows(tables.rule_src_port),
+        rrows(tables.rule_dst_port),
+    )
+    return best.reshape(b)
