@@ -323,3 +323,61 @@ def test_event_history_records():
         assert hist[1].error is None
     finally:
         ctl.stop()
+
+
+def test_periodic_healing_resyncs(monkeypatch):
+    """periodicHealing (plugin_controller.go :411-425): with the interval
+    configured, HealingResync(PERIODIC) events fire repeatedly."""
+    trace = []
+    ctl, sink = make_controller(
+        [TracingHandler("h", trace)], periodic_healing_interval=0.05
+    )
+    try:
+        ctl.push_event(DBResync())
+        deadline = time.time() + 3.0
+        while time.time() < deadline and sink.replayed < 2:
+            time.sleep(0.02)
+        # Periodic healing = downstream resync: southbound state replayed
+        # repeatedly without a full northbound recompute.
+        assert sink.replayed >= 2
+        assert ctl.resync_count == 1
+        descriptions = [r.description for r in ctl.event_history]
+        assert any("Periodic" in d for d in descriptions)
+    finally:
+        ctl.stop()
+
+
+def test_startup_resync_deadline_escalates():
+    """signalStartupResyncCheck (:383-393, :454-464): no resync within
+    the deadline -> FatalError via on_fatal, agent aborting."""
+    fatal = []
+    sink = MockSink()
+    ctl = Controller(
+        [TracingHandler("h", [])], sink,
+        startup_resync_deadline=0.1, on_fatal=fatal.append,
+    )
+    ctl.start()
+    try:
+        deadline = time.time() + 3.0
+        while time.time() < deadline and not fatal:
+            time.sleep(0.02)
+        assert fatal and "startup resync" in str(fatal[0])
+    finally:
+        ctl.stop()
+
+
+def test_startup_resync_deadline_satisfied():
+    fatal = []
+    sink = MockSink()
+    ctl = Controller(
+        [TracingHandler("h", [])], sink,
+        startup_resync_deadline=0.2, on_fatal=fatal.append,
+    )
+    ctl.start()
+    try:
+        ctl.push_event(DBResync())
+        time.sleep(0.4)
+        assert not fatal
+        assert ctl.resync_count == 1
+    finally:
+        ctl.stop()

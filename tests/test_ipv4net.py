@@ -159,3 +159,76 @@ def test_resync_rebuilds_pod_wiring_from_kube_state():
     finally:
         node["watcher"].stop()
         node["ctl"].stop()
+
+
+def test_dhcp_main_interface_flow():
+    """UseDHCP path (contivconf_api.go UseDHCP :32-36, node.go
+    handleDHCPNotification :188-240): the main interface renders as a
+    DHCP client with no static IP; the lease event publishes the node IP
+    and installs the learned default route; duplicate leases are no-ops."""
+    from dataclasses import replace
+
+    from vpp_tpu.ipv4net import DHCPLeaseChange
+
+    store = KVStore()
+    base = NetworkConfig()
+    config = replace(
+        base, interface=replace(base.interface, main_interface="eth0",
+                                use_dhcp=True),
+    )
+    n = boot(store, "node-1", config=config)
+    try:
+        def published():
+            rec = n["nodesync"].get_all_nodes().get("node-1")
+            return rec.ip_addresses if rec else ()
+
+        assert wait_for(lambda: n["fib"].get_interface("eth0") is not None)
+        main_if = n["fib"].get_interface("eth0")
+        assert main_if.dhcp and main_if.ip_addresses == ()
+        # No node IP published until a lease arrives.
+        assert published() == ()
+
+        ev = DHCPLeaseChange("eth0", "192.168.16.77/24", gateway="192.168.16.1")
+        n["ctl"].push_event(ev)
+        assert wait_for(lambda: published() == ("192.168.16.77/24",))
+        assert wait_for(
+            lambda: any(
+                s.key.endswith("0.0.0.0/0") and getattr(s.applied, "next_hop", "") == "192.168.16.1"
+                for s in n["sched"].dump()
+            )
+        )
+        # A lease for some other interface is ignored.
+        n["ctl"].push_event(DHCPLeaseChange("eth9", "10.0.0.5/24", "10.0.0.1"))
+        time.sleep(0.1)
+        assert published() == ("192.168.16.77/24",)
+
+        # The overlay consumes the leased address: a second node joins
+        # (publishing its own underlay IP) and the tunnel to it must be
+        # sourced from the lease, not IPAM arithmetic.
+        other = NodeSync(store, "node-2")
+        other.allocate_id()
+        other.publish_node_ips(("192.168.16.200/24",))
+        assert wait_for(lambda: n["fib"].get_interface("vxlan2") is not None)
+        tun = n["fib"].get_interface("vxlan2")
+        assert tun.vxlan_src == "192.168.16.77"
+        assert tun.vxlan_dst == "192.168.16.200"
+    finally:
+        n["watcher"].stop()
+        n["ctl"].stop()
+
+
+def test_static_main_interface_rendered():
+    from dataclasses import replace
+
+    store = KVStore()
+    base = NetworkConfig()
+    config = replace(base, interface=replace(base.interface, main_interface="eth0"))
+    n = boot(store, "node-1", config=config)
+    try:
+        assert wait_for(lambda: n["fib"].get_interface("eth0") is not None)
+        main_if = n["fib"].get_interface("eth0")
+        assert not main_if.dhcp
+        assert main_if.ip_addresses and main_if.ip_addresses[0].endswith("/24")
+    finally:
+        n["watcher"].stop()
+        n["ctl"].stop()

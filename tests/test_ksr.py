@@ -283,3 +283,45 @@ class TestPlugin:
         stats = plugin.get_stats()
         assert stats["pods"]["adds"] >= 1
         plugin.close()
+
+
+class TestSfcReflector:
+    """sfc_pod_reflector.go analog: pods labeled sfc=true reflected as
+    {pod, node} records under the sfc/ prefix."""
+
+    def test_only_sfc_labeled_pods_reflected(self, setup):
+        cluster, store, _, reflectors = setup
+        sfc_pod = k8s_pod("chain-1", labels={"sfc": "true"})
+        sfc_pod["spec"]["nodeName"] = "node-7"
+        cluster.apply("pods", sfc_pod)
+        cluster.apply("pods", k8s_pod("plain", labels={"app": "web"}))
+        r = reflectors["sfc-pods"]
+        r.start()
+        assert r.has_synced
+        from vpp_tpu.models import Sfc
+
+        rec = store.get(resource("sfc").key_prefix + "default/chain-1")
+        assert rec == Sfc(pod="chain-1", node="node-7", namespace="default")
+        assert store.get(resource("sfc").key_prefix + "default/plain") is None
+        # Filtered misses are not "malformed" errors.
+        assert r.stats.arg_errors == 0
+
+    def test_label_removal_deletes_sfc_record(self, setup):
+        cluster, store, _, reflectors = setup
+        r = reflectors["sfc-pods"]
+        r.start()
+        sfc_pod = k8s_pod("chain-1", labels={"sfc": "true"})
+        sfc_pod["spec"]["nodeName"] = "node-7"
+        cluster.apply("pods", sfc_pod)
+        key = resource("sfc").key_prefix + "default/chain-1"
+        assert store.get(key) is not None
+        # Label flips off: the record must be deleted, not left stale.
+        plain = k8s_pod("chain-1", labels={})
+        plain["spec"]["nodeName"] = "node-7"
+        cluster.apply("pods", plain)
+        assert store.get(key) is None
+        # Pod deletion with the label present also cleans up.
+        cluster.apply("pods", sfc_pod)
+        assert store.get(key) is not None
+        cluster.delete("pods", "chain-1", "default")
+        assert store.get(key) is None

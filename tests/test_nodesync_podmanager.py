@@ -126,3 +126,57 @@ def test_podmanager_addpod_revert_on_failure():
         assert pm.local_pods == {}
     finally:
         ctl.stop()
+
+
+class FakeRuntime:
+    """Injectable container-runtime client (the Docker-client analog)."""
+
+    def __init__(self, sandboxes=(), fail=False):
+        self.sandboxes = list(sandboxes)
+        self.fail = fail
+
+    def list_sandboxes(self):
+        if self.fail:
+            raise RuntimeError("runtime down")
+        return list(self.sandboxes)
+
+
+def test_podmanager_resyncs_from_container_runtime():
+    """podmanager.go Resync :137-200: local pods re-learned from the
+    runtime on the first resync and on healing resyncs only; non-running,
+    unlabeled and bare sandboxes are skipped."""
+    from vpp_tpu.controller.api import DBResync, HealingResync, HealingResyncType
+    from vpp_tpu.models import PodID
+    from vpp_tpu.podmanager import PodManager, Sandbox
+
+    runtime = FakeRuntime([
+        Sandbox("c1", "web-1", "default", "/var/run/netns/c1"),
+        Sandbox("c2", "db-1", "prod", "", pid=42),
+        Sandbox("c3", "gone", "default", state="exited"),
+        Sandbox("c4", "", ""),                      # missing identification
+        Sandbox("c5", "bare", "default", pid=0),    # no process
+    ])
+    pm = PodManager(runtime=runtime)
+    pm.resync(DBResync(), {}, 1, None)
+    pods = pm.local_pods
+    assert set(pods) == {PodID("web-1", "default"), PodID("db-1", "prod")}
+    assert pods[PodID("web-1", "default")].container_id == "c1"
+    assert pods[PodID("db-1", "prod")].network_namespace == "/proc/42/ns/net"
+
+    # Later plain resyncs do NOT re-read the runtime...
+    runtime.sandboxes.append(Sandbox("c9", "late", "default"))
+    pm.resync(DBResync(), {}, 2, None)
+    assert PodID("late", "default") not in pm.local_pods
+    # ...but healing resyncs do.
+    pm.resync(HealingResync(HealingResyncType.AFTER_ERROR), {}, 3, None)
+    assert PodID("late", "default") in pm.local_pods
+
+
+def test_podmanager_runtime_failure_is_fatal():
+    import pytest
+    from vpp_tpu.controller.api import DBResync, FatalError
+    from vpp_tpu.podmanager import PodManager
+
+    pm = PodManager(runtime=FakeRuntime(fail=True))
+    with pytest.raises(FatalError):
+        pm.resync(DBResync(), {}, 1, None)

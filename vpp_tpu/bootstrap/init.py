@@ -55,7 +55,8 @@ def bootstrap_config(
         cfg = replace(
             cfg,
             interface=replace(cfg.interface,
-                              main_interface=node_config.main_interface.name),
+                              main_interface=node_config.main_interface.name,
+                              use_dhcp=node_config.main_interface.use_dhcp),
         )
 
     stn_iface = ""

@@ -74,6 +74,9 @@ class InterfaceConfig:
     # Steal-the-NIC mode: the single host NIC is taken over by the
     # data plane.
     stn_mode: bool = False
+    # Acquire the main-interface IP via DHCP instead of IPAM arithmetic
+    # (contivconf_api.go UseDHCP :32-36 / NodeInterconnectDHCP :118-120).
+    use_dhcp: bool = False
 
 
 @dataclass(frozen=True)

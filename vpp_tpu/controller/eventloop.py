@@ -47,6 +47,13 @@ from .txn import Txn, TxnSink, RecordedTxn
 log = logging.getLogger(__name__)
 
 
+class _StartupResyncCheck(Event):
+    """Internal sentinel: the startup-resync deadline elapsed
+    (plugin_controller.go startupResyncCheck channel, :454-464)."""
+
+    name = "Startup Resync Check"
+
+
 @dataclass
 class HandlerRecord:
     """Outcome of one handler for one event."""
@@ -95,11 +102,21 @@ class Controller:
         healing_delay: float = 5.0,
         on_fatal: Optional[Callable[[Exception], None]] = None,
         history_limit: int = 1000,
+        periodic_healing_interval: float = 0.0,
+        startup_resync_deadline: float = 0.0,
     ):
         self.handlers = list(handlers)
         self.sink = sink
         self.healing_delay = healing_delay
         self.on_fatal = on_fatal
+        # Optional periodic healing resync (plugin_controller.go
+        # periodicHealing :411-425; disabled by default, as in the
+        # reference's config).
+        self.periodic_healing_interval = periodic_healing_interval
+        # Abort if the first resync does not land within the deadline
+        # (signalStartupResyncCheck :383-393, check :454-464; the
+        # reference restarts the agent via statuscheck).  0 = disabled.
+        self.startup_resync_deadline = startup_resync_deadline
 
         self.kube_state: KubeStateData = {}
         self.external_config: Dict[str, Any] = {}
@@ -127,6 +144,38 @@ class Controller:
     def start(self) -> None:
         self._thread = threading.Thread(target=self._event_loop, name="event-loop", daemon=True)
         self._thread.start()
+        if self.startup_resync_deadline > 0:
+            timer = threading.Timer(
+                self.startup_resync_deadline, self._startup_resync_check
+            )
+            timer.daemon = True
+            timer.start()
+        if self.periodic_healing_interval > 0:
+            self._schedule_periodic_healing()
+
+    def _startup_resync_check(self) -> None:
+        """The startup deadline fired: enqueue a sentinel processed ON THE
+        LOOP THREAD (the only legal toucher of ``_delayed``); the loop
+        escalates a FatalError if no resync has landed (the reference
+        marks the agent not-ready so K8s restarts it)."""
+        if not self._shutdown:
+            self._queue.put(_StartupResyncCheck())
+
+    def _schedule_periodic_healing(self) -> None:
+        def fire():
+            if self._shutdown:
+                return
+            # Heal only once the first resync established state; before
+            # that there is nothing to replay (the reference starts
+            # periodicHealing alongside the loop but HealingResyncs would
+            # otherwise pile up in the delayed queue).
+            if self._started_resync:
+                self._queue.put(HealingResync(HealingResyncType.PERIODIC))
+            self._schedule_periodic_healing()
+
+        timer = threading.Timer(self.periodic_healing_interval, fire)
+        timer.daemon = True
+        timer.start()
 
     def stop(self, timeout: float = 10.0) -> None:
         """Push Shutdown and wait for the loop to drain."""
@@ -207,6 +256,22 @@ class Controller:
         try:
             event = self._queue.get(timeout=0.1)
         except queue.Empty:
+            return None
+        if isinstance(event, _StartupResyncCheck):
+            # Deadline sentinel, handled here so all _delayed access stays
+            # on the loop thread (plugin_controller.go :454-464).
+            if not self._started_resync:
+                err = FatalError(
+                    f"startup resync has not executed within the first "
+                    f"{self.startup_resync_deadline:.0f} seconds"
+                )
+                log.error(str(err))
+                for ev in self._delayed:
+                    ev.done(err)
+                self._delayed = []
+                self._shutdown = True
+                if self.on_fatal is not None:
+                    self.on_fatal(err)
             return None
         if not self._started_resync:
             if isinstance(event, (DBResync, Shutdown)):

@@ -8,7 +8,7 @@ from .model import (
     VrfTable,
     CONFIG_PREFIX,
 )
-from .plugin import IPv4Net
+from .plugin import DHCPLeaseChange, IPv4Net
 
 __all__ = [
     "Interface",
@@ -20,4 +20,5 @@ __all__ = [
     "VrfTable",
     "CONFIG_PREFIX",
     "IPv4Net",
+    "DHCPLeaseChange",
 ]

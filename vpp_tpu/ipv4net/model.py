@@ -54,6 +54,9 @@ class Interface:
     host_if_name: str = ""
     namespace: str = ""
     physical_address: str = ""
+    # Acquire the address via DHCP instead of ip_addresses
+    # (vpp_interfaces.Interface SetDhcpClient analog).
+    dhcp: bool = False
 
     @property
     def key(self) -> str:

@@ -24,7 +24,7 @@ import logging
 from typing import Dict, List, Optional
 
 from ..conf import NetworkConfig
-from ..controller.api import EventHandler, KubeStateChange
+from ..controller.api import EventHandler, KubeStateChange, UpdateEvent
 from ..ipam import IPAM
 from ..models import PodID
 from ..nodesync import NodeSync, NodeUpdate
@@ -40,6 +40,25 @@ from .model import (
 )
 
 log = logging.getLogger(__name__)
+
+
+class DHCPLeaseChange(UpdateEvent):
+    """A DHCP lease arrived / changed on an interface (the notification
+    the reference's handleDHCPNotification consumes, ipv4net/node.go
+    :188-240).  Pushed into the event loop by the platform's DHCP-client
+    integration."""
+
+    name = "DHCP Lease Change"
+
+    def __init__(self, interface: str, ip_address: str, gateway: str = ""):
+        super().__init__()
+        self.interface = interface
+        self.ip_address = ip_address  # "a.b.c.d/len"
+        self.gateway = gateway
+
+    def describe(self) -> str:
+        return f"{self.interface}: {self.ip_address} gw {self.gateway}"
+
 
 VXLAN_BVI_NAME = "vxlanBVI"
 VXLAN_BD_NAME = "vxlanBD"
@@ -74,11 +93,17 @@ class IPv4Net(EventHandler):
         # PodManager supplies CNI-added local pods not (yet) reflected
         # into KubeState, so resyncs do not tear their wiring down.
         self.podmanager = podmanager
+        # DHCP mode for the main interface (UseDHCP / NodeInterconnectDHCP):
+        # the node IP comes from the lease, not IPAM arithmetic.
+        self.use_dhcp = (
+            config.interface.use_dhcp or config.ipam.node_interconnect_dhcp
+        )
+        self._dhcp_lease: Optional[DHCPLeaseChange] = None
 
     # --------------------------------------------------------------- resync
 
     def handles_event(self, event) -> bool:
-        if isinstance(event, (AddPod, DeletePod, NodeUpdate)):
+        if isinstance(event, (AddPod, DeletePod, NodeUpdate, DHCPLeaseChange)):
             return True
         if isinstance(event, KubeStateChange):
             return False
@@ -118,10 +143,15 @@ class IPv4Net(EventHandler):
             for kv in self.pod_connectivity_config(pod_id, str(ip)):
                 txn.put(kv.key, kv)
 
-        # Publish our data-plane IPs for other nodes.
-        self.nodesync.publish_node_ips(
-            (f"{self.ipam.node_ip()}/{self.config.ipam.node_interconnect().prefixlen}",),
-        )
+        # Publish our data-plane IPs for other nodes.  In DHCP mode the
+        # node IP is known only once a lease arrives (node.go
+        # handleDHCPNotification publishes then).
+        if not self.use_dhcp:
+            self.nodesync.publish_node_ips(
+                (f"{self.ipam.node_ip()}/{self.config.ipam.node_interconnect().prefixlen}",),
+            )
+        elif self._dhcp_lease is not None:
+            self.nodesync.publish_node_ips((self._dhcp_lease.ip_address,))
 
     # ------------------------------------------------------- config builders
 
@@ -169,10 +199,61 @@ class IPv4Net(EventHandler):
                 via_vrf=self.config.routing.main_vrf_id,
             )
         )
+        # Main (physical) data-plane interface: static IP from IPAM
+        # arithmetic, or a DHCP client (node.go configureVswitchNICs —
+        # UseDHCP path) whose address/gateway arrive via DHCPLeaseChange.
+        main_if = self.config.interface.main_interface
+        if main_if:
+            if self.use_dhcp:
+                kvs.append(
+                    Interface(
+                        name=main_if,
+                        type=InterfaceType.DPDK,
+                        dhcp=True,
+                        vrf=routing.main_vrf_id,
+                        mtu=self.config.interface.mtu,
+                    )
+                )
+                if self._dhcp_lease is not None and self._dhcp_lease.gateway:
+                    kvs.append(
+                        Route(
+                            dst_network="0.0.0.0/0",
+                            next_hop=self._dhcp_lease.gateway,
+                            outgoing_interface=main_if,
+                            vrf=routing.main_vrf_id,
+                        )
+                    )
+            else:
+                prefix = self.config.ipam.node_interconnect().prefixlen
+                kvs.append(
+                    Interface(
+                        name=main_if,
+                        type=InterfaceType.DPDK,
+                        ip_addresses=(f"{ipam.node_ip()}/{prefix}",),
+                        vrf=routing.main_vrf_id,
+                        mtu=self.config.interface.mtu,
+                    )
+                )
         return kvs
 
     def _vxlan_if_name(self, node_id: int) -> str:
         return f"vxlan{node_id}"
+
+    def _this_node_ip(self) -> str:
+        """This node's underlay address: the DHCP lease when in DHCP mode
+        (before a lease arrives the arithmetic address is a placeholder,
+        re-rendered on DHCPLeaseChange), IPAM arithmetic otherwise."""
+        if self.use_dhcp and self._dhcp_lease is not None:
+            return self._dhcp_lease.ip_address.split("/")[0]
+        return str(self.ipam.node_ip())
+
+    def _other_node_ip(self, node_id: int) -> str:
+        """Another node's underlay address: its PUBLISHED VppNode record
+        is authoritative (it may run DHCP too); arithmetic fallback."""
+        for rec in self.nodesync.other_nodes().values():
+            if rec.id == node_id and rec.ip_addresses:
+                return rec.ip_addresses[0].split("/")[0]
+        return str(self.ipam.node_ip(node_id))
 
     def node_connectivity_config(self, node_id: int) -> List:
         """Connectivity to one other node (vxlanIfToOtherNode :524 +
@@ -188,8 +269,8 @@ class IPv4Net(EventHandler):
                 Interface(
                     name=vxlan_if,
                     type=InterfaceType.VXLAN,
-                    vxlan_src=str(ipam.node_ip()),
-                    vxlan_dst=str(ipam.node_ip(node_id)),
+                    vxlan_src=self._this_node_ip(),
+                    vxlan_dst=self._other_node_ip(node_id),
                     vxlan_vni=VXLAN_VNI,
                     mtu=self.config.interface.mtu,
                 ),
@@ -208,7 +289,7 @@ class IPv4Net(EventHandler):
             next_hop = str(other_bvi)
             out_if = VXLAN_BVI_NAME
         else:
-            next_hop = str(ipam.node_ip(node_id))
+            next_hop = self._other_node_ip(node_id)
             out_if = ""
         kvs += [
             Route(
@@ -256,7 +337,44 @@ class IPv4Net(EventHandler):
             return self._delete_pod(event, txn)
         if isinstance(event, NodeUpdate):
             return self._node_update(event, txn)
+        if isinstance(event, DHCPLeaseChange):
+            return self._dhcp_lease_change(event, txn)
         return ""
+
+    def _dhcp_lease_change(self, event: DHCPLeaseChange, txn) -> str:
+        """handleDHCPNotification analog (node.go :188-240): validate the
+        lease, learn the node IP, publish it, install the default route."""
+        if not self.use_dhcp:
+            return ""  # dynamic assignment disabled
+        if event.interface != self.config.interface.main_interface:
+            return ""  # not the main interface
+        prev = self._dhcp_lease
+        if (
+            prev is not None
+            and prev.ip_address == event.ip_address
+            and prev.gateway == event.gateway
+        ):
+            return ""  # lease already processed
+        self._dhcp_lease = event
+        route = Route(
+            dst_network="0.0.0.0/0",
+            next_hop=event.gateway,
+            outgoing_interface=self.config.interface.main_interface,
+            vrf=self.config.routing.main_vrf_id,
+        )
+        if event.gateway:
+            txn.put(route.key, route)
+        elif prev is not None and prev.gateway:
+            # Renewed lease without a gateway: the old default route must
+            # not linger.
+            txn.delete(route.key)
+        # The node IP feeds VXLAN tunnel sources: re-render the overlay
+        # with the leased address.
+        for node in self.nodesync.other_nodes().values():
+            for kv in self.node_connectivity_config(node.id):
+                txn.put(kv.key, kv)
+        self.nodesync.publish_node_ips((event.ip_address,))
+        return f"DHCP lease on {event.interface}: {event.ip_address}"
 
     def _add_pod(self, event: AddPod, txn) -> str:
         pod_id = event.pod.id

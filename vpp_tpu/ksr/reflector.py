@@ -99,8 +99,17 @@ class Reflector:
         broker: Broker,
         min_resync_timeout: float = 0.1,
         max_resync_timeout: float = 1.0,
+        watch_kind: Optional[str] = None,
+        filtered: bool = False,
     ):
         self.kind = kind
+        # The K8s kind actually listed/watched; differs for derived
+        # reflectors like SFC (watches "pods", writes under sfc/ —
+        # reference sfc_pod_reflector.go).
+        self.watch_kind = watch_kind or kind
+        # A filtered reflector's converter returning None means "object
+        # not selected", not "malformed" (the SFC label filter).
+        self.filtered = filtered
         self.prefix = prefix
         self.converter = converter
         self.list_watch = list_watch
@@ -128,9 +137,9 @@ class Reflector:
         controller's dbwatcher uses); early change events simply land in
         the cache (``_ds_synced`` is still False) and the reconciliation
         absorbs duplicates."""
-        self.list_watch.subscribe(self.kind, self._on_change)
+        self.list_watch.subscribe(self.watch_kind, self._on_change)
         with self._lock:
-            for obj in self.list_watch.list(self.kind):
+            for obj in self.list_watch.list(self.watch_kind):
                 conv = self._convert(obj)
                 if conv is not None:
                     model, key = conv
@@ -145,7 +154,7 @@ class Reflector:
         self._abort.set()
         unsubscribe = getattr(self.list_watch, "unsubscribe", None)
         if unsubscribe is not None:
-            unsubscribe(self.kind, self._on_change)
+            unsubscribe(self.watch_kind, self._on_change)
 
     @property
     def has_synced(self) -> bool:
@@ -159,7 +168,7 @@ class Reflector:
             conv = self.converter(obj)
         except Exception:
             conv = None
-        if conv is None:
+        if conv is None and not self.filtered:
             self.stats.arg_errors += 1
             log.warning("%s reflector: malformed object dropped", self.kind)
         return conv
@@ -170,6 +179,22 @@ class Reflector:
                 return
             conv = self._convert(obj)
             if conv is None:
+                if self.filtered and event == "update" and old_obj is not None:
+                    # Selected before, deselected now (e.g. the sfc=true
+                    # label removed): treat as a delete of the old key
+                    # (reference sfc_pod_reflector.go updatePod).
+                    old_conv = self._convert(old_obj)
+                    if old_conv is not None:
+                        _, old_key = old_conv
+                        self._k8s_cache.pop(old_key, None)
+                        if self._ds_synced:
+                            try:
+                                self.broker.delete(old_key)
+                                self.stats.deletes += 1
+                            except Exception:
+                                self.stats.del_errors += 1
+                                self._ds_synced = False
+                                self.start_data_store_resync()
                 return
             model, key = conv
             if event == "delete":

@@ -273,6 +273,22 @@ def endpoints_to_model(obj: Dict) -> Optional[Tuple[Endpoints, str]]:
 # ------------------------------------------------------------------ node
 
 
+def sfc_to_model(obj: Dict) -> Optional[Tuple["Sfc", str]]:
+    """SFC pod filter (sfc_pod_reflector.go K8s2NodeFunc :56-73): only
+    pods labeled ``sfc=true`` are reflected, as {pod, node} records."""
+    from ..models import Sfc
+
+    name, namespace, labels = _meta(obj)
+    if not name or labels.get("sfc") != "true":
+        return None
+    model = Sfc(
+        pod=name,
+        node=obj.get("spec", {}).get("nodeName", ""),
+        namespace=namespace,
+    )
+    return model, key_for(model)
+
+
 def node_to_model(obj: Dict) -> Optional[Tuple[Node, str]]:
     name, _, labels = _meta(obj)
     if not name:
@@ -302,7 +318,14 @@ CONVERTERS = {
     "services": ("service", service_to_model),
     "endpoints": ("endpoints", endpoints_to_model),
     "nodes": ("node", node_to_model),
+    # Derived reflector: watches pods, reflects only those labeled
+    # sfc=true under the sfc/ prefix (sfc_pod_reflector.go).
+    "sfc-pods": ("sfc", sfc_to_model),
 }
+
+# Reflectors whose watched K8s kind differs from their registry keyword.
+WATCH_KINDS = {"sfc-pods": "pods"}
+FILTERED = {"sfc-pods"}
 
 
 def make_reflectors(
@@ -323,5 +346,7 @@ def make_reflectors(
             broker=broker,
             min_resync_timeout=min_resync_timeout,
             max_resync_timeout=max_resync_timeout,
+            watch_kind=WATCH_KINDS.get(kind),
+            filtered=kind in FILTERED,
         )
     return out

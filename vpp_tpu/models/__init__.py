@@ -27,6 +27,7 @@ from .policy import (
 from .service import Service, ServiceID, ServicePort
 from .endpoints import Endpoints, EndpointSubset, EndpointAddress, EndpointPort
 from .node import Node, NodeAddress
+from .sfc import Sfc
 from .vppnode import VppNode
 from .registry import DbResource, DB_RESOURCES, resource_for_key, key_for
 
@@ -58,7 +59,7 @@ __all__ = [
     "EndpointPort",
     "Node",
     "NodeAddress",
-    "VppNode",
+    "Sfc", "VppNode",
     "DbResource",
     "DB_RESOURCES",
     "resource_for_key",

@@ -18,6 +18,7 @@ from .node import Node
 from .pod import Pod
 from .policy import Policy
 from .service import Service
+from .sfc import Sfc
 from .vppnode import VppNode
 
 # Root prefix of everything the control plane keeps in the KV store
@@ -48,6 +49,7 @@ DB_RESOURCES = (
     DbResource("service", KSR_PREFIX + "service/", Service, _namespaced),
     DbResource("endpoints", KSR_PREFIX + "endpoints/", Endpoints, _namespaced),
     DbResource("node", KSR_PREFIX + "node/", Node, lambda o: o.name),
+    DbResource("sfc", KSR_PREFIX + "sfc/", Sfc, lambda o: f"{o.namespace}/{o.pod}"),
     DbResource("vppnode", NODESYNC_PREFIX + "vppnode/", VppNode, lambda o: str(o.id)),
 )
 

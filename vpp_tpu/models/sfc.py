@@ -1,0 +1,18 @@
+"""SFC pod marker model.
+
+Analog of the reference's ``plugins/ksr/model/sfc/sfc.proto``: pods
+labeled ``sfc=true`` are reflected as a tiny {pod, node} record under
+their own key prefix, feeding service-function-chaining consumers."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Sfc:
+    """sfc.proto Sfc message (:22-31): pod name + scheduled node."""
+
+    pod: str
+    node: str = ""
+    namespace: str = "default"

@@ -1,3 +1,13 @@
-from .podmanager import PodManager, AddPod, DeletePod, LocalPod
+from .podmanager import (
+    AddPod,
+    ContainerRuntime,
+    DeletePod,
+    LocalPod,
+    PodManager,
+    Sandbox,
+)
 
-__all__ = ["PodManager", "AddPod", "DeletePod", "LocalPod"]
+__all__ = [
+    "PodManager", "AddPod", "DeletePod", "LocalPod",
+    "ContainerRuntime", "Sandbox",
+]

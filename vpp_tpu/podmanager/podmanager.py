@@ -84,13 +84,37 @@ class DeletePod(UpdateEvent):
         return f"{self.name} [{self.pod_id}]"
 
 
+@dataclass
+class Sandbox:
+    """One pod sandbox container as reported by the container runtime
+    (the docker.APIContainers + InspectContainer fields the reference
+    consumes, podmanager.go Resync :137-200)."""
+
+    container_id: str
+    pod_name: str = ""
+    pod_namespace: str = ""
+    network_namespace: str = ""
+    state: str = "running"
+    pid: int = 1  # 0 = bare sandbox without a process
+
+
+class ContainerRuntime:
+    """Runtime client interface (the Docker-client analog)."""
+
+    def list_sandboxes(self) -> List[Sandbox]:
+        raise NotImplementedError
+
+
 class PodManager(EventHandler):
     """Tracks local pods; front end for CNI requests."""
 
     name = "podmanager"
 
-    def __init__(self, event_loop=None):
+    def __init__(self, event_loop=None, runtime: Optional[ContainerRuntime] = None):
         self.event_loop = event_loop
+        # Container-runtime client used to re-learn local pods on resync;
+        # None = CNI-registration only (pods re-register via repeated Adds).
+        self.runtime = runtime
         self._local_pods: Dict[PodID, LocalPod] = {}
 
     # ------------------------------------------------------------ CNI facade
@@ -143,9 +167,38 @@ class PodManager(EventHandler):
         return isinstance(event, (AddPod, DeletePod)) or event.method.is_resync
 
     def resync(self, event, kube_state, resync_count, txn) -> None:
-        """On startup the reference re-learns local pods from the container
-        runtime (podmanager.go Resync :137 via Docker inspect); here pods
-        re-register through repeated CNI Adds or an injected runtime list."""
+        """Re-learn local pods from the container runtime (podmanager.go
+        Resync :137-200): list sandbox containers, skip non-running /
+        unlabeled / bare ones, rebuild the LocalPods map.  Like the
+        reference, only the first resync and healing resyncs re-read the
+        runtime (pods cannot appear without the agent knowing otherwise);
+        a runtime listing failure is fatal (agent restart + retry)."""
+        from ..controller.api import FatalError, HealingResync
+
+        if self.runtime is None:
+            return
+        if resync_count > 1 and not isinstance(event, HealingResync):
+            return
+        try:
+            sandboxes = self.runtime.list_sandboxes()
+        except Exception as e:  # noqa: BLE001 - runtime down is fatal
+            raise FatalError(f"failed to list sandbox containers: {e}")
+        pods: Dict[PodID, LocalPod] = {}
+        for sb in sandboxes:
+            if sb.state != "running":
+                continue
+            if not sb.pod_name or not sb.pod_namespace:
+                log.warning("sandbox %s missing pod identification", sb.container_id)
+                continue
+            if not sb.pid:
+                continue  # bare sandbox without a process
+            pod_id = PodID(name=sb.pod_name, namespace=sb.pod_namespace)
+            pods[pod_id] = LocalPod(
+                id=pod_id,
+                container_id=sb.container_id,
+                network_namespace=sb.network_namespace or f"/proc/{sb.pid}/ns/net",
+            )
+        self._local_pods = pods
 
     def update(self, event, txn) -> str:
         if isinstance(event, AddPod):
