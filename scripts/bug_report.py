@@ -1,0 +1,94 @@
+"""Diagnostic bundle collector — the contiv-vpp-bug-report.sh analog.
+
+Crawls one or more agents' REST APIs and writes everything a bug report
+needs into a timestamped directory (optionally tarred): liveness, IPAM
+state, node registry, local pods, controller event history, scheduler
+dump, Prometheus metrics, and the packet-trace buffer.
+
+Usage:
+    python scripts/bug_report.py --server host:port [--server ...] \\
+        [--output DIR] [--tar]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import tarfile
+import time
+import urllib.error
+import urllib.request
+
+ENDPOINTS = {
+    "liveness": "/liveness",
+    "ipam": "/contiv/v1/ipam",
+    "nodes": "/contiv/v1/nodes",
+    "pods": "/contiv/v1/pods",
+    "event-history": "/controller/event-history",
+    "scheduler-dump": "/scheduler/dump",
+    "trace": "/contiv/v1/trace",
+}
+TEXT_ENDPOINTS = {"metrics": "/metrics"}
+
+
+def collect(server: str, outdir: pathlib.Path) -> dict:
+    nodedir = outdir / server.replace(":", "_")
+    nodedir.mkdir(parents=True, exist_ok=True)
+    summary = {"server": server, "collected": [], "errors": {}}
+    for name, path in {**ENDPOINTS, **TEXT_ENDPOINTS}.items():
+        url = f"http://{server}{path}"
+        try:
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                body = resp.read()
+        except (urllib.error.URLError, OSError) as e:
+            summary["errors"][name] = str(e)
+            continue
+        if name in TEXT_ENDPOINTS:
+            (nodedir / f"{name}.txt").write_bytes(body)
+        else:
+            try:
+                data = json.loads(body)
+            except json.JSONDecodeError as e:
+                summary["errors"][name] = f"bad json: {e}"
+                continue
+            (nodedir / f"{name}.json").write_text(
+                json.dumps(data, indent=2, sort_keys=True)
+            )
+        summary["collected"].append(name)
+    (nodedir / "summary.json").write_text(json.dumps(summary, indent=2))
+    return summary
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--server", action="append", required=True,
+                        help="agent REST endpoint host:port (repeatable)")
+    parser.add_argument("--output", default="",
+                        help="output directory (default: vpp-tpu-report-<ts>)")
+    parser.add_argument("--tar", action="store_true",
+                        help="also produce <output>.tar.gz")
+    args = parser.parse_args(argv)
+
+    outdir = pathlib.Path(
+        args.output or f"vpp-tpu-report-{time.strftime('%Y%m%d-%H%M%S')}"
+    )
+    outdir.mkdir(parents=True, exist_ok=True)
+    ok = True
+    for server in args.server:
+        summary = collect(server, outdir)
+        status = "ok" if not summary["errors"] else f"errors: {summary['errors']}"
+        print(f"{server}: {len(summary['collected'])} artifacts ({status})")
+        ok = ok and bool(summary["collected"])
+    if args.tar:
+        tar_path = outdir.parent / (outdir.name + ".tar.gz")
+        with tarfile.open(tar_path, "w:gz") as tf:
+            tf.add(outdir, arcname=outdir.name)
+        print(f"bundle: {tar_path}")
+    print(f"report: {outdir}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

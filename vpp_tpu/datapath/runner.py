@@ -44,6 +44,7 @@ from ..ops.pipeline import (
 from ..ops.slowpath import HostSlowPath
 from ..shim.hostshim import FrameBatch, HostShim
 from .io import FrameSink, FrameSource
+from .trace import PacketTracer
 
 
 @dataclasses.dataclass
@@ -141,6 +142,9 @@ class DataplaneRunner:
         self.sessions: NatSessions = empty_sessions(session_capacity)
         self.slow = HostSlowPath()
         self.counters = RunnerCounters()
+        # Sampled per-packet verdict traces (vpptrace analog), enabled on
+        # demand via REST/netctl.
+        self.tracer = PacketTracer()
         self._ts = 0
         # In-flight queue of (FrameBatch, PipelineResult, ts).
         self._inflight: Deque[Tuple[FrameBatch, object, int]] = collections.deque()
@@ -305,6 +309,12 @@ class DataplaneRunner:
                     rew["dst_port"][row] = d_port
                     allowed[row] = True
                     route_tag[row], node_id[row] = self._route_of(d_ip)
+
+        # ------------------------------------------------- packet trace
+        self.tracer.record_batch(
+            ts, orig, rew, allowed, route_tag, node_id,
+            dnat_hit, snat_hit, reply_hit, punt,
+        )
 
         # -------------------------------------------- native apply + TX
         rew_batch = PacketBatch(

@@ -99,6 +99,43 @@ def cmd_metrics(server: str, out) -> int:
     return 0
 
 
+def cmd_trace(server: str, out, action: str = "", sample: int = 1) -> int:
+    """Packet tracing (scripts/vpptrace.sh analog): enable/disable/clear
+    sampled traces or dump the buffer."""
+    if action:
+        q = f"?sample={sample}" if action == "enable" else ""
+        res = _fetch(server, f"/contiv/v1/trace/{action}{q}", method="POST")
+        print(json.dumps(res), file=out)
+        return 0
+    res = _fetch(server, "/contiv/v1/trace")
+    st = res["status"]
+    print(
+        f"trace: enabled={st['enabled']} sample=1/{st['sample_every']} "
+        f"recorded={st['recorded']}/{st['capacity']} seen={st['total_seen']}",
+        file=out,
+    )
+    rows = []
+    for e in res["entries"]:
+        flags = "".join(
+            c for c, on in (("D", e["dnat"]), ("S", e["snat"]),
+                            ("R", e["reply"]), ("P", e["punt"])) if on
+        )
+        rows.append([
+            str(e["seq"]),
+            f"{e['src']}:{e['src_port']}",
+            f"{e['dst']}:{e['dst_port']}",
+            str(e["protocol"]),
+            f"{e['rw_src']}:{e['rw_src_port']}",
+            f"{e['rw_dst']}:{e['rw_dst_port']}",
+            "allow" if e["allowed"] else "deny",
+            e["route"] + (f"#{e['node_id']}" if e["route"] == "remote" else ""),
+            flags,
+        ])
+    print(_table(rows, ["SEQ", "SRC", "DST", "PROTO", "RW-SRC", "RW-DST",
+                        "VERDICT", "ROUTE", "FLAGS"]), file=out)
+    return 0
+
+
 def main(argv: Optional[List[str]] = None, out=None) -> int:
     out = out if out is not None else sys.stdout
     common = argparse.ArgumentParser(add_help=False)
@@ -112,11 +149,18 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
         sub.add_parser(name, parents=[common])
     dump = sub.add_parser("dump", parents=[common])
     dump.add_argument("prefix", nargs="?", default="")
+    trace = sub.add_parser("trace", parents=[common])
+    trace.add_argument("action", nargs="?", default="",
+                       choices=["", "enable", "disable", "clear"])
+    trace.add_argument("--sample", type=int, default=1,
+                       help="record every Nth packet")
     args = parser.parse_args(argv)
 
     try:
         if args.command == "dump":
             return cmd_dump(args.server, out, args.prefix)
+        if args.command == "trace":
+            return cmd_trace(args.server, out, args.action, args.sample)
         return {
             "nodes": cmd_nodes,
             "pods": cmd_pods,

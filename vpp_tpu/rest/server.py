@@ -61,6 +61,7 @@ class AgentRestServer:
         podmanager=None,
         scheduler=None,
         stats_registry=None,
+        tracer=None,
         host: str = "127.0.0.1",
         port: int = 0,
     ):
@@ -72,6 +73,7 @@ class AgentRestServer:
         self.podmanager = podmanager
         self.scheduler = scheduler
         self.stats_registry = stats_registry
+        self.tracer = tracer
         self.host = host
         self.port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -130,6 +132,25 @@ class AgentRestServer:
             raise LookupError("no scheduler")
         return [_jsonable(v) for v in self.scheduler.dump(prefix)]
 
+    def get_trace(self) -> dict:
+        """Sampled packet traces (scripts/vpptrace.sh `show trace` analog)."""
+        if self.tracer is None:
+            raise LookupError("no tracer")
+        return {"status": self.tracer.status(), "entries": self.tracer.dump()}
+
+    def post_trace(self, action: str, sample: int = 1) -> dict:
+        if self.tracer is None:
+            raise LookupError("no tracer")
+        if action == "enable":
+            self.tracer.enable(sample_every=sample)
+        elif action == "disable":
+            self.tracer.disable()
+        elif action == "clear":
+            self.tracer.clear()
+        else:
+            raise FileNotFoundError(f"trace action {action!r}")
+        return {"trace": action, **self.tracer.status()}
+
     def get_metrics(self) -> str:
         from prometheus_client import generate_latest
 
@@ -154,6 +175,12 @@ class AgentRestServer:
             return self.get_scheduler_dump(query.get("prefix", ""))
         if method == "GET" and path == "/metrics":
             return self.get_metrics()
+        if method == "GET" and path == "/contiv/v1/trace":
+            return self.get_trace()
+        if method == "POST" and path.startswith("/contiv/v1/trace/"):
+            return self.post_trace(
+                path.rsplit("/", 1)[1], int(query.get("sample", "1"))
+            )
         raise FileNotFoundError(path)
 
     def start(self) -> int:
@@ -172,6 +199,11 @@ class AgentRestServer:
                     return
                 except LookupError as err:
                     self.send_error(404, str(err))
+                    return
+                except ValueError as err:
+                    # Malformed client input (e.g. a non-numeric query
+                    # parameter) is the caller's fault, not a server fault.
+                    self.send_error(400, str(err))
                     return
                 except Exception as err:  # noqa: BLE001
                     self.send_error(500, str(err))
