@@ -1,0 +1,36 @@
+# Developer entry points (the reference's Makefile:80-122 analog:
+# test / test-race / lint battery).
+
+PY ?= python
+
+.PHONY: test test-race lint bench bench-suite bench-sweep bench-scale
+
+test:
+	$(PY) -m pytest tests/ -q
+
+# Race-amplified run: CPython has no Go-style race detector, so instead
+# the whole suite runs under dev mode (threading/resource warnings are
+# errors-adjacent) with a pathologically small thread switch interval,
+# maximising interleavings across the event loop, dbwatcher, scheduler
+# retry timers and the gRPC watch threads.
+test-race:
+	VPP_TPU_RACE_STRESS=1 $(PY) -X dev -m pytest tests/ -q
+
+# Static battery: byte-compile everything and verify the test tree
+# collects (import errors, syntax, circular imports).
+lint:
+	$(PY) -m compileall -q vpp_tpu tests scripts bench.py benchsuite.py
+	$(PY) -m pytest tests/ -q --collect-only > /dev/null
+	@echo lint OK
+
+bench:
+	$(PY) bench.py
+
+bench-suite:
+	$(PY) benchsuite.py
+
+bench-sweep:
+	$(PY) benchsuite.py --sweep
+
+bench-scale:
+	$(PY) benchsuite.py --scale

@@ -36,3 +36,12 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: spawns OS processes / long-running e2e"
     )
+
+
+# Race-amplification mode (make test-race): shrink the GIL switch
+# interval so thread interleavings between the event loop, watch
+# threads, retry timers and gRPC streams are exercised aggressively.
+if os.environ.get("VPP_TPU_RACE_STRESS"):
+    import sys
+
+    sys.setswitchinterval(1e-5)

@@ -191,3 +191,84 @@ def test_node_config_events():
     kinds = [(e.node, e.prev is None, e.new is None) for e in loop.events]
     assert kinds == [("node-1", True, False), ("node-1", False, True)]
     assert all(isinstance(e, NodeConfigChange) for e in loop.events)
+
+
+class TestCrdController:
+    """Informer + rate-limited workqueue analog
+    (node_config_controller.go:45-210)."""
+
+    def test_nodeconfig_crd_flows_to_store_and_events(self):
+        from vpp_tpu.crd.controller import make_node_config_controller
+        from vpp_tpu.testing.k8s import FakeK8sCluster
+
+        store = KVStore()
+        loop = type("L", (), {"events": []})()
+        loop.push_event = loop.events.append
+        crd = CRDPlugin(store, event_loop=loop, node_name="node-1")
+        k8s = FakeK8sCluster()
+        ctl = make_node_config_controller(k8s, crd)
+        ctl.start()
+        try:
+            k8s.apply("nodeconfigs", {
+                "metadata": {"name": "node-1"},
+                "spec": {
+                    "mainVPPInterface": {"interfaceName": "eth0",
+                                         "useDHCP": True},
+                    "otherVPPInterfaces": [{"interfaceName": "eth1",
+                                            "ip": "10.9.0.1/24"}],
+                    "gateway": "192.168.16.1",
+                    "natExternalTraffic": True,
+                },
+            })
+            assert ctl.wait_idle()
+            for _ in range(100):
+                if crd.get_node_config("node-1") is not None:
+                    break
+                time.sleep(0.01)
+            cfg = crd.get_node_config("node-1")
+            assert cfg is not None
+            assert cfg.main_interface == NodeInterfaceConfig(
+                name="eth0", use_dhcp=True
+            )
+            assert cfg.other_interfaces[0].ip == "10.9.0.1/24"
+            assert cfg.gateway == "192.168.16.1" and cfg.nat_external_traffic
+            assert any(isinstance(e, NodeConfigChange) for e in loop.events)
+
+            # Deletion flows through too.
+            k8s.delete("nodeconfigs", "node-1")
+            for _ in range(100):
+                if crd.get_node_config("node-1") is None:
+                    break
+                time.sleep(0.01)
+            assert crd.get_node_config("node-1") is None
+        finally:
+            ctl.stop()
+
+    def test_workqueue_retries_then_drops(self):
+        from vpp_tpu.crd.controller import CrdController
+        from vpp_tpu.testing.k8s import FakeK8sCluster
+
+        attempts = {"good": 0, "bad": 0}
+
+        def process(key, obj):
+            name = key.rsplit("/", 1)[-1]
+            attempts[name] += 1
+            if name == "bad":
+                raise RuntimeError("boom")
+
+        k8s = FakeK8sCluster()
+        ctl = CrdController("nodeconfigs", k8s, process, base_delay=0.001)
+        ctl.start()
+        try:
+            k8s.apply("nodeconfigs", {"metadata": {"name": "good"}, "spec": {}})
+            k8s.apply("nodeconfigs", {"metadata": {"name": "bad"}, "spec": {}})
+            for _ in range(300):
+                if ctl.dropped >= 1 and ctl.processed >= 1:
+                    break
+                time.sleep(0.01)
+            assert attempts["good"] == 1
+            # 1 initial + MAX_RETRIES rate-limited requeues, then dropped.
+            assert attempts["bad"] == 6
+            assert ctl.dropped == 1
+        finally:
+            ctl.stop()

@@ -115,7 +115,8 @@ def test_trace_rest_netctl_and_bug_report(traced_cluster, tmp_path):
         text = out.getvalue()
         assert "enabled=True" in text
         assert f"{client_ip}:42000" in text and backend_ip in text
-        assert "D" in text  # DNAT flag column
+        svc_line = next(ln for ln in text.splitlines() if "10.96.0.10" in ln)
+        assert svc_line.rstrip().endswith("D")  # DNAT flag on the traced row
 
         with urllib.request.urlopen(
             f"http://{server}/contiv/v1/trace", timeout=5

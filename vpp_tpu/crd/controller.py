@@ -1,0 +1,247 @@
+"""CRD controllers — the informer + rate-limited-workqueue pattern.
+
+Analog of the reference's CRD controllers
+(``plugins/crd/controller/nodeconfig/node_config_controller.go:45-210``):
+an informer (ListWatch subscription + object cache) enqueues keys into a
+rate-limited work queue; a worker processes them, requeueing failures
+with backoff up to ``maxRetries = 5`` before giving up (workqueue
+Forget/NumRequeues/AddRateLimited semantics).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue as queue_mod
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..ksr.listwatch import K8sListWatch
+from .models import NodeConfig, NodeInterfaceConfig
+
+log = logging.getLogger(__name__)
+
+MAX_RETRIES = 5  # node_config_controller.go:45
+
+
+class WorkQueue:
+    """Rate-limited work queue (client-go util/workqueue analog):
+    de-duplicates queued items, tracks per-item requeue counts, and
+    re-adds failures after an exponential delay."""
+
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 1.0):
+        self._queue: "queue_mod.Queue[object]" = queue_mod.Queue()
+        self._lock = threading.Lock()
+        self._queued: set = set()
+        self._active: set = set()       # popped, processing not finished
+        self._backoff = 0               # items waiting in retry timers
+        self._requeues: Dict[object, int] = {}
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+
+    def add(self, item) -> None:
+        with self._lock:
+            if item in self._queued:
+                return
+            self._queued.add(item)
+        self._queue.put(item)
+
+    def add_rate_limited(self, item) -> None:
+        """Re-add after a backoff derived from the item's requeue count."""
+        with self._lock:
+            self._requeues[item] = self._requeues.get(item, 0) + 1
+            self._backoff += 1
+            delay = min(
+                self.base_delay * (2 ** (self._requeues[item] - 1)),
+                self.max_delay,
+            )
+
+        def fire():
+            with self._lock:
+                self._backoff -= 1
+            self.add(item)
+
+        timer = threading.Timer(delay, fire)
+        timer.daemon = True
+        timer.start()
+
+    def num_requeues(self, item) -> int:
+        with self._lock:
+            return self._requeues.get(item, 0)
+
+    def forget(self, item) -> None:
+        with self._lock:
+            self._requeues.pop(item, None)
+
+    def get(self, timeout: float = 0.1):
+        """Pop the next item; it stays "active" until done(item)."""
+        try:
+            item = self._queue.get(timeout=timeout)
+        except queue_mod.Empty:
+            return None
+        with self._lock:
+            self._queued.discard(item)
+            self._active.add(item)
+        return item
+
+    def done(self, item) -> None:
+        with self._lock:
+            self._active.discard(item)
+
+    def idle(self) -> bool:
+        with self._lock:
+            return not self._queued and not self._active and self._backoff == 0
+
+
+class CrdController:
+    """One CRD kind: informer cache + work queue + worker."""
+
+    def __init__(
+        self,
+        kind: str,
+        list_watch: K8sListWatch,
+        process: Callable[[str, Optional[Dict]], None],
+        max_retries: int = MAX_RETRIES,
+        base_delay: float = 0.005,
+    ):
+        self.kind = kind
+        self.list_watch = list_watch
+        self.process = process
+        self.max_retries = max_retries
+        self.queue = WorkQueue(base_delay=base_delay)
+        self._objects: Dict[str, Dict] = {}  # informer cache: key -> object
+        self._lock = threading.Lock()
+        self._synced = False
+        self._stop = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+        self.processed = 0
+        self.dropped = 0  # items that exhausted their retries
+
+    @staticmethod
+    def _key(obj: Dict) -> str:
+        meta = obj.get("metadata", {})
+        ns = meta.get("namespace", "")
+        name = meta.get("name", "")
+        return f"{ns}/{name}" if ns else name
+
+    # ------------------------------------------------------------- informer
+
+    def _on_change(self, event: str, obj: Dict, old_obj: Optional[Dict]) -> None:
+        key = self._key(obj)
+        if not key:
+            return
+        with self._lock:
+            if event == "delete":
+                self._objects.pop(key, None)
+            else:
+                self._objects[key] = obj
+        self.queue.add(key)
+
+    def has_synced(self) -> bool:
+        return self._synced
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self.list_watch.subscribe(self.kind, self._on_change)
+        for obj in self.list_watch.list(self.kind):
+            key = self._key(obj)
+            if key:
+                with self._lock:
+                    self._objects[key] = obj
+                self.queue.add(key)
+        self._synced = True
+        self._worker = threading.Thread(
+            target=self._run, name=f"crd-{self.kind}", daemon=True
+        )
+        self._worker.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        unsubscribe = getattr(self.list_watch, "unsubscribe", None)
+        if unsubscribe is not None:
+            unsubscribe(self.kind, self._on_change)
+        if self._worker is not None:
+            self._worker.join(timeout=2)
+
+    # --------------------------------------------------------------- worker
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            key = self.queue.get(timeout=0.1)
+            if key is None:
+                continue
+            with self._lock:
+                obj = self._objects.get(key)  # None = deleted
+            try:
+                self.process(key, obj)
+            except Exception as e:  # noqa: BLE001 - retried with backoff
+                if self.queue.num_requeues(key) < self.max_retries:
+                    log.warning("crd %s: processing %s failed (%s); requeueing",
+                                self.kind, key, e)
+                    self.queue.add_rate_limited(key)
+                else:
+                    log.error("crd %s: giving up on %s after %d retries: %s",
+                              self.kind, key, self.max_retries, e)
+                    self.queue.forget(key)
+                    self.dropped += 1
+            else:
+                self.queue.forget(key)
+                self.processed += 1
+            finally:
+                self.queue.done(key)
+
+    def wait_idle(self, timeout: float = 5.0) -> bool:
+        """Wait until nothing is queued, processing, or in retry backoff."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.queue.idle():
+                return True
+            time.sleep(0.01)
+        return False
+
+
+# ----------------------------------------------------------- NodeConfig CRD
+
+
+def parse_node_config(name: str, obj: Optional[Dict]) -> Optional[NodeConfig]:
+    """nodeconfig/v1 NodeConfigSpec JSON → NodeConfig model
+    (pkg/apis/nodeconfig/v1/types.go:44-56 field names)."""
+    if obj is None:
+        return None
+    spec = obj.get("spec", {}) or {}
+
+    def iface(d: Dict) -> NodeInterfaceConfig:
+        return NodeInterfaceConfig(
+            name=d.get("interfaceName", ""),
+            ip=d.get("ip", ""),
+            use_dhcp=bool(d.get("useDHCP", False)),
+        )
+
+    return NodeConfig(
+        name=name,
+        main_interface=iface(spec.get("mainVPPInterface", {}) or {}),
+        other_interfaces=tuple(
+            iface(d) for d in spec.get("otherVPPInterfaces", []) or []
+        ),
+        gateway=spec.get("gateway", ""),
+        nat_external_traffic=bool(spec.get("natExternalTraffic", False)),
+        stealth_interface=spec.get("stealInterface", ""),
+    )
+
+
+def make_node_config_controller(
+    list_watch: K8sListWatch, crd_plugin, kind: str = "nodeconfigs",
+) -> CrdController:
+    """The NodeConfig controller: CRD objects → parse → CRDPlugin (store
+    publish + NodeConfigChange events)."""
+
+    def process(key: str, obj: Optional[Dict]) -> None:
+        name = key.rsplit("/", 1)[-1]
+        config = parse_node_config(name, obj)
+        if config is None:
+            crd_plugin.delete_node_config(name)
+        else:
+            crd_plugin.apply_node_config(config)
+
+    return CrdController(kind, list_watch, process)

@@ -61,7 +61,8 @@ class PacketTracer:
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
         self._lock = threading.Lock()
-        self._entries: Deque[TraceEntry] = collections.deque(maxlen=capacity)
+        # Raw per-packet tuples (see record_batch); formatted in dump().
+        self._entries: Deque[tuple] = collections.deque(maxlen=capacity)
         self.enabled = False
         self.sample_every = 1
         self._seq = 0    # recorded entries (trace sequence numbers)
@@ -95,43 +96,53 @@ class PacketTracer:
         dnat, snat, reply, punt,
     ) -> None:
         """Record the sampled rows of one harvested batch; ``orig``/``rew``
-        are the harvest's field->ndarray dicts."""
+        are the harvest's field->ndarray dicts.  The hot path stores raw
+        int tuples; all string formatting is deferred to dump(), and the
+        lock is held only for the ring appends."""
         if not self.enabled:
             return
+        n = len(allowed)
         with self._lock:
-            n = len(allowed)
             self._seen += n
-            i = self._skip
-            while i < n:
-                self._seq += 1
-                self._entries.append(
-                    TraceEntry(
-                        seq=self._seq,
-                        batch_ts=int(batch_ts),
-                        src=u32_to_ip(int(orig["src_ip"][i])),
-                        dst=u32_to_ip(int(orig["dst_ip"][i])),
-                        protocol=int(orig["protocol"][i]),
-                        src_port=int(orig["src_port"][i]),
-                        dst_port=int(orig["dst_port"][i]),
-                        rw_src=u32_to_ip(int(rew["src_ip"][i])),
-                        rw_dst=u32_to_ip(int(rew["dst_ip"][i])),
-                        rw_src_port=int(rew["src_port"][i]),
-                        rw_dst_port=int(rew["dst_port"][i]),
-                        allowed=bool(allowed[i]),
-                        route=_ROUTE_NAMES.get(int(route_tag[i]), "?"),
-                        node_id=int(node_id[i]),
-                        dnat=bool(dnat[i]),
-                        snat=bool(snat[i]),
-                        reply=bool(reply[i]),
-                        punt=bool(punt[i]),
-                    )
-                )
-                i += self.sample_every
-            self._skip = (i - n) % self.sample_every
+            start = self._skip
+            rows = list(range(start, n, self.sample_every))
+            self._skip = (
+                (start + len(rows) * self.sample_every) - n
+            ) % self.sample_every if rows else (start - n) % self.sample_every
+            base_seq = self._seq
+            self._seq += len(rows)
+        raw = [
+            (
+                base_seq + j + 1, int(batch_ts),
+                int(orig["src_ip"][i]), int(orig["dst_ip"][i]),
+                int(orig["protocol"][i]),
+                int(orig["src_port"][i]), int(orig["dst_port"][i]),
+                int(rew["src_ip"][i]), int(rew["dst_ip"][i]),
+                int(rew["src_port"][i]), int(rew["dst_port"][i]),
+                bool(allowed[i]), int(route_tag[i]), int(node_id[i]),
+                bool(dnat[i]), bool(snat[i]), bool(reply[i]), bool(punt[i]),
+            )
+            for j, i in enumerate(rows)
+        ]
+        with self._lock:
+            self._entries.extend(raw)
+
+    @staticmethod
+    def _to_entry(r) -> TraceEntry:
+        return TraceEntry(
+            seq=r[0], batch_ts=r[1],
+            src=u32_to_ip(r[2]), dst=u32_to_ip(r[3]), protocol=r[4],
+            src_port=r[5], dst_port=r[6],
+            rw_src=u32_to_ip(r[7]), rw_dst=u32_to_ip(r[8]),
+            rw_src_port=r[9], rw_dst_port=r[10],
+            allowed=r[11], route=_ROUTE_NAMES.get(r[12], "?"),
+            node_id=r[13], dnat=r[14], snat=r[15], reply=r[16], punt=r[17],
+        )
 
     def dump(self) -> List[Dict]:
         with self._lock:
-            return [e.as_dict() for e in self._entries]
+            raw = list(self._entries)
+        return [self._to_entry(r).as_dict() for r in raw]
 
     def status(self) -> Dict:
         with self._lock:
