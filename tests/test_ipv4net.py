@@ -232,3 +232,37 @@ def test_static_main_interface_rendered():
     finally:
         n["watcher"].stop()
         n["ctl"].stop()
+
+
+def test_other_interfaces_rendered():
+    """NodeConfig OtherVPPInterfaces (contivconf GetOtherVPPInterfaces
+    :574) flow through the priority merge into rendered interfaces."""
+    from dataclasses import replace
+
+    from vpp_tpu.bootstrap.init import bootstrap_config
+    from vpp_tpu.crd.models import NodeConfig, NodeInterfaceConfig
+
+    base = NetworkConfig()
+    node_cfg = NodeConfig(
+        name="node-1",
+        main_interface=NodeInterfaceConfig(name="eth0"),
+        other_interfaces=(
+            NodeInterfaceConfig(name="eth1", ip="10.100.1.1/24"),
+            NodeInterfaceConfig(name="eth2", use_dhcp=True),
+        ),
+    )
+    config, _ = bootstrap_config(base, node_config=node_cfg)
+    assert config.interface.main_interface == "eth0"
+    assert len(config.interface.other_interfaces) == 2
+
+    store = KVStore()
+    n = boot(store, "node-1", config=config)
+    try:
+        assert wait_for(lambda: n["fib"].get_interface("eth2") is not None)
+        eth1 = n["fib"].get_interface("eth1")
+        assert eth1.ip_addresses == ("10.100.1.1/24",) and not eth1.dhcp
+        eth2 = n["fib"].get_interface("eth2")
+        assert eth2.dhcp and eth2.ip_addresses == ()
+    finally:
+        n["watcher"].stop()
+        n["ctl"].stop()

@@ -58,6 +58,19 @@ def bootstrap_config(
                               main_interface=node_config.main_interface.name,
                               use_dhcp=node_config.main_interface.use_dhcp),
         )
+    if node_config is not None and node_config.other_interfaces:
+        from ..conf import OtherInterface
+
+        cfg = replace(
+            cfg,
+            interface=replace(
+                cfg.interface,
+                other_interfaces=tuple(
+                    OtherInterface(name=i.name, ip=i.ip, use_dhcp=i.use_dhcp)
+                    for i in node_config.other_interfaces
+                ),
+            ),
+        )
 
     stn_iface = ""
     if node_config is not None and node_config.stealth_interface:

@@ -1,3 +1,12 @@
-from .config import IPAMConfig, InterfaceConfig, RoutingConfig, NetworkConfig
+from .config import (
+    IPAMConfig,
+    InterfaceConfig,
+    NetworkConfig,
+    OtherInterface,
+    RoutingConfig,
+)
 
-__all__ = ["IPAMConfig", "InterfaceConfig", "RoutingConfig", "NetworkConfig"]
+__all__ = [
+    "IPAMConfig", "InterfaceConfig", "OtherInterface",
+    "RoutingConfig", "NetworkConfig",
+]

@@ -66,6 +66,17 @@ class IPAMConfig:
 
 
 @dataclass(frozen=True)
+class OtherInterface:
+    """A non-main physical data-plane interface (contivconf_api.go
+    GetOtherVPPInterfaces :574, sourced from NodeConfig
+    OtherVPPInterfaces)."""
+
+    name: str
+    ip: str = ""          # CIDR; empty with use_dhcp=False = unnumbered
+    use_dhcp: bool = False
+
+
+@dataclass(frozen=True)
 class InterfaceConfig:
     """Main data-plane interface settings (contivconf_api.go InterfaceConfig)."""
 
@@ -77,6 +88,9 @@ class InterfaceConfig:
     # Acquire the main-interface IP via DHCP instead of IPAM arithmetic
     # (contivconf_api.go UseDHCP :32-36 / NodeInterconnectDHCP :118-120).
     use_dhcp: bool = False
+    # Non-main physical interfaces to configure (NodeConfig
+    # OtherVPPInterfaces via the priority merge).
+    other_interfaces: Tuple["OtherInterface", ...] = ()
 
 
 @dataclass(frozen=True)
@@ -106,9 +120,13 @@ class NetworkConfig:
     @classmethod
     def from_dict(cls, data: Optional[dict]) -> "NetworkConfig":
         data = data or {}
+        iface_data = dict(data.get("interface", {}))
+        others = tuple(
+            OtherInterface(**d) for d in iface_data.pop("other_interfaces", [])
+        )
         return cls(
             ipam=IPAMConfig(**data.get("ipam", {})),
-            interface=InterfaceConfig(**data.get("interface", {})),
+            interface=InterfaceConfig(other_interfaces=others, **iface_data),
             routing=RoutingConfig(**data.get("routing", {})),
             batch_size=data.get("batch_size", 256),
         )

@@ -234,6 +234,21 @@ class IPv4Net(EventHandler):
                         mtu=self.config.interface.mtu,
                     )
                 )
+        # Non-main physical interfaces (contivconf GetOtherVPPInterfaces
+        # :574-586, configured by node.go configureVswitchNICs).
+        for other in self.config.interface.other_interfaces:
+            if not other.name:
+                continue  # malformed CRD entry: never render a nameless NIC
+            kvs.append(
+                Interface(
+                    name=other.name,
+                    type=InterfaceType.DPDK,
+                    dhcp=other.use_dhcp,
+                    ip_addresses=(other.ip,) if other.ip else (),
+                    vrf=routing.main_vrf_id,
+                    mtu=self.config.interface.mtu,
+                )
+            )
         return kvs
 
     def _vxlan_if_name(self, node_id: int) -> str:

@@ -89,6 +89,24 @@ class _Entry:
     stats: InterfaceStats = field(default_factory=InterfaceStats)
 
 
+class _DatapathCollector:
+    """Custom Prometheus collector: one consistent runner.metrics()
+    snapshot per scrape (occupancy involves a device reduction — doing
+    it once per scrape, not once per gauge, keeps scrapes off the hot
+    path and the exported counters mutually consistent)."""
+
+    def __init__(self, runner):
+        self.runner = runner
+
+    def collect(self):
+        from prometheus_client.core import GaugeMetricFamily
+
+        snapshot = self.runner.metrics()
+        for name, value in snapshot.items():
+            yield GaugeMetricFamily(name, f"datapath counter {name}",
+                                    value=float(value))
+
+
 class StatsCollector(EventHandler):
     """Maps data-plane interface counters to pods and exports gauges."""
 
@@ -106,6 +124,22 @@ class StatsCollector(EventHandler):
             )
             for metric, help_text in METRICS
         }
+        self._datapath_collector: Optional[_DatapathCollector] = None
+
+    # ------------------------------------------------------------- datapath
+
+    def register_datapath(self, runner) -> None:
+        """Export the datapath runner's counters — frames, drops by
+        cause, NAT session occupancy, slow-path state, punts — via a
+        custom collector that reads ONE runner.metrics() snapshot per
+        scrape (VERDICT r1 #3: session eviction/occupancy observability
+        via /metrics).  Re-registering swaps the runner (restart case);
+        one StatsCollector exports one datapath."""
+        if self._datapath_collector is None:
+            self._datapath_collector = _DatapathCollector(runner)
+            self.registry.register(self._datapath_collector)
+        else:
+            self._datapath_collector.runner = runner
 
     # ----------------------------------------------------------- data plane
 
