@@ -114,8 +114,12 @@ class NetworkConfig:
     ipam: IPAMConfig = field(default_factory=IPAMConfig)
     interface: InterfaceConfig = field(default_factory=InterfaceConfig)
     routing: RoutingConfig = field(default_factory=RoutingConfig)
-    # NAT-pipeline batch size: packets per classify->rewrite step.
+    # NAT-pipeline vector size: packets per classify->rewrite vector
+    # (VPP's vector size).
     batch_size: int = 256
+    # Vectors the datapath runner may coalesce into one device program
+    # (pow2-floored; sessions thread vector-to-vector on device).
+    max_vectors: int = 64
 
     @classmethod
     def from_dict(cls, data: Optional[dict]) -> "NetworkConfig":
@@ -129,6 +133,7 @@ class NetworkConfig:
             interface=InterfaceConfig(other_interfaces=others, **iface_data),
             routing=RoutingConfig(**data.get("routing", {})),
             batch_size=data.get("batch_size", 256),
+            max_vectors=data.get("max_vectors", 64),
         )
 
     def overlay(self, **kw) -> "NetworkConfig":

@@ -68,12 +68,13 @@ class SimNode:
 
         self.nodesync = NodeSync(store, node_name=name)
         self.nodesync.allocate_id()
-        self.ipam = IPAM(NetworkConfig().ipam, self.nodesync.node_id)
+        self.config = NetworkConfig()
+        self.ipam = IPAM(self.config.ipam, self.nodesync.node_id)
 
         self.podmanager = PodManager()
         self.fib = MockHostFIB()
         self.ipv4net = IPv4Net(
-            NetworkConfig(), self.nodesync, ipam=self.ipam,
+            self.config, self.nodesync, ipam=self.ipam,
             podmanager=self.podmanager,
         )
 

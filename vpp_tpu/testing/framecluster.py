@@ -67,6 +67,8 @@ class FrameNode:
             acl=sim.policy_renderer.tables,
             nat=sim.nat_renderer.tables,
             route=make_route_config(sim.ipam),
+            batch_size=sim.config.batch_size,
+            max_vectors=sim.config.max_vectors,
             overlay=VxlanOverlay(local_ip=self.node_ip, local_node_id=self.node_id),
             source=self.rx,
             tx=wire,            # remote (encapped) frames ride the wire
