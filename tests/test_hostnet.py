@@ -1,0 +1,135 @@
+"""Real Linux host-network applicator — kernel state from ipv4net KVs,
+confined to a throwaway network namespace (requires CAP_NET_ADMIN;
+skips without)."""
+
+import subprocess
+import uuid
+
+import pytest
+
+from vpp_tpu.conf import NetworkConfig
+from vpp_tpu.controller import Controller, DBWatcher
+from vpp_tpu.hostnet import LinuxNetApplicator
+from vpp_tpu.ipv4net import IPv4Net
+from vpp_tpu.ipv4net.model import ArpEntry, BridgeDomain, Interface, InterfaceType, Route, VrfTable
+from vpp_tpu.kvstore import KVStore
+from vpp_tpu.nodesync import NodeSync
+from vpp_tpu.podmanager import PodManager
+from vpp_tpu.scheduler import TxnScheduler
+from vpp_tpu.controller.txn import RecordedTxn
+
+
+def _netns_available() -> bool:
+    name = f"vt-probe-{uuid.uuid4().hex[:6]}"
+    r = subprocess.run(["ip", "netns", "add", name], capture_output=True)
+    if r.returncode != 0:
+        return False
+    subprocess.run(["ip", "netns", "del", name], capture_output=True)
+    return True
+
+
+pytestmark = pytest.mark.skipif(
+    not _netns_available(), reason="no CAP_NET_ADMIN / ip netns support"
+)
+
+
+@pytest.fixture()
+def hostnet():
+    ns = f"vt-test-{uuid.uuid4().hex[:6]}"
+    app = LinuxNetApplicator(netns=ns, create_netns=True)
+    yield app
+    app.close(delete_netns=True)
+
+
+def test_applicator_programs_kernel_state(hostnet):
+    sched = TxnScheduler()
+    sched.register_applicator(hostnet)
+    bvi = Interface(name="vxlanBVI", type=InterfaceType.LOOPBACK,
+                    ip_addresses=("192.168.30.1/24",),
+                    physical_address="12:fe:c0:a8:1e:01", mtu=1450)
+    tap = Interface(name="tap-vpp2", type=InterfaceType.TAP,
+                    ip_addresses=("172.30.1.1/24",), host_if_name="vpp1",
+                    mtu=1450)
+    vxlan = Interface(name="vxlan2", type=InterfaceType.VXLAN,
+                      vxlan_src="192.168.16.1", vxlan_dst="192.168.16.2",
+                      vxlan_vni=10)
+    bd = BridgeDomain(name="vxlanBD", bvi_interface="vxlanBVI",
+                      interfaces=("vxlan2",))
+    route = Route(dst_network="10.1.2.0/24", next_hop="192.168.30.2",
+                  outgoing_interface="vxlanBVI", vrf=1)
+    arp = ArpEntry(interface="vxlanBVI", ip_address="192.168.30.2",
+                   physical_address="12:fe:c0:a8:1e:02")
+    vrfs = (VrfTable(id=0, label="main"), VrfTable(id=1, label="pods"))
+    sched.commit(RecordedTxn(seq_num=1, is_resync=True, values={
+        kv.key: kv for kv in (bvi, tap, vxlan, bd, route, arp) + vrfs
+    }))
+
+    # Links exist with addresses/MACs.
+    assert hostnet.addrs("vxlanBVI")[0]["address"] == "12:fe:c0:a8:1e:01"
+    assert any(a.get("local") == "192.168.30.1"
+               for a in hostnet.addrs("vxlanBVI")[0]["addr_info"])
+    # veth peer carries the interconnect address in the same ns.
+    assert any(a.get("local") == "172.30.1.1"
+               for a in hostnet.addrs("vpp1")[0]["addr_info"])
+    # VXLAN tunnel parameters landed.
+    vx = hostnet._ip_json(["-details", "link", "show", "vxlan2"])[0]
+    assert vx["linkinfo"]["info_kind"] == "vxlan"
+    assert vx["linkinfo"]["info_data"]["id"] == 10
+    # Bridge domain enslaves the tunnel INTO the BVI bridge (the L3
+    # address sits on the bridge device, like VPP's BVI).
+    assert hostnet._ip_json(["link", "show", "vxlan2"])[0].get("master") == "vxlanBVI"
+    # Route in the VRF table, ARP permanent.
+    assert any(r.get("dst") == "10.1.2.0/24" for r in hostnet.routes(vrf=1))
+    assert any(n.get("dst") == "192.168.30.2" for n in hostnet.neighbors())
+
+    # Resync that drops the tunnel removes it from the kernel.
+    sched.commit(RecordedTxn(seq_num=2, is_resync=True, values={
+        kv.key: kv for kv in (bvi, tap, bd, route, arp) + vrfs
+    }))
+    assert hostnet._ip_json(["link", "show"], ) is not None
+    assert not hostnet.link_exists("vxlan2")
+
+
+def test_full_agent_drives_real_kernel(hostnet):
+    """The actual IPv4Net plugin, through the controller + scheduler,
+    programs a real (namespaced) kernel: base vswitch config + pod veth
+    wiring in its own pod netns."""
+    store = KVStore()
+    nodesync = NodeSync(store, "node-1")
+    podmanager = PodManager()
+    ipv4net = IPv4Net(NetworkConfig(), nodesync, podmanager=podmanager)
+    sched = TxnScheduler()
+    sched.register_applicator(hostnet)
+    ctl = Controller([nodesync, podmanager, ipv4net], sched, healing_delay=0.05)
+    podmanager.event_loop = ctl
+    nodesync.event_loop = ctl
+    ctl.start()
+    watcher = DBWatcher(ctl, store)
+    watcher.start()
+    pod_ns = f"vt-pod-{uuid.uuid4().hex[:6]}"
+    try:
+        import time
+        deadline = time.time() + 5
+        while time.time() < deadline and not (
+            hostnet.link_exists("tap-vpp2") and hostnet.link_exists("vxlanBVI")
+        ):
+            time.sleep(0.05)
+        assert hostnet.link_exists("tap-vpp2")
+        assert hostnet.link_exists("vxlanBVI")
+
+        reply = podmanager.add_pod("web", "default", network_namespace=pod_ns)
+        assert reply.ip_address == "10.1.1.2/32"
+        # Host side of the pod veth exists; peer lives in the pod netns
+        # with the pod address.
+        assert hostnet.link_exists("tap-default-web")
+        out = subprocess.run(
+            ["ip", "netns", "exec", pod_ns, "ip", "-json", "addr", "show"],
+            capture_output=True, text=True,
+        )
+        assert '"10.1.1.2"' in out.stdout
+        # The /32 pod route exists in the pod VRF table.
+        assert any(r.get("dst") == "10.1.1.2" for r in hostnet.routes(vrf=1))
+    finally:
+        watcher.stop()
+        ctl.stop()
+        subprocess.run(["ip", "netns", "del", pod_ns], capture_output=True)

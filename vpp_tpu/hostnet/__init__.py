@@ -1,0 +1,3 @@
+from .applicator import LinuxNetApplicator
+
+__all__ = ["LinuxNetApplicator"]

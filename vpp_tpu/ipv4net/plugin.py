@@ -326,13 +326,24 @@ class IPv4Net(EventHandler):
         """One pod's wiring (podConnectivityConfig :57)."""
         if_name = f"{POD_IF_PREFIX}{pod_id.namespace}-{pod_id.name}"
         pod_mac = mac_from_ip(pod_ip)
+        # The pod's actual network namespace comes from the CNI request
+        # (LocalPod.network_namespace); KubeState-only pods fall back to
+        # a deterministic name.
+        netns = ""
+        if self.podmanager is not None:
+            local = self.podmanager.get_local_pod(pod_id)
+            if local is not None:
+                netns = local.network_namespace
         return [
             Interface(
                 name=if_name,
                 type=InterfaceType.TAP,
                 vrf=self.config.routing.pod_vrf_id,
                 host_if_name="eth0",
-                namespace=str(pod_id),
+                namespace=netns or f"pod-{pod_id.namespace}-{pod_id.name}",
+                # The pod (peer) side carries the address, like the
+                # reference's Linux TAP half (pod.go podLinuxTAP).
+                ip_addresses=(f"{pod_ip}/32",),
                 mtu=self.config.interface.mtu,
             ),
             ArpEntry(interface=if_name, ip_address=pod_ip, physical_address=pod_mac),
