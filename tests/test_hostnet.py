@@ -133,3 +133,76 @@ def test_full_agent_drives_real_kernel(hostnet):
         watcher.stop()
         ctl.stop()
         subprocess.run(["ip", "netns", "del", pod_ns], capture_output=True)
+
+
+@pytest.mark.slow
+def test_procnode_with_hostnet_programs_kernel(tmp_path):
+    """A separate-OS-process agent with --hostnet-netns connects to the
+    cluster store over gRPC and programs real kernel state for the
+    cluster's pods."""
+    import os
+    import sys
+    import time
+
+    from vpp_tpu.kvstore import KVStore, KVStoreServer
+    from vpp_tpu.models import Pod, key_for
+
+    store = KVStore()
+    server = KVStoreServer(store)
+    port = server.start()
+    ns = f"vt-proc-{uuid.uuid4().hex[:6]}"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    child = subprocess.Popen(
+        [sys.executable, "-m", "vpp_tpu.testing.procnode",
+         "--store", f"127.0.0.1:{port}", "--name", "node-1",
+         "--hostnet-netns", ns],
+        env=env, cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    app = LinuxNetApplicator(netns=ns)  # query-only handle
+    try:
+        deadline = time.time() + 90
+        while time.time() < deadline and not app.link_exists("tap-vpp2"):
+            time.sleep(0.2)
+        assert app.link_exists("tap-vpp2"), "agent never programmed the kernel"
+
+        # A pod appears in cluster state; like the reference, kube-state-
+        # only pods get wired on the next resync — provoke one through a
+        # store outage + reconnect.
+        store.put(key_for(Pod(name="w1", namespace="default",
+                              ip_address="10.1.1.7")),
+                  Pod(name="w1", namespace="default", ip_address="10.1.1.7"))
+        server.stop()
+        time.sleep(0.5)
+        server2 = KVStoreServer(store, port=port)
+        server2.start()
+        try:
+            deadline = time.time() + 30
+            while time.time() < deadline and not app.link_exists("tap-default-w1"):
+                time.sleep(0.2)
+            assert app.link_exists("tap-default-w1")
+
+            def pod_route():
+                try:
+                    return any(r.get("dst") == "10.1.1.7"
+                               for r in app.routes(vrf=1))
+                except Exception:
+                    return False
+
+            deadline = time.time() + 10
+            while time.time() < deadline and not pod_route():
+                time.sleep(0.2)
+            assert pod_route()
+        finally:
+            server2.stop()
+    finally:
+        child.terminate()
+        try:
+            child.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            child.kill()
+        server.stop()
+        subprocess.run(["ip", "netns", "del", ns], capture_output=True)
+        subprocess.run(["ip", "netns", "del", "pod-default-w1"], capture_output=True)

@@ -90,7 +90,10 @@ class LinuxNetApplicator(Applicator):
 
     def __init__(self, netns: Optional[str] = None, create_netns: bool = False):
         self.netns = netns
-        self._bd_bridge: dict = {}  # bridge-domain name -> actual bridge dev
+        self._bd_bridge: dict = {}   # bridge-domain name -> actual bridge dev
+        # bridge dev -> member names, so members created AFTER their BD
+        # (partial-BD semantics / replay ordering) still get enslaved.
+        self._bd_members: dict = {}
         if netns and create_netns:
             subprocess.run(["ip", "netns", "add", netns], check=False,
                            capture_output=True)
@@ -156,6 +159,7 @@ class LinuxNetApplicator(Applicator):
                 self._ip(["link", "add", br, "type", "bridge"], check=False)
             self._ip(["link", "set", br, "up"], check=False)
             self._bd_bridge[self.ifname(value.name)] = br
+            self._bd_members[br] = {self.ifname(m) for m in value.interfaces}
             for member in value.interfaces:
                 self._ip(["link", "set", self.ifname(member), "master", br],
                          check=False)
@@ -272,6 +276,12 @@ class LinuxNetApplicator(Applicator):
                 self._ip(["addr", "replace", addr, "dev", name])
         if iface.enabled:
             self._ip(["link", "set", name, "up"], check=False)
+        # Late BD attach: if a bridge domain already claims this device,
+        # enslave it now (partial-BD semantics — members attach as they
+        # appear, whatever the creation order).
+        for br, members in self._bd_members.items():
+            if name in members:
+                self._ip(["link", "set", name, "master", br], check=False)
         if iface.vrf:
             # Steer ingress from this interface into its VRF's routing
             # table (the lightweight Linux analog of VRF membership; the

@@ -137,7 +137,17 @@ class TxnScheduler(TxnSink):
     # -------------------------------------------------------------- registry
 
     def register_applicator(self, applicator: Applicator) -> None:
-        self._applicators.append(applicator)
+        with self._lock:
+            self._applicators.append(applicator)
+
+    def unregister_applicator(self, applicator: Applicator) -> None:
+        """Remove a backend (e.g. swapping the mock host FIB for the real
+        Linux applicator); follow with replay() to push applied state
+        into whichever applicator now owns the keys.  Serialized against
+        in-flight commits/retries/replays."""
+        with self._lock:
+            if applicator in self._applicators:
+                self._applicators.remove(applicator)
 
     def register_dependencies(self, prefix: str, fn: DependencyFn) -> None:
         """Declare how to compute dependencies for values under ``prefix``."""

@@ -39,6 +39,7 @@ def run_agent(
     heartbeat_prefix: str = HEARTBEAT_PREFIX,
     heartbeat_interval: float = 0.1,
     stop_event=None,
+    hostnet_netns: str = "",
 ) -> None:
     from .cluster import SimNode
 
@@ -47,6 +48,19 @@ def run_agent(
     # where the in-process store object sat.
     shim = types.SimpleNamespace(store=store)
     node = SimNode(shim, name, mirror_path=mirror_path or None)
+    hostnet = None
+    if hostnet_netns:
+        # Program REAL kernel networking (confined to the named netns):
+        # the Linux applicator REPLACES the mock host FIB (both claim the
+        # config/ prefix and the scheduler routes each key to one
+        # backend), and a replay pushes the already-applied state into
+        # the kernel.
+        from ..hostnet import LinuxNetApplicator
+
+        hostnet = LinuxNetApplicator(netns=hostnet_netns, create_netns=True)
+        node.scheduler.unregister_applicator(node.fib)
+        node.scheduler.register_applicator(hostnet)
+        node.scheduler.replay()
 
     seq = 0
     try:
@@ -72,6 +86,8 @@ def run_agent(
     finally:
         node.stop()
         store.close()
+        if hostnet is not None:
+            hostnet.close(delete_netns=True)
 
 
 def main(argv=None) -> int:
@@ -80,12 +96,15 @@ def main(argv=None) -> int:
     parser.add_argument("--name", required=True)
     parser.add_argument("--mirror", default="")
     parser.add_argument("--heartbeat-prefix", default=HEARTBEAT_PREFIX)
+    parser.add_argument("--hostnet-netns", default="",
+                        help="program real kernel networking inside this netns")
     args = parser.parse_args(argv)
 
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
     print(json.dumps({"agent": args.name, "store": args.store}), flush=True)
     run_agent(args.store, args.name, mirror_path=args.mirror,
-              heartbeat_prefix=args.heartbeat_prefix)
+              heartbeat_prefix=args.heartbeat_prefix,
+              hostnet_netns=args.hostnet_netns)
     return 0
 
 
