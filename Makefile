@@ -34,3 +34,6 @@ bench-sweep:
 
 bench-scale:
 	$(PY) benchsuite.py --scale
+
+bench-frames:
+	$(PY) scripts/frame_bench.py

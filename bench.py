@@ -95,7 +95,7 @@ def build_traffic(pod_ips, mappings, batch_size, seed=0):
     for _ in range(batch_size):
         src = rng.choice(pod_ips)
         r = rng.random()
-        if r < 0.5:  # service traffic
+        if r < 0.5 and mappings:  # service traffic
             m = rng.choice(mappings)
             flows.append((src, m.external_ip, 6, rng.randrange(1024, 65535), m.external_port))
         elif r < 0.8:  # pod-to-pod

@@ -1,0 +1,146 @@
+"""Frame-level dataplane benchmark — frames in, frames out.
+
+Measures the DataplaneRunner end to end on REAL Ethernet frames: ring
+ingest → C++ parse → jit pipeline (vector-scan dispatch) → host slow
+path → native verdict apply (RFC 1624 checksums) → local/VXLAN/host
+TX.  This is the dataplane number the round-1 verdict asked for, as
+opposed to the kernel-throughput numbers of bench.py (which never
+materialise results on the host).
+
+Two caveats worth knowing when reading results:
+- On the axon tunnel, harvesting verdicts is a device-to-host transfer,
+  which permanently switches the tunnel runtime into its degraded
+  transfer mode (scripts/tunnel_d2h_probe.py) — the TPU row therefore
+  reflects that mode, not the chip.  A local PCIe TPU does not behave
+  this way.
+- The per-frame host work (Python ring handling + C++ parse/apply) is
+  the same regardless of backend, so the CPU row is a fair measure of
+  the host-side frame path.
+
+Usage: python scripts/frame_bench.py [--frames N] [--rounds R]
+       [--rules N] [--services N]
+Prints one JSON line:
+    {"metric": "frame-in->frame-out", "value": Mpps, ...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import random
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--frames", type=int, default=16384)
+    parser.add_argument("--rounds", type=int, default=5, choices=range(1, 100),
+                        metavar="1..99")
+    parser.add_argument("--rules", type=int, default=10000)
+    parser.add_argument("--services", type=int, default=1000)
+    parser.add_argument("--batch", type=int, default=256)
+    parser.add_argument("--vectors", type=int, default=64)
+    parser.add_argument("--platform", default="",
+                        help="jax platform (cpu/axon); the axon plugin "
+                             "ignores JAX_PLATFORMS, only this works")
+    args = parser.parse_args(argv)
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import bench
+    from vpp_tpu.datapath import DataplaneRunner, InMemoryRing, VxlanOverlay
+    from vpp_tpu.ops.packets import ip_to_u32
+    from vpp_tpu.testing.frames import build_frame
+
+    acl, nat, route, _, pod_ips, mappings = bench.build_stress_state(
+        n_rules=max(args.rules, 2), n_services=args.services
+    )
+    if args.rules == 0:
+        # Permissive mode: no ACL tables at all (pods pass by default) —
+        # isolates the host frame path + NAT from classify compute.
+        from vpp_tpu.ops.classify import build_rule_tables
+
+        acl = build_rule_tables([], {})
+    rx = InMemoryRing(capacity=1 << 22)
+    tx = InMemoryRing(capacity=1 << 22)
+    local = InMemoryRing(capacity=1 << 22)
+    host = InMemoryRing(capacity=1 << 22)
+    runner = DataplaneRunner(
+        acl=acl, nat=nat, route=route,
+        overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"), local_node_id=1),
+        source=rx, tx=tx, local=local, host=host,
+        batch_size=args.batch, max_vectors=args.vectors,
+    )
+    for node_id in range(2, 64):
+        runner.overlay.set_remote(node_id, ip_to_u32(f"192.168.16.{node_id}"))
+
+    # The same stress traffic mix as bench.py (service VIPs / pod-to-pod
+    # / egress), rendered into real frames with real checksums — sharing
+    # the generator keeps frame-bench numbers mix-comparable with the
+    # kernel numbers.
+    tuples = bench.build_traffic(pod_ips, mappings, args.frames)
+    import numpy as np
+
+    from vpp_tpu.ops.packets import u32_to_ip
+
+    frames = [
+        build_frame(
+            u32_to_ip(int(np.asarray(tuples.src_ip[i]))),
+            u32_to_ip(int(np.asarray(tuples.dst_ip[i]))),
+            int(np.asarray(tuples.protocol[i])),
+            int(np.asarray(tuples.src_port[i])),
+            int(np.asarray(tuples.dst_port[i])),
+        )
+        for i in range(args.frames)
+    ]
+
+    def drain_outputs():
+        n = 0
+        for ring in (tx, local, host):
+            n += len(ring.recv_batch(1 << 22))
+        return n
+
+    # Warm-up (compiles all k buckets).
+    rx.send(frames)
+    runner.drain()
+    drain_outputs()
+
+    mpps_rounds = []
+    out_total = 0
+    for _ in range(args.rounds):
+        rx.send(frames)
+        t0 = time.perf_counter()
+        runner.drain()
+        dt = time.perf_counter() - t0
+        out_total += drain_outputs()
+        mpps_rounds.append(args.frames / dt / 1e6)
+    mpps_rounds.sort()
+    median = mpps_rounds[len(mpps_rounds) // 2]
+
+    stats = runner.metrics()
+    print(json.dumps({
+        "metric": "frame-in->frame-out dataplane throughput "
+                  f"({args.rules} rules + {args.services} services)",
+        "value": round(median, 3),
+        "unit": "Mpps",
+        "backend": jax.default_backend(),
+        "peak_mpps": round(mpps_rounds[-1], 3),
+        "frames_per_round": args.frames,
+        "out_frames": out_total,
+        "vs_baseline": round(median / 40.0, 3),
+        "denied": stats["datapath_dropped_denied_total"],
+        "tx_remote": stats["datapath_tx_remote_total"],
+        "punts": stats["datapath_punts_total"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
