@@ -97,6 +97,52 @@ def test_remote_watch_streams_changes_in_order(served_store):
     client.unwatch(watcher)
 
 
+def test_watch_limit_rejected_loudly_and_unary_rpcs_survive():
+    """ADVICE r2: Watch streams must not starve the unary pool; streams
+    beyond max_watchers are rejected with RESOURCE_EXHAUSTED and slots
+    are reclaimed on unwatch."""
+    store = KVStore()
+    server = KVStoreServer(store, max_watchers=2)
+    server.start()
+    client = RemoteKVStore(server.address, timeout=2.0)
+    try:
+        w1, w2 = client.watch(["/a/"]), client.watch(["/b/"])
+        assert w1.wait_subscribed(5.0) and w2.wait_subscribed(5.0)
+        w3 = client.watch(["/c/"])
+        assert not w3.wait_subscribed(0.5)   # rejected, never subscribes
+        # Unary path stays healthy while the limit is hit.
+        client.put("/a/x", {"v": 1})
+        assert client.get("/a/x") == {"v": 1}
+        assert w1.get(timeout=2.0).key == "/a/x"
+        # Freeing a slot lets the rejected watcher's retry land.
+        client.unwatch(w1)
+        assert w3.wait_subscribed(5.0)
+        client.unwatch(w2)
+        client.unwatch(w3)
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_is_store_unavailable_matches_only_outage_codes():
+    import grpc
+
+    from vpp_tpu.controller.dbwatcher import is_store_unavailable
+
+    class _Err(grpc.RpcError):
+        def __init__(self, code):
+            self._code = code
+
+        def code(self):
+            return self._code
+
+    assert is_store_unavailable(ConnectionError("down"))
+    assert is_store_unavailable(_Err(grpc.StatusCode.UNAVAILABLE))
+    assert is_store_unavailable(_Err(grpc.StatusCode.DEADLINE_EXCEEDED))
+    assert not is_store_unavailable(_Err(grpc.StatusCode.INTERNAL))
+    assert not is_store_unavailable(_Err(grpc.StatusCode.INVALID_ARGUMENT))
+
+
 # ------------------------------------------------------- mirror + reconnect
 
 

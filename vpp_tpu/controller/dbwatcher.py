@@ -20,14 +20,31 @@ from ..kvstore.mirror import LocalMirror
 from ..models import registry
 
 # Errors meaning "the remote store is unreachable" (fall back to the
-# local mirror).  Anything else — codec bugs, malformed responses —
-# must propagate, not masquerade as an outage.
+# local mirror).  Anything else — codec bugs, malformed responses,
+# server-side INTERNAL errors — must propagate, not masquerade as an
+# outage, so RpcErrors are filtered by status code in
+# ``is_store_unavailable`` rather than caught wholesale.
 try:
     import grpc as _grpc
 
+    from ..kvstore.remote import OUTAGE_CODES as _UNAVAILABLE_CODES
+
     STORE_UNAVAILABLE_ERRORS: tuple = (ConnectionError, _grpc.RpcError)
 except ImportError:  # pragma: no cover - grpc is in the base image
+    _grpc = None
     STORE_UNAVAILABLE_ERRORS = (ConnectionError,)
+    _UNAVAILABLE_CODES = frozenset()
+
+
+def is_store_unavailable(exc: Exception) -> bool:
+    """True only for transport-level outages; server-side errors
+    (INTERNAL, INVALID_ARGUMENT, ...) are real bugs and must propagate."""
+    if isinstance(exc, ConnectionError):
+        return True
+    if _grpc is not None and isinstance(exc, _grpc.RpcError):
+        code_fn = getattr(exc, "code", None)
+        return code_fn is not None and code_fn() in _UNAVAILABLE_CODES
+    return False
 from .api import DBResync, ExternalConfigChange, KubeStateChange
 from .eventloop import Controller
 
@@ -106,6 +123,8 @@ class DBWatcher:
             try:
                 snap, revision = self.store.snapshot_with_revision(self._prefixes)
             except STORE_UNAVAILABLE_ERRORS as e:
+                if not is_store_unavailable(e):
+                    raise
                 return self._resync_from_mirror(e)
             self._resync_revision = revision
             if self._mirror is not None:

@@ -33,7 +33,9 @@ from __future__ import annotations
 import hashlib
 import json
 import logging
+import os
 import subprocess
+import time
 from typing import List, Optional
 
 from ..ipv4net.model import (
@@ -94,6 +96,13 @@ class LinuxNetApplicator(Applicator):
         # bridge dev -> member names, so members created AFTER their BD
         # (partial-BD semantics / replay ordering) still get enslaved.
         self._bd_members: dict = {}
+        # Pod namespaces THIS applicator created (`ip netns add` for
+        # KubeState-only pods): ns name -> set of Interface model names
+        # placed inside.  Deleted again when the LAST such interface
+        # goes, so they cannot accumulate across pod churn nor tear
+        # down a shared multi-interface pod ns early.  Set-based (not a
+        # counter) so scheduler retries/replays stay idempotent.
+        self._created_netns: dict = {}
         if netns and create_netns:
             subprocess.run(["ip", "netns", "add", netns], check=False,
                            capture_output=True)
@@ -114,6 +123,28 @@ class LinuxNetApplicator(Applicator):
     def _ip_json(self, args: List[str]):
         out = self._run(["ip", "-json"] + args)
         return json.loads(out) if out.strip() else []
+
+    def _link_add(self, name: str, args: List[str]) -> None:
+        """`ip link add` that tolerates ONLY idempotent replay ("File
+        exists" for a device of the SAME type) — a genuinely failed
+        creation (missing module, bad address, name conflict with a
+        different device type) raises, entering the TxnScheduler's
+        FAILED/retry machinery instead of being recorded APPLIED."""
+        try:
+            self._ip(["link", "add"] + args)
+        except IpCmdError as e:
+            if "File exists" not in str(e):
+                raise
+            # EEXIST fires for ANY device with this name; accept the
+            # replay only if the existing device is the requested kind
+            # (a stale bridge named like our vxlan would blackhole).
+            want = args[args.index("type") + 1] if "type" in args else None
+            info = json.loads(self._run(
+                ["ip", "-details", "-json", "link", "show", name]))
+            have = (info[0].get("linkinfo") or {}).get("info_kind") if info else None
+            if want is not None and have != want:
+                raise IpCmdError(
+                    f"link add {name}: exists as {have!r}, wanted {want!r}")
 
     @staticmethod
     def ifname(name: str) -> str:
@@ -155,9 +186,10 @@ class LinuxNetApplicator(Applicator):
             # Without a BVI, a standalone bridge under the BD's name is
             # created instead.
             br = self.ifname(value.bvi_interface or value.name)
-            if not self.link_exists(br):
-                self._ip(["link", "add", br, "type", "bridge"], check=False)
-            self._ip(["link", "set", br, "up"], check=False)
+            # No link_exists guard: _link_add handles EEXIST itself and
+            # verifies a pre-existing device is actually a bridge.
+            self._link_add(br, [br, "type", "bridge"])
+            self._ip(["link", "set", br, "up"])
             self._bd_bridge[self.ifname(value.name)] = br
             self._bd_members[br] = {self.ifname(m) for m in value.interfaces}
             for member in value.interfaces:
@@ -178,6 +210,18 @@ class LinuxNetApplicator(Applicator):
                 self._ip(["rule", "del", "iif", self.ifname(value.name),
                           "lookup", str(1000 + value.vrf)], check=False)
             self._ip(["link", "del", self.ifname(value.name)], check=False)
+            if value.namespace:
+                # Remove pod namespaces WE created (`ip netns add` in
+                # _create_veth) so they do not accumulate across churn.
+                kind, ref = _resolve_netns(value.namespace)
+                members = (self._created_netns.get(ref)
+                           if kind == "name" else None)
+                if members is not None:
+                    members.discard(value.name)
+                    if not members:
+                        subprocess.run(["ip", "netns", "del", ref],
+                                       capture_output=True, check=False)
+                        del self._created_netns[ref]
         elif isinstance(value, Route):
             self._ip(["route", "del", value.dst_network] + _vrf_table(value.vrf),
                      check=False)
@@ -202,6 +246,28 @@ class LinuxNetApplicator(Applicator):
 
     # ------------------------------------------------------------ interfaces
 
+    @staticmethod
+    def _wait_holder_in_ns(holder: subprocess.Popen, ns_path: str,
+                           timeout: float = 2.0) -> None:
+        """Block until the holder child has setns()'d into ``ns_path``.
+        Moving the link by PID before that would silently drop it into
+        OUR namespace instead of the pod's."""
+        target = os.stat(ns_path)
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                st = os.stat(f"/proc/{holder.pid}/ns/net")
+                if (st.st_ino, st.st_dev) == (target.st_ino, target.st_dev):
+                    return
+            except OSError:
+                pass
+            if holder.poll() is not None:
+                raise IpCmdError(f"nsenter holder for {ns_path} exited "
+                                 f"rc={holder.returncode}")
+            if time.monotonic() > deadline:
+                raise IpCmdError(f"timed out entering netns {ns_path}")
+            time.sleep(0.005)
+
     def _create_interface(self, iface: Interface) -> None:
         name = self.ifname(iface.name)
         if iface.type in (InterfaceType.TAP, InterfaceType.VETH, InterfaceType.MEMIF):
@@ -211,12 +277,12 @@ class LinuxNetApplicator(Applicator):
             # BVI analog: an addressed BRIDGE device — tunnels enslave
             # into it (BridgeDomain create), putting the L3 address
             # exactly where VPP's bridge-virtual-interface sits.
-            self._ip(["link", "add", name, "type", "bridge"], check=False)
+            self._link_add(name, [name, "type", "bridge"])
         elif iface.type is InterfaceType.VXLAN:
-            self._ip(["link", "add", name, "type", "vxlan",
-                      "id", str(iface.vxlan_vni),
-                      "local", iface.vxlan_src, "remote", iface.vxlan_dst,
-                      "dstport", "4789"], check=False)
+            self._link_add(name, [name, "type", "vxlan",
+                           "id", str(iface.vxlan_vni),
+                           "local", iface.vxlan_src, "remote", iface.vxlan_dst,
+                           "dstport", "4789"])
         elif iface.type is InterfaceType.DPDK:
             pass  # physical NIC: must already exist
         self._finish_link(name, iface)
@@ -226,8 +292,7 @@ class LinuxNetApplicator(Applicator):
         host_if_name, optionally moved into the pod netns, and carries
         the addresses (the pod's eth0 side)."""
         peer_tmp = f"vp-{abs(hash(name)) % 0xFFFFFF:06x}"[:IFNAMSIZ]
-        self._ip(["link", "add", name, "type", "veth",
-                  "peer", "name", peer_tmp], check=False)
+        self._link_add(name, [name, "type", "veth", "peer", "name", peer_tmp])
         peer_name = self.ifname(iface.host_if_name or f"{name}-p")
         if iface.namespace:
             kind, ref = _resolve_netns(iface.namespace)
@@ -236,8 +301,10 @@ class LinuxNetApplicator(Applicator):
                 # namespace: running `ip netns add` under `ip netns exec`
                 # would leave its bind mount inside the exec's private
                 # mount ns and the name would resolve to an empty file.
-                subprocess.run(["ip", "netns", "add", ref],
-                               capture_output=True, check=False)
+                created = subprocess.run(["ip", "netns", "add", ref],
+                                         capture_output=True, check=False)
+                if created.returncode == 0 or ref in self._created_netns:
+                    self._created_netns.setdefault(ref, set()).add(iface.name)
                 self._ip(["link", "set", peer_tmp, "netns", ref])
                 ns = ["ip", "netns", "exec", ref, "ip"]
             elif kind == "pid":
@@ -246,11 +313,19 @@ class LinuxNetApplicator(Applicator):
                 self._ip(["link", "set", peer_tmp, "netns", ref])
                 ns = ["nsenter", f"--net=/proc/{ref}/ns/net", "ip"]
             else:
-                # An arbitrary nsfs path: nsenter can configure inside
-                # it, and iproute2 moves links into open ns fds via
-                # /proc/<nsenter-pid> — use nsenter's pid trick.
-                self._run(["nsenter", f"--net={ref}", "true"])  # validate
-                self._ip(["link", "set", peer_tmp, "netns", ref], check=False)
+                # An arbitrary nsfs path: iproute2's `netns` argument
+                # accepts only a registered name or a PID, so hold the
+                # target ns open with a child process and move the link
+                # by that child's PID.
+                holder = subprocess.Popen(
+                    ["nsenter", f"--net={ref}", "sleep", "30"],
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+                try:
+                    self._wait_holder_in_ns(holder, ref)
+                    self._ip(["link", "set", peer_tmp, "netns", str(holder.pid)])
+                finally:
+                    holder.terminate()
+                    holder.wait()
                 ns = ["nsenter", f"--net={ref}", "ip"]
             self._run(ns + ["link", "set", peer_tmp, "name", peer_name])
             for addr in iface.ip_addresses:

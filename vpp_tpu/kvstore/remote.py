@@ -41,6 +41,16 @@ log = logging.getLogger(__name__)
 SERVICE_NAME = "kvstore.KVStore"
 DEFAULT_PORT = 12379  # etcd's 2379, out of the privileged/common range
 
+# Status codes that mean "transport outage" (retry / fall back to the
+# local mirror) — everything else is a server-side bug and must surface.
+# Single source of truth; the dbwatcher's unary-path classifier imports
+# this so stream and unary outage handling cannot drift.
+OUTAGE_CODES = frozenset((
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+    grpc.StatusCode.CANCELLED,
+))
+
 
 def _encode(msg: dict) -> bytes:
     return codec.encode(msg)
@@ -51,12 +61,26 @@ def _decode(data: bytes) -> dict:
 
 
 class KVStoreServer:
-    """Serves one in-process KVStore to the cluster."""
+    """Serves one in-process KVStore to the cluster.
 
-    def __init__(self, store: KVStore, host: str = "127.0.0.1", port: int = 0):
+    Each Watch stream parks one thread of the server's pool for its whole
+    life (sync gRPC streams a generator from a worker thread), so the pool
+    is sized as ``max_watchers`` streaming slots PLUS a fixed reserve of
+    unary workers — a watcher storm can never starve Get/Put/Snapshot.
+    Watch registrations beyond ``max_watchers`` are rejected loudly with
+    RESOURCE_EXHAUSTED instead of silently wedging the control plane.
+    """
+
+    UNARY_WORKERS = 16
+
+    def __init__(self, store: KVStore, host: str = "127.0.0.1", port: int = 0,
+                 max_watchers: int = 64):
         self.store = store
         self.host = host
         self.port = port
+        self.max_watchers = max_watchers
+        self._active_watchers = 0
+        self._watch_lock = threading.Lock()
         self._server: Optional[grpc.Server] = None
 
     # ------------------------------------------------------------- handlers
@@ -91,8 +115,20 @@ class KVStoreServer:
         committed change.  The ack (empty key) proves the store-side
         watcher is registered, so a client that snapshots AFTER receiving
         it cannot lose events between snapshot and stream."""
-        watcher = self.store.watch(request["prefixes"])
+        with self._watch_lock:
+            if self._active_watchers >= self.max_watchers:
+                log.error(
+                    "watch limit reached (%d): rejecting new stream "
+                    "(raise KVStoreServer(max_watchers=...))", self.max_watchers,
+                )
+                context.abort(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    f"watcher limit {self.max_watchers} reached",
+                )
+            self._active_watchers += 1
+        watcher = None
         try:
+            watcher = self.store.watch(request["prefixes"])
             yield {"key": "", "value": None, "prev_value": None,
                    "revision": self.store.revision}
             while context.is_active():
@@ -106,7 +142,10 @@ class KVStoreServer:
                     "revision": ev.revision,
                 }
         finally:
-            self.store.unwatch(watcher)
+            if watcher is not None:
+                self.store.unwatch(watcher)
+            with self._watch_lock:
+                self._active_watchers -= 1
 
     # ------------------------------------------------------------ lifecycle
 
@@ -129,7 +168,8 @@ class KVStoreServer:
         unary["Watch"] = grpc.unary_stream_rpc_method_handler(
             self._watch, request_deserializer=_decode, response_serializer=_encode
         )
-        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))
+        self._server = grpc.server(futures.ThreadPoolExecutor(
+            max_workers=self.max_watchers + self.UNARY_WORKERS))
         self._server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(SERVICE_NAME, unary),)
         )
@@ -208,8 +248,18 @@ class RemoteWatcher(Watcher):
                             revision=msg["revision"],
                         )
                     )
-            except grpc.RpcError:
-                pass
+            except grpc.RpcError as e:
+                code_fn = getattr(e, "code", None)
+                code = code_fn() if code_fn is not None else None
+                if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                    # Server watcher limit hit — fail loudly (ADVICE r2);
+                    # the backoff retry may still grab a freed slot.
+                    log.error("watch stream rejected: %s", e)
+                elif code not in OUTAGE_CODES:
+                    # Not an outage: a server-side handler crash
+                    # (UNKNOWN/INTERNAL) would otherwise retry silently
+                    # forever while the watch is effectively dead.
+                    log.warning("watch stream failed with %s: %s", code, e)
             finally:
                 self._call = None
             if self.closed:
