@@ -1,0 +1,237 @@
+// Shared packet-plumbing helpers for the native host shim.
+//
+// Pulled out of hostshim.cpp so the batch API (hostshim.cpp) and the
+// native runner loop (runnerloop.cpp) compile against one definition of
+// frame parsing, RFC 1624 incremental checksums, and the VXLAN overlay
+// header layout (the reference's full-mesh VNI-10 overlay,
+// plugins/ipv4net/node.go vxlanIfToOtherNode :524).
+
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+
+namespace hs {
+
+constexpr uint16_t kEthertypeIPv4 = 0x0800;
+constexpr uint16_t kEthertypeVlan = 0x8100;
+constexpr uint8_t kProtoTCP = 6;
+constexpr uint8_t kProtoUDP = 17;
+
+constexpr uint16_t kVxlanPort = 4789;
+constexpr uint32_t kVxlanHdrBytes = 8;
+constexpr uint32_t kOuterBytes = 14 + 20 + 8 + kVxlanHdrBytes;  // 50
+
+inline uint16_t load_be16(const uint8_t* p) {
+  return static_cast<uint16_t>(p[0]) << 8 | p[1];
+}
+inline uint32_t load_be32(const uint8_t* p) {
+  return static_cast<uint32_t>(p[0]) << 24 | static_cast<uint32_t>(p[1]) << 16 |
+         static_cast<uint32_t>(p[2]) << 8 | p[3];
+}
+inline void store_be16(uint8_t* p, uint16_t v) {
+  p[0] = v >> 8;
+  p[1] = v & 0xff;
+}
+inline void store_be32(uint8_t* p, uint32_t v) {
+  p[0] = v >> 24;
+  p[1] = (v >> 16) & 0xff;
+  p[2] = (v >> 8) & 0xff;
+  p[3] = v & 0xff;
+}
+
+// RFC 1624 eqn. 3: HC' = ~(~HC + ~m + m'), one 16-bit field update.
+inline uint16_t csum_update16(uint16_t hc, uint16_t m_old, uint16_t m_new) {
+  uint32_t sum = static_cast<uint32_t>(static_cast<uint16_t>(~hc)) +
+                 static_cast<uint16_t>(~m_old) + m_new;
+  while (sum >> 16) sum = (sum & 0xffff) + (sum >> 16);
+  return static_cast<uint16_t>(~sum);
+}
+
+inline uint16_t csum_update32(uint16_t hc, uint32_t m_old, uint32_t m_new) {
+  hc = csum_update16(hc, m_old >> 16, m_new >> 16);
+  return csum_update16(hc, m_old & 0xffff, m_new & 0xffff);
+}
+
+struct FrameView {
+  uint8_t* ip = nullptr;   // IPv4 header start
+  uint8_t* l4 = nullptr;   // L4 header start (null if truncated/fragment)
+  uint8_t proto = 0;
+  bool valid = false;
+  bool has_ports = false;
+};
+
+// Parse one frame: Ethernet II (+ optional single 802.1Q tag) → IPv4 →
+// TCP/UDP ports.  Non-IPv4 and truncated frames yield valid=false; a
+// non-first fragment keeps valid but has no port view.
+inline FrameView parse_frame(uint8_t* frame, uint32_t len) {
+  FrameView v;
+  if (len < 14) return v;
+  uint32_t off = 12;
+  uint16_t ethertype = load_be16(frame + off);
+  off += 2;
+  if (ethertype == kEthertypeVlan) {
+    if (len < off + 4) return v;
+    ethertype = load_be16(frame + off + 2);
+    off += 4;
+  }
+  if (ethertype != kEthertypeIPv4) return v;
+  if (len < off + 20) return v;
+  uint8_t* ip = frame + off;
+  if ((ip[0] >> 4) != 4) return v;
+  uint32_t ihl = static_cast<uint32_t>(ip[0] & 0x0f) * 4;
+  if (ihl < 20 || len < off + ihl) return v;
+  v.ip = ip;
+  v.proto = ip[9];
+  v.valid = true;
+  uint16_t frag = load_be16(ip + 6);
+  bool first_fragment = (frag & 0x1fff) == 0;
+  if (!first_fragment) return v;  // ports live in the first fragment only
+  if ((v.proto == kProtoTCP || v.proto == kProtoUDP) && len >= off + ihl + 4) {
+    v.l4 = ip + ihl;
+    v.has_ports = true;
+  }
+  return v;
+}
+
+// Node-ID-derived locally-administered MAC (the BVI-MAC convention:
+// a fixed OUI-style prefix + the node ID).
+inline void node_mac(uint32_t node_id, uint8_t* mac) {
+  mac[0] = 0x02;
+  mac[1] = 0x76;
+  mac[2] = 0x70;
+  mac[3] = 0x70;
+  mac[4] = (node_id >> 8) & 0xff;
+  mac[5] = node_id & 0xff;
+}
+
+// Full (non-incremental) IPv4 header checksum over 20 bytes.
+inline uint16_t ip_header_csum(const uint8_t* hdr) {
+  uint32_t sum = 0;
+  for (int i = 0; i < 20; i += 2) {
+    if (i == 10) continue;  // checksum field itself
+    sum += load_be16(hdr + i);
+  }
+  while (sum >> 16) sum = (sum & 0xffff) + (sum >> 16);
+  return static_cast<uint16_t>(~sum);
+}
+
+// VXLAN-classify one frame: if it is a well-formed
+// eth/IPv4/UDP(4789)/VXLAN frame, returns the VNI (>= 0) and sets
+// *inner_off / *inner_len to the inner Ethernet frame's position within
+// the frame; otherwise returns -1 and leaves the whole frame.
+inline int32_t vxlan_classify(const uint8_t* frame, uint32_t len,
+                              uint32_t* inner_off, uint32_t* inner_len) {
+  *inner_off = 0;
+  *inner_len = len;
+  FrameView v = parse_frame(const_cast<uint8_t*>(frame), len);
+  if (!v.valid || v.proto != kProtoUDP || !v.has_ports) return -1;
+  if (load_be16(v.l4 + 2) != kVxlanPort) return -1;
+  const uint8_t* vx = v.l4 + 8;
+  uint64_t l4_off = static_cast<uint64_t>(v.l4 - frame);
+  if (len < l4_off + 8 + kVxlanHdrBytes + 14) return -1;  // need inner eth
+  if ((vx[0] & 0x08) == 0) return -1;  // VNI bit not set
+  *inner_off = static_cast<uint32_t>(l4_off + 8 + kVxlanHdrBytes);
+  *inner_len = len - *inner_off;
+  return static_cast<int32_t>(load_be32(vx + 4) >> 8);
+}
+
+// Write the 50-byte VXLAN overlay header for an inner frame of
+// inner_len bytes into out (outer eth + IPv4 + UDP 4789 + VXLAN).
+// entropy_h seeds the outer UDP source port (RFC 7348 §5 ECMP).
+inline void write_vxlan_outer(uint8_t* out, uint32_t inner_len,
+                              uint32_t local_ip, uint32_t dst_ip,
+                              uint32_t local_node_id, uint32_t dst_node_id,
+                              uint32_t vni, uint32_t entropy_h) {
+  node_mac(dst_node_id, out);          // dst MAC
+  node_mac(local_node_id, out + 6);    // src MAC
+  store_be16(out + 12, kEthertypeIPv4);
+
+  uint8_t* ip = out + 14;
+  ip[0] = 0x45;
+  ip[1] = 0;
+  store_be16(ip + 2, static_cast<uint16_t>(20 + 8 + kVxlanHdrBytes + inner_len));
+  store_be16(ip + 4, 0);        // identification
+  store_be16(ip + 6, 0x4000);   // DF
+  ip[8] = 64;                   // TTL
+  ip[9] = kProtoUDP;
+  store_be16(ip + 10, 0);
+  store_be32(ip + 12, local_ip);
+  store_be32(ip + 16, dst_ip);
+  store_be16(ip + 10, ip_header_csum(ip));
+
+  uint8_t* udp = ip + 20;
+  store_be16(udp, static_cast<uint16_t>(49152 + (entropy_h % 16384)));
+  store_be16(udp + 2, kVxlanPort);
+  store_be16(udp + 4, static_cast<uint16_t>(8 + kVxlanHdrBytes + inner_len));
+  store_be16(udp + 6, 0);  // UDP checksum optional for v4 (RFC 7348 §5)
+
+  uint8_t* vx = udp + 8;
+  vx[0] = 0x08;
+  vx[1] = vx[2] = vx[3] = 0;
+  store_be32(vx + 4, (vni << 8) & 0xffffff00);
+}
+
+// ECMP entropy hash over the inner flow (inner IPv4 addrs + ports).
+inline uint32_t flow_entropy(const uint8_t* inner, uint32_t inner_len) {
+  FrameView v = parse_frame(const_cast<uint8_t*>(inner), inner_len);
+  uint32_t h = 0;
+  if (v.valid) {
+    h = load_be32(v.ip + 12) ^ (load_be32(v.ip + 16) * 2654435761u);
+    if (v.has_ports) h ^= load_be32(v.l4);
+    h ^= h >> 16;
+  }
+  return h;
+}
+
+// Apply a verdict + 5-tuple rewrite to one parsed frame in place with
+// incremental checksum updates.  Returns false for unparseable frames.
+inline bool apply_rewrite(uint8_t* frame, uint32_t len, uint32_t new_src_ip,
+                          uint32_t new_dst_ip, uint16_t new_sport,
+                          uint16_t new_dport) {
+  FrameView v = parse_frame(frame, len);
+  if (!v.valid) return false;
+
+  uint32_t old_src = load_be32(v.ip + 12);
+  uint32_t old_dst = load_be32(v.ip + 16);
+  uint16_t ip_csum = load_be16(v.ip + 10);
+
+  uint8_t* l4_csum_p = nullptr;
+  if (v.l4 != nullptr) {
+    if (v.proto == kProtoTCP) {
+      l4_csum_p = v.l4 + 16;
+    } else if (v.proto == kProtoUDP && load_be16(v.l4 + 6) != 0) {
+      l4_csum_p = v.l4 + 6;  // UDP checksum 0 = disabled, keep it so
+    }
+  }
+  uint16_t l4_csum = l4_csum_p ? load_be16(l4_csum_p) : 0;
+
+  if (new_src_ip != old_src) {
+    ip_csum = csum_update32(ip_csum, old_src, new_src_ip);
+    if (l4_csum_p) l4_csum = csum_update32(l4_csum, old_src, new_src_ip);
+    store_be32(v.ip + 12, new_src_ip);
+  }
+  if (new_dst_ip != old_dst) {
+    ip_csum = csum_update32(ip_csum, old_dst, new_dst_ip);
+    if (l4_csum_p) l4_csum = csum_update32(l4_csum, old_dst, new_dst_ip);
+    store_be32(v.ip + 16, new_dst_ip);
+  }
+  store_be16(v.ip + 10, ip_csum);
+
+  if (v.has_ports) {
+    uint16_t old_sport = load_be16(v.l4);
+    uint16_t old_dport = load_be16(v.l4 + 2);
+    if (new_sport != old_sport) {
+      if (l4_csum_p) l4_csum = csum_update16(l4_csum, old_sport, new_sport);
+      store_be16(v.l4, new_sport);
+    }
+    if (new_dport != old_dport) {
+      if (l4_csum_p) l4_csum = csum_update16(l4_csum, old_dport, new_dport);
+      store_be16(v.l4 + 2, new_dport);
+    }
+  }
+  if (l4_csum_p) store_be16(l4_csum_p, l4_csum);
+  return true;
+}
+
+}  // namespace hs

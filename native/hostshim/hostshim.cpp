@@ -11,90 +11,16 @@
 // C ABI, consumed from Python via ctypes (no pybind11 in the image).
 // Frames live in ONE contiguous buffer described by (offset, len)
 // arrays — a single memcpy-free view for both sides.
+//
+// The batch-at-a-time API below serves tests and the Python-loop
+// runner; the full native admit/harvest loop lives in runnerloop.cpp.
 
 #include <cstdint>
 #include <cstring>
 
-namespace {
+#include "common.h"
 
-constexpr uint16_t kEthertypeIPv4 = 0x0800;
-constexpr uint16_t kEthertypeVlan = 0x8100;
-constexpr uint8_t kProtoTCP = 6;
-constexpr uint8_t kProtoUDP = 17;
-
-inline uint16_t load_be16(const uint8_t* p) {
-  return static_cast<uint16_t>(p[0]) << 8 | p[1];
-}
-inline uint32_t load_be32(const uint8_t* p) {
-  return static_cast<uint32_t>(p[0]) << 24 | static_cast<uint32_t>(p[1]) << 16 |
-         static_cast<uint32_t>(p[2]) << 8 | p[3];
-}
-inline void store_be16(uint8_t* p, uint16_t v) {
-  p[0] = v >> 8;
-  p[1] = v & 0xff;
-}
-inline void store_be32(uint8_t* p, uint32_t v) {
-  p[0] = v >> 24;
-  p[1] = (v >> 16) & 0xff;
-  p[2] = (v >> 8) & 0xff;
-  p[3] = v & 0xff;
-}
-
-// RFC 1624 eqn. 3: HC' = ~(~HC + ~m + m'), one 16-bit field update.
-inline uint16_t csum_update16(uint16_t hc, uint16_t m_old, uint16_t m_new) {
-  uint32_t sum = static_cast<uint32_t>(static_cast<uint16_t>(~hc)) +
-                 static_cast<uint16_t>(~m_old) + m_new;
-  while (sum >> 16) sum = (sum & 0xffff) + (sum >> 16);
-  return static_cast<uint16_t>(~sum);
-}
-
-inline uint16_t csum_update32(uint16_t hc, uint32_t m_old, uint32_t m_new) {
-  hc = csum_update16(hc, m_old >> 16, m_new >> 16);
-  return csum_update16(hc, m_old & 0xffff, m_new & 0xffff);
-}
-
-struct FrameView {
-  uint8_t* ip = nullptr;   // IPv4 header start
-  uint8_t* l4 = nullptr;   // L4 header start (null if truncated/fragment)
-  uint8_t proto = 0;
-  bool valid = false;
-  bool has_ports = false;
-};
-
-// Parse one frame: Ethernet II (+ optional single 802.1Q tag) → IPv4 →
-// TCP/UDP ports.  Non-IPv4 and truncated frames yield valid=false; a
-// non-first fragment keeps valid but has no port view.
-FrameView parse_frame(uint8_t* frame, uint32_t len) {
-  FrameView v;
-  if (len < 14) return v;
-  uint32_t off = 12;
-  uint16_t ethertype = load_be16(frame + off);
-  off += 2;
-  if (ethertype == kEthertypeVlan) {
-    if (len < off + 4) return v;
-    ethertype = load_be16(frame + off + 2);
-    off += 4;
-  }
-  if (ethertype != kEthertypeIPv4) return v;
-  if (len < off + 20) return v;
-  uint8_t* ip = frame + off;
-  if ((ip[0] >> 4) != 4) return v;
-  uint32_t ihl = static_cast<uint32_t>(ip[0] & 0x0f) * 4;
-  if (ihl < 20 || len < off + ihl) return v;
-  v.ip = ip;
-  v.proto = ip[9];
-  v.valid = true;
-  uint16_t frag = load_be16(ip + 6);
-  bool first_fragment = (frag & 0x1fff) == 0;
-  if (!first_fragment) return v;  // ports live in the first fragment only
-  if ((v.proto == kProtoTCP || v.proto == kProtoUDP) && len >= off + ihl + 4) {
-    v.l4 = ip + ihl;
-    v.has_ports = true;
-  }
-  return v;
-}
-
-}  // namespace
+using namespace hs;
 
 extern "C" {
 
@@ -137,55 +63,15 @@ int32_t hs_apply_batch(uint8_t* buf, const uint64_t* offsets,
                        uint8_t* fwd) {
   int32_t forwarded = 0;
   for (int32_t i = 0; i < n; ++i) {
-    FrameView v = parse_frame(buf + offsets[i], lens[i]);
-    if (!v.valid || !allowed[i]) {
+    if (!allowed[i] ||
+        !apply_rewrite(buf + offsets[i], lens[i], new_src_ip[i], new_dst_ip[i],
+                       static_cast<uint16_t>(new_src_port[i]),
+                       static_cast<uint16_t>(new_dst_port[i]))) {
       fwd[i] = 0;
       continue;
     }
     fwd[i] = 1;
     ++forwarded;
-
-    uint32_t old_src = load_be32(v.ip + 12);
-    uint32_t old_dst = load_be32(v.ip + 16);
-    uint16_t ip_csum = load_be16(v.ip + 10);
-
-    uint8_t* l4_csum_p = nullptr;
-    if (v.l4 != nullptr) {
-      if (v.proto == kProtoTCP) {
-        l4_csum_p = v.l4 + 16;
-      } else if (v.proto == kProtoUDP && load_be16(v.l4 + 6) != 0) {
-        l4_csum_p = v.l4 + 6;  // UDP checksum 0 = disabled, keep it so
-      }
-    }
-    uint16_t l4_csum = l4_csum_p ? load_be16(l4_csum_p) : 0;
-
-    if (new_src_ip[i] != old_src) {
-      ip_csum = csum_update32(ip_csum, old_src, new_src_ip[i]);
-      if (l4_csum_p) l4_csum = csum_update32(l4_csum, old_src, new_src_ip[i]);
-      store_be32(v.ip + 12, new_src_ip[i]);
-    }
-    if (new_dst_ip[i] != old_dst) {
-      ip_csum = csum_update32(ip_csum, old_dst, new_dst_ip[i]);
-      if (l4_csum_p) l4_csum = csum_update32(l4_csum, old_dst, new_dst_ip[i]);
-      store_be32(v.ip + 16, new_dst_ip[i]);
-    }
-    store_be16(v.ip + 10, ip_csum);
-
-    if (v.has_ports) {
-      uint16_t old_sport = load_be16(v.l4);
-      uint16_t old_dport = load_be16(v.l4 + 2);
-      uint16_t sport = static_cast<uint16_t>(new_src_port[i]);
-      uint16_t dport = static_cast<uint16_t>(new_dst_port[i]);
-      if (sport != old_sport) {
-        if (l4_csum_p) l4_csum = csum_update16(l4_csum, old_sport, sport);
-        store_be16(v.l4, sport);
-      }
-      if (dport != old_dport) {
-        if (l4_csum_p) l4_csum = csum_update16(l4_csum, old_dport, dport);
-        store_be16(v.l4 + 2, dport);
-      }
-    }
-    if (l4_csum_p) store_be16(l4_csum_p, l4_csum);
   }
   return forwarded;
 }
@@ -200,36 +86,6 @@ int32_t hs_apply_batch(uint8_t* buf, const uint64_t* offsets,
 // wraps them: outer Ethernet + IPv4 + UDP(4789) + VXLAN, outer source
 // port derived from the inner flow for ECMP entropy (RFC 7348 §5).
 // ---------------------------------------------------------------------------
-
-namespace {
-
-constexpr uint16_t kVxlanPort = 4789;
-constexpr uint32_t kVxlanHdrBytes = 8;
-constexpr uint32_t kOuterBytes = 14 + 20 + 8 + kVxlanHdrBytes;  // 50
-
-// Node-ID-derived locally-administered MAC (the BVI-MAC convention:
-// a fixed OUI-style prefix + the node ID).
-inline void node_mac(uint32_t node_id, uint8_t* mac) {
-  mac[0] = 0x02;
-  mac[1] = 0x76;
-  mac[2] = 0x70;
-  mac[3] = 0x70;
-  mac[4] = (node_id >> 8) & 0xff;
-  mac[5] = node_id & 0xff;
-}
-
-// Full (non-incremental) IPv4 header checksum over 20 bytes.
-inline uint16_t ip_header_csum(const uint8_t* hdr) {
-  uint32_t sum = 0;
-  for (int i = 0; i < 20; i += 2) {
-    if (i == 10) continue;  // checksum field itself
-    sum += load_be16(hdr + i);
-  }
-  while (sum >> 16) sum = (sum & 0xffff) + (sum >> 16);
-  return static_cast<uint16_t>(~sum);
-}
-
-}  // namespace
 
 // Encapsulate the ROUTE_REMOTE forwarded frames of a batch.
 //
@@ -264,49 +120,11 @@ int32_t hs_vxlan_encap_batch(const uint8_t* buf, const uint64_t* offsets,
     uint32_t total = kOuterBytes + inner_len;
     if (used + total > out_cap) return -1;
     uint8_t* p = out_buf + used;
-
-    // Outer Ethernet.
-    node_mac(static_cast<uint32_t>(nid), p);            // dst MAC
-    node_mac(local_node_id, p + 6);                     // src MAC
-    store_be16(p + 12, kEthertypeIPv4);
-
-    // Outer IPv4 (no options, DF, TTL 64).
-    uint8_t* ip = p + 14;
-    ip[0] = 0x45;
-    ip[1] = 0;
-    store_be16(ip + 2, static_cast<uint16_t>(20 + 8 + kVxlanHdrBytes + inner_len));
-    store_be16(ip + 4, 0);        // identification
-    store_be16(ip + 6, 0x4000);   // DF
-    ip[8] = 64;                   // TTL
-    ip[9] = kProtoUDP;
-    store_be16(ip + 10, 0);
-    store_be32(ip + 12, local_ip);
-    store_be32(ip + 16, dst_ip);
-    store_be16(ip + 10, ip_header_csum(ip));
-
-    // Outer UDP: source port from the inner flow for ECMP entropy
-    // (hash the inner IPv4 addresses + ports if present).
     const uint8_t* inner = buf + offsets[i];
-    FrameView v = parse_frame(const_cast<uint8_t*>(inner), inner_len);
-    uint32_t h = 0;
-    if (v.valid) {
-      h = load_be32(v.ip + 12) ^ (load_be32(v.ip + 16) * 2654435761u);
-      if (v.has_ports) h ^= load_be32(v.l4);
-      h ^= h >> 16;
-    }
-    uint8_t* udp = ip + 20;
-    store_be16(udp, static_cast<uint16_t>(49152 + (h % 16384)));
-    store_be16(udp + 2, kVxlanPort);
-    store_be16(udp + 4, static_cast<uint16_t>(8 + kVxlanHdrBytes + inner_len));
-    store_be16(udp + 6, 0);  // UDP checksum optional for v4 (RFC 7348 §5)
-
-    // VXLAN header: flags (I bit), reserved, VNI, reserved.
-    uint8_t* vx = udp + 8;
-    vx[0] = 0x08;
-    vx[1] = vx[2] = vx[3] = 0;
-    store_be32(vx + 4, (vni << 8) & 0xffffff00);
-
-    std::memcpy(vx + 4 + 4, inner, inner_len);
+    write_vxlan_outer(p, inner_len, local_ip, dst_ip, local_node_id,
+                      static_cast<uint32_t>(nid), vni,
+                      flow_entropy(inner, inner_len));
+    std::memcpy(p + kOuterBytes, inner, inner_len);
     out_offsets[emitted] = used;
     out_lens[emitted] = total;
     out_rows[emitted] = i;
@@ -330,20 +148,11 @@ int32_t hs_vxlan_decap_batch(const uint8_t* buf, const uint64_t* offsets,
                              int32_t* vnis) {
   int32_t decapped = 0;
   for (int32_t i = 0; i < n; ++i) {
-    inner_offsets[i] = offsets[i];
-    inner_lens[i] = lens[i];
-    vnis[i] = -1;
-    FrameView v = parse_frame(const_cast<uint8_t*>(buf + offsets[i]), lens[i]);
-    if (!v.valid || v.proto != kProtoUDP || !v.has_ports) continue;
-    if (load_be16(v.l4 + 2) != kVxlanPort) continue;
-    const uint8_t* vx = v.l4 + 8;
-    uint64_t l4_off = static_cast<uint64_t>(v.l4 - (buf + offsets[i]));
-    if (lens[i] < l4_off + 8 + kVxlanHdrBytes + 14) continue;  // need inner eth
-    if ((vx[0] & 0x08) == 0) continue;  // VNI bit not set
-    inner_offsets[i] = offsets[i] + l4_off + 8 + kVxlanHdrBytes;
-    inner_lens[i] = lens[i] - static_cast<uint32_t>(l4_off + 8 + kVxlanHdrBytes);
-    vnis[i] = static_cast<int32_t>(load_be32(vx + 4) >> 8);
-    ++decapped;
+    uint32_t rel_off, rel_len;
+    vnis[i] = vxlan_classify(buf + offsets[i], lens[i], &rel_off, &rel_len);
+    inner_offsets[i] = offsets[i] + rel_off;
+    inner_lens[i] = rel_len;
+    if (vnis[i] >= 0) ++decapped;
   }
   return decapped;
 }

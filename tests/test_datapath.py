@@ -144,7 +144,7 @@ def test_cross_node_vxlan_encap_decap_delivery(cluster):
     # Drive only node-1 first so we can inspect the wire format.
     fn1 = cluster.frame_nodes["node-1"]
     fn1.sync_tables()
-    fn1.runner.drain()
+    fn1.drain()
     assert fn1.runner.counters.tx_remote == 4
 
     # Frames crossed the wire into node-2's rx ring, VXLAN-encapped.
@@ -422,6 +422,124 @@ def test_cross_node_service_dnat_and_reply_over_vxlan(cluster):
     assert frame_tuple(rep[0]) == ("10.96.0.10", client_ip, 6, 80, 43000)
     assert verify_checksums(rep[0])
     assert cluster.frame_nodes["node-2"].runner.counters.tx_remote == 1
+
+
+def test_native_ring_roundtrip_and_wraparound():
+    """NativeRing: bytes-compat FIFO order, drop counting when full,
+    and arena wraparound integrity under mixed push/pop."""
+    from vpp_tpu.datapath.io import NativeRing
+
+    ring = NativeRing(arena_bytes=1 << 16, max_frames=256)
+    frames = [build_frame("10.1.1.2", "10.1.2.3", 6, 1000 + i, 80)
+              for i in range(10)]
+    ring.send(frames)
+    assert len(ring) == 10
+    assert ring.recv_batch(100) == frames
+    # capacity: tiny ring drops excess and counts it
+    tiny = NativeRing(arena_bytes=256, max_frames=8)
+    big = [b"\xab" * 100 for _ in range(5)]
+    tiny.send(big)
+    assert len(tiny) == 2 and tiny.dropped == 3
+    # wraparound: cycle far past the arena size, order preserved
+    ring2 = NativeRing(arena_bytes=2048, max_frames=16)
+    expect = []
+    got = []
+    for i in range(300):
+        f = bytes([i % 251]) * (60 + i % 90)
+        before = len(ring2)
+        ring2.send([f])
+        if len(ring2) == before + 1:
+            expect.append(f)
+        got += ring2.recv_batch(2)
+    got += ring2.recv_batch(100)
+    assert got == expect
+
+
+def test_native_python_engine_counter_parity():
+    """VERDICT r2 item 1: the C++ loop must be behaviorally identical
+    to the Python loop.  Same mixed traffic (local / remote / host /
+    denied-unparseable / foreign-VNI / VXLAN-ingress) through both
+    engines -> identical counters and identical output frames."""
+    from vpp_tpu.datapath import DataplaneRunner, InMemoryRing, NativeRing, VxlanOverlay
+    from vpp_tpu.ops.classify import build_rule_tables
+    from vpp_tpu.ops.nat import build_nat_tables
+    from vpp_tpu.ops.pipeline import RouteConfig
+    from vpp_tpu.shim.hostshim import HostShim
+
+    import jax.numpy as jnp
+
+    # Stand-alone tables: pod subnet 10.1.0.0/16, this node 10.1.1.0/24.
+    acl = build_rule_tables([], {})
+    nat = build_nat_tables([], snat_ip="192.168.16.1", snat_enabled=True)
+    route = RouteConfig(
+        pod_subnet_base=jnp.asarray(ip_to_u32("10.1.0.0"), dtype=jnp.uint32),
+        pod_subnet_mask=jnp.asarray(0xFFFF0000, dtype=jnp.uint32),
+        this_node_base=jnp.asarray(ip_to_u32("10.1.1.0"), dtype=jnp.uint32),
+        this_node_mask=jnp.asarray(0xFFFFFF00, dtype=jnp.uint32),
+        host_bits=jnp.asarray(8, dtype=jnp.int32),
+    )
+    shim = HostShim()
+
+    def mixed_traffic():
+        frames = []
+        # local pod-to-pod
+        frames += [build_frame("10.1.1.2", "10.1.1.3", 6, 40000 + i, 80)
+                   for i in range(5)]
+        # remote (node 2) and unroutable-remote (node 9, no VTEP)
+        frames += [build_frame("10.1.1.2", "10.1.2.9", 6, 41000 + i, 80)
+                   for i in range(4)]
+        frames += [build_frame("10.1.1.2", "10.1.9.9", 17, 42000, 53)]
+        # egress to the world (SNAT -> host)
+        frames += [build_frame("10.1.1.4", "93.184.216.34", 6, 43000 + i, 443)
+                   for i in range(3)]
+        # non-IPv4 (ARP) -> unparseable
+        frames += [b"\xff" * 6 + b"\x02\x00\x00\x00\x00\x01" + b"\x08\x06"
+                   + b"\x00" * 40]
+        # VXLAN ingress for our VNI + a foreign VNI
+        inner = build_frame("10.1.2.7", "10.1.1.3", 6, 44000, 8080)
+        fb = shim.parse([inner], pad_to=None)
+        remote_ips = np.zeros(4, dtype=np.uint32)
+        remote_ips[1] = ip_to_u32("192.168.16.1")
+        for vni in (10, 99):
+            buf, off, lens, rows, _ = shim.vxlan_encap(
+                fb, np.array([1], np.uint8), np.array([1], np.uint8),
+                np.array([1], np.int32), remote_ips,
+                local_ip=ip_to_u32("192.168.16.2"), local_node_id=2, vni=vni,
+            )
+            frames += [buf[int(off[0]):int(off[0]) + int(lens[0])].tobytes()]
+        return frames
+
+    results = {}
+    for engine in ("python", "native"):
+        if engine == "native":
+            rings = [NativeRing() for _ in range(4)]
+        else:
+            rings = [InMemoryRing() for _ in range(4)]
+        rx, tx, local, host = rings
+        runner = DataplaneRunner(
+            acl=acl, nat=nat, route=route,
+            overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"),
+                                 local_node_id=1),
+            source=rx, tx=tx, local=local, host=host,
+            batch_size=8, max_vectors=2, shim=shim,
+        )
+        assert runner.engine == engine
+        runner.overlay.set_remote(2, ip_to_u32("192.168.16.2"))
+        rx.send(mixed_traffic())
+        runner.drain()
+        results[engine] = {
+            "counters": dict(runner.counters.as_dict()),
+            "tx": tx.recv_batch(1 << 16),
+            "local": sorted(local.recv_batch(1 << 16)),
+            "host": host.recv_batch(1 << 16),
+        }
+    pc, nc = results["python"]["counters"], results["native"]["counters"]
+    assert pc == nc, f"counter divergence: {pc} vs {nc}"
+    assert results["python"]["local"] == results["native"]["local"]
+    assert results["python"]["host"] == results["native"]["host"]
+    # Encapped frames: same inner payloads and outer VTEPs (the outer
+    # UDP source port is flow-derived and deterministic -> bit equal).
+    assert results["python"]["tx"] == results["native"]["tx"]
 
 
 def test_afpacket_loopback_roundtrip():

@@ -5,9 +5,20 @@ The analog of the reference's DPDK→VPP fast path (vpp.env:1-3,
 docker/vpp-vswitch/dev/Dockerfile:1-16): continuous frame ingest,
 double-buffered batches through the TPU program, native verdict
 application + VXLAN overlay encap, and a host slow path for NAT punts.
+With NativeRing endpoints the admit/harvest loop runs in C++
+(native/hostshim/runnerloop.cpp) — frames never cross Python
+per-packet.
 """
 
-from .io import AfPacketIO, FrameSink, FrameSource, InMemoryRing, PcapReader, PcapWriter
+from .io import (
+    AfPacketIO,
+    FrameSink,
+    FrameSource,
+    InMemoryRing,
+    NativeRing,
+    PcapReader,
+    PcapWriter,
+)
 from .runner import DataplaneRunner, RunnerCounters, VxlanOverlay
 
 __all__ = [
@@ -16,6 +27,7 @@ __all__ = [
     "FrameSink",
     "FrameSource",
     "InMemoryRing",
+    "NativeRing",
     "PcapReader",
     "PcapWriter",
     "RunnerCounters",

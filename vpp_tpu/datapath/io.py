@@ -15,6 +15,11 @@ import struct
 import threading
 from typing import Iterable, List, Optional, Protocol, Sequence
 
+# The C++-backed frame ring (runnerloop.cpp) — the buffer-view
+# source/sink the native runner loop consumes; re-exported here so IO
+# call sites pick between InMemoryRing (pure Python) and NativeRing.
+from ..shim.hostshim import NativeRing, afp_rx_ring, afp_tx_ring  # noqa: F401
+
 
 class FrameSource(Protocol):
     def recv_batch(self, max_frames: int) -> List[bytes]:
@@ -176,6 +181,20 @@ class AfPacketIO:
                 self._sock.send(f)
             except BlockingIOError:
                 pass  # TX queue full — kernel drop semantics
+
+    # ------------------------------------------------- native burst IO
+
+    def fileno(self) -> int:
+        return self._sock.fileno()
+
+    def rx_into(self, ring: NativeRing, max_frames: int = 1 << 12) -> int:
+        """Burst-receive straight into a native ring (recvmmsg in C++;
+        no per-frame Python)."""
+        return afp_rx_ring(self.fileno(), ring, max_frames)
+
+    def tx_from(self, ring: NativeRing, max_frames: int = 1 << 12) -> int:
+        """Burst-transmit a native ring's frames (sendmmsg in C++)."""
+        return afp_tx_ring(self.fileno(), ring, max_frames)
 
     def close(self) -> None:
         self._sock.close()

@@ -33,6 +33,39 @@ import numpy as np
 ReplyKey = Tuple[int, int, int, int, int]  # src_ip, dst_ip, proto, sport, dport
 Restore = Tuple[int, int, int, int]        # orig src_ip, src_port, dst_ip, dst_port
 
+# Multiplicative key hash used by the vectorized batch pre-filter: the
+# same arithmetic runs per-row (numpy uint64, wrapping) and per-key
+# (scalar), so a dict-resident key always matches its row hash.  False
+# positives only cost an exact dict probe.
+_H = tuple(np.uint64(p) for p in (
+    0x9E3779B97F4A7C15, 0xC2B2AE3D27D4EB4F, 0x165667B19E3779F9,
+    0x27D4EB2F165667C5, 0x85EBCA77C2B2AE63,
+))
+
+
+def _hash_rows(src_ip, dst_ip, proto, sport, dport) -> np.ndarray:
+    """Vectorized ReplyKey hash over column arrays (uint64)."""
+    with np.errstate(over="ignore"):
+        return (
+            src_ip.astype(np.uint64) * _H[0]
+            ^ dst_ip.astype(np.uint64) * _H[1]
+            ^ proto.astype(np.uint64) * _H[2]
+            ^ sport.astype(np.uint64) * _H[3]
+            ^ dport.astype(np.uint64) * _H[4]
+        )
+
+
+def _hash_key(key: ReplyKey) -> int:
+    """Scalar twin of :func:`_hash_rows` for one (s,d,p,sp,dp) key."""
+    with np.errstate(over="ignore"):
+        return int(
+            np.uint64(key[0]) * _H[0]
+            ^ np.uint64(key[1]) * _H[1]
+            ^ np.uint64(key[2]) * _H[2]
+            ^ np.uint64(key[3]) * _H[3]
+            ^ np.uint64(key[4]) * _H[4]
+        )
+
 
 @dataclass
 class SlowSession:
@@ -74,6 +107,40 @@ class PuntOutcome(NamedTuple):
     drops: List[int]
 
 
+class _HashIndex:
+    """Refcounted hash-membership index with a cached numpy array.
+
+    The per-batch pre-filter does ONE vectorized ``np.isin`` against
+    this array; only rows whose hash is present reach the per-row
+    Python dict probes.  Refcounting keeps rare 64-bit hash collisions
+    correct (a removal cannot hide a distinct surviving key)."""
+
+    def __init__(self):
+        self._counts: Dict[int, int] = {}
+        self._arr: Optional[np.ndarray] = None
+
+    def add(self, h: int) -> None:
+        self._counts[h] = self._counts.get(h, 0) + 1
+        self._arr = None
+
+    def remove(self, h: int) -> None:
+        c = self._counts.get(h)
+        if c is None:
+            return
+        if c <= 1:
+            del self._counts[h]
+        else:
+            self._counts[h] = c - 1
+        self._arr = None
+
+    def arr(self) -> np.ndarray:
+        if self._arr is None:
+            self._arr = np.fromiter(
+                self._counts.keys(), dtype=np.uint64, count=len(self._counts)
+            )
+        return self._arr
+
+
 class HostSlowPath:
     """Exact host-side session table for punted flows."""
 
@@ -84,7 +151,21 @@ class HostSlowPath:
         self._by_fwd: Dict[ReplyKey, ReplyKey] = {}
         # Reserved (remote_ip, remote_port, proto, snat_ip, port) tuples.
         self._reserved_ports: Dict[Tuple[int, int, int, int], int] = {}
+        # Vectorized pre-filters over the dict keys (the fast-path cost
+        # of the slow path must stay O(batch) numpy, not O(batch) dict
+        # probes — at 16k-packet dispatches the per-row loop was the
+        # single largest frame-path cost).
+        self._reply_idx = _HashIndex()
+        self._fwd_idx = _HashIndex()
         self.counters = SlowPathCounters()
+
+    @staticmethod
+    def _batch_hashes(headers: Dict[str, np.ndarray], idx: np.ndarray) -> np.ndarray:
+        return _hash_rows(
+            headers["src_ip"][idx], headers["dst_ip"][idx],
+            headers["protocol"][idx], headers["src_port"][idx],
+            headers["dst_port"][idx],
+        )
 
     def __len__(self) -> int:
         return len(self.sessions)
@@ -160,10 +241,14 @@ class HostSlowPath:
                 self.counters.snat_reallocs += 1
 
             reply_key: ReplyKey = (r_src, r_dst, proto, r_sport, r_dport)
+            if reply_key not in self.sessions:
+                self._reply_idx.add(_hash_key(reply_key))
             self.sessions[reply_key] = SlowSession(
                 restore=o, last_seen=timestamp,
                 snat_port_override=override, fwd_key=fwd_key,
             )
+            if fwd_key not in self._by_fwd:
+                self._fwd_idx.add(_hash_key(fwd_key))
             self._by_fwd[fwd_key] = reply_key
         return PuntOutcome(fixups=fixups, drops=drops)
 
@@ -197,7 +282,13 @@ class HostSlowPath:
         scan to rows the device SNATted (candidates for an override).
         """
         fixups: List[Tuple[int, int]] = []
-        for i in np.nonzero(mask)[0].tolist():
+        idx = np.nonzero(mask)[0]
+        if not len(idx) or not self._by_fwd:
+            return fixups
+        # Vectorized membership pre-filter: only rows whose key hash is
+        # in the forward index pay a Python dict probe.
+        idx = idx[np.isin(self._batch_hashes(headers, idx), self._fwd_idx.arr())]
+        for i in idx.tolist():
             fwd_key = (int(headers["src_ip"][i]), int(headers["dst_ip"][i]),
                        int(headers["protocol"][i]),
                        int(headers["src_port"][i]), int(headers["dst_port"][i]))
@@ -224,7 +315,11 @@ class HostSlowPath:
         if not self.sessions:
             return []
         out: List[Tuple[int, Restore]] = []
-        for i in np.nonzero(candidates)[0].tolist():
+        idx = np.nonzero(candidates)[0]
+        if not len(idx):
+            return out
+        idx = idx[np.isin(self._batch_hashes(headers, idx), self._reply_idx.arr())]
+        for i in idx.tolist():
             key = (int(headers["src_ip"][i]), int(headers["dst_ip"][i]),
                    int(headers["protocol"][i]),
                    int(headers["src_port"][i]), int(headers["dst_port"][i]))
@@ -245,8 +340,10 @@ class HostSlowPath:
         stale = [k for k, s in self.sessions.items() if now - s.last_seen > max_age]
         for k in stale:
             sess = self.sessions.pop(k)
+            self._reply_idx.remove(_hash_key(k))
             if sess.fwd_key is not None:
-                self._by_fwd.pop(sess.fwd_key, None)
+                if self._by_fwd.pop(sess.fwd_key, None) is not None:
+                    self._fwd_idx.remove(_hash_key(sess.fwd_key))
             if sess.snat_port_override is not None:
                 endpoint = (k[0], k[3], k[2], k[1], sess.snat_port_override)
                 self._reserved_ports.pop(endpoint, None)

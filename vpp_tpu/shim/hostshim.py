@@ -24,16 +24,18 @@ import numpy as np
 from ..ops.packets import PacketBatch, VECTOR_SIZE
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
-_SRC = os.path.join(_NATIVE_DIR, "hostshim", "hostshim.cpp")
+_SRC_DIR = os.path.join(_NATIVE_DIR, "hostshim")
+_SOURCES = ("hostshim.cpp", "runnerloop.cpp", "common.h")
 _LIB = os.path.join(_NATIVE_DIR, "build", "libhostshim.so")
 
 
 def _build_library() -> str:
-    src = os.path.abspath(_SRC)
+    src_dir = os.path.abspath(_SRC_DIR)
     lib = os.path.abspath(_LIB)
-    if not os.path.exists(lib) or os.path.getmtime(lib) < os.path.getmtime(src):
+    newest = max(os.path.getmtime(os.path.join(src_dir, s)) for s in _SOURCES)
+    if not os.path.exists(lib) or os.path.getmtime(lib) < newest:
         subprocess.run(
-            ["make", "-s", "-C", os.path.dirname(src)],
+            ["make", "-s", "-C", src_dir],
             check=True,
             capture_output=True,
         )
@@ -71,7 +73,254 @@ def _load() -> ctypes.CDLL:
         _u8p, _u64p, _u32p, ctypes.c_int32,
         _u64p, _u32p, _i32p,
     ]
+    # --- native runner loop (runnerloop.cpp) ---
+    lib.hs_ring_new.restype = ctypes.c_void_p
+    lib.hs_ring_new.argtypes = [ctypes.c_uint64, ctypes.c_uint32]
+    lib.hs_ring_free.argtypes = [ctypes.c_void_p]
+    lib.hs_ring_count.restype = ctypes.c_uint32
+    lib.hs_ring_count.argtypes = [ctypes.c_void_p]
+    lib.hs_ring_dropped.restype = ctypes.c_uint64
+    lib.hs_ring_dropped.argtypes = [ctypes.c_void_p]
+    lib.hs_ring_push.restype = ctypes.c_int32
+    lib.hs_ring_push.argtypes = [
+        ctypes.c_void_p, _u8p, _u64p, _u32p, ctypes.c_int32,
+    ]
+    lib.hs_ring_pop.restype = ctypes.c_int32
+    lib.hs_ring_pop.argtypes = [
+        ctypes.c_void_p, _u8p, ctypes.c_uint64, _u64p, _u32p, ctypes.c_int32,
+    ]
+    lib.hs_loop_new.restype = ctypes.c_void_p
+    lib.hs_loop_new.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32,
+    ]
+    lib.hs_loop_free.argtypes = [ctypes.c_void_p]
+    lib.hs_loop_admit.restype = ctypes.c_int32
+    lib.hs_loop_admit.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32,
+        _u32p, _u32p, _i32p, _i32p, _i32p, _i32p, _u64p,
+    ]
+    lib.hs_loop_harvest.restype = ctypes.c_int32
+    lib.hs_loop_harvest.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32,
+        _u8p, _u32p, _u32p, _i32p, _i32p, _i32p, _i32p,
+        _u32p, ctypes.c_int32, ctypes.c_uint32, ctypes.c_uint32, _u64p,
+    ]
+    lib.hs_loop_slot_frame.restype = ctypes.c_int32
+    lib.hs_loop_slot_frame.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, _u8p, ctypes.c_uint32,
+    ]
+    lib.hs_afp_rx.restype = ctypes.c_int32
+    lib.hs_afp_rx.argtypes = [ctypes.c_int32, ctypes.c_void_p, ctypes.c_int32]
+    lib.hs_afp_tx.restype = ctypes.c_int32
+    lib.hs_afp_tx.argtypes = [ctypes.c_int32, ctypes.c_void_p, ctypes.c_int32]
     return lib
+
+
+_shared: Optional[ctypes.CDLL] = None
+
+
+def _shared_lib() -> ctypes.CDLL:
+    global _shared
+    if _shared is None:
+        _shared = _load()
+    return _shared
+
+
+class NativeRing:
+    """C++ frame ring: contiguous byte arena + (offset, len) FIFO.
+
+    The native replacement of InMemoryRing (VERDICT r2 item 1): frames
+    cross Python only as buffer views, never per-frame ``bytes``.  The
+    bytes-based ``send``/``recv_batch`` remain for tests and non-hot
+    callers; the native loop and AF_PACKET burst IO never touch them.
+    Thread-safe (mutex in C++), full-ring drops are counted like the
+    Python ring's.
+    """
+
+    def __init__(self, arena_bytes: int = 8 << 20, max_frames: int = 1 << 16):
+        self._lib = _shared_lib()
+        self._ptr = self._lib.hs_ring_new(arena_bytes, max_frames)
+        if not self._ptr:
+            raise MemoryError("hs_ring_new failed")
+        self._arena_bytes = arena_bytes
+        self._max_frames = max_frames
+        self._pop_buf = None  # allocated on first recv (sinks never pay)
+        self._pop_off = None
+        self._pop_len = None
+
+    def __len__(self) -> int:
+        return int(self._lib.hs_ring_count(self._ptr))
+
+    @property
+    def dropped(self) -> int:
+        return int(self._lib.hs_ring_dropped(self._ptr))
+
+    # ------------------------------------------------------------ view API
+
+    def send_views(self, buf: np.ndarray, offsets: np.ndarray,
+                   lens: np.ndarray) -> int:
+        """Push frames described by (offsets, lens) views into buf."""
+        n = len(offsets)
+        if not n:
+            return 0
+        offsets = np.ascontiguousarray(offsets, dtype=np.uint64)
+        lens = np.ascontiguousarray(lens, dtype=np.uint32)
+        return int(self._lib.hs_ring_push(
+            self._ptr, buf.ctypes.data_as(_u8p),
+            offsets.ctypes.data_as(_u64p), lens.ctypes.data_as(_u32p), n,
+        ))
+
+    def recv_views(self, max_frames: int):
+        """Pop up to max_frames into the reusable pop buffer; returns
+        (buf, offsets, lens) — views valid until the next recv call."""
+        if self._pop_buf is None:
+            self._pop_buf = np.empty(self._arena_bytes, dtype=np.uint8)
+            self._pop_off = np.empty(self._max_frames, dtype=np.uint64)
+            self._pop_len = np.empty(self._max_frames, dtype=np.uint32)
+        want = min(max_frames, self._max_frames)
+        n = int(self._lib.hs_ring_pop(
+            self._ptr, self._pop_buf.ctypes.data_as(_u8p),
+            self._pop_buf.size, self._pop_off.ctypes.data_as(_u64p),
+            self._pop_len.ctypes.data_as(_u32p), want,
+        ))
+        return self._pop_buf, self._pop_off[:n], self._pop_len[:n]
+
+    # ----------------------------------------------------- bytes-compat API
+
+    def send(self, frames) -> None:
+        if not frames:
+            return
+        lens = np.array([len(f) for f in frames], dtype=np.uint32)
+        offsets = np.zeros(len(frames), dtype=np.uint64)
+        np.cumsum(lens[:-1], dtype=np.uint64, out=offsets[1:])
+        buf = np.frombuffer(b"".join(frames), dtype=np.uint8)
+        self.send_views(buf, offsets, lens)
+
+    def recv_batch(self, max_frames: int) -> List[bytes]:
+        buf, off, lens = self.recv_views(max_frames)
+        return [
+            buf[int(off[i]):int(off[i]) + int(lens[i])].tobytes()
+            for i in range(len(off))
+        ]
+
+    def close(self) -> None:
+        ptr, self._ptr = self._ptr, None
+        if ptr:
+            self._lib.hs_ring_free(ptr)
+
+    def __del__(self):  # pragma: no cover - interpreter teardown
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeLoop:
+    """The C++ admit/harvest engine behind DataplaneRunner.
+
+    One ``admit`` call pops a batch from the rx ring, VXLAN-declassifies
+    and VNI-filters it, packs the kept frames into a per-slot buffer and
+    parses them into preallocated SoA header arrays; one ``harvest``
+    call applies verdicts/rewrites, encapsulates ROUTE_REMOTE frames
+    and routes everything to the TX rings.  Python in between only
+    dispatches the jit pipeline and services punts.
+    """
+
+    ADMIT_COUNTERS = 3    # rx_frames, rx_decapped, dropped_foreign_vni
+    HARVEST_COUNTERS = 6  # tx_remote, tx_local, tx_host, denied,
+                          # unparseable, unroutable
+
+    def __init__(self, rx: NativeRing, tx_remote: NativeRing,
+                 tx_local: NativeRing, tx_host: NativeRing,
+                 batch_size: int, max_vectors: int, vni: int, n_slots: int):
+        self._lib = _shared_lib()
+        self._rings = (rx, tx_remote, tx_local, tx_host)  # keep alive
+        self._ptr = self._lib.hs_loop_new(
+            rx._ptr, tx_remote._ptr, tx_local._ptr, tx_host._ptr,
+            batch_size, max_vectors, vni, n_slots,
+        )
+        if not self._ptr:
+            raise MemoryError("hs_loop_new failed")
+        cap = batch_size * max_vectors
+        self._soa = [
+            {
+                "src_ip": np.zeros(cap, dtype=np.uint32),
+                "dst_ip": np.zeros(cap, dtype=np.uint32),
+                "protocol": np.zeros(cap, dtype=np.int32),
+                "src_port": np.zeros(cap, dtype=np.int32),
+                "dst_port": np.zeros(cap, dtype=np.int32),
+            }
+            for _ in range(n_slots)
+        ]
+
+    def admit(self, slot: int, counters: np.ndarray):
+        """Returns (n_kept, k, soa_dict); counters (uint64[3]) += deltas."""
+        soa = self._soa[slot]
+        k = ctypes.c_int32(0)
+        n = int(self._lib.hs_loop_admit(
+            self._ptr, slot,
+            soa["src_ip"].ctypes.data_as(_u32p),
+            soa["dst_ip"].ctypes.data_as(_u32p),
+            soa["protocol"].ctypes.data_as(_i32p),
+            soa["src_port"].ctypes.data_as(_i32p),
+            soa["dst_port"].ctypes.data_as(_i32p),
+            ctypes.byref(k),
+            counters.ctypes.data_as(_u64p),
+        ))
+        return n, int(k.value), soa
+
+    def harvest(self, slot: int, allowed: np.ndarray, new_src: np.ndarray,
+                new_dst: np.ndarray, new_sport: np.ndarray,
+                new_dport: np.ndarray, route_tag: np.ndarray,
+                node_id: np.ndarray, remote_ips: np.ndarray, local_ip: int,
+                local_node_id: int, counters: np.ndarray) -> int:
+        remote_ips = np.ascontiguousarray(remote_ips, dtype=np.uint32)
+        return int(self._lib.hs_loop_harvest(
+            self._ptr, slot,
+            np.ascontiguousarray(allowed, dtype=np.uint8).ctypes.data_as(_u8p),
+            np.ascontiguousarray(new_src, dtype=np.uint32).ctypes.data_as(_u32p),
+            np.ascontiguousarray(new_dst, dtype=np.uint32).ctypes.data_as(_u32p),
+            np.ascontiguousarray(new_sport, dtype=np.int32).ctypes.data_as(_i32p),
+            np.ascontiguousarray(new_dport, dtype=np.int32).ctypes.data_as(_i32p),
+            np.ascontiguousarray(route_tag, dtype=np.int32).ctypes.data_as(_i32p),
+            np.ascontiguousarray(node_id, dtype=np.int32).ctypes.data_as(_i32p),
+            remote_ips.ctypes.data_as(_u32p),
+            len(remote_ips) - 1,
+            ctypes.c_uint32(local_ip), ctypes.c_uint32(local_node_id),
+            counters.ctypes.data_as(_u64p),
+        ))
+
+    def slot_frame(self, slot: int, row: int) -> bytes:
+        """Copy one admitted frame back out (slow path / tracing only)."""
+        out = np.empty(1 << 16, dtype=np.uint8)
+        n = int(self._lib.hs_loop_slot_frame(
+            self._ptr, slot, row, out.ctypes.data_as(_u8p), out.size,
+        ))
+        if n < 0:
+            raise IndexError(f"slot {slot} row {row}")
+        return out[:n].tobytes()
+
+    def close(self) -> None:
+        ptr, self._ptr = self._ptr, None
+        if ptr:
+            self._lib.hs_loop_free(ptr)
+
+    def __del__(self):  # pragma: no cover - interpreter teardown
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def afp_rx_ring(fd: int, ring: NativeRing, max_frames: int) -> int:
+    """Burst-receive from an AF_PACKET socket into a ring (recvmmsg)."""
+    return int(_shared_lib().hs_afp_rx(fd, ring._ptr, max_frames))
+
+
+def afp_tx_ring(fd: int, ring: NativeRing, max_frames: int) -> int:
+    """Burst-transmit from a ring out of an AF_PACKET socket (sendmmsg)."""
+    return int(_shared_lib().hs_afp_tx(fd, ring._ptr, max_frames))
 
 
 @dataclass

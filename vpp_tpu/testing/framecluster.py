@@ -16,7 +16,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ..datapath import DataplaneRunner, InMemoryRing, VxlanOverlay
+from ..datapath import DataplaneRunner, NativeRing, VxlanOverlay
 from ..ops.packets import ip_to_u32
 from ..ops.pipeline import make_route_config
 from ..shim.hostshim import HostShim
@@ -36,32 +36,40 @@ class VirtualWire:
     external-world bucket."""
 
     def __init__(self):
-        self._by_ip: Dict[int, InMemoryRing] = {}
+        self._by_ip: Dict[int, NativeRing] = {}
         self.external: List[bytes] = []
 
-    def attach(self, ip: int, ring: InMemoryRing) -> None:
+    def attach(self, ip: int, ring: NativeRing) -> None:
         self._by_ip[ip] = ring
 
     def send(self, frames: Sequence[bytes]) -> None:
+        # Group by destination ring so each ring pays ONE batched push.
+        batches: Dict[int, List[bytes]] = {}
         for f in frames:
-            ring = self._by_ip.get(_outer_dst_ip(f))
-            if ring is not None:
-                ring.send([f])
+            dst = _outer_dst_ip(f)
+            if dst in self._by_ip:
+                batches.setdefault(dst, []).append(f)
             else:
                 self.external.append(bytes(f))
+        for dst, batch in batches.items():
+            self._by_ip[dst].send(batch)
 
 
 class FrameNode:
-    """One node's datapath attachment: uplink rx ring + runner + local
-    pod delivery ring."""
+    """One node's datapath attachment: uplink rx ring + native-engine
+    runner + local pod delivery ring.  The runner's TX ring holds
+    encapped frames bound for other nodes; :meth:`pump_wire` carries
+    them across the virtual wire by outer destination IP."""
 
     def __init__(self, sim: SimNode, wire: VirtualWire, shim: Optional[HostShim] = None):
         self.sim = sim
+        self.wire = wire
         self.node_id = sim.nodesync.node_id
         self.node_ip = ip_to_u32(f"192.168.16.{self.node_id}")
-        self.rx = InMemoryRing()
-        self.delivered = InMemoryRing()  # frames delivered to local pods
-        self.to_host = InMemoryRing()    # handed to the host stack / uplink
+        self.rx = NativeRing()
+        self.tx = NativeRing()           # encapped frames for other nodes
+        self.delivered = NativeRing()    # frames delivered to local pods
+        self.to_host = NativeRing()      # handed to the host stack / uplink
         wire.attach(self.node_ip, self.rx)
         self.runner = DataplaneRunner(
             acl=sim.policy_renderer.tables,
@@ -71,11 +79,12 @@ class FrameNode:
             max_vectors=sim.config.max_vectors,
             overlay=VxlanOverlay(local_ip=self.node_ip, local_node_id=self.node_id),
             source=self.rx,
-            tx=wire,            # remote (encapped) frames ride the wire
+            tx=self.tx,
             local=self.delivered,
             host=self.to_host,
             shim=shim,
         )
+        assert self.runner.engine == "native"
         # The scheduler's TPU applicators push each transaction's atomic
         # table swap straight into the runner (VERDICT r1 #4).
         sim.acl_applicator.on_compiled = lambda t: self.runner.update_tables(acl=t)
@@ -89,6 +98,19 @@ class FrameNode:
             nat=self.sim.nat_renderer.tables,
             route=make_route_config(self.sim.ipam),
         )
+
+    def pump_wire(self) -> int:
+        """Carry this node's encapped TX frames across the wire."""
+        frames = self.tx.recv_batch(1 << 20)
+        if frames:
+            self.wire.send(frames)
+        return len(frames)
+
+    def drain(self) -> int:
+        """Drain the runner, then deliver its TX frames over the wire."""
+        sent = self.runner.drain()
+        self.pump_wire()
+        return sent
 
 
 class FrameCluster(SimCluster):
@@ -125,7 +147,7 @@ class FrameCluster(SimCluster):
             fn.sync_tables()
         for _ in range(max_rounds):
             for fn in self.frame_nodes.values():
-                fn.runner.drain()  # leaves no in-flight work behind
+                fn.drain()  # leaves no in-flight work behind; pumps wire
             if not any(len(fn.rx) for fn in self.frame_nodes.values()):
                 break
 
