@@ -111,7 +111,18 @@ class DataplaneRunner:
         local: Optional[FrameSink] = None,
         host: Optional[FrameSink] = None,
         batch_size: int = 256,
-        max_vectors: int = 1,
+        # Production coalesce default, chosen from BENCHLAT_r03 +
+        # BENCHSWEEP_r03: K=64 (16384 pkts/dispatch) is the smallest
+        # power-of-two coalesce whose production (vector-scan) dispatch
+        # clears the 40 Mpps baseline (72.3 Mpps sustained), and its
+        # latency cost stays sub-millisecond — p50 dispatch latency is
+        # ~266 us (tunnel-round-trip dominated, nearly independent of
+        # size), so worst-case added latency at 40 Mpps offered load is
+        # fill (410 us) + dispatch (266 us) ~= 0.7 ms.  K=16 fills
+        # faster (102 us) but sustains only 11 Mpps; K=256 sustains
+        # 238 Mpps but its 1.6 ms fill at 40 Mpps (65 ms at 1 Mpps!)
+        # blows any latency budget at low load.
+        max_vectors: int = 64,
         max_inflight: int = 2,
         session_capacity: int = 1 << 16,
         sweep_interval: int = 4096,

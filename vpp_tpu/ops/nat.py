@@ -315,6 +315,139 @@ def _reply_key_match(
     )
 
 
+class ReplyRestore(NamedTuple):
+    """Output of the session-reading reply-restore phase."""
+
+    batch: PacketBatch       # restored headers (rows without a hit keep
+                             # their original values)
+    reply_hit: jnp.ndarray   # bool [B]
+    reply_slot: jnp.ndarray  # int32 [B] resolved session slot of hits
+
+
+class StatelessRewrite(NamedTuple):
+    """Output of the session-INDEPENDENT rewrite phase (DNAT LB + SNAT
+    computed on the original headers).  Valid for every row that is not
+    a reply hit; reply rows take the restored path instead."""
+
+    batch: PacketBatch
+    dnat_hit: jnp.ndarray
+    snat_hit: jnp.ndarray
+
+
+def nat_reply_restore(sessions: NatSessions, batch: PacketBatch) -> ReplyRestore:
+    """Probe the session table for reply keys and restore originals.
+
+    This is the ONLY part of the NAT translation that reads session
+    state — the scan dispatch keeps just this (plus the commit) inside
+    ``lax.scan`` and hoists everything else flat across vectors.
+    """
+    cap = sessions.capacity
+    slot_mask = jnp.uint32(cap - 1)
+    rhash = flow_hash(batch.src_ip, batch.dst_ip, batch.protocol, batch.src_port, batch.dst_port)
+    base = (rhash & slot_mask).astype(jnp.int32)
+    cand = _probe_slots(base, cap)                      # [B, W]
+    key_match = _reply_key_match(sessions, cand, batch)  # [B, W]
+    reply_hit = jnp.any(key_match, axis=1)
+    w = jnp.argmax(key_match, axis=1)
+    slot = jnp.take_along_axis(cand, w[:, None], axis=1)[:, 0]
+    # Restore: src <- original dst (VIP), dst <- original src (client).
+    restored = PacketBatch(
+        src_ip=jnp.where(reply_hit, sessions.orig_dst_ip[slot], batch.src_ip),
+        dst_ip=jnp.where(reply_hit, sessions.orig_src_ip[slot], batch.dst_ip),
+        protocol=batch.protocol,
+        src_port=jnp.where(reply_hit, sessions.orig_dst_port[slot], batch.src_port),
+        dst_port=jnp.where(reply_hit, sessions.orig_src_port[slot], batch.dst_port),
+    )
+    return ReplyRestore(batch=restored, reply_hit=reply_hit, reply_slot=slot)
+
+
+def nat_rewrite_stateless(tables: NatTables, batch: PacketBatch) -> StatelessRewrite:
+    """DNAT LB + twice-NAT + SNAT on the given headers — no session
+    reads, so the scan dispatch computes this flat over all vectors at
+    once (MXU/VPU-efficient wide shapes, Pallas-eligible batch sizes)."""
+    # --------------------------------------------------------- 1. DNAT LB
+    hit = (
+        tables.map_valid[None, :]
+        & (batch.dst_ip[:, None] == tables.map_ext_ip[None, :])
+        & (batch.dst_port[:, None] == tables.map_ext_port[None, :])
+        & (batch.protocol[:, None] == tables.map_proto[None, :])
+    )  # [B, M]
+    dnat_hit = jnp.any(hit, axis=1)
+    midx = jnp.argmax(hit, axis=1)
+
+    # Backend pick: affinity hashes the client IP only, else full 5-tuple.
+    h_full = flow_hash(batch.src_ip, batch.dst_ip, batch.protocol,
+                       batch.src_port, batch.dst_port)
+    h_aff = _mix(batch.src_ip.astype(jnp.uint32) * jnp.uint32(0x9E3779B1))
+    use_aff = tables.map_affinity[midx] == 1
+    h_pick = jnp.where(use_aff, h_aff, h_full)
+    k = (h_pick % jnp.uint32(tables.bucket_size)).astype(jnp.int32)
+    new_dst_ip = tables.backend_ip[midx, k]
+    new_dst_port = tables.backend_port[midx, k]
+    # A mapping that lost all backends was compiled invalid -> no hit; a
+    # zero backend entry inside a valid mapping cannot occur (ring filled).
+
+    dst_ip2 = jnp.where(dnat_hit, new_dst_ip, batch.dst_ip)
+    dst_port2 = jnp.where(dnat_hit, new_dst_port, batch.dst_port)
+
+    # Twice-NAT: SELF only when the backend is the client itself
+    # (hairpin); ENABLED always.
+    mode = tables.map_twice_nat[midx]
+    hairpin = dnat_hit & (
+        ((mode == TWICE_NAT_SELF) & (dst_ip2 == batch.src_ip))
+        | (mode == TWICE_NAT_ENABLED)
+    )
+    src_ip2 = jnp.where(hairpin, jnp.broadcast_to(tables.nat_loopback, batch.src_ip.shape), batch.src_ip)
+
+    # ------------------------------------------------------------ 2. SNAT
+    in_cluster = (dst_ip2 & tables.pod_subnet_mask) == tables.pod_subnet_base
+    from_pod = (src_ip2 & tables.pod_subnet_mask) == tables.pod_subnet_base
+    snat_hit = (
+        jnp.broadcast_to(tables.snat_enabled, dnat_hit.shape)
+        & from_pod & ~in_cluster & ~dnat_hit
+    )
+    # Hash-allocated ephemeral port (32768..65535).
+    snat_port = (h_full % jnp.uint32(32768)).astype(jnp.int32) + 32768
+    src_ip3 = jnp.where(snat_hit, jnp.broadcast_to(tables.snat_ip, src_ip2.shape), src_ip2)
+    src_port3 = jnp.where(snat_hit, snat_port, batch.src_port)
+
+    out = PacketBatch(
+        src_ip=src_ip3,
+        dst_ip=dst_ip2,
+        protocol=batch.protocol,
+        src_port=src_port3,
+        dst_port=dst_port2,
+    )
+    return StatelessRewrite(batch=out, dnat_hit=dnat_hit, snat_hit=snat_hit)
+
+
+def combine_rewrite(restore: ReplyRestore, stateless: StatelessRewrite) -> NatRewrite:
+    """Merge the two phases into the full translation: reply rows take
+    the restored headers and bypass DNAT/SNAT; everything else takes
+    the stateless rewrite.  Bit-identical to the fused ``nat_rewrite``
+    (the stateless phase sees original headers exactly when there is no
+    reply hit, and its outputs are masked out exactly when there is)."""
+    rh = restore.reply_hit
+
+    def sel(a, b):
+        return jnp.where(rh, a, b)
+
+    out = PacketBatch(
+        src_ip=sel(restore.batch.src_ip, stateless.batch.src_ip),
+        dst_ip=sel(restore.batch.dst_ip, stateless.batch.dst_ip),
+        protocol=restore.batch.protocol,
+        src_port=sel(restore.batch.src_port, stateless.batch.src_port),
+        dst_port=sel(restore.batch.dst_port, stateless.batch.dst_port),
+    )
+    return NatRewrite(
+        batch=out,
+        dnat_hit=stateless.dnat_hit & ~rh,
+        reply_hit=rh,
+        snat_hit=stateless.snat_hit & ~rh,
+        reply_slot=restore.reply_slot,
+    )
+
+
 def nat_rewrite(
     tables: NatTables,
     sessions: NatSessions,
@@ -327,81 +460,9 @@ def nat_rewrite(
     sessions (the pipeline gates this on its ACL verdict so denied flows
     can never seed a reflective bypass).
     """
-    cap = sessions.capacity
-    slot_mask = jnp.uint32(cap - 1)
-
-    # ---------------------------------------------------- 1. reply restore
-    rhash = flow_hash(batch.src_ip, batch.dst_ip, batch.protocol, batch.src_port, batch.dst_port)
-    base = (rhash & slot_mask).astype(jnp.int32)
-    cand = _probe_slots(base, cap)                      # [B, W]
-    key_match = _reply_key_match(sessions, cand, batch)  # [B, W]
-    reply_hit = jnp.any(key_match, axis=1)
-    w = jnp.argmax(key_match, axis=1)
-    slot = jnp.take_along_axis(cand, w[:, None], axis=1)[:, 0]
-    # Restore: src <- original dst (VIP), dst <- original src (client).
-    src_ip1 = jnp.where(reply_hit, sessions.orig_dst_ip[slot], batch.src_ip)
-    src_port1 = jnp.where(reply_hit, sessions.orig_dst_port[slot], batch.src_port)
-    dst_ip1 = jnp.where(reply_hit, sessions.orig_src_ip[slot], batch.dst_ip)
-    dst_port1 = jnp.where(reply_hit, sessions.orig_src_port[slot], batch.dst_port)
-
-    # --------------------------------------------------------- 2. DNAT LB
-    hit = (
-        tables.map_valid[None, :]
-        & (dst_ip1[:, None] == tables.map_ext_ip[None, :])
-        & (dst_port1[:, None] == tables.map_ext_port[None, :])
-        & (batch.protocol[:, None] == tables.map_proto[None, :])
-    )  # [B, M]
-    dnat_hit = jnp.any(hit, axis=1) & ~reply_hit
-    midx = jnp.argmax(hit, axis=1)
-
-    # Backend pick: affinity hashes the client IP only, else full 5-tuple.
-    h_full = flow_hash(src_ip1, dst_ip1, batch.protocol, src_port1, dst_port1)
-    h_aff = _mix(src_ip1.astype(jnp.uint32) * jnp.uint32(0x9E3779B1))
-    use_aff = tables.map_affinity[midx] == 1
-    h_pick = jnp.where(use_aff, h_aff, h_full)
-    k = (h_pick % jnp.uint32(tables.bucket_size)).astype(jnp.int32)
-    new_dst_ip = tables.backend_ip[midx, k]
-    new_dst_port = tables.backend_port[midx, k]
-    # A mapping that lost all backends was compiled invalid -> no hit; a
-    # zero backend entry inside a valid mapping cannot occur (ring filled).
-
-    dst_ip2 = jnp.where(dnat_hit, new_dst_ip, dst_ip1)
-    dst_port2 = jnp.where(dnat_hit, new_dst_port, dst_port1)
-
-    # Twice-NAT: SELF only when the backend is the client itself
-    # (hairpin); ENABLED always.
-    mode = tables.map_twice_nat[midx]
-    hairpin = dnat_hit & (
-        ((mode == TWICE_NAT_SELF) & (dst_ip2 == src_ip1))
-        | (mode == TWICE_NAT_ENABLED)
-    )
-    src_ip2 = jnp.where(hairpin, jnp.broadcast_to(tables.nat_loopback, src_ip1.shape), src_ip1)
-
-    # ------------------------------------------------------------ 3. SNAT
-    in_cluster = (dst_ip2 & tables.pod_subnet_mask) == tables.pod_subnet_base
-    from_pod = (src_ip2 & tables.pod_subnet_mask) == tables.pod_subnet_base
-    snat_hit = (
-        jnp.broadcast_to(tables.snat_enabled, dnat_hit.shape)
-        & from_pod & ~in_cluster & ~dnat_hit & ~reply_hit
-    )
-    # Hash-allocated ephemeral port (32768..65535).
-    snat_port = (h_full % jnp.uint32(32768)).astype(jnp.int32) + 32768
-    src_ip3 = jnp.where(snat_hit, jnp.broadcast_to(tables.snat_ip, src_ip2.shape), src_ip2)
-    src_port3 = jnp.where(snat_hit, snat_port, src_port1)
-
-    out = PacketBatch(
-        src_ip=src_ip3,
-        dst_ip=dst_ip2,
-        protocol=batch.protocol,
-        src_port=src_port3,
-        dst_port=dst_port2,
-    )
-    return NatRewrite(
-        batch=out,
-        dnat_hit=dnat_hit,
-        reply_hit=reply_hit,
-        snat_hit=snat_hit,
-        reply_slot=slot,
+    return combine_rewrite(
+        nat_reply_restore(sessions, batch),
+        nat_rewrite_stateless(tables, batch),
     )
 
 

@@ -31,7 +31,15 @@ import jax
 import jax.numpy as jnp
 
 from .classify import RuleTables, _DENY, classify_dst, classify_src
-from .nat import NatSessions, NatTables, nat_commit_sessions, nat_rewrite
+from .nat import (
+    NatSessions,
+    NatTables,
+    combine_rewrite,
+    nat_commit_sessions,
+    nat_reply_restore,
+    nat_rewrite,
+    nat_rewrite_stateless,
+)
 from .packets import PacketBatch
 
 # Route tags.
@@ -99,29 +107,22 @@ class PipelineResult(NamedTuple):
     punt: jnp.ndarray       # bool [B] flow needs the host slow path
 
 
-def pipeline_step(
-    acl: RuleTables,
-    nat: NatTables,
+def _commit_and_route(
     route: RouteConfig,
     sessions: NatSessions,
     batch: PacketBatch,
+    rw,
+    acl_ok: jnp.ndarray,
     timestamp: jnp.ndarray,
-) -> PipelineResult:
-    """One batch through the whole data plane."""
-    # 1. Ingress ACL on original headers (source pod's table).
-    src_action = classify_src(acl, batch)
-
-    # 2. NAT translation: reply restore -> DNAT LB -> SNAT (no session
-    # writes yet — those are gated on the full ACL verdict below).
-    rw = nat_rewrite(nat, sessions, batch)
+):
+    """Shared tail of both disciplines: ACL/reply gating, session
+    commit, and node-ID routing.  Returns (new_sessions, result) with
+    ``result.sessions`` left as a placeholder scalar — the caller
+    decides whether it carries the table (flat) or the scan threads it.
+    """
     rewritten = rw.batch
-
-    # 3. Egress ACL on rewritten headers (destination pod's table).
-    dst_action = classify_dst(acl, rewritten)
-
     # Session-restored replies skip ACLs (reflective semantics — valid
     # precisely because only permitted flows ever record sessions).
-    acl_ok = (src_action != _DENY) & (dst_action != _DENY)
     allowed = acl_ok | rw.reply_hit
 
     # Commit sessions for translated AND permitted flows only: a denied
@@ -131,7 +132,7 @@ def pipeline_step(
         sessions, batch, rewritten, record, rw.reply_hit, rw.reply_slot, timestamp
     )
 
-    # 4. Routing on the post-NAT destination.
+    # Routing on the post-NAT destination.
     dst = rewritten.dst_ip
     in_cluster = (dst & route.pod_subnet_mask) == route.pod_subnet_base
     on_this_node = (dst & route.this_node_mask) == route.this_node_base
@@ -147,9 +148,9 @@ def pipeline_step(
         jnp.int32(0),
     )
 
-    return PipelineResult(
+    result = PipelineResult(
         batch=rewritten,
-        sessions=new_sessions,
+        sessions=jnp.int32(0),
         allowed=allowed,
         route=tag,
         node_id=node_id,
@@ -158,6 +159,33 @@ def pipeline_step(
         reply_hit=rw.reply_hit,
         punt=punt,
     )
+    return new_sessions, result
+
+
+def pipeline_step(
+    acl: RuleTables,
+    nat: NatTables,
+    route: RouteConfig,
+    sessions: NatSessions,
+    batch: PacketBatch,
+    timestamp: jnp.ndarray,
+) -> PipelineResult:
+    """One batch through the whole data plane."""
+    # 1. Ingress ACL on original headers (source pod's table).
+    src_action = classify_src(acl, batch)
+
+    # 2. NAT translation: reply restore -> DNAT LB -> SNAT (no session
+    # writes yet — those are gated on the full ACL verdict below).
+    rw = nat_rewrite(nat, sessions, batch)
+
+    # 3. Egress ACL on rewritten headers (destination pod's table).
+    dst_action = classify_dst(acl, rw.batch)
+    acl_ok = (src_action != _DENY) & (dst_action != _DENY)
+
+    new_sessions, result = _commit_and_route(
+        route, sessions, batch, rw, acl_ok, timestamp
+    )
+    return result._replace(sessions=new_sessions)
 
 
 pipeline_step_jit = jax.jit(pipeline_step, donate_argnums=(3,))
@@ -180,24 +208,58 @@ def pipeline_scan(
 ) -> PipelineResult:
     """K packet vectors through the pipeline in ONE device dispatch.
 
-    ``lax.scan`` threads the NAT session table from vector to vector
-    *on device*, preserving VPP's sequential-vector semantics (a flow's
-    session created in vector i is visible to its replies in vector
-    i+1) while amortising the host→device dispatch cost over K·V
-    packets.  This is what makes the 256-packet granularity of the
-    reference (BASELINE.md config 5) viable across a host↔TPU link:
-    measured on v5e, a flat 16384-packet batch sustains ~45 Mpps while
-    scan(64 × 256) sustains ~186 Mpps at identical table state.
+    Only the session-table stages are sequential: ``lax.scan`` threads
+    the NAT table from vector to vector *on device* (a flow's session
+    created in vector i is visible to its replies in vector i+1 —
+    VPP's sequential-vector semantics).  Everything session-INDEPENDENT
+    — both ACL classifies and the stateless DNAT/SNAT rewrite — is
+    hoisted OUT of the scan and computed flat over all K·V packets at
+    once, so the classify stage runs at wide-batch efficiency (MXU
+    tiling, the Pallas first-match kernel's preferred shapes) instead
+    of re-streaming the rule tables once per 256-packet vector.  At 64k
+    rules that re-streaming made the scan dispatch 3x slower than a
+    flat one (BENCHSCALE_r02); hoisting closes the gap while keeping
+    the scan's session semantics bit-identical (reply rows bypass the
+    ACL by the reflective rule, and their stateless rewrite is masked —
+    see ``combine_rewrite``).
+
+    Correctness note: the egress ACL is evaluated on the STATELESS
+    rewrite of each packet.  That matches the fused per-vector step for
+    every row because the only rows whose true rewrite differs (reply
+    restores) never consult the ACL — ``allowed = acl_ok | reply_hit``.
 
     Returned leaves are stacked [K, V]; ``sessions`` is the final table.
     """
+    k, v = batches.src_ip.shape
 
+    def flatten(a):
+        return a.reshape((k * v,) + a.shape[2:])
+
+    def unflatten(a):
+        return a.reshape((k, v) + a.shape[1:])
+
+    flat = jax.tree_util.tree_map(flatten, batches)
+
+    # ---- flat prepass: ingress ACL, stateless NAT, egress ACL --------
+    src_action = classify_src(acl, flat)
+    stateless = nat_rewrite_stateless(nat, flat)
+    dst_action = classify_dst(acl, stateless.batch)
+    acl_ok = (src_action != _DENY) & (dst_action != _DENY)
+
+    per_vec = (
+        batches,
+        jax.tree_util.tree_map(unflatten, stateless),
+        unflatten(acl_ok),
+        timestamps,
+    )
+
+    # ---- sequential session stage ------------------------------------
     def body(sess, xs):
-        batch, ts = xs
-        res = pipeline_step(acl, nat, route, sess, batch, ts)
-        return res.sessions, res._replace(sessions=jnp.int32(0))
+        batch, sless, ok, ts = xs
+        rw = combine_rewrite(nat_reply_restore(sess, batch), sless)
+        return _commit_and_route(route, sess, batch, rw, ok, ts)
 
-    final_sessions, stacked = jax.lax.scan(body, sessions, (batches, timestamps))
+    final_sessions, stacked = jax.lax.scan(body, sessions, per_vec)
     return stacked._replace(sessions=final_sessions)
 
 
