@@ -3,7 +3,8 @@
 
 PY ?= python
 
-.PHONY: test test-race lint bench bench-suite bench-sweep bench-scale
+.PHONY: test test-race lint bench bench-suite bench-sweep bench-scale \
+        bench-latency bench-frames images native
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -35,5 +36,21 @@ bench-sweep:
 bench-scale:
 	$(PY) benchsuite.py --scale
 
+bench-latency:
+	$(PY) benchsuite.py --latency
+
 bench-frames:
 	$(PY) scripts/frame_bench.py
+
+native:
+	$(MAKE) -C native/hostshim
+
+# Container images (the reference's docker/build-all.sh analog).  One
+# multi-stage build, one target per component; see deploy/docker/.
+DOCKER ?= docker
+IMAGE_TAG ?= latest
+images:
+	$(DOCKER) build -f deploy/docker/Dockerfile --target store  -t vpp-tpu-store:$(IMAGE_TAG) .
+	$(DOCKER) build -f deploy/docker/Dockerfile --target ksr    -t vpp-tpu-ksr:$(IMAGE_TAG) .
+	$(DOCKER) build -f deploy/docker/Dockerfile --target agent  -t vpp-tpu-agent:$(IMAGE_TAG) .
+	$(DOCKER) build -f deploy/docker/Dockerfile --target netctl -t vpp-tpu-netctl:$(IMAGE_TAG) .

@@ -1,0 +1,289 @@
+"""Production agent entrypoint — the contiv-vswitch container analog.
+
+The reference deploys one vswitch agent per node as a DaemonSet pod
+(/root/reference/k8s/contiv-vpp.yaml contiv-vswitch; cmd/contiv-agent)
+wired to the cluster etcd, exposing a CNI gRPC endpoint and REST
+diagnostics.  This module is the same composition for the TPU-native
+stack, runnable as ``python -m vpp_tpu.agent``:
+
+- cluster store:   RemoteKVStore -> KVStoreServer (``python -m
+  vpp_tpu.kvstore``, the contiv-etcd analog)
+- control plane:   Controller event loop + DBWatcher (sqlite mirror),
+  NodeSync ID allocation, PodManager, IPv4Net, policy + service stacks
+  rendering through the TxnScheduler into atomic TPU table swaps
+- host networking: LinuxNetApplicator programming real kernel state
+  (veth/vxlan/bridge/routes), optionally confined to a netns
+- pod interface:   CNI gRPC server consumed by the contiv-cni shim
+  (vpp_tpu/cni/shim.py, installed via deploy/10-vpp-tpu.conflist)
+- data plane:      optional AF_PACKET uplink driven through the native
+  C++ runner loop (NativeRing + DataplaneRunner)
+- diagnostics:     AgentRestServer (/contiv/v1/*, /metrics, /liveness)
+
+The SimCluster/procnode test harnesses wire the same plugin set; this
+module is the production composition (no mock engines, no oracles).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import sys
+import threading
+import time
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+class Agent:
+    """One node's full TPU-native vswitch agent."""
+
+    def __init__(
+        self,
+        store,
+        name: str,
+        config=None,
+        mirror_path: Optional[str] = None,
+        hostnet: str = "off",          # off | root | netns:<name>
+        rest_port: int = 0,
+        cni_port: int = 0,
+        uplink: str = "",
+    ):
+        from .conf import NetworkConfig
+        from .controller.dbwatcher import DBWatcher
+        from .controller.eventloop import Controller
+        from .ipam import IPAM
+        from .ipv4net import IPv4Net
+        from .nodesync import NodeSync
+        from .podmanager import PodManager
+        from .policy import PolicyPlugin
+        from .policy.renderer.sched import SchedPolicyRenderer
+        from .scheduler import TxnScheduler
+        from .scheduler.tpu_applicators import TpuAclApplicator, TpuNatApplicator
+        from .service import ServicePlugin
+        from .service.renderer.sched import SchedNatRenderer
+
+        self.name = name
+        self.store = store
+        self.config = config or NetworkConfig()
+
+        self.nodesync = NodeSync(store, node_name=name)
+        self.nodesync.allocate_id()
+        self.ipam = IPAM(self.config.ipam, self.nodesync.node_id)
+
+        self.podmanager = PodManager()
+        self.ipv4net = IPv4Net(
+            self.config, self.nodesync, ipam=self.ipam,
+            podmanager=self.podmanager,
+        )
+
+        self.acl_applicator = TpuAclApplicator()
+        self.policy_renderer = SchedPolicyRenderer(
+            lambda: self.controller.current_txn, applicator=self.acl_applicator
+        )
+        self.policy = PolicyPlugin(ipam=self.ipam)
+        self.policy.register_renderer(self.policy_renderer)
+
+        self.nat_applicator = TpuNatApplicator()
+        self.nat_renderer = SchedNatRenderer(
+            lambda: self.controller.current_txn,
+            nat_loopback=str(self.ipam.nat_loopback_ip()),
+            snat_ip=f"192.168.16.{self.nodesync.node_id}",
+            snat_enabled=True,
+            pod_subnet=str(self.ipam.pod_subnet_all_nodes),
+            applicator=self.nat_applicator,
+        )
+        self.service = ServicePlugin(name, ipam=self.ipam, nodesync=self.nodesync)
+        self.service.register_renderer(self.nat_renderer)
+
+        self.scheduler = TxnScheduler()
+        self.hostnet = None
+        if hostnet != "off":
+            from .hostnet import LinuxNetApplicator
+
+            netns = hostnet.split(":", 1)[1] if hostnet.startswith("netns:") else None
+            self.hostnet = LinuxNetApplicator(netns=netns, create_netns=bool(netns))
+            self.scheduler.register_applicator(self.hostnet)
+        self.scheduler.register_applicator(self.acl_applicator)
+        self.scheduler.register_applicator(self.nat_applicator)
+
+        self.controller = Controller(
+            handlers=[
+                self.nodesync, self.podmanager, self.ipv4net,
+                self.service, self.policy,
+            ],
+            sink=self.scheduler,
+        )
+        self.podmanager.event_loop = self.controller
+        self.nodesync.event_loop = self.controller
+        self.controller.start()
+        self.watcher = DBWatcher(self.controller, store, mirror_path=mirror_path)
+        self.watcher.start()
+
+        # ------------------------------------------------------ data plane
+        self.runner = None
+        self._uplink_io = None
+        self._dp_thread: Optional[threading.Thread] = None
+        self._dp_stop = threading.Event()
+        self.datapath_errors = 0
+        if uplink:
+            self._start_datapath(uplink)
+
+        # ----------------------------------------------------- diagnostics
+        from .rest.server import AgentRestServer
+
+        self.rest = AgentRestServer(
+            node_name=name,
+            controller=self.controller,
+            dbwatcher=self.watcher,
+            ipam=self.ipam,
+            nodesync=self.nodesync,
+            podmanager=self.podmanager,
+            scheduler=self.scheduler,
+            tracer=self.runner.tracer if self.runner else None,
+            host="0.0.0.0" if rest_port else "127.0.0.1",
+            port=rest_port,
+        )
+        self.rest_port = self.rest.start()
+
+        from .cni.rpc import CNIServer
+
+        self.cni = CNIServer(self.podmanager, port=cni_port)
+        self.cni_port = self.cni.start()
+
+    # ---------------------------------------------------------- data plane
+
+    def _start_datapath(self, uplink: str) -> None:
+        """Attach the native runner loop to a real interface: AF_PACKET
+        bursts feed the rx ring, TX rings burst back out (the
+        DPDK-uplink analog on kernel sockets)."""
+        from .datapath import AfPacketIO, DataplaneRunner, NativeRing, VxlanOverlay
+        from .ops.classify import build_rule_tables
+        from .ops.nat import build_nat_tables
+        from .ops.packets import ip_to_u32
+        from .ops.pipeline import make_route_config
+
+        self._uplink_io = AfPacketIO(uplink)
+        rx, tx = NativeRing(), NativeRing()
+        local, host = NativeRing(), NativeRing()
+        node_ip = f"192.168.16.{self.nodesync.node_id}"
+        self.runner = DataplaneRunner(
+            acl=self.policy_renderer.tables or build_rule_tables([], {}),
+            nat=self.nat_renderer.tables or build_nat_tables([]),
+            route=make_route_config(self.ipam),
+            overlay=VxlanOverlay(
+                local_ip=ip_to_u32(node_ip),
+                local_node_id=self.nodesync.node_id,
+            ),
+            source=rx, tx=tx, local=local, host=host,
+            batch_size=self.config.batch_size,
+            max_vectors=self.config.max_vectors,
+        )
+        self.acl_applicator.on_compiled = lambda t: self.runner.update_tables(acl=t)
+        self.nat_applicator.on_compiled = lambda t: self.runner.update_tables(nat=t)
+        rings = (rx, tx, local, host)
+
+        def loop():
+            burst = self.config.batch_size * self.runner.max_vectors
+            while not self._dp_stop.is_set():
+                try:
+                    got = self._uplink_io.rx_into(rings[0], burst)
+                    sent = self.runner.poll()
+                    # Remote + local + host frames all leave via the
+                    # uplink in this single-interface attachment.
+                    moved = 0
+                    for ring in rings[1:]:
+                        moved += self._uplink_io.tx_from(ring, burst)
+                except Exception:  # noqa: BLE001 - interface flap etc.
+                    self.datapath_errors += 1
+                    log.exception("datapath loop error (uplink %s); retrying",
+                                  uplink)
+                    self._dp_stop.wait(1.0)
+                    continue
+                if not (got or sent or moved):
+                    time.sleep(0.0005)  # idle
+
+        self._dp_thread = threading.Thread(target=loop, name="datapath", daemon=True)
+        self._dp_thread.start()
+
+    # ----------------------------------------------------------- lifecycle
+
+    def stop(self) -> None:
+        self._dp_stop.set()
+        if self._dp_thread is not None:
+            self._dp_thread.join(timeout=2)
+        if self._uplink_io is not None:
+            self._uplink_io.close()
+        self.cni.stop()
+        self.rest.stop()
+        self.watcher.stop()
+        self.controller.stop()
+        if self.hostnet is not None:
+            self.hostnet.close()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="TPU-native vswitch agent (contiv-vswitch analog)"
+    )
+    parser.add_argument("--store", required=True, help="host:port of the cluster store")
+    parser.add_argument("--name", required=True, help="node name")
+    parser.add_argument("--config", default="", help="path to the JSON network "
+                        "config (contiv.conf analog; NetworkConfig.from_dict shape)")
+    parser.add_argument("--mirror", default="", help="sqlite mirror path (Bolt analog)")
+    parser.add_argument("--hostnet", default="off",
+                        help="off | root | netns:<name> — where to program "
+                             "real kernel networking")
+    parser.add_argument("--rest-port", type=int, default=9999)
+    parser.add_argument("--cni-port", type=int, default=9111)
+    parser.add_argument("--uplink", default="",
+                        help="attach the native datapath loop to this interface "
+                             "via AF_PACKET (the DPDK-uplink analog)")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+
+    from .conf import NetworkConfig
+    from .kvstore.remote import RemoteKVStore
+
+    config = NetworkConfig()
+    if args.config:
+        with open(args.config) as fh:
+            config = NetworkConfig.from_dict(json.load(fh))
+
+    store = RemoteKVStore(args.store)
+    agent = Agent(
+        store, args.name, config=config,
+        mirror_path=args.mirror or None,
+        hostnet=args.hostnet,
+        rest_port=args.rest_port,
+        cni_port=args.cni_port,
+        uplink=args.uplink,
+    )
+    print(json.dumps({
+        "agent": args.name,
+        "node_id": agent.nodesync.node_id,
+        "store": args.store,
+        "rest_port": agent.rest_port,
+        "cni_port": agent.cni_port,
+    }), flush=True)
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    try:
+        while not stop.is_set():
+            stop.wait(1.0)
+    finally:
+        agent.stop()
+        store.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

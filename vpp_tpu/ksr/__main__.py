@@ -1,0 +1,73 @@
+"""KSR daemon — the contiv-ksr container analog.
+
+Reflects the K8s API (pods, namespaces, policies, services, endpoints,
+nodes, SFC pods) into the cluster store, exactly the role of
+cmd/contiv-ksr in the reference (k8s/contiv-vpp.yaml contiv-ksr
+Deployment on the master):
+
+    python -m vpp_tpu.ksr --store 127.0.0.1:12379 \\
+        [--k8s-api https://10.96.0.1:443 | --in-cluster]
+
+The K8s side uses the dependency-free list/watch client
+(:mod:`.k8s_api`); ``--in-cluster`` reads the conventional
+ServiceAccount mount.  Reflector stats are printed once per minute
+(ksr_reflector.go stats logging analog).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="vpp-tpu KSR (K8s state reflector)")
+    parser.add_argument("--store", required=True, help="host:port of the cluster store")
+    parser.add_argument("--k8s-api", default="", help="K8s API base URL")
+    parser.add_argument("--in-cluster", action="store_true",
+                        help="use the in-cluster ServiceAccount config")
+    parser.add_argument("--token", default="", help="bearer token (overrides SA mount)")
+    parser.add_argument("--ca-file", default="", help="API server CA bundle")
+    parser.add_argument("--insecure", action="store_true",
+                        help="skip TLS verification (dev only)")
+    args = parser.parse_args(argv)
+
+    import logging
+
+    logging.basicConfig(level=logging.INFO)
+
+    from ..kvstore.remote import RemoteKVStore
+    from . import KSRPlugin, KVBroker
+    from .k8s_api import K8sApiListWatch
+
+    store = RemoteKVStore(args.store)
+    from .k8s_api import in_cluster_base_url
+
+    base_url = in_cluster_base_url() if args.in_cluster else (args.k8s_api or None)
+    list_watch = K8sApiListWatch(
+        base_url=base_url,
+        token=args.token or None,
+        ca_file=args.ca_file or None,
+        insecure=args.insecure,
+    )
+    ksr = KSRPlugin(list_watch, KVBroker(store))
+    ksr.init()
+    print(json.dumps({"ksr": "running", "store": args.store,
+                      "k8s_api": list_watch.base_url}), flush=True)
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    while not stop.wait(60.0):
+        print(json.dumps({"ksr_stats": ksr.get_stats()}), flush=True)
+    ksr.close()
+    list_watch.close()
+    store.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,78 @@
+"""Standalone cluster store server — the contiv-etcd analog.
+
+The reference deploys etcd on the master (k8s/contiv-vpp.yaml
+contiv-etcd StatefulSet); this serves the framework's KVStore over the
+same gRPC surface the agents consume:
+
+    python -m vpp_tpu.kvstore [--host 0.0.0.0] [--port 12379]
+        [--snapshot /var/lib/vpp-tpu/store.db]
+
+``--snapshot`` persists every change to a sqlite snapshot and reloads
+it on startup (the etcd-data-volume analog), so a store restart
+recovers the cluster state without waiting for KSR to re-reflect.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+
+from .remote import DEFAULT_PORT, KVStoreServer
+from .store import KVStore
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="vpp-tpu cluster store server")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=DEFAULT_PORT)
+    parser.add_argument("--snapshot", default="",
+                        help="sqlite snapshot path (persistence across restarts)")
+    parser.add_argument("--max-watchers", type=int, default=64)
+    args = parser.parse_args(argv)
+
+    store = KVStore()
+    mirror = None
+    if args.snapshot:
+        from .mirror import LocalMirror
+
+        mirror = LocalMirror(args.snapshot)
+        loaded = mirror.load()
+        if loaded is not None:
+            snap, _rev = loaded
+            for key, value in snap.items():
+                store.put(key, value)
+        # Persist continuously: every committed change refreshes the
+        # snapshot (coalesced by revision, cheap at control-plane rates).
+        watcher = store.watch([""])
+
+        def persist():
+            while True:
+                ev = watcher.get(timeout=0.5)
+                if ev is None:
+                    if watcher.closed:
+                        return
+                    continue
+                snap, rev = store.snapshot_with_revision([""])
+                mirror.save_snapshot(snap, rev)
+
+        threading.Thread(target=persist, name="store-persist", daemon=True).start()
+
+    server = KVStoreServer(store, host=args.host, port=args.port,
+                           max_watchers=args.max_watchers)
+    port = server.start()
+    print(json.dumps({"store": f"{args.host}:{port}",
+                      "snapshot": args.snapshot or None}), flush=True)
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

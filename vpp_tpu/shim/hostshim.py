@@ -32,7 +32,13 @@ _LIB = os.path.join(_NATIVE_DIR, "build", "libhostshim.so")
 def _build_library() -> str:
     src_dir = os.path.abspath(_SRC_DIR)
     lib = os.path.abspath(_LIB)
-    newest = max(os.path.getmtime(os.path.join(src_dir, s)) for s in _SOURCES)
+    sources = [os.path.join(src_dir, s) for s in _SOURCES]
+    if not all(os.path.exists(s) for s in sources):
+        # Prebuilt deployment (container images ship only the .so).
+        if os.path.exists(lib):
+            return lib
+        raise FileNotFoundError(f"{lib} missing and sources not present to build it")
+    newest = max(os.path.getmtime(s) for s in sources)
     if not os.path.exists(lib) or os.path.getmtime(lib) < newest:
         subprocess.run(
             ["make", "-s", "-C", src_dir],
