@@ -1,0 +1,115 @@
+"""Per-step sharding overhead of the mesh pipeline (VERDICT r2 item 4).
+
+Measures the full pipeline step per-dispatch wall time single-device
+vs GSPMD-sharded over an 8-device mesh, for both session placements
+(replicated and slot-partitioned), at the production session capacity
+(2^16) — isolating what the data/rules partition + the session-scatter
+combine collectives add to a step.
+
+Caveat (stated in the artifact): with one real TPU chip in the
+environment, the mesh runs on 8 VIRTUAL CPU devices
+(xla_force_host_platform_device_count), so the numbers measure GSPMD
+partitioning + emulated-collective overhead on host shapes, NOT ICI
+latency.  The artifact's purpose is (a) the overhead STRUCTURE
+(replicated vs partitioned sessions; which placement pays more per
+step) and (b) proof the sharded step is driven end-to-end over many
+steps — real-ICI numbers need a multi-chip slice.
+
+Usage: python scripts/mesh_overhead.py [--devices 8] [--batch 4096]
+       [--iters 30]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--devices", type=int, default=8)
+    parser.add_argument("--batch", type=int, default=4096)
+    parser.add_argument("--iters", type=int, default=30)
+    parser.add_argument("--capacity", type=int, default=1 << 16)
+    args = parser.parse_args(argv)
+
+    from vpp_tpu.parallel.mesh import ensure_devices
+
+    ensure_devices(args.devices)
+
+    import numpy as np  # noqa: F401
+
+    import jax
+    import jax.numpy as jnp
+
+    import bench
+    from vpp_tpu.ops.nat import empty_sessions
+    from vpp_tpu.ops.pipeline import pipeline_step_jit
+    from vpp_tpu.parallel import make_mesh, shard_dataplane, sharded_pipeline_step
+    from vpp_tpu.parallel.mesh import shard_batch
+
+    acl, nat, route, _, pod_ips, mappings = bench.build_stress_state(
+        n_rules=10000, n_services=1000
+    )
+    batch = bench.build_traffic(pod_ips, mappings, args.batch)
+
+    def measure(step, a, n, r, sessions, put_batch):
+        b = put_batch(batch)
+        res = step(a, n, r, sessions, b, jnp.int32(0))
+        res.allowed.block_until_ready()
+        sess = res.sessions
+        lats = []
+        for i in range(args.iters):
+            t0 = time.perf_counter()
+            res = step(a, n, r, sess, b, jnp.int32(i + 1))
+            res.allowed.block_until_ready()
+            lats.append(time.perf_counter() - t0)
+            sess = res.sessions
+        lats.sort()
+        return lats[len(lats) // 2] * 1e6
+
+    rows = []
+    single_us = measure(
+        pipeline_step_jit, acl, nat, route, empty_sessions(args.capacity),
+        put_batch=lambda b: b,
+    )
+    rows.append({"mode": "single-device", "p50_step_us": round(single_us, 1)})
+
+    mesh = make_mesh(args.devices)
+    for partitioned in (False, True):
+        with mesh:
+            a, n, r, s = shard_dataplane(
+                mesh, acl, nat, route, empty_sessions(args.capacity),
+                partition_sessions=partitioned,
+            )
+            us = measure(
+                sharded_pipeline_step(mesh), a, n, r, s,
+                put_batch=lambda b: shard_batch(mesh, b),
+            )
+        rows.append({
+            "mode": ("mesh-8-partitioned-sessions" if partitioned
+                     else "mesh-8-replicated-sessions"),
+            "p50_step_us": round(us, 1),
+            "overhead_vs_single": round(us / single_us, 2),
+        })
+
+    meta = {
+        "batch": args.batch,
+        "session_capacity": args.capacity,
+        "devices": args.devices,
+        "backend": jax.default_backend(),
+        "note": "virtual CPU devices: structure/correctness of the "
+                "sharding overhead, not ICI latency",
+    }
+    for row in rows:
+        print(json.dumps({**meta, **row}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -133,6 +133,29 @@ def test_store_and_agent_processes_come_up(store_proc):
         ) as resp:
             ipam = json.load(resp)
         assert ipam["podSubnetThisNode"].startswith("10.1.")
+
+        # CNI over the stdlib HTTP fallback (the installed shim's path
+        # on hosts without grpcio): a pod ADD allocates an address.
+        from vpp_tpu.cni.messages import CNIRequest
+        from vpp_tpu.cni.shim import _http_cni
+
+        reply = _http_cni(
+            f"127.0.0.1:{rest}", "add",
+            CNIRequest(
+                container_id="c1", network_namespace="/proc/self/ns/net",
+                extra_arguments="K8S_POD_NAME=cni-pod;K8S_POD_NAMESPACE=default",
+            ),
+        )
+        assert reply.result == 0, reply.error
+        assert reply.interfaces and reply.interfaces[0].get("ip", "").startswith("10.1.")
+        reply = _http_cni(
+            f"127.0.0.1:{rest}", "del",
+            CNIRequest(
+                container_id="c1",
+                extra_arguments="K8S_POD_NAME=cni-pod;K8S_POD_NAMESPACE=default",
+            ),
+        )
+        assert reply.result == 0, reply.error
     finally:
         agent.send_signal(signal.SIGTERM)
         agent.wait(timeout=15)

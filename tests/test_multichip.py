@@ -1,0 +1,183 @@
+"""Multi-chip sharding beyond the one-step dryrun (VERDICT r2 item 4).
+
+Runs under the conftest-forced 8-virtual-CPU-device backend:
+
+- multi-step session semantics on the mesh: sessions committed by a
+  sharded dispatch N restore replies in dispatch N+1, for BOTH session
+  placements (replicated and hash-partitioned over ``data``), with
+  verdict/header parity against the single-device pipeline;
+- the DataplaneRunner wired to the mesh behind the ``mesh=`` flag:
+  frame-level outputs and counters identical to the unsharded runner.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from vpp_tpu.ops.classify import build_rule_tables
+from vpp_tpu.ops.nat import NatMapping, build_nat_tables, empty_sessions
+from vpp_tpu.ops.packets import ip_to_u32, make_batch
+from vpp_tpu.ops.pipeline import RouteConfig, pipeline_step_jit
+from vpp_tpu.parallel import make_mesh, shard_dataplane, sharded_pipeline_step
+from vpp_tpu.parallel.mesh import shard_batch
+
+
+def _route():
+    return RouteConfig(
+        pod_subnet_base=jnp.asarray(ip_to_u32("10.1.0.0"), dtype=jnp.uint32),
+        pod_subnet_mask=jnp.asarray(0xFFFF0000, dtype=jnp.uint32),
+        this_node_base=jnp.asarray(ip_to_u32("10.1.1.0"), dtype=jnp.uint32),
+        this_node_mask=jnp.asarray(0xFFFFFF00, dtype=jnp.uint32),
+        host_bits=jnp.asarray(8, dtype=jnp.int32),
+    )
+
+
+def _world():
+    acl = build_rule_tables([], {})
+    nat = build_nat_tables(
+        [NatMapping("10.96.0.10", 80, 6,
+                    [(f"10.1.1.{i + 2}", 8080, 1) for i in range(4)])],
+        snat_ip="192.168.16.1", snat_enabled=True,
+    )
+    return acl, nat, _route()
+
+
+FWD = [(f"10.1.1.{10 + (i % 8)}", "10.96.0.10", 6, 41000 + i, 80)
+       for i in range(64)]
+
+
+def _reply_flows(fwd_result):
+    """Reply 5-tuples for each DNAT'ed forward flow of a result."""
+    b = fwd_result.batch
+    return [
+        (
+            str(np.asarray(b.dst_ip)[i] >> 24 & 0xFF) + "."
+            + str(np.asarray(b.dst_ip)[i] >> 16 & 0xFF) + "."
+            + str(np.asarray(b.dst_ip)[i] >> 8 & 0xFF) + "."
+            + str(np.asarray(b.dst_ip)[i] & 0xFF),
+            FWD[i][0], 6, int(np.asarray(b.dst_port)[i]), FWD[i][3],
+        )
+        for i in range(len(FWD))
+    ]
+
+
+def _run_two_steps(step_fn, acl, nat, route, sessions, shard=None):
+    """Dispatch forward flows, then their replies; returns both results."""
+    fwd_batch = make_batch(FWD)
+    if shard is not None:
+        fwd_batch = shard(fwd_batch)
+    r1 = step_fn(acl, nat, route, sessions, fwd_batch, jnp.int32(1))
+    reply_batch = make_batch(_reply_flows(r1))
+    if shard is not None:
+        reply_batch = shard(reply_batch)
+    r2 = step_fn(acl, nat, route, r1.sessions, reply_batch, jnp.int32(2))
+    return r1, r2
+
+
+@pytest.mark.parametrize("partition_sessions", [False, True],
+                         ids=["replicated", "slot-partitioned"])
+def test_multistep_sessions_on_mesh_match_single_device(partition_sessions):
+    """A session committed by sharded dispatch N restores its reply in
+    sharded dispatch N+1 — bit-identical to the single-device run, for
+    both session placements."""
+    acl, nat, route = _world()
+
+    single1, single2 = _run_two_steps(
+        pipeline_step_jit, acl, nat, route, empty_sessions(1024)
+    )
+    assert bool(np.asarray(single1.dnat_hit).all())
+    # Replies restore for exactly the forwards whose session committed
+    # on device (punted forwards are the host slow path's business).
+    fwd_ok = ~np.asarray(single1.punt)
+    assert fwd_ok.sum() >= len(FWD) - 8, "too many commit punts for the test"
+    np.testing.assert_array_equal(np.asarray(single2.reply_hit), fwd_ok)
+
+    mesh = make_mesh(8)
+    with mesh:
+        acl_s, nat_s, route_s, sess_s = shard_dataplane(
+            mesh, acl, nat, route, empty_sessions(1024),
+            partition_sessions=partition_sessions,
+        )
+        step = sharded_pipeline_step(mesh)
+        mesh1, mesh2 = _run_two_steps(
+            step, acl_s, nat_s, route_s, sess_s,
+            shard=lambda b: shard_batch(mesh, b),
+        )
+
+    for sr, mr in ((single1, mesh1), (single2, mesh2)):
+        np.testing.assert_array_equal(np.asarray(sr.allowed), np.asarray(mr.allowed))
+        np.testing.assert_array_equal(np.asarray(sr.reply_hit), np.asarray(mr.reply_hit))
+        np.testing.assert_array_equal(np.asarray(sr.punt), np.asarray(mr.punt))
+        np.testing.assert_array_equal(
+            np.asarray(sr.batch.src_ip), np.asarray(mr.batch.src_ip))
+        np.testing.assert_array_equal(
+            np.asarray(sr.batch.dst_ip), np.asarray(mr.batch.dst_ip))
+        np.testing.assert_array_equal(
+            np.asarray(sr.batch.src_port), np.asarray(mr.batch.src_port))
+        np.testing.assert_array_equal(
+            np.asarray(sr.batch.dst_port), np.asarray(mr.batch.dst_port))
+    # Device-restored replies carry the VIP on the mesh path too.
+    rh = np.asarray(mesh2.reply_hit)
+    assert rh.sum() >= len(FWD) - 8
+    assert bool((np.asarray(mesh2.batch.src_ip)[rh]
+                 == ip_to_u32("10.96.0.10")).all())
+
+
+def test_runner_on_mesh_matches_unsharded_runner():
+    """The SAME DataplaneRunner loop, sharded vs not: identical frame
+    outputs and counters over mixed traffic including cross-dispatch
+    replies (mesh= is the only difference)."""
+    from vpp_tpu.datapath import DataplaneRunner, NativeRing, VxlanOverlay
+    from vpp_tpu.testing.frames import build_frame, frame_tuple
+
+    acl, nat, route = _world()
+
+    def run(mesh):
+        rings = [NativeRing(arena_bytes=1 << 20, max_frames=1 << 12)
+                 for _ in range(4)]
+        rx, tx, local, host = rings
+        runner = DataplaneRunner(
+            acl=acl, nat=nat, route=route,
+            overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"),
+                                 local_node_id=1),
+            source=rx, tx=tx, local=local, host=host,
+            batch_size=32, max_vectors=2, mesh=mesh,
+        )
+        runner.overlay.set_remote(2, ip_to_u32("192.168.16.2"))
+        fwd = [build_frame(f"10.1.1.{10 + (i % 4)}", "10.96.0.10", 6,
+                           42000 + i, 80) for i in range(48)]
+        fwd += [build_frame("10.1.1.9", "10.1.2.7", 6, 43000 + i, 80)
+                for i in range(8)]   # remote pod -> VXLAN
+        fwd += [build_frame("10.1.1.9", "8.8.4.4", 6, 44000 + i, 443)
+                for i in range(8)]   # egress -> SNAT host
+        rx.send(fwd)
+        runner.drain()
+        delivered = local.recv_batch(1 << 12)
+        # Replies to the DNAT'ed flows, next dispatch.
+        rx.send([build_frame(frame_tuple(f)[1], frame_tuple(f)[0], 6,
+                             frame_tuple(f)[4], frame_tuple(f)[3])
+                 for f in delivered])
+        runner.drain()
+        replies = local.recv_batch(1 << 12)
+        return {
+            "delivered": delivered,
+            "replies": replies,
+            "tx": tx.recv_batch(1 << 12),
+            "host": host.recv_batch(1 << 12),
+            "counters": runner.counters.as_dict(),
+        }
+
+    base = run(mesh=None)
+    sharded = run(mesh=make_mesh(8))
+    assert base["counters"] == sharded["counters"]
+    assert base["delivered"] == sharded["delivered"]
+    assert base["replies"] == sharded["replies"]
+    assert base["tx"] == sharded["tx"]
+    assert base["host"] == sharded["host"]
+    # The scenario is non-trivial: replies actually restored.
+    assert len(base["replies"]) == 48
+    restored = [f for f in base["replies"]
+                if frame_tuple(f)[0] == "10.96.0.10"]
+    assert len(restored) == 48

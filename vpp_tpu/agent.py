@@ -170,8 +170,8 @@ class Agent:
         local, host = NativeRing(), NativeRing()
         node_ip = f"192.168.16.{self.nodesync.node_id}"
         self.runner = DataplaneRunner(
-            acl=self.policy_renderer.tables or build_rule_tables([], {}),
-            nat=self.nat_renderer.tables or build_nat_tables([]),
+            acl=build_rule_tables([], {}),
+            nat=build_nat_tables([]),
             route=make_route_config(self.ipam),
             overlay=VxlanOverlay(
                 local_ip=ip_to_u32(node_ip),
@@ -181,8 +181,14 @@ class Agent:
             batch_size=self.config.batch_size,
             max_vectors=self.config.max_vectors,
         )
+        # Hook FIRST, then pull whatever the renderers have already
+        # compiled — a table compiled in between fires the hook, so no
+        # window exists where a compile is dropped.
         self.acl_applicator.on_compiled = lambda t: self.runner.update_tables(acl=t)
         self.nat_applicator.on_compiled = lambda t: self.runner.update_tables(nat=t)
+        self.runner.update_tables(
+            acl=self.policy_renderer.tables, nat=self.nat_renderer.tables
+        )
         rings = (rx, tx, local, host)
 
         def loop():

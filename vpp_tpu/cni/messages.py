@@ -1,0 +1,48 @@
+"""CNI wire messages — grpc-free so the host-side shim can import them.
+
+Schema follows ``plugins/podmanager/cni/cni.proto`` (CNIRequest /
+CNIReply); :mod:`.rpc` re-exports these for the gRPC service, and the
+agent REST server serves the same messages over plain HTTP for hosts
+whose system python has no grpcio (the shim's stdlib fallback path).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+CNI_VERSION = "0.3.1"
+DEFAULT_PORT = 9111  # the reference agent's CNI gRPC port
+
+
+@dataclass
+class CNIRequest:
+    """cni.proto CNIRequest."""
+
+    version: str = ""
+    container_id: str = ""
+    network_namespace: str = ""
+    interface_name: str = ""
+    extra_nw_config: str = ""
+    extra_arguments: str = ""  # "K8S_POD_NAME=..;K8S_POD_NAMESPACE=.."
+    ipam_type: str = ""
+    ipam_data: str = ""
+
+    def extra_args(self) -> dict:
+        out = {}
+        for part in self.extra_arguments.split(";"):
+            key, sep, value = part.partition("=")
+            if sep:
+                out[key] = value
+        return out
+
+
+@dataclass
+class CNIReply:
+    """cni.proto CNIReply (interfaces/routes as plain dicts)."""
+
+    result: int = 0
+    error: str = ""
+    interfaces: List[dict] = field(default_factory=list)
+    routes: List[dict] = field(default_factory=list)
+    dns: List[dict] = field(default_factory=list)

@@ -16,48 +16,18 @@ from __future__ import annotations
 import json
 import logging
 from concurrent import futures
-from dataclasses import asdict, dataclass, field
-from typing import List, Optional
+from dataclasses import asdict
+from typing import Optional
 
 import grpc
+
+# Message dataclasses live in the grpc-free .messages module so the
+# host-side shim can run without grpcio; re-exported here unchanged.
+from .messages import DEFAULT_PORT, CNIReply, CNIRequest  # noqa: F401
 
 log = logging.getLogger(__name__)
 
 SERVICE_NAME = "cni.RemoteCNI"
-DEFAULT_PORT = 9111  # the reference agent's CNI gRPC port
-
-
-@dataclass
-class CNIRequest:
-    """cni.proto CNIRequest."""
-
-    version: str = ""
-    container_id: str = ""
-    network_namespace: str = ""
-    interface_name: str = ""
-    extra_nw_config: str = ""
-    extra_arguments: str = ""  # "K8S_POD_NAME=..;K8S_POD_NAMESPACE=.."
-    ipam_type: str = ""
-    ipam_data: str = ""
-
-    def extra_args(self) -> dict:
-        out = {}
-        for part in self.extra_arguments.split(";"):
-            key, sep, value = part.partition("=")
-            if sep:
-                out[key] = value
-        return out
-
-
-@dataclass
-class CNIReply:
-    """cni.proto CNIReply (interfaces/routes as plain dicts)."""
-
-    result: int = 0
-    error: str = ""
-    interfaces: List[dict] = field(default_factory=list)
-    routes: List[dict] = field(default_factory=list)
-    dns: List[dict] = field(default_factory=list)
 
 
 def _encode(msg) -> bytes:

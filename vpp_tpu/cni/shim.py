@@ -16,9 +16,32 @@ import json
 import os
 import sys
 
-from .rpc import CNIRequest, DEFAULT_PORT, remote_cni_add, remote_cni_delete
+from .messages import CNI_VERSION, DEFAULT_PORT, CNIReply, CNIRequest
 
-CNI_VERSION = "0.3.1"
+# The primary transport is the cni.proto-parity gRPC service; host
+# pythons without grpcio (the common case for the installed shim — only
+# the container image pip-installs deps) fall back to the agent REST
+# server's /cni/* routes over stdlib HTTP.
+try:
+    from .rpc import remote_cni_add, remote_cni_delete
+
+    _HAVE_GRPC = True
+except ImportError:  # pragma: no cover - exercised on dep-less hosts
+    _HAVE_GRPC = False
+
+
+def _http_cni(target: str, action: str, request: CNIRequest) -> CNIReply:
+    import urllib.request
+    from dataclasses import asdict
+
+    req = urllib.request.Request(
+        f"http://{target}/cni/{action}",
+        data=json.dumps(asdict(request)).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:  # noqa: S310
+        return CNIReply(**json.load(resp))
 
 
 def _error_result(code: int, msg: str) -> dict:
@@ -82,6 +105,7 @@ def main(env=None, stdin=None, stdout=None) -> int:
     except ValueError:
         conf = {}
     target = conf.get("grpcServer", f"127.0.0.1:{DEFAULT_PORT}")
+    http_target = conf.get("httpServer", "127.0.0.1:9999")
     request = build_request(env, config)
 
     if command == "VERSION":
@@ -93,10 +117,15 @@ def main(env=None, stdin=None, stdout=None) -> int:
         return 1
 
     try:
-        if command == "ADD":
-            reply = remote_cni_add(target, request)
+        if _HAVE_GRPC:
+            if command == "ADD":
+                reply = remote_cni_add(target, request)
+            else:
+                reply = remote_cni_delete(target, request)
         else:
-            reply = remote_cni_delete(target, request)
+            reply = _http_cni(
+                http_target, "add" if command == "ADD" else "del", request
+            )
     except Exception as err:
         json.dump(_error_result(11, f"agent RPC failed: {err}"), stdout)
         return 1

@@ -92,7 +92,6 @@ class K8sApiListWatch:
         path = _KIND_PATHS[kind]
         with self._request(path) as resp:
             body = json.load(resp)
-        self._last_rv = body.get("metadata", {}).get("resourceVersion", "")
         return body.get("items", [])
 
     def subscribe(self, kind: str, handler: Callable) -> None:

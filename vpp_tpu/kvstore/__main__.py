@@ -44,8 +44,9 @@ def main(argv=None) -> int:
             snap, _rev = loaded
             for key, value in snap.items():
                 store.put(key, value)
-        # Persist continuously: every committed change refreshes the
-        # snapshot (coalesced by revision, cheap at control-plane rates).
+        # Persist continuously, coalescing bursts: drain every queued
+        # change, then write ONE snapshot covering all of them (a KSR
+        # initial reflection is hundreds of puts but one sqlite write).
         watcher = store.watch([""])
 
         def persist():
@@ -55,6 +56,8 @@ def main(argv=None) -> int:
                     if watcher.closed:
                         return
                     continue
+                while watcher.get(timeout=0.02) is not None:
+                    pass  # drain the burst
                 snap, rev = store.snapshot_with_revision([""])
                 mirror.save_snapshot(snap, rev)
 

@@ -73,12 +73,24 @@ def shard_dataplane(
     nat: NatTables,
     route: RouteConfig,
     sessions: NatSessions,
+    partition_sessions: bool = False,
 ):
     """Place the data-plane state onto the mesh.
 
     Rule rows shard over the ``rules`` axis; pod lookup tables, NAT
-    mappings, routing scalars and the session table replicate (NAT
-    state is small; sessions must be visible to every batch shard).
+    mappings and routing scalars replicate.  The session table has two
+    supported placements:
+
+    - replicated (default): every chip holds the full table.  Cost at
+      the production capacity (2^16 slots) is ~3 MB/chip of HBM plus
+      the GSPMD-inserted combine of each step's scatter updates across
+      the ``data`` axis (measured by scripts/mesh_overhead.py).
+    - ``partition_sessions=True``: slots shard over the ``data`` axis
+      (hash-partitioned table).  Any batch shard may probe any slot —
+      flow hashes do not respect the slot partition — so GSPMD inserts
+      the cross-shard gathers/scatters; HBM per chip drops by the mesh
+      width.  Verdict-identical to the replicated placement
+      (tests/test_multichip.py asserts both against single-device).
     """
     rule_fields = {
         "rule_valid", "rule_tid", "rule_src_base", "rule_src_mask",
@@ -103,14 +115,24 @@ def shard_dataplane(
     replicate = lambda leaf: P()  # noqa: E731
     nat_sharded = jax.device_put(nat, _sharding_tree(nat, mesh, replicate))
     route_sharded = jax.device_put(route, _sharding_tree(route, mesh, replicate))
-    sessions_sharded = jax.device_put(sessions, _sharding_tree(sessions, mesh, replicate))
+    sess_spec = (lambda leaf: P("data")) if partition_sessions else replicate
+    sessions_sharded = jax.device_put(sessions, _sharding_tree(sessions, mesh, sess_spec))
     return acl_sharded, nat_sharded, route_sharded, sessions_sharded
 
 
 def shard_batch(mesh: Mesh, batch: PacketBatch) -> PacketBatch:
-    """Shard the packet batch over the ``data`` axis."""
-    sharding = NamedSharding(mesh, P("data"))
-    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
+    """Shard a packet batch over the ``data`` axis.
+
+    Accepts both dispatch shapes: flat ``[B]`` leaves shard on their
+    only dim; scan-shaped ``[K, V]`` leaves shard the packet dim (each
+    of the K vectors splits across the axis, preserving the scan's
+    sequential session semantics)."""
+
+    def put(x):
+        spec = P("data") if x.ndim == 1 else P(None, "data")
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(put, batch)
 
 
 def sharded_pipeline_step(mesh: Mesh):
@@ -170,22 +192,24 @@ def ensure_devices(n: int) -> None:
 
 
 def dryrun_multichip(n_devices: int) -> None:
-    """Compile and execute ONE full data-plane step over an
-    ``n_devices``-device mesh on tiny shapes.
-
-    Exercises the real shardings: batch over ``data``, rule tensor over
-    ``rules``, NAT/session state replicated — the framework's DP x TP
-    analog (there is no gradient step in a packet processor; the
-    data-plane step IS the full per-iteration workload).
+    """Compile and execute the FULL datapath over an ``n_devices``
+    mesh: real Ethernet frames through the native runner loop
+    (C++ rings, admit/harvest) with every dispatch GSPMD-sharded —
+    batch over ``data``, rule tensor over ``rules`` — across MULTIPLE
+    steps, so sessions committed by one sharded dispatch restore
+    replies in the next (the multi-step sharded-session contract, not
+    a one-shot compile check).  The framework's DP x TP analog: there
+    is no gradient step in a packet processor; the data-plane step IS
+    the full per-iteration workload.
     """
-    import ipaddress
-
     ensure_devices(n_devices)
 
     from ..conf import IPAMConfig
     from ..ipam import IPAM
     from ..models import (
+        IngressRule,
         LabelSelector,
+        Peer,
         Pod,
         PodID,
         Policy,
@@ -207,10 +231,15 @@ def dryrun_multichip(n_devices: int) -> None:
             ip_address=str(ipam.allocate_pod_ip(PodID(f"p{i}", "default"))))
         for i in range(4)
     ]
+    # Web pods accept ingress from web pods only (a real rule table on
+    # the ``rules`` axis, permitting the dry run's service traffic).
     policy = Policy(
-        name="lockdown", namespace="default",
+        name="web-only", namespace="default",
         pods=LabelSelector(match_labels={"app": "web"}),
         policy_type=PolicyType.INGRESS,
+        ingress_rules=(IngressRule(
+            from_peers=(Peer(pods=LabelSelector(match_labels={"app": "web"})),),
+        ),),
     )
     tpu_renderer = TpuPolicyRenderer()
     plugin = PolicyPlugin(ipam=ipam)
@@ -232,28 +261,64 @@ def dryrun_multichip(n_devices: int) -> None:
         pod_subnet=str(ipam.pod_subnet_all_nodes),
     )
     route = make_route_config(ipam)
+
+    # ---- the runner loop on the mesh (VERDICT r2 item 4) -------------
+    from ..datapath import DataplaneRunner, NativeRing, VxlanOverlay
+    from ..ops.packets import ip_to_u32
+    from ..testing.frames import build_frame, frame_tuple
+
+    data_width = mesh.devices.shape[0]
+    # Batch must split over the data axis, whatever its width.
+    batch_size = ((max(64, 8 * n_devices) + data_width - 1)
+                  // data_width) * data_width
+    rings = [NativeRing(arena_bytes=1 << 20, max_frames=1 << 12) for _ in range(4)]
+    rx, tx, local_ring, host_ring = rings
+    runner = DataplaneRunner(
+        acl=acl, nat=nat, route=route,
+        overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"), local_node_id=1),
+        source=rx, tx=tx, local=local_ring, host=host_ring,
+        batch_size=batch_size, max_vectors=2,
+        mesh=mesh,
+    )
+    assert runner.engine == "native"
+
+    # Step N: forward service flows — DNAT + session commit, sharded.
+    n_flows = batch_size
+    client = pods[1].ip_address
+    backend = pods[0].ip_address
+    rx.send([build_frame(client, "10.96.0.10", 6, 40000 + i, 80)
+             for i in range(n_flows)])
+    runner.drain()
+    fwd = local_ring.recv_batch(1 << 12)
+    assert len(fwd) == n_flows, f"forward delivery {len(fwd)}/{n_flows}"
+    assert all(frame_tuple(f)[1] == backend for f in fwd)
+
+    # Step N+1: replies in a LATER dispatch ride the sessions the
+    # sharded step N committed — restored to the VIP.
+    rx.send([build_frame(backend, client, 6, 8080, 40000 + i)
+             for i in range(n_flows)])
+    runner.drain()
+    rep = local_ring.recv_batch(1 << 12)
+    assert len(rep) == n_flows, f"reply delivery {len(rep)}/{n_flows}"
+    restored = sum(1 for f in rep if frame_tuple(f)[0] == "10.96.0.10")
+    assert restored == n_flows, f"VIP restored on {restored}/{n_flows} replies"
+
+    # One direct sharded-step sanity check on top of the runner drive.
     sessions = empty_sessions(1024)
-
-    batch_size = max(64, 8 * n_devices)
-    flows = [
-        (pods[i % len(pods)].ip_address, "10.96.0.10", 6, 40000 + i, 80)
+    batch = make_batch([
+        (pods[i % len(pods)].ip_address, "10.96.0.10", 6, 50000 + i, 80)
         for i in range(batch_size)
-    ]
-    batch = make_batch(flows)
-
+    ])
     with mesh:
         acl_s, nat_s, route_s, sess_s = shard_dataplane(mesh, acl, nat, route, sessions)
         batch_s = shard_batch(mesh, batch)
         step = sharded_pipeline_step(mesh)
         result = step(acl_s, nat_s, route_s, sess_s, batch_s, jnp.int32(0))
         result.allowed.block_until_ready()
+    assert np.asarray(result.allowed).shape == (batch_size,)
 
-    allowed = np.asarray(result.allowed)
-    route_tags = np.asarray(result.route)
-    assert allowed.shape == (batch_size,)
-    # The DNAT'ed flows route to the local backend pod; verdicts finite.
-    assert route_tags.min() >= 0 and route_tags.max() <= 3
     print(
         f"dryrun_multichip OK: mesh {dict(zip(mesh.axis_names, mesh.devices.shape))}, "
-        f"batch {batch_size}, {int(allowed.sum())}/{batch_size} allowed"
+        f"runner loop native+sharded, {n_flows} forward + {n_flows} "
+        f"session-restored replies across steps"
     )

@@ -158,9 +158,25 @@ class AgentRestServer:
             raise LookupError("no stats registry")
         return generate_latest(self.stats_registry).decode()
 
+    def post_cni(self, action: str, body: bytes) -> dict:
+        """CNI Add/Del over plain HTTP — the stdlib fallback transport
+        for host shims whose system python has no grpcio (the gRPC
+        service remains the primary, cni.proto-parity path)."""
+        if self.podmanager is None:
+            raise LookupError("no podmanager")
+        from dataclasses import asdict
+
+        from ..cni.messages import CNIRequest
+        from ..cni.rpc import CNIServer
+
+        request = CNIRequest(**json.loads(body.decode()))
+        handlers = CNIServer(self.podmanager)  # reuse handlers, no server
+        reply = handlers.add(request) if action == "add" else handlers.delete(request)
+        return asdict(reply)
+
     # ------------------------------------------------------------ http glue
 
-    def _route(self, method: str, path: str, query: dict):
+    def _route(self, method: str, path: str, query: dict, body: bytes = b""):
         routes = {
             ("GET", "/liveness"): self.get_liveness,
             ("GET", "/controller/event-history"): self.get_event_history,
@@ -171,6 +187,8 @@ class AgentRestServer:
         }
         if (method, path) in routes:
             return routes[(method, path)]()
+        if method == "POST" and path in ("/cni/add", "/cni/del"):
+            return self.post_cni(path.rsplit("/", 1)[1], body)
         if method == "GET" and path == "/scheduler/dump":
             return self.get_scheduler_dump(query.get("prefix", ""))
         if method == "GET" and path == "/metrics":
@@ -192,8 +210,10 @@ class AgentRestServer:
 
                 parsed = urlparse(self.path)
                 query = dict(parse_qsl(parsed.query))
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
                 try:
-                    result = server._route(method, parsed.path, query)
+                    result = server._route(method, parsed.path, query, body)
                 except FileNotFoundError:
                     self.send_error(404)
                     return
