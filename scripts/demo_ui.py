@@ -1,26 +1,21 @@
-"""Stand up a mini single-node agent + UI backend for a manual look.
+"""Stand up a LIVE 2-node cluster + UI backend for a manual look.
+
+A real SimCluster (two full agents, KSR, shared store) with pods and a
+service deployed, each agent exposing its REST API, fronted by the UI
+backend — the dashboard's topology shows both vswitches, the VXLAN
+mesh edge, and the pods hanging off each node (the d3-topology analog,
+/root/reference/ui/src/app/d3-topology).
 
 Usage: python scripts/demo_ui.py [--port N]
 Serves the dashboard at http://127.0.0.1:<port>/ until interrupted.
 """
 
 import argparse
+import pathlib
+import sys
 import time
 
-from prometheus_client import CollectorRegistry
-
-from vpp_tpu.conf import NetworkConfig
-from vpp_tpu.controller.dbwatcher import DBWatcher
-from vpp_tpu.controller.eventloop import Controller
-from vpp_tpu.ipv4net import IPv4Net
-from vpp_tpu.kvstore import KVStore
-from vpp_tpu.models import VppNode, key_for
-from vpp_tpu.nodesync import NodeSync
-from vpp_tpu.podmanager import PodManager
-from vpp_tpu.rest import AgentRestServer
-from vpp_tpu.scheduler import TxnScheduler
-from vpp_tpu.statscollector import StatsCollector
-from vpp_tpu.uibackend import UIBackend
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 
 def main():
@@ -28,48 +23,67 @@ def main():
     parser.add_argument("--port", type=int, default=8900)
     args = parser.parse_args()
 
-    store = KVStore()
-    nodesync = NodeSync(store, node_name="node-1")
-    podmanager = PodManager()
-    ipv4net = IPv4Net(NetworkConfig(), nodesync, podmanager=podmanager)
-    scheduler = TxnScheduler()
-    registry = CollectorRegistry()
-    stats = StatsCollector(registry=registry)
-    ctl = Controller(handlers=[nodesync, podmanager, ipv4net, stats], sink=scheduler)
-    podmanager.event_loop = ctl
-    nodesync.event_loop = ctl
-    ctl.start()
-    watcher = DBWatcher(ctl, store)
-    watcher.start()
-    while ipv4net.ipam is None:
-        time.sleep(0.02)
+    from vpp_tpu.rest import AgentRestServer
+    from vpp_tpu.testing.cluster import SimCluster, wait_for
+    from vpp_tpu.uibackend import UIBackend
 
-    # A couple of local pods and one remote node for the topology view.
-    podmanager.add_pod(name="web-1", container_id="c1")
-    podmanager.add_pod(name="db-1", container_id="c2")
-    remote = VppNode(id=2, name="node-2", ip_addresses=["192.168.16.2"])
-    store.put(key_for(remote), remote)
+    cluster = SimCluster()
+    n1 = cluster.add_node("node-1")
+    n2 = cluster.add_node("node-2")
+    cluster.deploy_pod("node-1", "client")
+    cluster.deploy_pod("node-1", "web-1", labels={"app": "web"})
+    backend_ip = cluster.deploy_pod("node-2", "web-2", labels={"app": "web"})
+    cluster.deploy_pod("node-2", "db-1", labels={"app": "db"})
+    cluster.apply_service({
+        "metadata": {"name": "web", "namespace": "default"},
+        "spec": {"clusterIP": "10.96.0.10", "selector": {"app": "web"},
+                 "ports": [{"name": "http", "protocol": "TCP", "port": 80,
+                            "targetPort": 8080}]},
+    })
+    cluster.apply_endpoints({
+        "metadata": {"name": "web", "namespace": "default"},
+        "subsets": [{
+            "addresses": [{"ip": backend_ip, "nodeName": "node-2",
+                           "targetRef": {"kind": "Pod", "name": "web-2",
+                                         "namespace": "default"}}],
+            "ports": [{"name": "http", "port": 8080, "protocol": "TCP"}],
+        }],
+    })
+    if not wait_for(lambda: len(n1.nat_renderer.mappings()) > 0):
+        raise SystemExit("service/NAT resync never converged — demo aborted")
 
-    rest = AgentRestServer(
-        node_name="node-1",
-        controller=ctl,
-        dbwatcher=watcher,
-        ipam=ipv4net.ipam,
-        nodesync=nodesync,
-        podmanager=podmanager,
-        scheduler=scheduler,
-        stats_registry=registry,
-    )
-    agent_port = rest.start()
+    from prometheus_client import CollectorRegistry
 
-    directory = {"node-1": f"127.0.0.1:{agent_port}"}
+    from vpp_tpu.statscollector import StatsCollector
+
+    rests = {}
+    directory = {}
+    for name, node in (("node-1", n1), ("node-2", n2)):
+        # Pod gauges for /metrics (SimNode does not wire a collector).
+        registry = CollectorRegistry()
+        stats = StatsCollector(registry=registry)
+        node.controller.handlers.append(stats)
+        rest = AgentRestServer(
+            node_name=name,
+            controller=node.controller,
+            dbwatcher=node.watcher,
+            ipam=node.ipam,
+            nodesync=node.nodesync,
+            podmanager=node.podmanager,
+            scheduler=node.scheduler,
+            stats_registry=registry,
+        )
+        rests[name] = rest
+        directory[name] = f"127.0.0.1:{rest.start()}"
+
     backend = UIBackend(
         node_directory=directory.get,
         list_nodes=lambda: list(directory),
         port=args.port,
     )
     backend.start()
-    print(f"dashboard: http://127.0.0.1:{backend.port}/  (agent on :{agent_port})")
+    print(f"dashboard: http://127.0.0.1:{backend.port}/  (agents: {directory})",
+          flush=True)
     try:
         while True:
             time.sleep(3600)
@@ -77,9 +91,9 @@ def main():
         pass
     finally:
         backend.stop()
-        rest.stop()
-        watcher.stop()
-        ctl.stop()
+        for rest in rests.values():
+            rest.stop()
+        cluster.stop()
 
 
 if __name__ == "__main__":
