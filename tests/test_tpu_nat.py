@@ -425,3 +425,168 @@ def test_slowpath_capacity_drops_snat_but_forwards_dnat():
     assert outcome.drops == [1]
     assert slow.counters.drops == 1
     assert len(slow) == 0
+
+
+# ---------------------------------------------------------------------------
+# DNAT exact-match hash index (the [B, W]-gather replacement for the
+# dense [B, M] mapping compare)
+# ---------------------------------------------------------------------------
+
+
+def _random_mappings(rng, n):
+    maps = []
+    for i in range(n):
+        maps.append(NatMapping(
+            external_ip=u32_to_ip(int(rng.integers(1, 2**32 - 1, dtype=np.uint64))),
+            external_port=int(rng.integers(1, 65535)),
+            protocol=int(rng.choice([6, 17])),
+            backends=[(f"10.1.{rng.integers(1, 200)}.{rng.integers(2, 250)}", 8080, 1)],
+        ))
+    return maps
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_dnat_hash_lookup_matches_dense(seed):
+    """Hash and dense lookups agree bit-for-bit on hits, misses and
+    near-misses (right IP wrong port, right key wrong proto)."""
+    from vpp_tpu.ops.nat import _dnat_lookup_dense, _dnat_lookup_hash
+
+    rng = np.random.default_rng(seed)
+    maps = _random_mappings(rng, 300)
+    tables = build_nat_tables(maps, pod_subnet="10.1.0.0/16")
+
+    flows = []
+    for m in maps[:150]:  # exact hits
+        flows.append(("10.1.1.9", m.external_ip, m.protocol, 40000, m.external_port))
+    for m in maps[:50]:  # near misses
+        flows.append(("10.1.1.9", m.external_ip, m.protocol, 40000, m.external_port + 1))
+        flows.append(("10.1.1.9", m.external_ip, 23 - m.protocol, 40000, m.external_port))
+    for _ in range(100):  # random misses
+        flows.append((
+            "10.1.1.9", u32_to_ip(int(rng.integers(1, 2**32 - 1, dtype=np.uint64))),
+            6, 40000, int(rng.integers(1, 65535)),
+        ))
+    batch = make_batch(flows)
+    h_hit, h_idx = _dnat_lookup_hash(tables, batch)
+    d_hit, d_idx = _dnat_lookup_dense(tables, batch)
+    np.testing.assert_array_equal(np.asarray(h_hit), np.asarray(d_hit))
+    np.testing.assert_array_equal(np.asarray(h_idx), np.asarray(d_idx))
+    assert int(np.asarray(h_hit).sum()) == 150
+
+
+def test_map_hash_py_device_lockstep():
+    """The host insert hash and the device probe hash must be the same
+    function, or lookups silently miss."""
+    from vpp_tpu.ops.nat import _map_key_hash, _map_key_hash_py
+
+    rng = np.random.default_rng(7)
+    ips = rng.integers(0, 2**32, size=64, dtype=np.uint64).astype(np.uint32)
+    ports = rng.integers(0, 65536, size=64).astype(np.int32)
+    protos = rng.choice([6, 17], size=64).astype(np.int32)
+    dev = np.asarray(_map_key_hash(jnp.asarray(ips), jnp.asarray(ports), jnp.asarray(protos)))
+    host = np.array(
+        [_map_key_hash_py(int(ip), int(p), int(pr)) for ip, p, pr in zip(ips, ports, protos)],
+        dtype=np.uint32,
+    )
+    np.testing.assert_array_equal(dev, host)
+
+
+def test_map_hash_build_grows_past_collisions():
+    """A tiny starting capacity forces bucket overflow; the build must
+    grow until every key fits within the probe window, and every key
+    must then resolve."""
+    from vpp_tpu.ops.nat import MAP_PROBE_WAYS, _build_map_hash, _map_key_hash_py
+
+    rng = np.random.default_rng(3)
+    entries = [
+        (i, (int(rng.integers(1, 2**32 - 1, dtype=np.uint64)),
+             int(rng.integers(1, 65535)), 6))
+        for i in range(200)
+    ]
+    table = _build_map_hash(entries, start_capacity=16)
+    cap = len(table)
+    assert cap & (cap - 1) == 0
+    for idx, key in entries:
+        base = _map_key_hash_py(*key) & (cap - 1)
+        slots = [(base + w) & (cap - 1) for w in range(MAP_PROBE_WAYS)]
+        assert idx in [int(table[s]) for s in slots]
+
+
+def test_duplicate_mapping_keys_first_wins():
+    """Two mappings with the same (ip, port, proto): dense argmax picks
+    the first — the hash index must agree."""
+    from vpp_tpu.ops.nat import _dnat_lookup_dense, _dnat_lookup_hash
+
+    dup = [
+        NatMapping("10.96.0.1", 80, 6, backends=[("10.1.1.2", 8080, 1)]),
+        NatMapping("10.96.0.1", 80, 6, backends=[("10.1.9.9", 9090, 1)]),
+        NatMapping("10.96.0.2", 80, 6, backends=[("10.1.2.2", 8080, 1)]),
+    ]
+    tables = build_nat_tables(dup, pod_subnet="10.1.0.0/16")
+    batch = make_batch([
+        ("10.1.1.9", "10.96.0.1", 6, 40000, 80),
+        ("10.1.1.9", "10.96.0.2", 6, 40000, 80),
+    ])
+    h_hit, h_idx = _dnat_lookup_hash(tables, batch)
+    d_hit, d_idx = _dnat_lookup_dense(tables, batch)
+    np.testing.assert_array_equal(np.asarray(h_hit), np.asarray(d_hit))
+    np.testing.assert_array_equal(np.asarray(h_idx), np.asarray(d_idx))
+    assert int(h_idx[0]) == 0 and int(h_idx[1]) == 2
+
+
+def test_crafted_hash_collisions_fall_back_to_dense():
+    """>W distinct keys with the SAME full 32-bit hash (the unseeded
+    hash is invertible, so an adversary who controls Service specs can
+    craft them) must not hang the build in unbounded doubling: the
+    growth bound trips, ``use_hmap`` flips off, and lookups stay
+    correct via the dense path."""
+    from vpp_tpu.ops.nat import (
+        MAP_PROBE_WAYS, _build_map_hash, _map_key_hash_py,
+    )
+
+    M = 1 << 32
+
+    def unmix(x):
+        # Inverse of _mix_py: undo xor-shift-16 (involutive for >=16),
+        # multiply by modular inverses, undo xor-shift-13 (two rounds).
+        x ^= x >> 16
+        x = (x * pow(0xC2B2AE35, -1, M)) % M
+        x ^= (x >> 13) ^ (x >> 26)
+        x = (x * pow(0x85EBCA6B, -1, M)) % M
+        x ^= x >> 16
+        return x
+
+    target = 0xDEADBEEF
+    pre = unmix(target)
+    inv_golden = pow(0x9E3779B1, -1, M)
+    keys = []
+    for port in range(80, 80 + MAP_PROBE_WAYS + 1):
+        ip = ((pre ^ ((port << 16) | 6)) * inv_golden) % M
+        keys.append((ip, port, 6))
+    for k in keys:
+        assert _map_key_hash_py(*k) == target  # collision is real
+    assert _build_map_hash(list(enumerate(keys))) is None  # bounded, no hang
+
+    maps = [
+        NatMapping(u32_to_ip(ip), port, proto,
+                   backends=[("10.1.1.2", 8080, 1)])
+        for ip, port, proto in keys
+    ]
+    tables = build_nat_tables(maps, pod_subnet="10.1.0.0/16")
+    assert not tables.use_hmap
+    res = run_nat(tables, empty_sessions(1024),
+                  [("10.1.1.9", u32_to_ip(keys[-1][0]), 6, 40000, keys[-1][1])])
+    assert bool(res.dnat_hit[0])  # dense fallback still translates
+
+
+def test_map_hash_build_survives_oversized_start_capacity():
+    """start_capacity above the collision bound (mapping list mostly
+    invalid) must not spuriously fail the build."""
+    from vpp_tpu.ops.nat import _build_map_hash
+
+    table = _build_map_hash([(0, (1, 80, 6))], start_capacity=1 << 18)
+    assert table is not None and len(table) == 1 << 18
+    maps = [NatMapping("10.96.0.1", 80, 6, backends=[])] * 40000
+    maps.append(NatMapping("10.96.0.2", 80, 6, backends=[("10.1.1.2", 8080, 1)]))
+    tables = build_nat_tables(maps, pod_subnet="10.1.0.0/16")
+    assert tables.use_hmap  # 1 valid entry, huge padded M: hash stays on

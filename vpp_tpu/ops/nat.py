@@ -59,6 +59,12 @@ TWICE_NAT_ENABLED = 2
 # same-batch evictions impossible until a bucket truly fills).
 PROBE_WAYS = 4
 
+# DNAT mapping-index hash table probe width.  Unlike the session table
+# the mapping set is compiled on the host, so the build can simply grow
+# the table until every key lands within the probe window — the device
+# lookup is always exactly W gathers.
+MAP_PROBE_WAYS = 4
+
 
 @dataclass
 class NatMapping:
@@ -91,6 +97,13 @@ class NatTables:
     backend_ip: jnp.ndarray     # uint32
     backend_port: jnp.ndarray   # int32
 
+    # Exact-match mapping index [H]: open-addressed hash over
+    # (ext_ip, ext_port, proto) -> mapping row, -1 = empty.  Replaces
+    # the dense [B, M] compare with MAP_PROBE_WAYS gathers per packet
+    # (VPP's nat44 static-mapping lookup is likewise a hash probe, not
+    # a linear scan over mappings).
+    hmap_idx: jnp.ndarray       # int32
+
     # SNAT config (scalars).
     nat_loopback: jnp.ndarray   # uint32 []
     snat_ip: jnp.ndarray        # uint32 [] - node IP for egress SNAT
@@ -101,20 +114,25 @@ class NatTables:
 
     num_mappings: int = 0
     bucket_size: int = 0
+    # Static (trace-time) lookup discipline: False only when the hash
+    # build hit its growth bound (> MAP_PROBE_WAYS mapping keys sharing
+    # one full 32-bit hash — constructible by an adversary since the
+    # hash is unseeded), in which case the dense compare serves lookups.
+    use_hmap: bool = True
 
     def tree_flatten(self):
         children = (
             self.map_ext_ip, self.map_ext_port, self.map_proto,
             self.map_twice_nat, self.map_affinity, self.map_valid,
-            self.backend_ip, self.backend_port,
+            self.backend_ip, self.backend_port, self.hmap_idx,
             self.nat_loopback, self.snat_ip, self.snat_enabled,
             self.pod_subnet_base, self.pod_subnet_mask,
         )
-        return children, (self.num_mappings, self.bucket_size)
+        return children, (self.num_mappings, self.bucket_size, self.use_hmap)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children, num_mappings=aux[0], bucket_size=aux[1])
+        return cls(*children, num_mappings=aux[0], bucket_size=aux[1], use_hmap=aux[2])
 
 
 jax.tree_util.register_pytree_node(NatTables, NatTables.tree_flatten, NatTables.tree_unflatten)
@@ -180,6 +198,78 @@ def empty_sessions(capacity: int = 65536) -> NatSessions:
     )
 
 
+def _mix_py(h: int) -> int:
+    """Host mirror of :func:`_mix` (explicit 32-bit wraparound)."""
+    h &= 0xFFFFFFFF
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h
+
+
+def _map_key_hash_py(ext_ip: int, ext_port: int, proto: int) -> int:
+    """Host mirror of :func:`_map_key_hash` — the two must stay in
+    lockstep (tested in tests/test_tpu_nat.py)."""
+    h = (ext_ip * 0x9E3779B1) & 0xFFFFFFFF
+    return _mix_py(h ^ ((ext_port << 16) | proto))
+
+
+def _map_key_hash(dst_ip: jnp.ndarray, dst_port: jnp.ndarray, proto: jnp.ndarray) -> jnp.ndarray:
+    """Device hash of the DNAT exact-match key (uint32 [B])."""
+    h = dst_ip.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+    return _mix(h ^ ((dst_port.astype(jnp.uint32) << jnp.uint32(16)) | proto.astype(jnp.uint32)))
+
+
+def _build_map_hash(
+    entries: Sequence[Tuple[int, Tuple[int, int, int]]], start_capacity: int = 16
+) -> Optional[np.ndarray]:
+    """Open-addressed (ext_ip, ext_port, proto) -> mapping-index table.
+
+    Inserts every key within ``MAP_PROBE_WAYS`` linear-probe slots of
+    its hash slot, doubling the table until that invariant holds — the
+    device lookup then needs exactly W gathers, no overflow chains.
+    Duplicate keys keep the FIRST mapping index (the dense first-match
+    semantics, since later duplicates are unreachable there too).
+
+    Returns ``None`` when growth hits its bound: more than W distinct
+    keys with the SAME full 32-bit hash collide at every capacity, so
+    doubling can never separate them.  The unseeded hash is invertible,
+    so such key sets are craftable by whoever controls Service specs —
+    the caller must fall back to the dense lookup, not hang the
+    control plane.
+    """
+    capacity = max(16, start_capacity)
+    assert capacity & (capacity - 1) == 0
+    # The bound exists to stop UNBOUNDED growth on same-full-hash key
+    # sets; it must never sit below the starting capacity (a caller
+    # sizing from a mostly-invalid mapping list would otherwise get a
+    # spurious None before the first insert attempt).
+    limit = max(1 << 16, 16 * _next_pow2(max(len(entries), 1)), capacity)
+    while capacity <= limit:
+        table = np.full(capacity, -1, dtype=np.int32)
+        seen: Dict[Tuple[int, int, int], int] = {}
+        ok = True
+        for idx, key in entries:
+            if key in seen:
+                continue  # first mapping wins, matching dense argmax
+            base = _map_key_hash_py(*key) & (capacity - 1)
+            for w in range(MAP_PROBE_WAYS):
+                slot = (base + w) & (capacity - 1)
+                if table[slot] < 0:
+                    table[slot] = idx
+                    seen[key] = idx
+                    break
+            else:
+                ok = False
+                break
+        if ok:
+            return table
+        capacity *= 2
+    return None
+
+
 def build_nat_tables(
     mappings: Sequence[NatMapping],
     nat_loopback: str = "0.0.0.0",
@@ -228,6 +318,19 @@ def build_nat_tables(
     net = ipaddress.ip_network(pod_subnet)
     mask = (0xFFFFFFFF << (32 - net.prefixlen)) & 0xFFFFFFFF if net.prefixlen else 0
 
+    # Only valid mappings enter the exact-match index (invalid rows can
+    # never hit the dense compare either); size for ~50% max load.
+    hmap = _build_map_hash(
+        [
+            (i, (int(ext_ip[i]), int(ext_port[i]), int(proto[i])))
+            for i in range(m) if valid[i]
+        ],
+        start_capacity=_next_pow2(max(2 * m, 8), minimum=16),
+    )
+    use_hmap = hmap is not None
+    if hmap is None:  # adversarial hash-collision set: dense fallback
+        hmap = np.full(16, -1, dtype=np.int32)
+
     return NatTables(
         map_ext_ip=jnp.asarray(ext_ip),
         map_ext_port=jnp.asarray(ext_port),
@@ -237,6 +340,7 @@ def build_nat_tables(
         map_valid=jnp.asarray(valid),
         backend_ip=jnp.asarray(b_ip),
         backend_port=jnp.asarray(b_port),
+        hmap_idx=jnp.asarray(hmap),
         nat_loopback=jnp.asarray(ip_to_u32(nat_loopback), dtype=jnp.uint32),
         snat_ip=jnp.asarray(ip_to_u32(snat_ip), dtype=jnp.uint32),
         snat_enabled=jnp.asarray(snat_enabled),
@@ -244,6 +348,7 @@ def build_nat_tables(
         pod_subnet_mask=jnp.asarray(mask, dtype=jnp.uint32),
         num_mappings=m,
         bucket_size=bucket_size,
+        use_hmap=use_hmap,
     )
 
 
@@ -361,19 +466,54 @@ def nat_reply_restore(sessions: NatSessions, batch: PacketBatch) -> ReplyRestore
     return ReplyRestore(batch=restored, reply_hit=reply_hit, reply_slot=slot)
 
 
-def nat_rewrite_stateless(tables: NatTables, batch: PacketBatch) -> StatelessRewrite:
-    """DNAT LB + twice-NAT + SNAT on the given headers — no session
-    reads, so the scan dispatch computes this flat over all vectors at
-    once (MXU/VPU-efficient wide shapes, Pallas-eligible batch sizes)."""
-    # --------------------------------------------------------- 1. DNAT LB
+def _dnat_lookup_hash(tables: NatTables, batch: PacketBatch) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(dnat_hit bool [B], mapping index int32 [B]) via the exact-match
+    index: W gathers per packet instead of an O(M) compare.  Bit-equal
+    to :func:`_dnat_lookup_dense` (A/B-tested)."""
+    cap = tables.hmap_idx.shape[0]
+    kh = _map_key_hash(batch.dst_ip, batch.dst_port, batch.protocol)
+    base = (kh & jnp.uint32(cap - 1)).astype(jnp.int32)
+    cand = (
+        base[:, None] + jnp.arange(MAP_PROBE_WAYS, dtype=jnp.int32)[None, :]
+    ) & jnp.int32(cap - 1)                      # [B, W]
+    midx_c = tables.hmap_idx[cand]              # [B, W] (-1 = empty)
+    safe = jnp.maximum(midx_c, 0)
+    ok = (
+        (midx_c >= 0)
+        & (tables.map_ext_ip[safe] == batch.dst_ip[:, None])
+        & (tables.map_ext_port[safe] == batch.dst_port[:, None])
+        & (tables.map_proto[safe] == batch.protocol[:, None])
+    )
+    dnat_hit = jnp.any(ok, axis=1)
+    w = jnp.argmax(ok, axis=1)
+    midx = jnp.take_along_axis(safe, w[:, None], axis=1)[:, 0]
+    # Miss rows must still index in-range (masked downstream); argmax
+    # over all-False picks way 0 whose `safe` is already >= 0.
+    return dnat_hit, jnp.where(dnat_hit, midx, jnp.int32(0))
+
+
+def _dnat_lookup_dense(tables: NatTables, batch: PacketBatch) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Reference O(B·M) lookup, kept for A/B parity testing."""
     hit = (
         tables.map_valid[None, :]
         & (batch.dst_ip[:, None] == tables.map_ext_ip[None, :])
         & (batch.dst_port[:, None] == tables.map_ext_port[None, :])
         & (batch.protocol[:, None] == tables.map_proto[None, :])
     )  # [B, M]
-    dnat_hit = jnp.any(hit, axis=1)
-    midx = jnp.argmax(hit, axis=1)
+    return jnp.any(hit, axis=1), jnp.argmax(hit, axis=1)
+
+
+def nat_rewrite_stateless(tables: NatTables, batch: PacketBatch) -> StatelessRewrite:
+    """DNAT LB + twice-NAT + SNAT on the given headers — no session
+    reads, so the scan dispatch computes this flat over all vectors at
+    once (MXU/VPU-efficient wide shapes, Pallas-eligible batch sizes)."""
+    # --------------------------------------------------------- 1. DNAT LB
+    # use_hmap is pytree aux data, so this branch resolves at trace
+    # time — the compiled program contains exactly one lookup.
+    if tables.use_hmap:
+        dnat_hit, midx = _dnat_lookup_hash(tables, batch)
+    else:
+        dnat_hit, midx = _dnat_lookup_dense(tables, batch)
 
     # Backend pick: affinity hashes the client IP only, else full 5-tuple.
     h_full = flow_hash(batch.src_ip, batch.dst_ip, batch.protocol,

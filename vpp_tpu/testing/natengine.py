@@ -23,18 +23,9 @@ from ..ops.nat import (
     PROBE_WAYS,
     TWICE_NAT_ENABLED,
     TWICE_NAT_SELF,
+    _mix_py as _mix,
 )
 from ..ops.packets import ip_to_u32, u32_to_ip
-
-
-def _mix(h: int) -> int:
-    h &= 0xFFFFFFFF
-    h ^= h >> 16
-    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
-    h ^= h >> 13
-    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
-    h ^= h >> 16
-    return h
 
 
 def flow_hash_py(src_ip: int, dst_ip: int, proto: int, src_port: int, dst_port: int) -> int:
