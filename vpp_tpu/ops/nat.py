@@ -65,6 +65,16 @@ PROBE_WAYS = 4
 # lookup is always exactly W gathers.
 MAP_PROBE_WAYS = 4
 
+# TPU crossover for the lookup discipline, measured on v5e through the
+# chained config-5 pipeline (64x256 scan dispatch, B=16384): the dense
+# [B, M] compare FUSES into a VPU-friendly reduce and beats the 4-way
+# gather probe up to at least M=8192 (hash 107us vs dense 97us p50 at
+# M=1024; dead even at 8192), because random gathers are the TPU
+# anti-pattern while regular compares are nearly free.  Past this the
+# dense compare's O(B*M) work dominates and the hash takes over.  On
+# CPU/GPU backends gathers are cheap and the hash wins at any size.
+HMAP_MIN_MAPPINGS_TPU = 8192
+
 
 @dataclass
 class NatMapping:
@@ -114,10 +124,15 @@ class NatTables:
 
     num_mappings: int = 0
     bucket_size: int = 0
-    # Static (trace-time) lookup discipline: False only when the hash
-    # build hit its growth bound (> MAP_PROBE_WAYS mapping keys sharing
-    # one full 32-bit hash — constructible by an adversary since the
-    # hash is unseeded), in which case the dense compare serves lookups.
+    # Static (trace-time) lookup discipline.  False in two cases:
+    # (a) TPU backend with a padded mapping width at or below the
+    #     measured crossover (HMAP_MIN_MAPPINGS_TPU) — the fused dense
+    #     compare beats gather probes there; hmap_idx is still built so
+    #     A/B tests and a ``dataclasses.replace`` re-enable keep working;
+    # (b) the hash build hit its growth bound (> MAP_PROBE_WAYS mapping
+    #     keys sharing one full 32-bit hash — constructible by an
+    #     adversary since the hash is unseeded); only then is hmap_idx
+    #     a 16-entry stub and the dense path the sole correct lookup.
     use_hmap: bool = True
 
     def tree_flatten(self):
@@ -319,17 +334,26 @@ def build_nat_tables(
     mask = (0xFFFFFFFF << (32 - net.prefixlen)) & 0xFFFFFFFF if net.prefixlen else 0
 
     # Only valid mappings enter the exact-match index (invalid rows can
-    # never hit the dense compare either); size for ~50% max load.
+    # never hit the dense compare either); size for ~50% max load on
+    # the VALID count so mostly-invalid mapping lists don't inflate it.
+    n_valid = int(valid.sum())
     hmap = _build_map_hash(
         [
             (i, (int(ext_ip[i]), int(ext_port[i]), int(proto[i])))
             for i in range(m) if valid[i]
         ],
-        start_capacity=_next_pow2(max(2 * m, 8), minimum=16),
+        start_capacity=_next_pow2(max(2 * n_valid, 8), minimum=16),
     )
-    use_hmap = hmap is not None
     if hmap is None:  # adversarial hash-collision set: dense fallback
         hmap = np.full(16, -1, dtype=np.int32)
+        use_hmap = False
+    elif jax.default_backend() == "tpu":
+        # Measured crossover (HMAP_MIN_MAPPINGS_TPU).  Gate on the
+        # PADDED width — that, not the valid count, is what the dense
+        # [B, M] compare streams.
+        use_hmap = padded > HMAP_MIN_MAPPINGS_TPU
+    else:
+        use_hmap = True
 
     return NatTables(
         map_ext_ip=jnp.asarray(ext_ip),
