@@ -44,11 +44,13 @@ def _net(cidr):
     return ipaddress.ip_network(cidr, strict=False)
 
 
-def _measure(acl, nat, route, batch, iters, rounds=3):
+def _measure(acl, nat, route, batch, iters, rounds=3, step=None):
     """Steady-state pipelined Mpps for one pipeline config, using the
     production dispatch discipline (datapath/runner.py): the flat batch
-    is split into 256-packet vectors and scanned on device, sessions
-    threading vector-to-vector.  Returns (best_mpps, flat_result).
+    is split into 256-packet vectors and dispatched with the flat-safe
+    discipline (batch-parallel with post-commit same-dispatch-reply
+    reconciliation; pass ``step=pipeline_scan_jit`` for the sequential
+    scan).  Returns (best_mpps, flat_result).
 
     Best-of-``rounds``: the shared-TPU tunnel shows high run-to-run
     variance, and the max is the honest estimate of what the pipeline
@@ -58,15 +60,17 @@ def _measure(acl, nat, route, batch, iters, rounds=3):
     from vpp_tpu.ops.pipeline import (
         VECTOR_SIZE,
         flatten_scan_result,
-        pipeline_scan_jit,
+        pipeline_flat_safe_jit,
     )
 
+    if step is None:
+        step = pipeline_flat_safe_jit
     n = batch.src_ip.shape[0]
     assert n % VECTOR_SIZE == 0, "bench batches must be vector multiples"
     k = n // VECTOR_SIZE
     batches = jax.tree_util.tree_map(lambda a: a.reshape(k, VECTOR_SIZE), batch)
     sessions = empty_sessions(1 << 16)
-    result = pipeline_scan_jit(
+    result = step(
         acl, nat, route, sessions, batches, jnp.arange(k, dtype=jnp.int32)
     )
     result.allowed.block_until_ready()
@@ -78,7 +82,7 @@ def _measure(acl, nat, route, batch, iters, rounds=3):
         for _ in range(iters):
             tss = jnp.arange(ts, ts + k, dtype=jnp.int32)
             ts += k
-            result = pipeline_scan_jit(acl, nat, route, sessions, batches, tss)
+            result = step(acl, nat, route, sessions, batches, tss)
             sessions = result.sessions
         result.allowed.block_until_ready()
         dt = (time.perf_counter() - t0) / iters
@@ -244,7 +248,9 @@ def sweep(iters):
     through per-dispatch host round-trips."""
     import jax
 
-    from vpp_tpu.ops.pipeline import VECTOR_SIZE, pipeline_scan_jit
+    from vpp_tpu.ops.pipeline import (
+        VECTOR_SIZE, pipeline_flat_safe_jit, pipeline_scan_jit,
+    )
 
     acl, nat, route, _, pod_ips, mappings = bench.build_stress_state()
     for n in (256, 1024, 4096, 16384, 65536):
@@ -283,6 +289,8 @@ def sweep(iters):
                 sessions = r.sessions
             r.allowed.block_until_ready()
             scan_best = max(scan_best, n / ((time.perf_counter() - t0) / it) / 1e6)
+        # Flat-safe dispatch (production): batch-parallel + reconcile.
+        safe_best, _ = _measure(acl, nat, route, batch, it)
         print(
             json.dumps(
                 {
@@ -291,6 +299,7 @@ def sweep(iters):
                     "vectors": k,
                     "flat_mpps": round(flat_best, 2),
                     "scan_mpps": round(scan_best, 2),
+                    "safe_mpps": round(safe_best, 2),
                 }
             ),
             flush=True,
@@ -314,7 +323,9 @@ def latency(iters):
     runner's production max_vectors default is chosen from data."""
     import jax
 
-    from vpp_tpu.ops.pipeline import VECTOR_SIZE, pipeline_scan_jit
+    from vpp_tpu.ops.pipeline import (
+        VECTOR_SIZE, pipeline_flat_safe_jit, pipeline_scan_jit,
+    )
 
     acl, nat, route, _, pod_ips, mappings = bench.build_stress_state()
     n_lat_samples = max(100, min(300, iters * 2))  # p99 needs >=100
@@ -322,7 +333,7 @@ def latency(iters):
         batch = bench.build_traffic(pod_ips, mappings, n)
         k = n // VECTOR_SIZE
         batches = jax.tree_util.tree_map(lambda a: a.reshape(k, VECTOR_SIZE), batch)
-        for disc in ("flat", "scan"):
+        for disc in ("flat", "scan", "flat-safe"):
             sessions = empty_sessions(1 << 16)
             ts = 0
 
@@ -335,7 +346,9 @@ def latency(iters):
                 else:
                     tss = jnp.arange(ts, ts + k, dtype=jnp.int32)
                     ts += k
-                    r = pipeline_scan_jit(acl, nat, route, sessions, batches, tss)
+                    step = (pipeline_flat_safe_jit if disc == "flat-safe"
+                            else pipeline_scan_jit)
+                    r = step(acl, nat, route, sessions, batches, tss)
                 sessions = r.sessions
                 return r.allowed
 
@@ -422,9 +435,13 @@ def scale(iters):
             flush=True,
         )
 
-    # Production dispatch (64x256 vector scan; dense in-vector classify —
-    # pallas is gated to wide batches where it measures faster).
+    # Production dispatch (flat-safe: batch-parallel + reconcile) and
+    # the sequential vector-scan for comparison.
     mpps, _ = _measure(acl, nat, route, batch, iters)
+    report("flat-safe", mpps)
+    from vpp_tpu.ops.pipeline import pipeline_scan_jit
+
+    mpps, _ = _measure(acl, nat, route, batch, iters, step=pipeline_scan_jit)
     report("vector-scan", mpps)
 
     # Wide flat dispatch: pallas vs dense A/B at [16384, 64k].

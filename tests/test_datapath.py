@@ -577,3 +577,56 @@ def test_afpacket_loopback_roundtrip():
     finally:
         tx.close()
         rx.close()
+
+
+def test_flat_safe_dispatch_restores_same_vector_replies(cluster):
+    """dispatch="flat-safe": forwards and their replies packed into the
+    SAME 16-packet vector of one dispatch.  The scan discipline cannot
+    restore these (a vector's restore probe sees only the pre-vector
+    table, and the host slow path only knows host-recorded sessions);
+    the flat-safe post-commit re-probe restores them on device."""
+    n1 = cluster.add_node("node-1")
+    client_ip = cluster.deploy_pod("node-1", "client")
+    backend_ip = cluster.deploy_pod("node-1", "web-1", labels=WEB_LABELS)
+    cluster.apply_service({
+        "metadata": {"name": "web", "namespace": "default"},
+        "spec": {"clusterIP": "10.96.0.10", "selector": WEB_LABELS,
+                 "ports": [{"name": "http", "protocol": "TCP", "port": 80,
+                            "targetPort": 8080}]},
+    })
+    cluster.apply_endpoints({
+        "metadata": {"name": "web", "namespace": "default"},
+        "subsets": [{
+            "addresses": [{"ip": backend_ip, "nodeName": "node-1",
+                           "targetRef": {"kind": "Pod", "name": "web-1",
+                                          "namespace": "default"}}],
+            "ports": [{"name": "http", "port": 8080, "protocol": "TCP"}],
+        }],
+    })
+    assert wait_for(lambda: len(n1.nat_renderer.mappings()) > 0)
+
+    fn = cluster.frame_nodes["node-1"]
+    fn.runner.batch_size = 16
+    fn.runner.max_vectors = 2
+    fn.runner.dispatch = "flat-safe"
+
+    # fwd/reply pairs interleaved: every reply shares a vector with its
+    # forward (8 pairs = 16 frames = exactly one vector).
+    frames = []
+    for i in range(8):
+        frames.append(build_frame(client_ip, "10.96.0.10", 6, 41000 + i, 80))
+        frames.append(build_frame(backend_ip, client_ip, 6, 8080, 41000 + i))
+    cluster.inject("node-1", frames)
+    cluster.run_datapaths()
+
+    out = cluster.delivered_frames("node-1")
+    assert len(out) == 16
+    got = [frame_tuple(f) for f in out]
+    for i in range(8):
+        assert (client_ip, backend_ip, 6, 41000 + i, 8080) in got
+        assert ("10.96.0.10", client_ip, 6, 80, 41000 + i) in got
+    for f in out:
+        assert verify_checksums(f)
+    # Restored ON DEVICE: no host restores, no punts.
+    assert fn.runner.counters.host_restores == 0
+    assert fn.runner.metrics()["slowpath_punts_total"] == 0

@@ -237,3 +237,209 @@ def test_scan_matches_sequential_steps():
         np.asarray(sessions.r_src_ip), np.asarray(scanned.sessions.r_src_ip)
     )
     assert bool(np.asarray(scanned.reply_hit).any())
+
+
+# ---------------------------------------------------------------------------
+# flat-safe discipline: flat-parallel dispatch with the scan's
+# same-dispatch reply semantics recovered by post-commit re-probes
+# ---------------------------------------------------------------------------
+
+
+def _flat_leaves(res):
+    """Flatten a [K, V] PipelineResult to comparable [B] numpy leaves."""
+    import jax
+
+    def f(a):
+        return np.asarray(a).reshape(-1)
+
+    return {
+        "src_ip": f(res.batch.src_ip), "dst_ip": f(res.batch.dst_ip),
+        "src_port": f(res.batch.src_port), "dst_port": f(res.batch.dst_port),
+        "allowed": f(res.allowed), "route": f(res.route),
+        "node_id": f(res.node_id), "dnat": f(res.dnat_hit),
+        "snat": f(res.snat_hit), "reply": f(res.reply_hit), "punt": f(res.punt),
+    }
+
+
+def _assert_results_equal(a, b, skip=()):
+    for key, arr in _flat_leaves(a).items():
+        if key in skip:
+            continue
+        np.testing.assert_array_equal(arr, _flat_leaves(b)[key], err_msg=key)
+
+
+def test_flat_safe_matches_scan_with_cross_vector_replies():
+    """Traffic where every reply's forward sits in an EARLIER vector of
+    the same dispatch (the orderings the scan itself restores): flat-
+    safe must be bit-identical to the scan, including the final session
+    table.  (Same-vector and reply-before-forward orderings — where
+    flat-safe restores a strict superset — are covered by the next
+    test.)"""
+    import jax
+
+    from vpp_tpu.ops.pipeline import (
+        VECTOR_SIZE, pipeline_flat_safe, pipeline_scan,
+    )
+
+    mapping = NatMapping("10.96.0.10", 80, 6, [("10.1.1.2", 8080, 1)])
+    _, pods, acl, nat, route = build_world(mappings=[mapping])
+    k = 4
+    flows = []
+    for i in range(VECTOR_SIZE):  # vector 0: service forwards
+        flows.append(("10.1.1.3", "10.96.0.10", 6, 1000 + i, 80))
+    for i in range(VECTOR_SIZE):  # vector 1: their replies
+        flows.append(("10.1.1.2", "10.1.1.3", 6, 8080, 1000 + i))
+    for i in range(VECTOR_SIZE):  # vector 2: pod-to-pod
+        flows.append((f"10.1.1.{2 + i % 4}", f"10.1.1.{2 + (i + 1) % 4}", 6, 2000 + i, 8080))
+    for i in range(VECTOR_SIZE):  # vector 3: replies (even) + new fwds (odd)
+        if i % 2 == 0:
+            flows.append(("10.1.1.2", "10.1.1.3", 6, 8080, 1000 + i))
+        else:
+            flows.append(("10.1.1.3", "10.96.0.10", 6, 3000 + i, 80))
+    flat = make_batch(flows)
+    batches = jax.tree_util.tree_map(lambda a: a.reshape(k, VECTOR_SIZE), flat)
+    ts = jnp.arange(1, k + 1, dtype=jnp.int32)
+
+    # Over-provisioned capacity so no two of the 384 inserts race on a
+    # slot: the flat batch-wide commit punts a strict superset of the
+    # scan's per-vector commits when slots contend (vector-0 and
+    # vector-3 forwards racing a slot the scan fills temporally), which
+    # is conservative-but-not-bit-equal; with no contention the two
+    # disciplines must agree exactly.
+    scanned = pipeline_scan(acl, nat, route, empty_sessions(1 << 20), batches, ts)
+    safe = pipeline_flat_safe(acl, nat, route, empty_sessions(1 << 20), batches, ts)
+
+    _assert_results_equal(scanned, safe)
+    for field in ("valid", "r_src_ip", "r_dst_ip", "r_src_port", "r_dst_port",
+                  "orig_src_ip", "orig_dst_ip", "last_seen"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(scanned.sessions, field)),
+            np.asarray(getattr(safe.sessions, field)), err_msg=field)
+    assert bool(np.asarray(safe.reply_hit).any())
+
+
+def test_flat_safe_restores_same_vector_and_preceding_replies():
+    """A reply in the SAME vector as its forward (scan restores it one
+    vector too late -> next dispatch) and a reply BEFORE its forward:
+    flat-safe restores both within the dispatch, with exactly the
+    headers a later-dispatch restore would produce."""
+    import jax
+
+    from vpp_tpu.ops.pipeline import pipeline_flat_safe, pipeline_step
+
+    mapping = NatMapping("10.96.0.10", 80, 6, [("10.1.1.2", 8080, 1)])
+    _, pods, acl, nat, route = build_world(mappings=[mapping])
+
+    fwd = ("10.1.1.3", "10.96.0.10", 6, 41000, 80)
+    reply = ("10.1.1.2", "10.1.1.3", 6, 8080, 41000)
+    filler = ("10.1.1.4", "10.1.1.5", 6, 2000, 8080)
+
+    # Reference: forward dispatched first, reply in a LATER dispatch.
+    r1 = pipeline_step(acl, nat, route, empty_sessions(1024), make_batch([fwd]), jnp.int32(1))
+    r2 = pipeline_step(acl, nat, route, r1.sessions, make_batch([reply]), jnp.int32(2))
+    ref_src = u32_to_ip(int(r2.batch.src_ip[0]))
+    ref_dst = u32_to_ip(int(r2.batch.dst_ip[0]))
+    assert bool(r2.reply_hit[0]) and ref_src == "10.96.0.10"
+
+    # Same vector: [fwd, reply] side by side in vector 0.
+    flows = [fwd, reply, filler, filler]
+    batches = jax.tree_util.tree_map(
+        lambda a: a.reshape(2, 2), make_batch(flows))
+    res = pipeline_flat_safe(acl, nat, route, empty_sessions(1024), batches,
+                             jnp.arange(1, 3, dtype=jnp.int32))
+    leaves = _flat_leaves(res)
+    assert bool(leaves["reply"][1])
+    assert u32_to_ip(int(leaves["src_ip"][1])) == ref_src
+    assert u32_to_ip(int(leaves["dst_ip"][1])) == ref_dst
+    assert not bool(leaves["punt"][1])
+    assert int(leaves["route"][1]) == ROUTE_LOCAL
+
+    # Reply BEFORE forward (vector 0 reply, vector 1 forward).
+    flows = [reply, filler, fwd, filler]
+    batches = jax.tree_util.tree_map(
+        lambda a: a.reshape(2, 2), make_batch(flows))
+    res = pipeline_flat_safe(acl, nat, route, empty_sessions(1024), batches,
+                             jnp.arange(1, 3, dtype=jnp.int32))
+    leaves = _flat_leaves(res)
+    assert bool(leaves["reply"][0])
+    assert u32_to_ip(int(leaves["src_ip"][0])) == ref_src
+    assert u32_to_ip(int(leaves["dst_ip"][0])) == ref_dst
+
+
+def test_flat_safe_undoes_bogus_reply_session():
+    """A same-dispatch reply whose destination is ITSELF a service VIP
+    (client IP doubles as a mapping) dnat-hits in pass 1 and commits a
+    bogus forward session; flat-safe must undo exactly that entry,
+    restore the reply, and finish with the same session table the scan
+    produces."""
+    import jax
+
+    from vpp_tpu.ops.pipeline import pipeline_flat_safe, pipeline_scan
+
+    # client 10.1.1.3:41000 -> VIP; its own IP:41000 is another VIP.
+    maps = [
+        NatMapping("10.96.0.10", 80, 6, [("10.1.1.2", 8080, 1)]),
+        NatMapping("10.1.1.3", 41000, 6, [("10.1.1.5", 9090, 1)]),
+    ]
+    _, pods, acl, nat, route = build_world(mappings=maps)
+    fwd = ("10.1.1.3", "10.96.0.10", 6, 41000, 80)
+    reply = ("10.1.1.2", "10.1.1.3", 6, 8080, 41000)  # dnat-hits VIP2!
+    filler = ("10.1.1.4", "10.1.1.5", 6, 2000, 8080)
+    flows = [fwd, filler, reply, filler]
+    batches = jax.tree_util.tree_map(
+        lambda a: a.reshape(2, 2), make_batch(flows))
+    ts = jnp.arange(1, 3, dtype=jnp.int32)
+
+    scanned = pipeline_scan(acl, nat, route, empty_sessions(1024), batches, ts)
+    safe = pipeline_flat_safe(acl, nat, route, empty_sessions(1024), batches, ts)
+    leaves = _flat_leaves(safe)
+    assert bool(leaves["reply"][2])          # restored, not treated as DNAT
+    assert not bool(leaves["dnat"][2])
+    assert u32_to_ip(int(leaves["src_ip"][2])) == "10.96.0.10"
+    _assert_results_equal(scanned, safe)
+    # The bogus session (reply translated to backend 10.1.1.5:9090) must
+    # be dead: same live slots as the scan's table.  The undo flips
+    # `valid` only — the tombstoned payload may linger, so compare the
+    # key fields masked by liveness.
+    sv = np.asarray(scanned.sessions.valid)
+    fv = np.asarray(safe.sessions.valid)
+    np.testing.assert_array_equal(sv, fv)
+    np.testing.assert_array_equal(
+        np.asarray(scanned.sessions.r_src_ip) * sv,
+        np.asarray(safe.sessions.r_src_ip) * fv)
+
+
+def test_flat_safe_cross_aliased_bogus_sessions_punt():
+    """Adversarial corner: two crafted twice-NAT flows whose bogus
+    sessions alias EACH OTHER's original tuples.  Neither has a real
+    forward session; flat-safe must undo both bogus entries and punt
+    both rows (host slow path takes over) rather than restore either
+    from a bogus entry."""
+    import jax
+
+    from vpp_tpu.ops.nat import TWICE_NAT_ENABLED
+    from vpp_tpu.ops.pipeline import pipeline_flat_safe
+
+    ipam = IPAM(IPAMConfig(), node_id=1)
+    loopback = str(ipam.nat_loopback_ip())
+    maps = [
+        NatMapping(loopback, 80, 6, [("10.1.1.9", 80, 1)],
+                   twice_nat=TWICE_NAT_ENABLED),
+        NatMapping(loopback, 81, 6, [("10.1.1.8", 81, 1)],
+                   twice_nat=TWICE_NAT_ENABLED),
+    ]
+    _, pods, acl, nat, route = build_world(mappings=maps)
+    # R1 = (C1:81 -> L:80) with C1 = mapping2's backend; R2 = (B_A:80 -> L:81).
+    r1 = ("10.1.1.8", loopback, 6, 81, 80)
+    r2 = ("10.1.1.9", loopback, 6, 80, 81)
+    filler = ("10.1.1.4", "10.1.1.5", 6, 2000, 8080)
+    flows = [r1, filler, r2, filler]
+    batches = jax.tree_util.tree_map(
+        lambda a: a.reshape(2, 2), make_batch(flows))
+    res = pipeline_flat_safe(acl, nat, route, empty_sessions(1024), batches,
+                             jnp.arange(1, 3, dtype=jnp.int32))
+    leaves = _flat_leaves(res)
+    assert bool(leaves["punt"][0]) and bool(leaves["punt"][2])
+    assert not bool(leaves["reply"][0]) and not bool(leaves["reply"][2])
+    # Neither bogus session survives.
+    assert int(np.asarray(res.sessions.valid).sum()) == 0

@@ -180,6 +180,7 @@ class Agent:
             source=rx, tx=tx, local=local, host=host,
             batch_size=self.config.batch_size,
             max_vectors=self.config.max_vectors,
+            dispatch=self.config.dispatch,
         )
         # Hook FIRST, then pull whatever the renderers have already
         # compiled — a table compiled in between fires the hook, so no

@@ -120,6 +120,10 @@ class NetworkConfig:
     # Vectors the datapath runner may coalesce into one device program
     # (pow2-floored; sessions thread vector-to-vector on device).
     max_vectors: int = 64
+    # Multi-vector dispatch discipline: "scan" (sequential session
+    # semantics via lax.scan) or "flat-safe" (batch-parallel with
+    # post-commit reply reconciliation; see ops/pipeline.py).
+    dispatch: str = "flat-safe"
 
     @classmethod
     def from_dict(cls, data: Optional[dict]) -> "NetworkConfig":
@@ -134,6 +138,7 @@ class NetworkConfig:
             routing=RoutingConfig(**data.get("routing", {})),
             batch_size=data.get("batch_size", 256),
             max_vectors=data.get("max_vectors", 64),
+            dispatch=data.get("dispatch", "flat-safe"),
         )
 
     def overlay(self, **kw) -> "NetworkConfig":

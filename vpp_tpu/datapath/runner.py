@@ -38,6 +38,7 @@ from ..ops.pipeline import (
     VECTOR_SIZE,
     RouteConfig,
     flatten_scan_result,
+    pipeline_flat_safe_jit,
     pipeline_scan_jit,
     pipeline_step_jit,
 )
@@ -113,15 +114,15 @@ class DataplaneRunner:
         batch_size: int = 256,
         # Production coalesce default, chosen from BENCHLAT_r03 +
         # BENCHSWEEP_r03: K=64 (16384 pkts/dispatch) is the smallest
-        # power-of-two coalesce whose production (vector-scan) dispatch
-        # clears the 40 Mpps baseline (72.3 Mpps sustained), and its
-        # latency cost stays sub-millisecond — p50 dispatch latency is
-        # ~266 us (tunnel-round-trip dominated, nearly independent of
-        # size), so worst-case added latency at 40 Mpps offered load is
-        # fill (410 us) + dispatch (266 us) ~= 0.7 ms.  K=16 fills
-        # faster (102 us) but sustains only 11 Mpps; K=256 sustains
-        # 238 Mpps but its 1.6 ms fill at 40 Mpps (65 ms at 1 Mpps!)
-        # blows any latency budget at low load.
+        # power-of-two coalesce whose production dispatch clears the
+        # 40 Mpps baseline (flat-safe ~62, scan ~48-72 sustained), and
+        # its latency cost stays sub-millisecond — p50 dispatch latency
+        # is ~266 us (tunnel-round-trip dominated, nearly independent
+        # of size), so worst-case added latency at 40 Mpps offered load
+        # is fill (410 us) + dispatch (266 us) ~= 0.7 ms.  K=16 fills
+        # faster (102 us) but sustains a fraction of that; K=256
+        # sustains 200+ Mpps but its 1.6 ms fill at 40 Mpps (65 ms at
+        # 1 Mpps!) blows any latency budget at low load.
         max_vectors: int = 64,
         max_inflight: int = 2,
         session_capacity: int = 1 << 16,
@@ -131,6 +132,15 @@ class DataplaneRunner:
         engine: Optional[str] = None,
         mesh=None,
         partition_sessions: bool = False,
+        # Multi-vector dispatch discipline: "scan" threads sessions
+        # vector-to-vector with lax.scan (VPP's sequential-vector
+        # semantics on device); "flat-safe" runs every vector batch-
+        # parallel and recovers same-dispatch replies with post-commit
+        # re-probes (pipeline_flat_safe) — ~30% more throughput at the
+        # production coalesce, restores same-VECTOR replies the scan
+        # cannot, and punts crafted-aliasing corners to the host slow
+        # path instead of restoring them.
+        dispatch: str = "flat-safe",
     ):
         self.acl = acl
         self.nat = nat
@@ -149,6 +159,9 @@ class DataplaneRunner:
         # so the effective cap is the power-of-two floor of max_vectors
         # (enforced by the property setter).
         self.max_vectors = max_vectors
+        if dispatch not in ("scan", "flat-safe"):
+            raise ValueError(f"unknown dispatch discipline: {dispatch!r}")
+        self.dispatch = dispatch
         self.max_inflight = max(1, max_inflight)
         self.sweep_interval = sweep_interval
         self.sweep_max_age = sweep_max_age
@@ -316,7 +329,10 @@ class DataplaneRunner:
         timestamp and runs the periodic session sweep."""
         prev_ts = self._ts
         self._ts += k
-        if k == 1:
+        if k == 1 and self.dispatch != "flat-safe":
+            # flat-safe handles k==1 through its own path below: the
+            # plain flat step cannot restore a reply sharing its ONE
+            # vector with the forward flow; the re-probe pass can.
             if self.mesh is not None:
                 from ..parallel.mesh import shard_batch
 
@@ -334,10 +350,12 @@ class DataplaneRunner:
 
                 vectors = shard_batch(self.mesh, vectors)
             tss = jnp.arange(prev_ts + 1, prev_ts + 1 + k, dtype=jnp.int32)
+            step = (
+                pipeline_flat_safe_jit if self.dispatch == "flat-safe"
+                else pipeline_scan_jit
+            )
             result = flatten_scan_result(
-                pipeline_scan_jit(
-                    self.acl, self.nat, self.route, self.sessions, vectors, tss
-                )
+                step(self.acl, self.nat, self.route, self.sessions, vectors, tss)
             )
         # Chain the session state into the next dispatch WITHOUT
         # materialising — keeps the device busy back-to-back.

@@ -630,7 +630,21 @@ def nat_rewrite(
     )
 
 
-def nat_commit_sessions(
+class CommitResult(NamedTuple):
+    """Full output of the session-commit phase (``nat_commit_sessions``
+    returns the (sessions, punt) subset).  ``committed``/``ins_slot``
+    let the flat-safe discipline undo a same-dispatch reply's bogus
+    forward session: a committed row OWNS its slot's content (the
+    post-write verify proved its scatter won), so invalidating that
+    slot is race-free."""
+
+    sessions: NatSessions
+    punt: jnp.ndarray       # bool [B]
+    committed: jnp.ndarray  # bool [B] row's session write won and verified
+    ins_slot: jnp.ndarray   # int32 [B] slot written by committed rows
+
+
+def nat_commit_sessions_full(
     sessions: NatSessions,
     orig: PacketBatch,
     rewritten: PacketBatch,
@@ -638,7 +652,7 @@ def nat_commit_sessions(
     reply_hit: jnp.ndarray,
     reply_slot: jnp.ndarray,
     timestamp: jnp.ndarray,
-) -> Tuple[NatSessions, jnp.ndarray]:
+) -> CommitResult:
     """Scatter new sessions in and refresh reply keep-alives.
 
     ``record`` (bool [B]) marks flows allowed to create a session —
@@ -724,17 +738,40 @@ def nat_commit_sessions(
         & (new_sessions.orig_dst_ip[ins_slot] == orig.dst_ip)
         & (new_sessions.orig_dst_port[ins_slot] == orig.dst_port)
     )
-    punt = record & ~(can_insert & wrote)
+    committed = can_insert & wrote
+    punt = record & ~committed
 
     # Touch last_seen for reply hits too (keep-alive for the GC sweep).
+    # ``max``, not ``set``: several rows of one batch may touch the SAME
+    # slot with different per-row timestamps (flat-safe passes a ts
+    # vector), and duplicate-index scatter-set resolution order is
+    # undefined — max is monotone and order-independent.
     touch = jnp.where(reply_hit, reply_slot, drop_sentinel)
-    return (
-        dataclasses.replace(
+    return CommitResult(
+        sessions=dataclasses.replace(
             new_sessions,
-            last_seen=new_sessions.last_seen.at[touch].set(timestamp, mode="drop"),
+            last_seen=new_sessions.last_seen.at[touch].max(timestamp, mode="drop"),
         ),
-        punt,
+        punt=punt,
+        committed=committed,
+        ins_slot=ins_slot,
     )
+
+
+def nat_commit_sessions(
+    sessions: NatSessions,
+    orig: PacketBatch,
+    rewritten: PacketBatch,
+    record: jnp.ndarray,
+    reply_hit: jnp.ndarray,
+    reply_slot: jnp.ndarray,
+    timestamp: jnp.ndarray,
+) -> Tuple[NatSessions, jnp.ndarray]:
+    """(sessions, punt) view of :func:`nat_commit_sessions_full`."""
+    r = nat_commit_sessions_full(
+        sessions, orig, rewritten, record, reply_hit, reply_slot, timestamp
+    )
+    return r.sessions, r.punt
 
 
 def nat_step(
