@@ -463,6 +463,25 @@ class StatelessRewrite(NamedTuple):
     snat_hit: jnp.ndarray
 
 
+def nat_reply_probe(
+    sessions: NatSessions, batch: PacketBatch
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Key-match half of the reply probe: ``(key_match [B, W], cand
+    [B, W])`` — which probe slots hold each row's reply key (validity
+    included).  ``nat_reply_restore`` composes this with the restore-
+    value gathers; the flat-safe reconcile uses it alone where restored
+    headers aren't needed yet, and re-masks ``key_match`` with an
+    updated ``valid`` after the bogus-session undo (key fields never
+    change during a dispatch, only validity does)."""
+    cap = sessions.capacity
+    slot_mask = jnp.uint32(cap - 1)
+    rhash = flow_hash(batch.src_ip, batch.dst_ip, batch.protocol,
+                      batch.src_port, batch.dst_port)
+    base = (rhash & slot_mask).astype(jnp.int32)
+    cand = _probe_slots(base, cap)                       # [B, W]
+    return _reply_key_match(sessions, cand, batch), cand
+
+
 def nat_reply_restore(sessions: NatSessions, batch: PacketBatch) -> ReplyRestore:
     """Probe the session table for reply keys and restore originals.
 
@@ -470,12 +489,7 @@ def nat_reply_restore(sessions: NatSessions, batch: PacketBatch) -> ReplyRestore
     state — the scan dispatch keeps just this (plus the commit) inside
     ``lax.scan`` and hoists everything else flat across vectors.
     """
-    cap = sessions.capacity
-    slot_mask = jnp.uint32(cap - 1)
-    rhash = flow_hash(batch.src_ip, batch.dst_ip, batch.protocol, batch.src_port, batch.dst_port)
-    base = (rhash & slot_mask).astype(jnp.int32)
-    cand = _probe_slots(base, cap)                      # [B, W]
-    key_match = _reply_key_match(sessions, cand, batch)  # [B, W]
+    key_match, cand = nat_reply_probe(sessions, batch)   # [B, W] each
     reply_hit = jnp.any(key_match, axis=1)
     w = jnp.argmax(key_match, axis=1)
     slot = jnp.take_along_axis(cand, w[:, None], axis=1)[:, 0]

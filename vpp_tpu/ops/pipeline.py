@@ -39,6 +39,7 @@ from .nat import (
     combine_rewrite,
     nat_commit_sessions,
     nat_commit_sessions_full,
+    nat_reply_probe,
     nat_reply_restore,
     nat_rewrite,
     nat_rewrite_stateless,
@@ -343,9 +344,16 @@ def pipeline_flat_safe(
     )
 
     # ---- pass 2: straggler detection + bogus-session undo -----------
-    probe2 = nat_reply_restore(commit.sessions, flat)
-    own_write = commit.committed & (probe2.reply_slot == commit.ins_slot)
-    straggler = probe2.reply_hit & ~rw.reply_hit & ~own_write
+    # Key-match only — restored headers aren't needed until pass 3, and
+    # pass 3 reuses this key match (undo changes validity, never keys),
+    # so the reconcile costs ONE full probe + one validity re-gather
+    # instead of two full restore probes.
+    km2, cand2 = nat_reply_probe(commit.sessions, flat)
+    hit2 = jnp.any(km2, axis=1)
+    w2 = jnp.argmax(km2, axis=1)
+    slot2 = jnp.take_along_axis(cand2, w2[:, None], axis=1)[:, 0]
+    own_write = commit.committed & (slot2 == commit.ins_slot)
+    straggler = hit2 & ~rw.reply_hit & ~own_write
     cap_sentinel = jnp.int32(sessions.capacity)
     undo_slot = jnp.where(straggler & commit.committed, commit.ins_slot, cap_sentinel)
     sessions2 = _dc_replace(
@@ -354,9 +362,12 @@ def pipeline_flat_safe(
     )
 
     # ---- pass 3: restore stragglers against the cleaned table -------
-    probe3 = nat_reply_restore(sessions2, flat)
-    restored_now = straggler & probe3.reply_hit
-    touch = jnp.where(restored_now, probe3.reply_slot, cap_sentinel)
+    km3 = km2 & sessions2.valid[cand2]
+    hit3 = jnp.any(km3, axis=1)
+    w3 = jnp.argmax(km3, axis=1)
+    slot3 = jnp.take_along_axis(cand2, w3[:, None], axis=1)[:, 0]
+    restored_now = straggler & hit3
+    touch = jnp.where(restored_now, slot3, cap_sentinel)
     # max, not set: duplicate slots with differing per-row timestamps
     # (two restored replies to one session) scatter in undefined order.
     sessions3 = _dc_replace(
@@ -367,16 +378,18 @@ def pipeline_flat_safe(
     def merge(a, b):
         return jnp.where(restored_now, a, b)
 
+    # Restore mapping as in nat_reply_restore: src <- original dst
+    # (VIP), dst <- original src (client), ports likewise.
     final_batch = PacketBatch(
-        src_ip=merge(probe3.batch.src_ip, rw.batch.src_ip),
-        dst_ip=merge(probe3.batch.dst_ip, rw.batch.dst_ip),
+        src_ip=merge(sessions2.orig_dst_ip[slot3], rw.batch.src_ip),
+        dst_ip=merge(sessions2.orig_src_ip[slot3], rw.batch.dst_ip),
         protocol=flat.protocol,
-        src_port=merge(probe3.batch.src_port, rw.batch.src_port),
-        dst_port=merge(probe3.batch.dst_port, rw.batch.dst_port),
+        src_port=merge(sessions2.orig_dst_port[slot3], rw.batch.src_port),
+        dst_port=merge(sessions2.orig_src_port[slot3], rw.batch.dst_port),
     )
     reply_final = rw.reply_hit | restored_now
     allowed_final = allowed | restored_now
-    punt_final = (commit.punt & ~restored_now) | (straggler & ~probe3.reply_hit)
+    punt_final = (commit.punt & ~restored_now) | (straggler & ~hit3)
     tag, node_id = _route_tags(route, final_batch.dst_ip, allowed_final)
 
     def unflatten(a):
