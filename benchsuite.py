@@ -471,7 +471,7 @@ def main():
     parser.add_argument("--batch", type=int, default=16384)
     parser.add_argument("--iters", type=int, default=50)
     parser.add_argument("--sweep", action="store_true",
-                        help="Mpps vs dispatch size, flat vs vector-scan")
+                        help="Mpps vs dispatch size: flat / scan / flat-safe")
     parser.add_argument("--latency", action="store_true",
                         help="p50/p99 us per dispatch + coalesce-fill "
                              "delay at 1/10/40 Mpps offered load")

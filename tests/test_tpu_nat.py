@@ -590,3 +590,53 @@ def test_map_hash_build_survives_oversized_start_capacity():
     maps.append(NatMapping("10.96.0.2", 80, 6, backends=[("10.1.1.2", 8080, 1)]))
     tables = build_nat_tables(maps, pod_subnet="10.1.0.0/16")
     assert tables.use_hmap  # 1 valid entry, huge padded M: hash stays on
+
+
+def test_large_backend_set_all_receive_traffic():
+    """The reference's NAT44 caps a service at 256 backends receiving
+    traffic (CHANGELOG.md:13-14).  The ring auto-widens instead: with
+    300 backends every single one must be reachable, flow-sticky, and
+    bit-identical to the oracle's pick."""
+    backends = [(f"10.1.{i // 250 + 1}.{i % 250 + 2}", 8080, 1) for i in range(300)]
+    mapping = NatMapping("10.96.0.10", 80, 6, backends=backends)
+    tables = simple_tables(backends=backends)
+    assert tables.bucket_size == 512  # next_pow2(300)
+
+    engine = MockNatEngine(
+        nat_loopback="10.1.1.254", snat_ip="192.168.16.1", snat_enabled=True,
+        pod_subnet="10.1.0.0/16", session_capacity=1 << 16)
+    engine.set_mappings([mapping])
+
+    flows = [("10.2.0.9", CLUSTER_IP, 6, 1024 + i, 80) for i in range(4096)]
+    res = run_nat(tables, empty_sessions(1 << 16), flows)
+    got_ips = np.asarray(res.batch.dst_ip)
+    assert bool(np.asarray(res.dnat_hit).all())
+    # Oracle parity per flow + full coverage.
+    for i, fl in enumerate(flows):
+        oracle = engine.process(Flow.make(*fl), timestamp=0)
+        assert int(got_ips[i]) == oracle.flow.dst_ip, fl
+    backend_u32 = {ip_to_u32(ip) for ip, _, _ in backends}
+    assert set(int(x) for x in got_ips) == backend_u32  # all 300 hit
+
+
+def test_ring_cap_never_starves_backends():
+    """Weights past the 4096-slot ring cap downscale proportionally
+    with a one-slot floor: a 8000-weight elephant next to nine
+    weight-1 backends must not starve the small ones."""
+    from vpp_tpu.ops.nat import bucket_ring, effective_bucket_size
+
+    backends = [("10.1.1.2", 8080, 8000)] + [
+        (f"10.1.2.{i + 2}", 8080, 1) for i in range(9)
+    ]
+    mapping = NatMapping("10.96.0.10", 80, 6, backends=backends)
+    k = effective_bucket_size([mapping])
+    assert k == 4096
+    ring = bucket_ring(mapping, k)
+    ips = {ip for ip, _ in ring}
+    assert len(ips) == 10  # every backend holds at least one slot
+    # The elephant still dominates.
+    elephant = sum(1 for ip, _ in ring if ip == ip_to_u32("10.1.1.2"))
+    assert elephant > 3500
+
+    # Caller-supplied width above the cap is respected, not shrunk.
+    assert effective_bucket_size([mapping], bucket_size=8192) == 8192

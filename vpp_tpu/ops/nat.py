@@ -285,6 +285,54 @@ def _build_map_hash(
     return None
 
 
+def effective_bucket_size(mappings: Sequence[NatMapping], bucket_size: int = 64) -> int:
+    """Table-wide backend-ring width: auto-widened (pow2) to fit the
+    largest weighted-expanded backend list, capped at 4096 slots —
+    but never below the caller's width, and never below the largest
+    raw backend COUNT (so every backend keeps at least one slot even
+    when weights must be downscaled into the cap).
+    """
+    need = 0
+    n_max = 0
+    for mp in mappings:
+        if not mp.backends:
+            continue
+        need = max(need, sum(max(1, w) for _, _, w in mp.backends))
+        n_max = max(n_max, len(mp.backends))
+    k = bucket_size
+    if need > k:
+        k = max(k, _next_pow2(min(need, 4096)))
+    if n_max > k:
+        k = _next_pow2(n_max)
+    return k
+
+
+def bucket_ring(mapping: NatMapping, k_ring: int) -> List[Tuple[int, int]]:
+    """One mapping's backend ring [k_ring] of (ip_u32, port): weighted
+    round-robin, stride-sampled so every backend is represented in
+    proportion.  When the weighted expansion exceeds the ring, weights
+    are downscaled proportionally with a floor of one slot per backend
+    (k_ring >= backend count is the caller's contract — see
+    effective_bucket_size), so no backend is ever starved; weight
+    granularity coarsens instead.  Shared by build_nat_tables and the
+    MockNatEngine oracle so the two stay lockstep by construction."""
+    expanded: List[Tuple[int, int]] = []
+    for ip, port, weight in mapping.backends:
+        expanded.extend([(ip_to_u32(ip), port)] * max(1, weight))
+    if len(expanded) > k_ring:
+        # Scale into a budget of (k_ring - n) so the +1-per-backend
+        # floors can never overflow the ring.
+        total = len(expanded)
+        budget = k_ring - len(mapping.backends)
+        expanded = []
+        for ip, port, weight in mapping.backends:
+            scaled = max(1, (max(1, weight) * budget) // total)
+            expanded.extend([(ip_to_u32(ip), port)] * scaled)
+        assert len(expanded) <= k_ring
+    n = len(expanded)
+    return [expanded[(k * n) // k_ring] for k in range(k_ring)]
+
+
 def build_nat_tables(
     mappings: Sequence[NatMapping],
     nat_loopback: str = "0.0.0.0",
@@ -301,6 +349,11 @@ def build_nat_tables(
     """
     m = len(mappings)
     padded = _next_pow2(max(m, 1))
+    # Auto-widen the ring: a fixed width would silently drop backends
+    # past it.  The reference's NAT44 caps a service at 256 backends
+    # receiving traffic (CHANGELOG.md:13-14); here the ring grows with
+    # demand (see effective_bucket_size for the cap/guarantees).
+    bucket_size = effective_bucket_size(mappings, bucket_size)
     ext_ip = np.zeros(padded, dtype=np.uint32)
     ext_port = np.zeros(padded, dtype=np.int32)
     proto = np.zeros(padded, dtype=np.int32)
@@ -320,13 +373,7 @@ def build_nat_tables(
         if not mapping.backends:
             valid[i] = False
             continue
-        # Weighted ring fill: repeat each backend `weight` times, then
-        # tile the expanded list across the bucket.
-        expanded: List[Tuple[int, int]] = []
-        for ip, port, weight in mapping.backends:
-            expanded.extend([(ip_to_u32(ip), port)] * max(1, weight))
-        for k in range(bucket_size):
-            ip_u, port_u = expanded[k % len(expanded)]
+        for k, (ip_u, port_u) in enumerate(bucket_ring(mapping, bucket_size)):
             b_ip[i, k] = ip_u
             b_port[i, k] = port_u
 

@@ -24,6 +24,8 @@ from ..ops.nat import (
     TWICE_NAT_ENABLED,
     TWICE_NAT_SELF,
     _mix_py as _mix,
+    bucket_ring,
+    effective_bucket_size,
 )
 from ..ops.packets import ip_to_u32, u32_to_ip
 
@@ -81,6 +83,8 @@ class MockNatEngine:
         session_capacity: int = 65536,
     ):
         self.mappings: List[NatMapping] = []
+        self._k_ring = bucket_size
+        self._rings: List[Optional[List[Tuple[int, int]]]] = []
         self.nat_loopback = ip_to_u32(nat_loopback)
         self.snat_ip = ip_to_u32(snat_ip)
         self.snat_enabled = snat_enabled
@@ -94,6 +98,14 @@ class MockNatEngine:
 
     def set_mappings(self, mappings: Sequence[NatMapping]) -> None:
         self.mappings = list(mappings)
+        # Ring layout cached here — the only place mappings change —
+        # using the SAME helpers the compiled tables use (lockstep by
+        # construction, no per-flow rebuild).
+        self._k_ring = effective_bucket_size(self.mappings, self.bucket_size)
+        self._rings = [
+            bucket_ring(m, self._k_ring) if m.backends else None
+            for m in self.mappings
+        ]
 
     def has_static_mapping(self, external_ip: str, external_port: int, protocol: int) -> bool:
         ip = ip_to_u32(external_ip)
@@ -114,12 +126,6 @@ class MockNatEngine:
 
     # ------------------------------------------------------------- traffic
 
-    def _bucket_ring(self, mapping: NatMapping) -> List[Tuple[int, int]]:
-        expanded: List[Tuple[int, int]] = []
-        for ip, port, weight in mapping.backends:
-            expanded.extend([(ip_to_u32(ip), port)] * max(1, weight))
-        return [expanded[k % len(expanded)] for k in range(self.bucket_size)]
-
     def process(self, flow: Flow, timestamp: int = 0) -> FlowResult:
         """Mirror of nat_step for one flow: reply -> DNAT -> SNAT."""
         result = FlowResult(flow=Flow(*flow.key()))
@@ -139,7 +145,7 @@ class MockNatEngine:
         orig = flow.key()
 
         # 2. DNAT (first mapping wins, matching the kernel's argmax).
-        for mapping in self.mappings:
+        for mi, mapping in enumerate(self.mappings):
             if not mapping.backends:
                 continue
             if (
@@ -151,8 +157,8 @@ class MockNatEngine:
                     h = _mix((f.src_ip * 0x9E3779B1) & 0xFFFFFFFF)
                 else:
                     h = flow_hash_py(*f.key())
-                ring = self._bucket_ring(mapping)
-                b_ip, b_port = ring[h % self.bucket_size]
+                ring = self._rings[mi]
+                b_ip, b_port = ring[h % len(ring)]
                 hairpin = (
                     mapping.twice_nat == TWICE_NAT_ENABLED
                     or (mapping.twice_nat == TWICE_NAT_SELF and b_ip == f.src_ip)
