@@ -310,8 +310,8 @@ def test_flat_safe_matches_scan_with_cross_vector_replies():
     safe = pipeline_flat_safe(acl, nat, route, empty_sessions(1 << 20), batches, ts)
 
     _assert_results_equal(scanned, safe)
-    for field in ("valid", "r_src_ip", "r_dst_ip", "r_src_port", "r_dst_port",
-                  "orig_src_ip", "orig_dst_ip", "last_seen"):
+    for field in ("valid", "r_src_ip", "r_dst_ip", "r_ports",
+                  "orig_src_ip", "orig_dst_ip", "orig_ports", "last_seen"):
         np.testing.assert_array_equal(
             np.asarray(getattr(scanned.sessions, field)),
             np.asarray(getattr(safe.sessions, field)), err_msg=field)

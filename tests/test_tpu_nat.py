@@ -640,3 +640,18 @@ def test_ring_cap_never_starves_backends():
 
     # Caller-supplied width above the cap is respected, not shrunk.
     assert effective_bucket_size([mapping], bucket_size=8192) == 8192
+
+
+def test_protocol_zero_flow_punts_not_silently_lost():
+    """r_meta doubles as the validity flag, so a protocol-0 flow can
+    never own a device session (its write would be an invisible empty
+    slot).  It must PUNT to the host slow path — whose dict keys carry
+    proto 0 fine — rather than silently lose its session."""
+    from vpp_tpu.ops.nat import session_occupancy
+
+    tables = simple_tables()
+    res = run_nat(tables, empty_sessions(1024),
+                  [("10.1.1.9", "8.8.8.8", 0, 40000, 53)])
+    assert bool(res.snat_hit[0])      # translated (SNAT has no proto guard)
+    assert bool(res.punt[0])          # ...but the session goes to the host
+    assert session_occupancy(res.sessions) == 0

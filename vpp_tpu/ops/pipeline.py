@@ -358,11 +358,11 @@ def pipeline_flat_safe(
     undo_slot = jnp.where(straggler & commit.committed, commit.ins_slot, cap_sentinel)
     sessions2 = _dc_replace(
         commit.sessions,
-        valid=commit.sessions.valid.at[undo_slot].set(False, mode="drop"),
+        r_meta=commit.sessions.r_meta.at[undo_slot].set(jnp.int32(0), mode="drop"),
     )
 
     # ---- pass 3: restore stragglers against the cleaned table -------
-    km3 = km2 & sessions2.valid[cand2]
+    km3 = km2 & (sessions2.r_meta[cand2] > 0)
     hit3 = jnp.any(km3, axis=1)
     w3 = jnp.argmax(km3, axis=1)
     slot3 = jnp.take_along_axis(cand2, w3[:, None], axis=1)[:, 0]
@@ -379,13 +379,15 @@ def pipeline_flat_safe(
         return jnp.where(restored_now, a, b)
 
     # Restore mapping as in nat_reply_restore: src <- original dst
-    # (VIP), dst <- original src (client), ports likewise.
+    # (VIP), dst <- original src (client), ports likewise (unpacked
+    # from the single orig_ports word).
+    op3 = sessions2.orig_ports[slot3]
     final_batch = PacketBatch(
         src_ip=merge(sessions2.orig_dst_ip[slot3], rw.batch.src_ip),
         dst_ip=merge(sessions2.orig_src_ip[slot3], rw.batch.dst_ip),
         protocol=flat.protocol,
-        src_port=merge(sessions2.orig_dst_port[slot3], rw.batch.src_port),
-        dst_port=merge(sessions2.orig_src_port[slot3], rw.batch.dst_port),
+        src_port=merge((op3 & jnp.uint32(0xFFFF)).astype(jnp.int32), rw.batch.src_port),
+        dst_port=merge((op3 >> jnp.uint32(16)).astype(jnp.int32), rw.batch.dst_port),
     )
     reply_final = rw.reply_hit | restored_now
     allowed_final = allowed | restored_now
