@@ -354,6 +354,7 @@ def test_multi_vector_scan_dispatch(cluster):
     fn = cluster.frame_nodes["node-1"]
     fn.runner.batch_size = 8
     fn.runner.max_vectors = 4
+    fn.runner.dispatch = "scan"  # pin: the default is flat-safe now
 
     # 8 forward service flows fill vector 0; their replies land in
     # vectors 1-2 of the same 4-vector dispatch (session visibility
