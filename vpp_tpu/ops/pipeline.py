@@ -30,10 +30,13 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from dataclasses import replace as _dc_replace
-
 from .classify import RuleTables, _DENY, classify_dst, classify_src
 from .nat import (
+    _K_META,
+    _V_ODST,
+    _V_OPORTS,
+    _V_OSRC,
+    _V_SEEN,
     NatSessions,
     NatTables,
     combine_rewrite,
@@ -344,10 +347,10 @@ def pipeline_flat_safe(
     )
 
     # ---- pass 2: straggler detection + bogus-session undo -----------
-    # Key-match only — restored headers aren't needed until pass 3, and
-    # pass 3 reuses this key match (undo changes validity, never keys),
-    # so the reconcile costs ONE full probe + one validity re-gather
-    # instead of two full restore probes.
+    # One 16-byte key-row gather; pass 3 reuses the key match (the undo
+    # clears only a slot's meta column; keys never change mid-dispatch)
+    # plus a meta-column re-gather, and restore values are read at the
+    # single selected slot.
     km2, cand2 = nat_reply_probe(commit.sessions, flat)
     hit2 = jnp.any(km2, axis=1)
     w2 = jnp.argmax(km2, axis=1)
@@ -356,23 +359,28 @@ def pipeline_flat_safe(
     straggler = hit2 & ~rw.reply_hit & ~own_write
     cap_sentinel = jnp.int32(sessions.capacity)
     undo_slot = jnp.where(straggler & commit.committed, commit.ins_slot, cap_sentinel)
-    sessions2 = _dc_replace(
-        commit.sessions,
-        r_meta=commit.sessions.r_meta.at[undo_slot].set(jnp.int32(0), mode="drop"),
+    sessions2 = NatSessions(
+        key_tbl=commit.sessions.key_tbl.at[undo_slot, _K_META].set(
+            jnp.uint32(0), mode="drop"
+        ),
+        val_tbl=commit.sessions.val_tbl,
     )
 
     # ---- pass 3: restore stragglers against the cleaned table -------
-    km3 = km2 & (sessions2.r_meta[cand2] > 0)
+    km3 = km2 & (sessions2.key_tbl[cand2, _K_META] > 0)
     hit3 = jnp.any(km3, axis=1)
     w3 = jnp.argmax(km3, axis=1)
     slot3 = jnp.take_along_axis(cand2, w3[:, None], axis=1)[:, 0]
+    vals3 = sessions2.val_tbl[slot3]  # [B, 4]
     restored_now = straggler & hit3
     touch = jnp.where(restored_now, slot3, cap_sentinel)
     # max, not set: duplicate slots with differing per-row timestamps
     # (two restored replies to one session) scatter in undefined order.
-    sessions3 = _dc_replace(
-        sessions2,
-        last_seen=sessions2.last_seen.at[touch].max(ts_rows, mode="drop"),
+    sessions3 = NatSessions(
+        key_tbl=sessions2.key_tbl,
+        val_tbl=sessions2.val_tbl.at[touch, _V_SEEN].max(
+            ts_rows.astype(jnp.uint32), mode="drop"
+        ),
     )
 
     def merge(a, b):
@@ -380,11 +388,11 @@ def pipeline_flat_safe(
 
     # Restore mapping as in nat_reply_restore: src <- original dst
     # (VIP), dst <- original src (client), ports likewise (unpacked
-    # from the single orig_ports word).
-    op3 = sessions2.orig_ports[slot3]
+    # from the packed-ports word of the selected value row).
+    op3 = vals3[:, _V_OPORTS]
     final_batch = PacketBatch(
-        src_ip=merge(sessions2.orig_dst_ip[slot3], rw.batch.src_ip),
-        dst_ip=merge(sessions2.orig_src_ip[slot3], rw.batch.dst_ip),
+        src_ip=merge(vals3[:, _V_ODST], rw.batch.src_ip),
+        dst_ip=merge(vals3[:, _V_OSRC], rw.batch.dst_ip),
         protocol=flat.protocol,
         src_port=merge((op3 & jnp.uint32(0xFFFF)).astype(jnp.int32), rw.batch.src_port),
         dst_port=merge((op3 >> jnp.uint32(16)).astype(jnp.int32), rw.batch.dst_port),
