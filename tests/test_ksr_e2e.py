@@ -70,7 +70,8 @@ def test_k8s_to_tpu_verdicts():
                      "ingress": [{"ports": [{"protocol": "TCP", "port": 80}],
                                   "from": [{"podSelector":
                                             {"matchLabels": {"app": "web"}}}]}]}})
-        assert _wait(lambda: int(renderer.tables.rule_valid.sum()) > 0)
+        assert _wait(lambda: renderer.tables is not None
+                     and int(renderer.tables.rule_valid.sum()) > 0)
 
         batch = make_batch([
             ("10.1.1.2", "10.1.1.3", 6, 4444, 80),    # web -> web :80
@@ -82,7 +83,8 @@ def test_k8s_to_tpu_verdicts():
 
         # Policy withdrawn via the API -> traffic opens up.
         cluster.delete("networkpolicies", "web-isolate")
-        assert _wait(lambda: int(renderer.tables.rule_valid.sum()) == 0)
+        assert _wait(lambda: renderer.tables is not None
+                     and int(renderer.tables.rule_valid.sum()) == 0)
         allowed = [int(v) for v in classify(renderer.tables, batch).allowed]
         assert allowed == [1, 1, 1]
     finally:

@@ -655,3 +655,91 @@ def test_protocol_zero_flow_punts_not_silently_lost():
     assert bool(res.snat_hit[0])      # translated (SNAT has no proto guard)
     assert bool(res.punt[0])          # ...but the session goes to the host
     assert session_occupancy(res.sessions) == 0
+
+
+def test_packed_ports_mask_out_of_range_halves():
+    """Advisor r3: an out-of-range port in an int32 column must not
+    bleed into the other packed half — two distinct tuples would alias
+    one session key (false reply restore).  Both halves are masked."""
+    from vpp_tpu.ops.nat import _pack_ports
+
+    sp = jnp.asarray([40000, 40001], dtype=jnp.int32)
+    dp = jnp.asarray([80, 80 + (1 << 16)], dtype=jnp.int32)  # dp[1] overflows
+    packed = np.asarray(_pack_ports(sp, dp))
+    assert packed[0] == (40000 << 16) | 80
+    # The overflowed dst-port bit is masked off, NOT carried into the
+    # src-port half: the two keys stay distinct in the src half.
+    assert packed[1] == (40001 << 16) | 80
+    assert (packed[1] >> 16) == 40001
+
+
+def test_retarget_tables_rederives_lookup_gate():
+    """Advisor r3: the use_hmap crossover must follow the backend the
+    dispatch TARGETS, not the builder's process."""
+    from vpp_tpu.ops.nat import (
+        HMAP_MIN_MAPPINGS_TPU, retarget_tables,
+    )
+
+    tables = simple_tables()  # built on CPU in tests -> hash on
+    assert tables.use_hmap
+    # Shipped to a TPU worker: padded width (2) is far below the
+    # crossover, the dense compare must take over.
+    on_tpu = retarget_tables(tables, "tpu")
+    assert not on_tpu.use_hmap
+    # ...and back: CPU always probes the hash.
+    assert retarget_tables(on_tpu, "cpu").use_hmap
+    # Device arrays are untouched (aux-only change).
+    assert on_tpu.hmap_idx is tables.hmap_idx
+
+    # A dense-fallback stub (crafted full-hash collisions) must never
+    # be re-enabled, whatever the target.
+    from vpp_tpu.ops.nat import MAP_PROBE_WAYS, _map_key_hash_py
+
+    M = 1 << 32
+
+    def unmix(x):
+        x ^= x >> 16
+        x = (x * pow(0xC2B2AE35, -1, M)) % M
+        x ^= (x >> 13) ^ (x >> 26)
+        x = (x * pow(0x85EBCA6B, -1, M)) % M
+        x ^= x >> 16
+        return x
+
+    pre = unmix(0xDEADBEEF)
+    inv_golden = pow(0x9E3779B1, -1, M)
+    keys = [
+        (((pre ^ ((port << 16) | 6)) * inv_golden) % M, port, 6)
+        for port in range(80, 80 + MAP_PROBE_WAYS + 1)
+    ]
+    maps = [
+        NatMapping(u32_to_ip(ip), port, proto, backends=[("10.1.1.2", 8080, 1)])
+        for ip, port, proto in keys
+    ]
+    stub = build_nat_tables(maps, pod_subnet="10.1.0.0/16")
+    assert not stub.use_hmap
+    assert not retarget_tables(stub, "cpu").use_hmap
+
+
+def test_ring_widen_cap_is_configurable_and_logged(caplog):
+    """Advisor r3: table-wide ring widening is surfaced (logged) and
+    the 4096 cap is configurable."""
+    import logging
+
+    from vpp_tpu.ops.nat import effective_bucket_size
+
+    backends = [("10.1.1.2", 8080, 500), ("10.1.2.3", 8080, 1)]
+    mapping = NatMapping("10.96.0.10", 80, 6, backends=backends)
+    with caplog.at_level(logging.INFO, logger="vpp_tpu.ops.nat"):
+        k = effective_bucket_size([mapping], bucket_size=64)
+    assert k == 512  # next_pow2(501)
+    assert any("auto-widened" in r.message for r in caplog.records)
+    # Tighter cap honored (floors still guarantee one slot per backend).
+    assert effective_bucket_size([mapping], bucket_size=64, max_bucket_size=256) == 256
+    # No widening -> no log line.
+    caplog.clear()
+    with caplog.at_level(logging.INFO, logger="vpp_tpu.ops.nat"):
+        assert effective_bucket_size(
+            [NatMapping("10.96.0.10", 80, 6, backends=[("10.1.1.2", 8080, 1)])],
+            bucket_size=64,
+        ) == 64
+    assert not caplog.records

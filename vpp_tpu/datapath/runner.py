@@ -28,7 +28,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..ops.nat import NatSessions, NatTables, empty_sessions, session_occupancy, sweep_sessions
+from ..ops.nat import (
+    NatSessions, NatTables, empty_sessions, retarget_tables,
+    session_occupancy, sweep_sessions,
+)
 from ..ops.classify import RuleTables
 from ..ops.packets import PacketBatch
 from ..ops.pipeline import (
@@ -143,7 +146,12 @@ class DataplaneRunner:
         dispatch: str = "flat-safe",
     ):
         self.acl = acl
-        self.nat = nat
+        self.mesh = mesh
+        # The lookup-discipline gate (use_hmap) is derived from the
+        # backend the dispatch TARGETS, not the builder's process —
+        # tables built CPU-side and shipped to TPU workers (or vice
+        # versa) would otherwise keep the wrong crossover pick.
+        self.nat = retarget_tables(nat, self._target_backend())
         self.route = route
         self.overlay = overlay
         self.source = source
@@ -171,7 +179,6 @@ class DataplaneRunner:
         # batch over ``data``; sessions replicated or hash-partitioned)
         # and every dispatch runs GSPMD-sharded — SURVEY §5.8's ICI
         # scaling axis, driven by the SAME runner loop as single-chip.
-        self.mesh = mesh
         self.partition_sessions = partition_sessions
         self.sessions: NatSessions = empty_sessions(session_capacity)
         if mesh is not None:
@@ -257,6 +264,12 @@ class DataplaneRunner:
 
     # ------------------------------------------------------------- tables
 
+    def _target_backend(self) -> str:
+        """The JAX platform this runner's dispatches execute on."""
+        if self.mesh is not None:
+            return next(iter(self.mesh.devices.flat)).platform
+        return jax.default_backend()
+
     def _shard_state(self) -> None:
         """(Re-)place tables + sessions onto the mesh."""
         from ..parallel.mesh import shard_dataplane
@@ -278,7 +291,7 @@ class DataplaneRunner:
         if acl is not None:
             self.acl = acl
         if nat is not None:
-            self.nat = nat
+            self.nat = retarget_tables(nat, self._target_backend())
         if route is not None:
             self.route = route
         if self.mesh is not None and (

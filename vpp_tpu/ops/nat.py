@@ -37,7 +37,8 @@ XLA program.
 from __future__ import annotations
 
 import ipaddress
-from dataclasses import dataclass
+import logging
+from dataclasses import dataclass, replace as _dc_replace
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -46,6 +47,8 @@ import numpy as np
 
 from .classify import _next_pow2
 from .packets import PacketBatch, ip_to_u32
+
+logger = logging.getLogger(__name__)
 
 # Twice-NAT modes (nat44 DNat44_StaticMapping TwiceNat).
 TWICE_NAT_NONE = 0
@@ -255,10 +258,14 @@ def empty_sessions(capacity: int = 65536) -> NatSessions:
 
 
 def _pack_ports(src_port: jnp.ndarray, dst_port: jnp.ndarray) -> jnp.ndarray:
-    """(sp << 16) | dp as uint32 — one gather/scatter word per pair."""
+    """(sp & 0xFFFF) << 16 | (dp & 0xFFFF) as uint32 — one
+    gather/scatter word per pair.  Both halves are masked: ports ride
+    int32 batch columns and nothing clamps them on the Python/test
+    ingestion path, so an out-of-range value must not bleed into the
+    other half and alias two distinct tuples onto one packed key."""
     return (
-        (src_port.astype(jnp.uint32) << jnp.uint32(16))
-        | dst_port.astype(jnp.uint32)
+        ((src_port.astype(jnp.uint32) & jnp.uint32(0xFFFF)) << jnp.uint32(16))
+        | (dst_port.astype(jnp.uint32) & jnp.uint32(0xFFFF))
     )
 
 
@@ -334,12 +341,23 @@ def _build_map_hash(
     return None
 
 
-def effective_bucket_size(mappings: Sequence[NatMapping], bucket_size: int = 64) -> int:
+def effective_bucket_size(
+    mappings: Sequence[NatMapping],
+    bucket_size: int = 64,
+    max_bucket_size: int = 4096,
+) -> int:
     """Table-wide backend-ring width: auto-widened (pow2) to fit the
-    largest weighted-expanded backend list, capped at 4096 slots —
-    but never below the caller's width, and never below the largest
-    raw backend COUNT (so every backend keeps at least one slot even
-    when weights must be downscaled into the cap).
+    largest weighted-expanded backend list, capped at ``max_bucket_size``
+    slots — but never below the caller's width, and never below the
+    largest raw backend COUNT (so every backend keeps at least one slot
+    even when weights must be downscaled into the cap; a single mapping
+    with more than ``max_bucket_size`` backends therefore still exceeds
+    the cap via the one-slot-per-backend floor).
+
+    The widening is table-wide — one high-weight mapping inflates the
+    ``backend_ip``/``backend_port`` rows of EVERY mapping — so any
+    widening beyond the caller's width is logged with the resulting
+    footprint multiplier rather than growing silently (advisor r3).
     """
     need = 0
     n_max = 0
@@ -350,9 +368,16 @@ def effective_bucket_size(mappings: Sequence[NatMapping], bucket_size: int = 64)
         n_max = max(n_max, len(mp.backends))
     k = bucket_size
     if need > k:
-        k = max(k, _next_pow2(min(need, 4096)))
+        k = max(k, _next_pow2(min(need, max_bucket_size)))
     if n_max > k:
         k = _next_pow2(n_max)
+    if k > bucket_size:
+        logger.info(
+            "NAT backend ring auto-widened %d -> %d slots "
+            "(largest weighted expansion %d, largest backend count %d; "
+            "table-wide footprint x%d)",
+            bucket_size, k, need, n_max, max(1, k // max(1, bucket_size)),
+        )
     return k
 
 
@@ -382,6 +407,36 @@ def bucket_ring(mapping: NatMapping, k_ring: int) -> List[Tuple[int, int]]:
     return [expanded[(k * n) // k_ring] for k in range(k_ring)]
 
 
+def _pick_use_hmap(padded_width: int, target_backend: Optional[str]) -> bool:
+    """Lookup-discipline crossover for a given target backend.  On TPU
+    the dense [B, M] compare fuses on the VPU and beats gather probes
+    up to the measured HMAP_MIN_MAPPINGS_TPU padded width; gathers are
+    cheap everywhere else so the hash always wins there."""
+    backend = target_backend or jax.default_backend()
+    if backend == "tpu":
+        return padded_width > HMAP_MIN_MAPPINGS_TPU
+    return True
+
+
+def retarget_tables(tables: NatTables, target_backend: str) -> NatTables:
+    """Re-derive the trace-time lookup gate for the backend the dispatch
+    actually targets.  Tables built in a CPU-default process and shipped
+    to TPU workers (or vice versa) would otherwise keep the builder's
+    crossover pick; use_hmap is pytree AUX data so this is free — no
+    device arrays are touched, only retraces differ.  A dense-fallback
+    table (hmap growth bound hit) is returned unchanged: its stub index
+    must never be re-enabled."""
+    if (
+        not tables.use_hmap
+        and tables.num_mappings > 0
+        and not bool(jnp.any(tables.hmap_idx >= 0))
+    ):
+        return tables  # dense fallback — hmap_idx is a stub
+    return _dc_replace(
+        tables, use_hmap=_pick_use_hmap(tables.map_ext_ip.shape[0], target_backend)
+    )
+
+
 def build_nat_tables(
     mappings: Sequence[NatMapping],
     nat_loopback: str = "0.0.0.0",
@@ -389,12 +444,22 @@ def build_nat_tables(
     snat_enabled: bool = False,
     pod_subnet: str = "10.1.0.0/16",
     bucket_size: int = 64,
+    target_backend: Optional[str] = None,
 ) -> NatTables:
     """Compile DNAT mappings to tensors.
 
     The backend ring of each mapping is filled by weighted round-robin
     so that ``flow_hash %% K`` lands on backend b with probability
     weight_b / sum(weights) (up to rounding) — flow-sticky weighted LB.
+
+    ``target_backend`` names the JAX backend the dispatch will RUN on
+    ("tpu"/"cpu"/"gpu"); it gates the lookup-discipline crossover
+    (``use_hmap``).  Default is this process's ``jax.default_backend()``
+    — correct when tables are built in the device process; a builder
+    shipping tables elsewhere must pass the target explicitly or call
+    :func:`retarget_tables` at the dispatch site (advisor r3: the gate
+    is perf-only — both lookups are bit-equal — but the wrong pick
+    costs the measured crossover margin).
     """
     m = len(mappings)
     padded = _next_pow2(max(m, 1))
@@ -443,13 +508,8 @@ def build_nat_tables(
     if hmap is None:  # adversarial hash-collision set: dense fallback
         hmap = np.full(16, -1, dtype=np.int32)
         use_hmap = False
-    elif jax.default_backend() == "tpu":
-        # Measured crossover (HMAP_MIN_MAPPINGS_TPU).  Gate on the
-        # PADDED width — that, not the valid count, is what the dense
-        # [B, M] compare streams.
-        use_hmap = padded > HMAP_MIN_MAPPINGS_TPU
     else:
-        use_hmap = True
+        use_hmap = _pick_use_hmap(padded, target_backend)
 
     return NatTables(
         map_ext_ip=jnp.asarray(ext_ip),
