@@ -36,34 +36,63 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 
 def host_path_bench(args, runner, rx, tx, local, host, frames) -> int:
-    """Native frame-path capacity: admit (pop+decap+parse) and harvest
-    (rewrite-apply+encap+route-split+push) in C++, with the verdict and
-    route computed VECTORIZED on the host instead of dispatching the
-    device pipeline.  This is the VPP-main-loop-analog number: what the
-    loop itself sustains when the classifier isn't the bound (on TPU
-    the kernel does hundreds of Mpps; on this 1-core CPU host the XLA
-    pipeline is the e2e ceiling — see the e2e row)."""
+    """Native frame-path capacity: admit (zero-copy read+decap+parse)
+    and harvest (rewrite-apply+encap+route-split+push) in C++, with the
+    verdict and route computed VECTORIZED on the host instead of
+    dispatching the device pipeline.  This is the VPP-main-loop-analog
+    number: what the loop itself sustains when the classifier isn't
+    the bound (on TPU the kernel does hundreds of Mpps; on a small CPU
+    host the XLA pipeline is the e2e ceiling — see the e2e row).
+
+    --workers N shards the loop: N rings+loops driven by N threads
+    (the C++ calls release the GIL, so shards scale with CORES — on a
+    1-core host N>1 only proves the architecture, the number stays
+    per-core).  Reported value is the aggregate over all shards.
+    """
     import json
+    import threading
     import time
 
     import numpy as np
 
     import jax
 
+    from vpp_tpu.datapath import NativeRing
     from vpp_tpu.ops.pipeline import ROUTE_HOST, ROUTE_LOCAL, ROUTE_REMOTE
     from vpp_tpu.shim.hostshim import NativeLoop
 
-    loop = runner._native
-    assert loop is not None, "--host-path requires the native engine"
     base = int(np.asarray(runner.route.pod_subnet_base))
     mask = int(np.asarray(runner.route.pod_subnet_mask))
     tbase = int(np.asarray(runner.route.this_node_base))
     tmask = int(np.asarray(runner.route.this_node_mask))
     hbits = int(np.asarray(runner.route.host_bits))
-    admit_c = np.zeros(NativeLoop.ADMIT_COUNTERS, dtype=np.uint64)
-    harv_c = np.zeros(NativeLoop.HARVEST_COUNTERS, dtype=np.uint64)
 
-    def run_once() -> int:
+    n_workers = max(1, args.workers)
+    if n_workers == 1:
+        shards = [(runner._native, rx, (tx, local, host))]
+        assert shards[0][0] is not None, "--host-path requires the native engine"
+    else:
+        shards = []
+        for _ in range(n_workers):
+            srx = NativeRing(arena_bytes=64 << 20, max_frames=1 << 17)
+            souts = tuple(
+                NativeRing(arena_bytes=64 << 20, max_frames=1 << 17)
+                for _ in range(3)
+            )
+            shards.append((
+                NativeLoop(srx, *souts, batch_size=args.batch,
+                           max_vectors=args.vectors, vni=10, n_slots=2),
+                srx, souts,
+            ))
+
+    admit_cs = [np.zeros(NativeLoop.ADMIT_COUNTERS, dtype=np.uint64)
+                for _ in shards]
+    harv_cs = [np.zeros(NativeLoop.HARVEST_COUNTERS, dtype=np.uint64)
+               for _ in shards]
+
+    def run_shard(idx: int) -> int:
+        loop, _, _ = shards[idx]
+        admit_c, harv_c = admit_cs[idx], harv_cs[idx]
         done = 0
         while True:
             n, k, soa = loop.admit(0, admit_c)
@@ -86,43 +115,153 @@ def host_path_bench(args, runner, rx, tx, local, host, frames) -> int:
             )
             done += n
 
+    def run_all() -> None:
+        if n_workers == 1:
+            run_shard(0)
+            return
+        threads = [
+            threading.Thread(target=run_shard, args=(i,))
+            for i in range(n_workers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def feed() -> None:
+        # Round-robin split across shard rx rings.
+        for i, (_, srx, _) in enumerate(shards):
+            srx.send(frames[i::n_workers])
+
     def drain_outputs() -> int:
         total = 0
-        for ring in (tx, local, host):
-            while True:
-                _, off, _lens = ring.recv_views(1 << 17)
-                if not len(off):
-                    break
-                total += len(off)
+        for _, _, outs in shards:
+            for ring in outs:
+                while True:
+                    _, off, _lens = ring.recv_views(1 << 17)
+                    if not len(off):
+                        break
+                    total += len(off)
         return total
 
-    rx.send(frames)
-    run_once()
+    feed()
+    run_all()
     drain_outputs()
-    admit_c[:] = 0  # warm-up traffic must not skew the reported counts
-    harv_c[:] = 0
+    for c in admit_cs:  # warm-up traffic must not skew reported counts
+        c[:] = 0
+    for c in harv_cs:
+        c[:] = 0
     mpps_rounds = []
     out_total = 0
     for _ in range(args.rounds):
-        rx.send(frames)
+        feed()
         t0 = time.perf_counter()
-        run_once()
+        run_all()
         dt = time.perf_counter() - t0
         out_total += drain_outputs()
         mpps_rounds.append(args.frames / dt / 1e6)
     mpps_rounds.sort()
     median = mpps_rounds[len(mpps_rounds) // 2]
+    import os
+
     print(json.dumps({
         "metric": "native host frame path capacity (no device dispatch)",
         "value": round(median, 3),
         "unit": "Mpps",
         "backend": jax.default_backend(),
         "engine": "native",
+        "workers": n_workers,
+        "host_cores": os.cpu_count(),
         "peak_mpps": round(mpps_rounds[-1], 3),
+        "min_mpps": round(mpps_rounds[0], 3),
+        "rounds": args.rounds,
         "frames_per_round": args.frames,
         "out_frames": out_total,
-        "tx_remote": int(harv_c[0]),
+        "tx_remote": int(sum(int(c[0]) for c in harv_cs)),
         "vs_baseline": round(median / 40.0, 3),
+    }))
+    return 0
+
+
+def sharded_e2e_bench(args, acl, nat, route, frames) -> int:
+    """Frame-in→frame-out with the XLA pipeline in the loop and N host
+    shards sharing one device session state (ShardedDataplane)."""
+    import json
+    import time
+
+    import jax
+
+    from vpp_tpu.datapath import NativeRing, ShardedDataplane, VxlanOverlay
+    from vpp_tpu.ops.packets import ip_to_u32
+
+    n = args.workers
+    ios = [
+        tuple(NativeRing(arena_bytes=64 << 20, max_frames=1 << 17)
+              for _ in range(4))
+        for _ in range(n)
+    ]
+    dp = ShardedDataplane(
+        acl=acl, nat=nat, route=route,
+        overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"),
+                             local_node_id=1),
+        shard_ios=ios,
+        batch_size=args.batch, max_vectors=args.vectors,
+    )
+    for node_id in range(2, 64):
+        dp.overlay.set_remote(node_id, ip_to_u32(f"192.168.16.{node_id}"))
+
+    def feed():
+        for i, io_set in enumerate(ios):
+            io_set[0].send(frames[i::n])
+
+    def drain_outputs():
+        total = 0
+        for io_set in ios:
+            for ring in io_set[1:]:
+                while True:
+                    _, off, _lens = ring.recv_views(1 << 17)
+                    if not len(off):
+                        break
+                    total += len(off)
+        return total
+
+    feed()
+    dp.drain()
+    drain_outputs()
+
+    mpps_rounds = []
+    out_total = 0
+    for _ in range(args.rounds):
+        feed()
+        t0 = time.perf_counter()
+        dp.drain()
+        dt = time.perf_counter() - t0
+        out_total += drain_outputs()
+        mpps_rounds.append(args.frames / dt / 1e6)
+    mpps_rounds.sort()
+    median = mpps_rounds[len(mpps_rounds) // 2]
+    stats = dp.metrics()
+    import os
+
+    print(json.dumps({
+        "metric": "frame-in->frame-out dataplane throughput "
+                  f"({args.rules} rules + {args.services} services)",
+        "value": round(median, 3),
+        "unit": "Mpps",
+        "backend": jax.default_backend(),
+        "engine": "native-sharded",
+        "workers": n,
+        "host_cores": os.cpu_count(),
+        "dispatch": dp.shards[0].dispatch,
+        "peak_mpps": round(mpps_rounds[-1], 3),
+        "min_mpps": round(mpps_rounds[0], 3),
+        "rounds": args.rounds,
+        "frames_per_round": args.frames,
+        "out_frames": out_total,
+        "vs_baseline": round(median / 40.0, 3),
+        "denied": stats["datapath_dropped_denied_total"],
+        "tx_remote": stats["datapath_tx_remote_total"],
+        "punts": stats["datapath_punts_total"],
     }))
     return 0
 
@@ -136,6 +275,10 @@ def main(argv=None) -> int:
     parser.add_argument("--services", type=int, default=1000)
     parser.add_argument("--batch", type=int, default=256)
     parser.add_argument("--vectors", type=int, default=64)
+    parser.add_argument("--workers", type=int, default=1,
+                        help="host-side shards (threads); >1 uses the "
+                             "sharded engine (C++ calls release the GIL, "
+                             "so shards scale with CPU cores)")
     parser.add_argument("--engine", choices=["native", "python"], default="native",
                         help="runner engine: native C++ rings/loop (default) "
                              "or the pure-Python reference loop")
@@ -209,6 +352,9 @@ def main(argv=None) -> int:
 
     if args.host_path:
         return host_path_bench(args, runner, rx, tx, local, host, frames)
+
+    if args.workers > 1:
+        return sharded_e2e_bench(args, acl, nat, route, frames)
 
     def drain_outputs():
         n = 0

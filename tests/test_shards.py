@@ -1,0 +1,270 @@
+"""Sharded dataplane tests — per-core host workers over one device
+session state (vpp_tpu/datapath/shards.py, VERDICT r3 item 1).
+
+The reference scales its data plane with DPDK multi-queue + per-worker
+graph instances and NAT worker handoff; here the host side shards
+across threads while the device session table stays ONE array, so a
+flow's reply restores regardless of which shard it lands on.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from vpp_tpu.datapath import (
+    DataplaneRunner,
+    NativeRing,
+    ShardedDataplane,
+    VxlanOverlay,
+)
+from vpp_tpu.ops.classify import build_rule_tables
+from vpp_tpu.ops.nat import build_nat_tables
+from vpp_tpu.ops.packets import ip_to_u32
+from vpp_tpu.ops.pipeline import RouteConfig
+from vpp_tpu.testing.frames import build_frame, frame_tuple, verify_checksums
+
+
+def make_route():
+    return RouteConfig(
+        pod_subnet_base=jnp.asarray(ip_to_u32("10.1.0.0"), dtype=jnp.uint32),
+        pod_subnet_mask=jnp.asarray(0xFFFF0000, dtype=jnp.uint32),
+        this_node_base=jnp.asarray(ip_to_u32("10.1.1.0"), dtype=jnp.uint32),
+        this_node_mask=jnp.asarray(0xFFFFFF00, dtype=jnp.uint32),
+        host_bits=jnp.asarray(8, dtype=jnp.int32),
+    )
+
+
+def make_sharded(n_shards, **kw):
+    kw.setdefault("batch_size", 8)
+    kw.setdefault("max_vectors", 2)
+    ios = [tuple(NativeRing() for _ in range(4)) for _ in range(n_shards)]
+    dp = ShardedDataplane(
+        acl=build_rule_tables([], {}),
+        nat=build_nat_tables(
+            [], nat_loopback="10.1.1.254", snat_ip="192.168.16.1",
+            snat_enabled=True, pod_subnet="10.1.0.0/16",
+        ),
+        route=make_route(),
+        overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"), local_node_id=1),
+        shard_ios=ios,
+        **kw,
+    )
+    dp.overlay.set_remote(2, ip_to_u32("192.168.16.2"))
+    return dp, ios
+
+
+def test_cross_shard_session_reply_restore():
+    """A SNAT'd egress flow admitted on shard 0 must restore its reply
+    arriving on the LAST shard: the session table is one device array,
+    so no worker handoff is needed (unlike the reference's NAT)."""
+    dp, ios = make_sharded(3)
+    fwd = build_frame("10.1.1.5", "93.184.216.34", 6, 40000, 443)
+    ios[0][0].send([fwd])
+    dp.drain()
+    out = ios[0][3].recv_batch(16)  # host ring of shard 0
+    assert len(out) == 1
+    src, dst, proto, sport, dport = frame_tuple(out[0])
+    assert src == "192.168.16.1" and 32768 <= sport < 65536
+
+    # Reply lands on a DIFFERENT shard.
+    reply = build_frame("93.184.216.34", "192.168.16.1", 6, 443, sport)
+    ios[2][0].send([reply])
+    dp.drain()
+    back = ios[2][2].recv_batch(16)  # local ring of shard 2
+    assert len(back) == 1
+    assert frame_tuple(back[0]) == ("93.184.216.34", "10.1.1.5", 6, 443, 40000)
+    assert verify_checksums(back[0])
+
+
+def test_sharded_matches_single_runner():
+    """Same mixed traffic through 1 runner and through 3 shards →
+    identical aggregate counters and identical output frame multisets."""
+    def traffic():
+        frames = []
+        frames += [build_frame("10.1.1.2", "10.1.1.3", 6, 40000 + i, 80)
+                   for i in range(6)]
+        frames += [build_frame("10.1.1.2", "10.1.2.9", 6, 41000 + i, 80)
+                   for i in range(6)]
+        frames += [build_frame("10.1.1.4", "93.184.216.34", 6, 43000 + i, 443)
+                   for i in range(6)]
+        return frames
+
+    # Single runner reference.
+    rings = [NativeRing() for _ in range(4)]
+    single = DataplaneRunner(
+        acl=build_rule_tables([], {}),
+        nat=build_nat_tables(
+            [], nat_loopback="10.1.1.254", snat_ip="192.168.16.1",
+            snat_enabled=True, pod_subnet="10.1.0.0/16",
+        ),
+        route=make_route(),
+        overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"), local_node_id=1),
+        source=rings[0], tx=rings[1], local=rings[2], host=rings[3],
+        batch_size=8, max_vectors=2,
+    )
+    single.overlay.set_remote(2, ip_to_u32("192.168.16.2"))
+    rings[0].send(traffic())
+    single.drain()
+    ref = {
+        "tx": sorted(rings[1].recv_batch(1 << 10)),
+        "local": sorted(rings[2].recv_batch(1 << 10)),
+        "host": sorted(rings[3].recv_batch(1 << 10)),
+    }
+
+    dp, ios = make_sharded(3)
+    frames = traffic()
+    for i, f in enumerate(frames):  # round-robin ingest across shards
+        ios[i % 3][0].send([f])
+    dp.drain()
+    got = {"tx": [], "local": [], "host": []}
+    for io_set in ios:
+        got["tx"] += io_set[1].recv_batch(1 << 10)
+        got["local"] += io_set[2].recv_batch(1 << 10)
+        got["host"] += io_set[3].recv_batch(1 << 10)
+    for key in ref:
+        assert sorted(got[key]) == ref[key], key
+
+    m = dp.metrics()
+    assert m["datapath_rx_frames_total"] == len(frames)
+    assert m["datapath_tx_remote_total"] == len(ref["tx"])
+    assert m["datapath_tx_local_total"] == len(ref["local"])
+    assert m["datapath_tx_host_total"] == len(ref["host"])
+    assert m["datapath_shards"] == 3
+    # Aggregate counters match the single runner's.
+    sc = single.counters.as_dict()
+    for key in ("datapath_tx_remote_total", "datapath_tx_local_total",
+                "datapath_tx_host_total", "datapath_rx_frames_total"):
+        assert m[key] == sc[key], key
+
+
+def test_sharded_table_swap_applies_everywhere():
+    """update_tables fans out to every shard atomically-per-shard."""
+    from vpp_tpu.ops.nat import NatMapping
+
+    dp, ios = make_sharded(2)
+    nat2 = build_nat_tables(
+        [NatMapping("10.96.0.10", 80, 6, backends=[("10.1.1.9", 8080, 1)])],
+        nat_loopback="10.1.1.254", snat_ip="192.168.16.1",
+        snat_enabled=True, pod_subnet="10.1.0.0/16",
+    )
+    dp.update_tables(nat=nat2)
+    for shard_idx in range(2):
+        ios[shard_idx][0].send(
+            [build_frame("10.1.1.2", "10.96.0.10", 6, 40000 + shard_idx, 80)]
+        )
+    dp.drain()
+    for shard_idx in range(2):
+        out = ios[shard_idx][2].recv_batch(16)
+        assert len(out) == 1
+        assert frame_tuple(out[0])[1] == "10.1.1.9"
+
+
+def test_concurrent_shard_stress_no_loss():
+    """Hammer all shards concurrently (the pool drives them in
+    parallel); every injected frame must come out exactly once."""
+    dp, ios = make_sharded(4, batch_size=16, max_vectors=2)
+    n_per_shard = 400
+    total = 0
+    for s, io_set in enumerate(ios):
+        frames = [
+            build_frame(f"10.1.1.{2 + (i % 20)}", f"10.1.1.{30 + (i % 20)}",
+                        6, 1024 + (s * n_per_shard + i) % 60000, 80)
+            for i in range(n_per_shard)
+        ]
+        io_set[0].send(frames)
+        total += len(frames)
+    dp.drain()
+    out = sum(len(io_set[2].recv_batch(1 << 12)) for io_set in ios)
+    assert out == total
+    m = dp.metrics()
+    assert m["datapath_rx_frames_total"] == total
+    assert m["datapath_inflight"] == 0
+
+
+def test_zero_copy_guards():
+    """The zero-copy loop's safety rails: popping a ring with pinned
+    in-flight frames raises, as does harvesting out of FIFO order."""
+    from vpp_tpu.shim.hostshim import NativeLoop
+
+    rx, txr, txl, txh = (NativeRing() for _ in range(4))
+    loop = NativeLoop(rx, txr, txl, txh, batch_size=8, max_vectors=2,
+                      vni=10, n_slots=3)
+    counters = np.zeros(NativeLoop.ADMIT_COUNTERS, dtype=np.uint64)
+    rx.send([build_frame("10.1.1.2", "10.1.1.3", 6, 40000 + i, 80)
+             for i in range(4)])
+    n, k, _ = loop.admit(0, counters)
+    assert n == 4
+    # Pinned frames: ring pop must refuse rather than corrupt.
+    with pytest.raises(RuntimeError, match="pinned"):
+        rx.recv_views(16)
+    # Re-admitting a live slot refuses.
+    with pytest.raises(RuntimeError, match="in flight"):
+        loop.admit(0, counters)
+    # Admit a second batch, then try to harvest it before the first.
+    rx.send([build_frame("10.1.1.2", "10.1.1.3", 6, 41000, 80)])
+    n2, _, soa2 = loop.admit(1, counters)
+    assert n2 == 1
+    harv = np.zeros(NativeLoop.HARVEST_COUNTERS, dtype=np.uint64)
+    ones = np.ones(1, dtype=np.uint8)
+    with pytest.raises(RuntimeError, match="FIFO"):
+        loop.harvest(1, ones, soa2["src_ip"][:1], soa2["dst_ip"][:1],
+                     soa2["src_port"][:1], soa2["dst_port"][:1],
+                     np.full(1, 1, np.int32), np.zeros(1, np.int32),
+                     np.zeros(4, np.uint32), ip_to_u32("192.168.16.1"), 1,
+                     harv)
+    loop.close()
+    # close() released the pins: the in-flight frames are discarded
+    # (a torn-down loop's batches never complete) and the ring pops
+    # cleanly again instead of raising.
+    assert rx.recv_batch(16) == []
+    rx.send([build_frame("10.1.1.9", "10.1.1.3", 6, 42000, 80)])
+    assert len(rx.recv_batch(16)) == 1
+
+
+def test_afpacket_fanout_spreads_frames():
+    """PACKET_FANOUT: two sockets in one fanout group on loopback
+    split the frames between them with none lost (the multi-queue
+    ingest path of the sharded engine)."""
+    from vpp_tpu.datapath.io import AfPacketIO
+
+    try:
+        tx = AfPacketIO("lo")
+        # Round-robin mode guarantees both sockets receive (hash mode
+        # would too on 16 distinct flows, but is kernel-hash dependent).
+        rx_a = AfPacketIO("lo", blocking_ms=300, fanout_group=77,
+                          fanout_mode="lb")
+        rx_b = AfPacketIO("lo", blocking_ms=300, fanout_group=77,
+                          fanout_mode="lb")
+    except (PermissionError, OSError) as e:
+        pytest.skip(f"AF_PACKET unavailable: {e}")
+    try:
+        sent = [
+            build_frame(f"10.9.{i}.2", f"10.9.{i}.3", 6, 40000 + i, 80,
+                        payload=b"fanout-probe")
+            for i in range(16)
+        ]
+        tx.send(sent)
+
+        def ours(f):
+            return b"fanout-probe" in f
+
+        got_a, got_b = [], []
+        # Loopback shows each frame to the group once per direction
+        # (TX + RX), so expect up to 2x; collect until all flows seen.
+        want = {(f"10.9.{i}.2", f"10.9.{i}.3", 6, 40000 + i, 80)
+                for i in range(16)}
+        for _ in range(20):
+            got_a += [f for f in rx_a.recv_batch(64) if ours(f)]
+            got_b += [f for f in rx_b.recv_batch(64) if ours(f)]
+            if {frame_tuple(f) for f in got_a + got_b} == want:
+                break
+        assert {frame_tuple(f) for f in got_a + got_b} == want
+        # The group SPREADS: neither socket saw everything alone.
+        assert got_a and got_b
+    finally:
+        tx.close()
+        rx_a.close()
+        rx_b.close()

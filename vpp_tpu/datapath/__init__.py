@@ -19,11 +19,13 @@ from .io import (
     PcapReader,
     PcapWriter,
 )
-from .runner import DataplaneRunner, RunnerCounters, VxlanOverlay
+from .runner import DataplaneRunner, DeviceSessionState, RunnerCounters, VxlanOverlay
+from .shards import ShardedDataplane
 
 __all__ = [
     "AfPacketIO",
     "DataplaneRunner",
+    "DeviceSessionState",
     "FrameSink",
     "FrameSource",
     "InMemoryRing",
@@ -31,5 +33,6 @@ __all__ = [
     "PcapReader",
     "PcapWriter",
     "RunnerCounters",
+    "ShardedDataplane",
     "VxlanOverlay",
 ]

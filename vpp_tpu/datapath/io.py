@@ -149,16 +149,41 @@ class AfPacketIO:
     (pkg/pci/pci.go DriverBind :40) — zero-dependency, works on veth
     pairs for e2e tests and on a real NIC for small deployments.
     Requires CAP_NET_RAW; construction raises PermissionError without.
+
+    Multi-queue ingest (the DPDK RSS analog): open N sockets on the
+    same interface with the same ``fanout_group`` and the kernel
+    spreads frames across them (PACKET_FANOUT).  The default ``hash``
+    mode keeps a flow on one socket — one shard's rings stay
+    flow-sticky, the property VPP's per-worker RX queues rely on.
+    Each shard of a ShardedDataplane gets its own fanout socket.
     """
 
     ETH_P_ALL = 0x0003
+    SOL_PACKET = 263
+    PACKET_FANOUT = 18
+    FANOUT_MODES = {
+        "hash": 0,      # symmetric-ish flow hash (flow-sticky)
+        "lb": 1,        # round-robin load balance
+        "cpu": 2,       # incoming CPU
+        "rollover": 3,  # fill one socket, overflow to next
+        "rnd": 4,       # random
+        "qm": 5,        # NIC RX queue mapping (true multi-queue)
+    }
 
-    def __init__(self, ifname: str, blocking_ms: int = 0):
+    def __init__(self, ifname: str, blocking_ms: int = 0,
+                 fanout_group: Optional[int] = None,
+                 fanout_mode: str = "hash"):
         self.ifname = ifname
         self._sock = socket.socket(
             socket.AF_PACKET, socket.SOCK_RAW, socket.htons(self.ETH_P_ALL)
         )
         self._sock.bind((ifname, 0))
+        if fanout_group is not None:
+            mode = self.FANOUT_MODES[fanout_mode]
+            self._sock.setsockopt(
+                self.SOL_PACKET, self.PACKET_FANOUT,
+                (fanout_group & 0xFFFF) | (mode << 16),
+            )
         if blocking_ms:
             self._sock.settimeout(blocking_ms / 1000.0)
         else:

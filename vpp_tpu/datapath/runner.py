@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import threading
 from typing import Deque, Dict, Optional, Tuple
 
 import numpy as np
@@ -78,6 +79,22 @@ class VxlanOverlay:
     def del_remote(self, node_id: int) -> None:
         if 0 <= node_id < len(self.remote_ips):
             self.remote_ips[node_id] = 0
+
+
+class DeviceSessionState:
+    """Device-resident NAT session table + batch timestamp, shareable
+    across shard runners (vpp_tpu/datapath/shards.py): the table is ONE
+    device array regardless of how many host-side shards feed it, so a
+    forward flow admitted on shard 0 restores its reply on shard 3 —
+    no cross-worker handoff needed (the reference's NAT worker-handoff
+    problem disappears because session state lives on the device, not
+    per-core).  ``lock`` serialises jit dispatches so the session state
+    threads dispatch-to-dispatch in a single total order."""
+
+    def __init__(self, capacity: int = 1 << 16):
+        self.sessions: NatSessions = empty_sessions(capacity)
+        self.ts = 0
+        self.lock = threading.RLock()
 
 
 @dataclasses.dataclass
@@ -144,6 +161,13 @@ class DataplaneRunner:
         # cannot, and punts crafted-aliasing corners to the host slow
         # path instead of restoring them.
         dispatch: str = "flat-safe",
+        # Sharing hooks for the multi-shard engine (shards.py): a common
+        # DeviceSessionState (one device session table for all shards),
+        # a common host slow path + tracer, and the lock guarding them.
+        state: Optional[DeviceSessionState] = None,
+        slow=None,
+        tracer=None,
+        host_lock: Optional[threading.Lock] = None,
     ):
         self.acl = acl
         self.mesh = mesh
@@ -180,15 +204,21 @@ class DataplaneRunner:
         # and every dispatch runs GSPMD-sharded — SURVEY §5.8's ICI
         # scaling axis, driven by the SAME runner loop as single-chip.
         self.partition_sessions = partition_sessions
-        self.sessions: NatSessions = empty_sessions(session_capacity)
+        self._state = state or DeviceSessionState(session_capacity)
         if mesh is not None:
             self._shard_state()
-        self.slow = HostSlowPath()
+        self.slow = slow if slow is not None else HostSlowPath()
+        self._host_lock = host_lock or threading.Lock()
+        # With a SHARED slow path (sharded engine), "will the slow path
+        # mutate this batch's verdicts?" cannot be answered outside the
+        # host lock — another shard may insert a session between the
+        # check and the use — so harvest must always take the copying
+        # path there.  Solo runners keep the zero-copy fast path.
+        self._shared_host = host_lock is not None
         self.counters = RunnerCounters()
         # Sampled per-packet verdict traces (vpptrace analog), enabled on
         # demand via REST/netctl.
-        self.tracer = PacketTracer()
-        self._ts = 0
+        self.tracer = tracer if tracer is not None else PacketTracer()
         # In-flight queue: python engine (FrameBatch, result, ts);
         # native engine (slot, n, orig-SoA dict, result, ts).
         self._inflight: Deque[Tuple] = collections.deque()
@@ -214,6 +244,28 @@ class DataplaneRunner:
                 batch_size=self.batch_size, max_vectors=self.max_vectors,
                 vni=self.overlay.vni, n_slots=self._n_slots,
             )
+
+    # ------------------------------------------------------ shared state
+
+    # Session table + timestamp live in the (possibly shared)
+    # DeviceSessionState; these properties keep the runner's historical
+    # field API while routing through it.
+
+    @property
+    def sessions(self) -> NatSessions:
+        return self._state.sessions
+
+    @sessions.setter
+    def sessions(self, value: NatSessions) -> None:
+        self._state.sessions = value
+
+    @property
+    def _ts(self) -> int:
+        return self._state.ts
+
+    @_ts.setter
+    def _ts(self, value: int) -> None:
+        self._state.ts = value
 
     # ----------------------------------------------------- sizing knobs
 
@@ -339,7 +391,19 @@ class DataplaneRunner:
     def _dispatch(self, batch: PacketBatch, k: int):
         """Dispatch one (k × batch_size)-packet batch through the jit
         pipeline, threading the session state on device; bumps the
-        timestamp and runs the periodic session sweep."""
+        timestamp and runs the periodic session sweep.  Serialised on
+        the DeviceSessionState lock: shard threads enqueue device work
+        in a single total order so the session state threads cleanly
+        (dispatch is async — the lock covers enqueue, not execution).
+
+        Returns ``(result, ts)`` where ``ts`` is THIS batch's timestamp,
+        read while the lock is held — another shard may bump the shared
+        counter the moment the lock drops, so callers must not re-read
+        ``self._ts`` for bookkeeping."""
+        with self._state.lock:
+            return self._dispatch_locked(batch, k), self._ts
+
+    def _dispatch_locked(self, batch: PacketBatch, k: int):
         prev_ts = self._ts
         self._ts += k
         if k == 1 and self.dispatch != "flat-safe":
@@ -378,7 +442,8 @@ class DataplaneRunner:
             self._ts // self.sweep_interval != prev_ts // self.sweep_interval
         ):
             self.sessions = sweep_sessions(self.sessions, self._ts, self.sweep_max_age)
-            self.slow.sweep(self._ts, self.sweep_max_age)
+            with self._host_lock:  # slow-path dict is shared across shards
+                self.slow.sweep(self._ts, self.sweep_max_age)
         return result
 
     # ------------------------------------------------------- native engine
@@ -401,8 +466,8 @@ class DataplaneRunner:
             src_port=jnp.asarray(soa["src_port"][:kb]),
             dst_port=jnp.asarray(soa["dst_port"][:kb]),
         )
-        result = self._dispatch(batch, k)
-        self._inflight.append((slot, n, soa, result, self._ts))
+        result, batch_ts = self._dispatch(batch, k)
+        self._inflight.append((slot, n, soa, result, batch_ts))
         return True
 
     def _harvest_native(self) -> int:
@@ -414,8 +479,10 @@ class DataplaneRunner:
         snat_hit = np.asarray(result.snat_hit)[:n]
         # The slow path mutates verdicts/rewrites in place — copy only
         # when it can actually fire (punts in this batch or live host
-        # sessions); the all-fast-path case stays zero-copy.
-        mutable = bool(punt.any()) or len(self.slow) > 0
+        # sessions); the all-fast-path case stays zero-copy.  A shared
+        # slow path (sharded engine) always copies: its emptiness can
+        # change between this check and the locked slow-path pass.
+        mutable = self._shared_host or bool(punt.any()) or len(self.slow) > 0
         def mat(x):
             arr = np.asarray(x)[:n]
             return arr.copy() if mutable else arr
@@ -494,8 +561,8 @@ class DataplaneRunner:
             src_port=jnp.asarray(fb.batch.src_port),
             dst_port=jnp.asarray(fb.batch.dst_port),
         )
-        result = self._dispatch(batch, k)
-        self._inflight.append((fb, result, self._ts))
+        result, batch_ts = self._dispatch(batch, k)
+        self._inflight.append((fb, result, batch_ts))
         return True
 
     def _harvest_python(self) -> int:
@@ -581,7 +648,20 @@ class DataplaneRunner:
         """Host slow path (punt servicing, port fixups, reply restores)
         + sampled packet trace — shared by both engines.  Mutates
         ``rew``/``allowed``/``route_tag``/``node_id`` in place and
-        returns the number of slow-path drops."""
+        returns the number of slow-path drops.  Guarded by the (shared)
+        host lock: in the sharded engine the slow path's session dict is
+        one structure for all shards, because a punted flow's reply may
+        land on a different shard than its forward packet did."""
+        with self._host_lock:
+            return self._slowpath_and_trace_locked(
+                orig, rew, allowed, route_tag, node_id,
+                punt, reply_hit, dnat_hit, snat_hit, ts,
+            )
+
+    def _slowpath_and_trace_locked(
+        self, orig, rew, allowed, route_tag, node_id,
+        punt, reply_hit, dnat_hit, snat_hit, ts,
+    ) -> int:
         slow_drops = 0
         if punt.any():
             self.counters.punts += int(punt.sum())

@@ -1,0 +1,133 @@
+"""Multi-shard dataplane — per-core host workers over one device state.
+
+The reference's data plane scales across cores with DPDK multi-queue
+RX + per-worker VPP graph instances, handing NAT flows between workers
+so session state stays consistent (docs/ARCHITECTURE.md:20, the
+dpdk-input → worker model).  The TPU-native translation splits the
+same roles differently:
+
+- **Host side (per core)**: N shards, each with its own rx/tx rings and
+  its own native C++ admit/harvest loop (runnerloop.cpp).  Shard calls
+  release the GIL, so a thread pool drives all shards' frame work
+  concurrently on multi-core hosts — parse, rewrite, checksums, VXLAN
+  encap all scale with cores, the way VPP workers do.
+- **Device side (shared)**: ONE session table and ONE jit pipeline.
+  Dispatches from all shards serialise on the DeviceSessionState lock
+  and thread the session state in a single total order.  This deletes
+  the reference's worker-handoff problem outright: a flow's forward
+  packet admitted by shard 0 and its reply arriving on shard 3 hit the
+  same device table, so no cross-worker handoff or flow-pinning is
+  needed for correctness.  (PACKET_FANOUT_HASH still keeps flows
+  shard-sticky for cache locality — see AfPacketIO's fanout support.)
+- **Host slow path (shared)**: punts are rare; one lock-guarded
+  HostSlowPath serves all shards, again because a punted flow's reply
+  may land on any shard.
+
+Ingest fanout options: PACKET_FANOUT on AF_PACKET sockets (kernel
+multi-queue; vpp_tpu/datapath/io.py), or any per-shard frame source.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..ops.classify import RuleTables
+from ..ops.nat import NatTables
+from .runner import DataplaneRunner, DeviceSessionState, VxlanOverlay
+from .trace import PacketTracer
+
+# A shard's IO endpoints: (source, tx_remote, tx_local, tx_host).
+ShardIO = Tuple[object, object, object, object]
+
+
+class ShardedDataplane:
+    """N DataplaneRunner shards sharing one device session state, one
+    host slow path, and one tracer; driven concurrently by a thread
+    pool.  API mirrors the single runner (poll/drain/update_tables/
+    metrics) so call sites swap in transparently."""
+
+    def __init__(
+        self,
+        acl: RuleTables,
+        nat: NatTables,
+        route,
+        overlay: VxlanOverlay,
+        shard_ios: Sequence[ShardIO],
+        batch_size: int = 256,
+        max_vectors: int = 64,
+        session_capacity: int = 1 << 16,
+        workers: Optional[int] = None,
+        **runner_kw,
+    ):
+        if not shard_ios:
+            raise ValueError("need at least one shard")
+        from ..ops.slowpath import HostSlowPath
+
+        self.state = DeviceSessionState(session_capacity)
+        self.slow = HostSlowPath()
+        self.tracer = PacketTracer()
+        self._host_lock = threading.Lock()
+        self.overlay = overlay
+        self.shards: List[DataplaneRunner] = [
+            DataplaneRunner(
+                acl=acl, nat=nat, route=route, overlay=overlay,
+                source=src, tx=tx, local=local, host=host,
+                batch_size=batch_size, max_vectors=max_vectors,
+                state=self.state, slow=self.slow, tracer=self.tracer,
+                host_lock=self._host_lock,
+                **runner_kw,
+            )
+            for (src, tx, local, host) in shard_ios
+        ]
+        self._pool = ThreadPoolExecutor(
+            max_workers=workers or len(self.shards),
+            thread_name_prefix="dp-shard",
+        )
+
+    @property
+    def engine(self) -> str:
+        return self.shards[0].engine
+
+    # --------------------------------------------------------------- loop
+
+    def poll(self) -> int:
+        """One scheduling turn on every shard, concurrently.  Each shard
+        runs in exactly one pool task at a time (shards are not
+        re-entrant); returns total frames transmitted this turn."""
+        return sum(self._pool.map(lambda r: r.poll(), self.shards))
+
+    def drain(self) -> int:
+        """Drain every shard concurrently until all are idle."""
+        return sum(self._pool.map(lambda r: r.drain(), self.shards))
+
+    # ------------------------------------------------------------- tables
+
+    def update_tables(self, acl=None, nat=None, route=None) -> None:
+        for r in self.shards:
+            r.update_tables(acl=acl, nat=nat, route=route)
+
+    # ------------------------------------------------------------ metrics
+
+    def metrics(self) -> Dict[str, int]:
+        """Aggregated counters over all shards (shared gauges taken
+        once, per-shard totals summed)."""
+        agg: Dict[str, int] = {}
+        for r in self.shards:
+            for key, value in r.counters.as_dict().items():
+                agg[key] = agg.get(key, 0) + value
+        one = self.shards[0].metrics()
+        for key in (
+            "datapath_sessions_active",
+            "datapath_slowpath_sessions_active",
+        ):
+            agg[key] = one[key]
+        for key, value in self.slow.counters.as_dict().items():
+            agg[key] = value
+        agg["datapath_inflight"] = sum(len(r._inflight) for r in self.shards)
+        agg["datapath_shards"] = len(self.shards)
+        return agg
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=True)

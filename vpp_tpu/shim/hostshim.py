@@ -101,6 +101,7 @@ def _load() -> ctypes.CDLL:
         ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32,
     ]
     lib.hs_loop_free.argtypes = [ctypes.c_void_p]
+    lib.hs_loop_release_all.argtypes = [ctypes.c_void_p]
     lib.hs_loop_admit.restype = ctypes.c_int32
     lib.hs_loop_admit.argtypes = [
         ctypes.c_void_p, ctypes.c_int32,
@@ -190,6 +191,11 @@ class NativeRing:
             self._pop_buf.size, self._pop_off.ctypes.data_as(_u64p),
             self._pop_len.ctypes.data_as(_u32p), want,
         ))
+        if n < 0:
+            raise RuntimeError(
+                "ring has frames pinned by an in-flight zero-copy batch; "
+                "harvest it before popping"
+            )
         return self._pop_buf, self._pop_off[:n], self._pop_len[:n]
 
     # ----------------------------------------------------- bytes-compat API
@@ -225,11 +231,14 @@ class NativeRing:
 class NativeLoop:
     """The C++ admit/harvest engine behind DataplaneRunner.
 
-    One ``admit`` call pops a batch from the rx ring, VXLAN-declassifies
-    and VNI-filters it, packs the kept frames into a per-slot buffer and
-    parses them into preallocated SoA header arrays; one ``harvest``
-    call applies verdicts/rewrites, encapsulates ROUTE_REMOTE frames
-    and routes everything to the TX rings.  Python in between only
+    One ``admit`` call reads a batch from the rx ring ZERO-COPY (the
+    frames stay pinned in the ring arena), VXLAN-declassifies and
+    VNI-filters it, and parses the kept frames once into preallocated
+    SoA header arrays, caching the IP/L4 offsets; one ``harvest`` call
+    applies verdicts/rewrites in place against those cached offsets,
+    encapsulates ROUTE_REMOTE frames from a header template, routes
+    everything to the TX rings, and releases the batch's arena pin
+    (strictly FIFO across in-flight batches).  Python in between only
     dispatches the jit pipeline and services punts.
     """
 
@@ -274,6 +283,8 @@ class NativeLoop:
             ctypes.byref(k),
             counters.ctypes.data_as(_u64p),
         ))
+        if n < 0:
+            raise RuntimeError(f"slot {slot} is still in flight (unharvested)")
         return n, int(k.value), soa
 
     def harvest(self, slot: int, allowed: np.ndarray, new_src: np.ndarray,
@@ -282,7 +293,7 @@ class NativeLoop:
                 node_id: np.ndarray, remote_ips: np.ndarray, local_ip: int,
                 local_node_id: int, counters: np.ndarray) -> int:
         remote_ips = np.ascontiguousarray(remote_ips, dtype=np.uint32)
-        return int(self._lib.hs_loop_harvest(
+        sent = int(self._lib.hs_loop_harvest(
             self._ptr, slot,
             np.ascontiguousarray(allowed, dtype=np.uint8).ctypes.data_as(_u8p),
             np.ascontiguousarray(new_src, dtype=np.uint32).ctypes.data_as(_u32p),
@@ -296,6 +307,12 @@ class NativeLoop:
             ctypes.c_uint32(local_ip), ctypes.c_uint32(local_node_id),
             counters.ctypes.data_as(_u64p),
         ))
+        if sent < 0:
+            raise RuntimeError(
+                f"slot {slot} harvested out of admit order (batches "
+                "release their arena pins FIFO)"
+            )
+        return sent
 
     def slot_frame(self, slot: int, row: int) -> bytes:
         """Copy one admitted frame back out (slow path / tracing only)."""
@@ -310,6 +327,13 @@ class NativeLoop:
     def close(self) -> None:
         ptr, self._ptr = self._ptr, None
         if ptr:
+            # Unpin any in-flight batches first — but only while the RX
+            # ring (the only one release_all dereferences) is still open
+            # (GC may finalise rings before the loop when breaking
+            # reference cycles; touching a freed ring from C++ would be
+            # use-after-free).
+            if self._rings[0]._ptr:
+                self._lib.hs_loop_release_all(ptr)
             self._lib.hs_loop_free(ptr)
 
     def __del__(self):  # pragma: no cover - interpreter teardown
