@@ -163,9 +163,12 @@ def _measure_shaped(acl, nat, route, pod_ips, mappings, n_vectors, step_jit):
     state = {"sessions": empty_sessions(1 << 16)}
 
     def dispatch(ts):
-        tss = jnp.arange(ts * n_vectors, (ts + 1) * n_vectors, dtype=jnp.int32)
+        # Scalar base-ts entry point: the per-vector ts vector is built
+        # on device (a host-side arange per dispatch is an extra tunnel
+        # round trip — measured at a 40-100% tax in r4).
         result = step_jit(
-            acl, nat, route, state["sessions"], batches, tss
+            acl, nat, route, state["sessions"], batches,
+            jnp.int32(ts * n_vectors),
         )
         state["sessions"] = result.sessions
         return result.allowed
@@ -175,20 +178,20 @@ def _measure_shaped(acl, nat, route, pod_ips, mappings, n_vectors, step_jit):
 
 def _measure_scan(acl, nat, route, pod_ips, mappings, n_vectors):
     """Median/peak Mpps of the vector-scan dispatch at K = n_vectors."""
-    from vpp_tpu.ops.pipeline import pipeline_scan_jit
+    from vpp_tpu.ops.pipeline import pipeline_scan_ts0_jit
 
     return _measure_shaped(
-        acl, nat, route, pod_ips, mappings, n_vectors, pipeline_scan_jit
+        acl, nat, route, pod_ips, mappings, n_vectors, pipeline_scan_ts0_jit
     )
 
 
 def _measure_flat_safe(acl, nat, route, pod_ips, mappings, n_vectors):
     """Median/peak Mpps of the flat-safe dispatch (the runner's
     production default) at K = n_vectors."""
-    from vpp_tpu.ops.pipeline import pipeline_flat_safe_jit
+    from vpp_tpu.ops.pipeline import pipeline_flat_safe_ts0_jit
 
     return _measure_shaped(
-        acl, nat, route, pod_ips, mappings, n_vectors, pipeline_flat_safe_jit
+        acl, nat, route, pod_ips, mappings, n_vectors, pipeline_flat_safe_ts0_jit
     )
 
 
@@ -248,16 +251,16 @@ def main():
     # the full per-size distribution lives in BENCHLAT
     # (benchsuite.py --latency).
     from vpp_tpu.ops.nat import empty_sessions
-    from vpp_tpu.ops.pipeline import VECTOR_SIZE, pipeline_flat_safe_jit
+    from vpp_tpu.ops.pipeline import VECTOR_SIZE, pipeline_flat_safe_ts0_jit
 
     flat = build_traffic(pod_ips, mappings, 64 * VECTOR_SIZE)
     vecs = jax.tree_util.tree_map(lambda a: a.reshape(64, VECTOR_SIZE), flat)
     state = {"sessions": empty_sessions(1 << 16), "ts": 0}
 
     def dispatch():
-        tss = jnp.arange(state["ts"], state["ts"] + 64, dtype=jnp.int32)
+        ts0 = jnp.int32(state["ts"])
         state["ts"] += 64
-        r = pipeline_flat_safe_jit(acl, nat, route, state["sessions"], vecs, tss)
+        r = pipeline_flat_safe_ts0_jit(acl, nat, route, state["sessions"], vecs, ts0)
         state["sessions"] = r.sessions
         return r.allowed
 

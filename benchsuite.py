@@ -49,7 +49,7 @@ def _measure(acl, nat, route, batch, iters, rounds=3, step=None):
     production dispatch discipline (datapath/runner.py): the flat batch
     is split into 256-packet vectors and dispatched with the flat-safe
     discipline (batch-parallel with post-commit same-dispatch-reply
-    reconciliation; pass ``step=pipeline_scan_jit`` for the sequential
+    reconciliation; pass ``step=pipeline_scan_ts0_jit`` for the sequential
     scan).  Returns (best_mpps, flat_result).
 
     Best-of-``rounds``: the shared-TPU tunnel shows high run-to-run
@@ -59,20 +59,20 @@ def _measure(acl, nat, route, batch, iters, rounds=3, step=None):
 
     from vpp_tpu.ops.pipeline import (
         VECTOR_SIZE,
-        flatten_scan_result,
-        pipeline_flat_safe_jit,
+        pipeline_flat_safe_ts0_jit,
     )
 
     if step is None:
-        step = pipeline_flat_safe_jit
+        step = pipeline_flat_safe_ts0_jit
     n = batch.src_ip.shape[0]
     assert n % VECTOR_SIZE == 0, "bench batches must be vector multiples"
     k = n // VECTOR_SIZE
     batches = jax.tree_util.tree_map(lambda a: a.reshape(k, VECTOR_SIZE), batch)
     sessions = empty_sessions(1 << 16)
-    result = step(
-        acl, nat, route, sessions, batches, jnp.arange(k, dtype=jnp.int32)
-    )
+    # Scalar base-ts entry points: the ts vector is built on device (a
+    # host-side arange per dispatch is an extra tunnel round trip,
+    # measured at a 40-100% tax in r4), and leaves come back flat.
+    result = step(acl, nat, route, sessions, batches, jnp.int32(0))
     result.allowed.block_until_ready()
     sessions = result.sessions
     best = 0.0
@@ -80,14 +80,13 @@ def _measure(acl, nat, route, batch, iters, rounds=3, step=None):
     for _ in range(rounds):
         t0 = time.perf_counter()
         for _ in range(iters):
-            tss = jnp.arange(ts, ts + k, dtype=jnp.int32)
+            result = step(acl, nat, route, sessions, batches, jnp.int32(ts))
             ts += k
-            result = step(acl, nat, route, sessions, batches, tss)
             sessions = result.sessions
         result.allowed.block_until_ready()
         dt = (time.perf_counter() - t0) / iters
         best = max(best, n / dt / 1e6)
-    return best, flatten_scan_result(result)
+    return best, result
 
 
 def _report(config, metric, mpps):
@@ -249,7 +248,7 @@ def sweep(iters):
     import jax
 
     from vpp_tpu.ops.pipeline import (
-        VECTOR_SIZE, pipeline_flat_safe_jit, pipeline_scan_jit,
+        VECTOR_SIZE, pipeline_scan_ts0_jit,
     )
 
     acl, nat, route, _, pod_ips, mappings = bench.build_stress_state()
@@ -274,8 +273,8 @@ def sweep(iters):
         k = n // VECTOR_SIZE
         batches = jax.tree_util.tree_map(lambda a: a.reshape(k, VECTOR_SIZE), batch)
         sessions = empty_sessions(1 << 16)
-        r = pipeline_scan_jit(
-            acl, nat, route, sessions, batches, jnp.arange(k, dtype=jnp.int32)
+        r = pipeline_scan_ts0_jit(
+            acl, nat, route, sessions, batches, jnp.int32(0)
         )
         r.allowed.block_until_ready()
         sessions = r.sessions
@@ -283,9 +282,9 @@ def sweep(iters):
         for _ in range(3):
             t0 = time.perf_counter()
             for _ in range(it):
-                tss = jnp.arange(ts, ts + k, dtype=jnp.int32)
+                r = pipeline_scan_ts0_jit(acl, nat, route, sessions, batches,
+                                          jnp.int32(ts))
                 ts += k
-                r = pipeline_scan_jit(acl, nat, route, sessions, batches, tss)
                 sessions = r.sessions
             r.allowed.block_until_ready()
             scan_best = max(scan_best, n / ((time.perf_counter() - t0) / it) / 1e6)
@@ -324,7 +323,7 @@ def latency(iters):
     import jax
 
     from vpp_tpu.ops.pipeline import (
-        VECTOR_SIZE, pipeline_flat_safe_jit, pipeline_scan_jit,
+        VECTOR_SIZE, pipeline_flat_safe_ts0_jit, pipeline_scan_ts0_jit,
     )
 
     acl, nat, route, _, pod_ips, mappings = bench.build_stress_state()
@@ -344,11 +343,10 @@ def latency(iters):
                                           jnp.int32(ts))
                     ts += 1
                 else:
-                    tss = jnp.arange(ts, ts + k, dtype=jnp.int32)
+                    step = (pipeline_flat_safe_ts0_jit if disc == "flat-safe"
+                            else pipeline_scan_ts0_jit)
+                    r = step(acl, nat, route, sessions, batches, jnp.int32(ts))
                     ts += k
-                    step = (pipeline_flat_safe_jit if disc == "flat-safe"
-                            else pipeline_scan_jit)
-                    r = step(acl, nat, route, sessions, batches, tss)
                 sessions = r.sessions
                 return r.allowed
 
@@ -439,9 +437,9 @@ def scale(iters):
     # the sequential vector-scan for comparison.
     mpps, _ = _measure(acl, nat, route, batch, iters)
     report("flat-safe", mpps)
-    from vpp_tpu.ops.pipeline import pipeline_scan_jit
+    from vpp_tpu.ops.pipeline import pipeline_scan_ts0_jit
 
-    mpps, _ = _measure(acl, nat, route, batch, iters, step=pipeline_scan_jit)
+    mpps, _ = _measure(acl, nat, route, batch, iters, step=pipeline_scan_ts0_jit)
     report("vector-scan", mpps)
 
     # Wide flat dispatch: pallas vs dense A/B at [16384, 64k].

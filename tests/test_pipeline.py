@@ -443,3 +443,80 @@ def test_flat_safe_cross_aliased_bogus_sessions_punt():
     assert not bool(leaves["reply"][0]) and not bool(leaves["reply"][2])
     # Neither bogus session survives.
     assert int(np.asarray(res.sessions.valid).sum()) == 0
+
+
+def test_flat_safe_organic_reply_with_dnat_hit_across_dispatches():
+    """Commit-first corner (r4): a reply to a PRE-DISPATCH session whose
+    destination is itself a VIP commits a bogus session in the commit
+    pass; the undo must clear exactly that fresh entry while restoring
+    the reply from the (unwritten) pre-existing slot — ending with the
+    same table the scan produces."""
+    import jax
+
+    from vpp_tpu.ops.pipeline import pipeline_flat_safe, pipeline_scan
+
+    maps = [
+        NatMapping("10.96.0.10", 80, 6, [("10.1.1.2", 8080, 1)]),
+        NatMapping("10.1.1.3", 41000, 6, [("10.1.1.5", 9090, 1)]),
+    ]
+    _, pods, acl, nat, route = build_world(mappings=maps)
+    fwd = ("10.1.1.3", "10.96.0.10", 6, 41000, 80)
+    reply = ("10.1.1.2", "10.1.1.3", 6, 8080, 41000)  # dnat-hits VIP2!
+    filler = ("10.1.1.4", "10.1.1.5", 6, 2000, 8080)
+
+    def two_dispatches(step):
+        # Dispatch 1 carries the forward flow; dispatch 2 the reply.
+        s = empty_sessions(1024)
+        b1 = jax.tree_util.tree_map(
+            lambda a: a.reshape(1, 2), make_batch([fwd, filler]))
+        r1 = step(acl, nat, route, s, b1, jnp.arange(1, 2, dtype=jnp.int32))
+        b2 = jax.tree_util.tree_map(
+            lambda a: a.reshape(1, 2), make_batch([reply, filler]))
+        return step(acl, nat, route, r1.sessions, b2,
+                    jnp.arange(2, 3, dtype=jnp.int32))
+
+    scanned = two_dispatches(pipeline_scan)
+    safe = two_dispatches(pipeline_flat_safe)
+    leaves = _flat_leaves(safe)
+    assert bool(leaves["reply"][0])
+    assert not bool(leaves["dnat"][0])
+    assert u32_to_ip(int(leaves["src_ip"][0])) == "10.96.0.10"
+    assert not bool(leaves["punt"][0])
+    _assert_results_equal(scanned, safe)
+    sv = np.asarray(scanned.sessions.valid)
+    fv = np.asarray(safe.sessions.valid)
+    np.testing.assert_array_equal(sv, fv)
+    np.testing.assert_array_equal(
+        np.asarray(scanned.sessions.r_src_ip) * sv,
+        np.asarray(safe.sessions.r_src_ip) * fv)
+
+
+def test_session_keys_unique_under_load():
+    """The commit-first probe split relies on valid slots holding
+    UNIQUE reply keys (a fresh insert can never duplicate a live key).
+    Hammer the flat-safe dispatch with duplicate-heavy traffic and
+    assert the invariant directly on the table."""
+    import jax
+
+    from vpp_tpu.ops.pipeline import pipeline_flat_safe
+
+    maps = [NatMapping("10.96.0.10", 80, 6,
+                       [("10.1.1.2", 8080, 1), ("10.1.2.3", 8080, 1)])]
+    _, pods, acl, nat, route = build_world(mappings=maps)
+    rng = np.random.RandomState(7)
+    sessions = empty_sessions(256)  # small table -> heavy probe contention
+    for dispatch in range(4):
+        flows = []
+        for i in range(64):
+            src = f"10.1.1.{rng.randint(2, 6)}"
+            flows.append((src, "10.96.0.10", 6,
+                          int(rng.randint(1024, 1200)), 80))
+        batches = jax.tree_util.tree_map(
+            lambda a: a.reshape(4, 16), make_batch(flows))
+        ts = jnp.arange(dispatch * 4 + 1, dispatch * 4 + 5, dtype=jnp.int32)
+        res = pipeline_flat_safe(acl, nat, route, sessions, batches, ts)
+        sessions = res.sessions
+        valid = np.asarray(sessions.valid)
+        keys = np.asarray(sessions.key_tbl)[valid]
+        uniq = {tuple(row) for row in keys}
+        assert len(uniq) == valid.sum(), "duplicate live session keys"

@@ -41,9 +41,8 @@ from ..ops.pipeline import (
     ROUTE_REMOTE,
     VECTOR_SIZE,
     RouteConfig,
-    flatten_scan_result,
-    pipeline_flat_safe_jit,
-    pipeline_scan_jit,
+    pipeline_flat_safe_ts0_jit,
+    pipeline_scan_ts0_jit,
     pipeline_step_jit,
 )
 from ..ops.slowpath import HostSlowPath
@@ -426,13 +425,17 @@ class DataplaneRunner:
                 from ..parallel.mesh import shard_batch
 
                 vectors = shard_batch(self.mesh, vectors)
-            tss = jnp.arange(prev_ts + 1, prev_ts + 1 + k, dtype=jnp.int32)
+            # Scalar base-ts entry points: the per-vector ts vector is
+            # built INSIDE the program (a host-side arange per dispatch
+            # costs a full extra round trip on a remote-TPU tunnel),
+            # and the result comes back with flat [K·V] leaves.
             step = (
-                pipeline_flat_safe_jit if self.dispatch == "flat-safe"
-                else pipeline_scan_jit
+                pipeline_flat_safe_ts0_jit if self.dispatch == "flat-safe"
+                else pipeline_scan_ts0_jit
             )
-            result = flatten_scan_result(
-                step(self.acl, self.nat, self.route, self.sessions, vectors, tss)
+            result = step(
+                self.acl, self.nat, self.route, self.sessions, vectors,
+                jnp.int32(prev_ts),
             )
         # Chain the session state into the next dispatch WITHOUT
         # materialising — keeps the device busy back-to-back.

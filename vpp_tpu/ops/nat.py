@@ -157,6 +157,18 @@ jax.tree_util.register_pytree_node(NatTables, NatTables.tree_flatten, NatTables.
 
 # Column indices of the NatSessions key table (16-byte key rows).
 _K_META = 0       # 0 = empty slot, else protocol
+
+# Meta-column tag bit marking "written by the CURRENT dispatch".  Set
+# by nat_commit_sessions_full(tag_writes=True) and cleared by the
+# flat-safe finalize scatter before the dispatch returns, so it never
+# survives in a materialised table.  Folding the mark into the meta
+# word lets ONE key-row probe answer both "does this key match?" and
+# "was it written this batch?" — the alternative (a separate written-
+# mask table) costs a zeros+scatter+gather chain of its own, and the
+# session stages are bound by the NUMBER of small random-access ops,
+# not their bytes.
+WRITE_TAG = 1 << 31
+_META_MASK = WRITE_TAG ^ 0xFFFFFFFF
 _K_RSRC = 1       # reply key: src ip (backend / server)
 _K_RDST = 2       # reply key: dst ip (client after twice-nat)
 _K_RPORTS = 3     # reply key: src_port << 16 | dst_port
@@ -592,10 +604,13 @@ def _rows_key_match(key_rows: jnp.ndarray, batch: PacketBatch) -> jnp.ndarray:
     Operates on ``key_rows = sessions.key_tbl[cand]`` ([B, W, 4]) so
     the probe is ONE 16-byte row gather, not one per field.  The
     proto>0 guard keeps a protocol-0 packet from "matching" empty
-    slots (meta 0)."""
+    slots (meta 0).  The WRITE_TAG bit is masked out of the compare so
+    a flat-safe probe matches this-dispatch writes too (the caller
+    reads the tag from the same rows to tell the two classes apart)."""
     return (
         (batch.protocol[:, None] > 0)
-        & (key_rows[..., _K_META] == batch.protocol.astype(jnp.uint32)[:, None])
+        & ((key_rows[..., _K_META] & jnp.uint32(_META_MASK))
+           == batch.protocol.astype(jnp.uint32)[:, None])
         & (key_rows[..., _K_RSRC] == batch.src_ip[:, None])
         & (key_rows[..., _K_RDST] == batch.dst_ip[:, None])
         & (key_rows[..., _K_RPORTS] == _pack_ports(batch.src_port, batch.dst_port)[:, None])
@@ -623,13 +638,15 @@ class StatelessRewrite(NamedTuple):
 
 def nat_reply_probe(
     sessions: NatSessions, batch: PacketBatch
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Reply probe: ``(key_match [B, W], cand [B, W])`` — which probe
-    slots hold each row's reply key (validity included).  Probes touch
-    only the 16-byte key rows; restore values live in ``val_tbl`` and
-    are gathered by callers at the single selected slot.  The flat-safe
-    reconcile re-masks ``key_match`` with post-undo validity (an undo
-    clears a slot's meta column; keys never change mid-dispatch)."""
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Reply probe: ``(key_match [B, W], cand [B, W], meta [B, W])`` —
+    which probe slots hold each row's reply key (validity included),
+    plus the raw meta words of the probed rows (the flat-safe
+    discipline reads WRITE_TAG out of them to split matches into
+    pre-dispatch sessions vs this-dispatch writes at zero extra memory
+    traffic).  Probes touch only the 16-byte key rows; restore values
+    live in ``val_tbl`` and are gathered by callers at the single
+    selected slot."""
     cap = sessions.capacity
     slot_mask = jnp.uint32(cap - 1)
     rhash = flow_hash(batch.src_ip, batch.dst_ip, batch.protocol,
@@ -637,7 +654,7 @@ def nat_reply_probe(
     base = (rhash & slot_mask).astype(jnp.int32)
     cand = _probe_slots(base, cap)                       # [B, W]
     key_rows = sessions.key_tbl[cand]                    # [B, W, 4]
-    return _rows_key_match(key_rows, batch), cand
+    return _rows_key_match(key_rows, batch), cand, key_rows[..., _K_META]
 
 
 def nat_reply_restore(sessions: NatSessions, batch: PacketBatch) -> ReplyRestore:
@@ -647,7 +664,7 @@ def nat_reply_restore(sessions: NatSessions, batch: PacketBatch) -> ReplyRestore
     state — the scan dispatch keeps just this (plus the commit) inside
     ``lax.scan`` and hoists everything else flat across vectors.
     """
-    key_match, cand = nat_reply_probe(sessions, batch)
+    key_match, cand, _ = nat_reply_probe(sessions, batch)
     reply_hit = jnp.any(key_match, axis=1)
     w = jnp.argmax(key_match, axis=1)
     slot = jnp.take_along_axis(cand, w[:, None], axis=1)[:, 0]
@@ -812,12 +829,15 @@ class CommitResult(NamedTuple):
     let the flat-safe discipline undo a same-dispatch reply's bogus
     forward session: a committed row OWNS its slot's content (the
     post-write verify proved its scatter won), so invalidating that
-    slot is race-free."""
+    slot is race-free.  ``reused`` distinguishes a keep-alive refresh
+    of a PRE-EXISTING slot (same key, same orig — clearing it would
+    destroy a legit session) from a fresh insert (safe to undo)."""
 
     sessions: NatSessions
     punt: jnp.ndarray       # bool [B]
     committed: jnp.ndarray  # bool [B] row's session write won and verified
     ins_slot: jnp.ndarray   # int32 [B] slot written by committed rows
+    reused: jnp.ndarray     # bool [B] committed into a pre-existing slot
 
 
 def nat_commit_sessions_full(
@@ -828,6 +848,7 @@ def nat_commit_sessions_full(
     reply_hit: jnp.ndarray,
     reply_slot: jnp.ndarray,
     timestamp: jnp.ndarray,
+    tag_writes: bool = False,
 ) -> CommitResult:
     """Scatter new sessions in and refresh reply keep-alives.
 
@@ -858,19 +879,28 @@ def nat_commit_sessions_full(
     base = (rkh & slot_mask).astype(jnp.int32)
     cand = _probe_slots(base, cap)                     # [B, W]
     key_rows = sessions.key_tbl[cand]                  # [B, W, 4]
-    val_rows = sessions.val_tbl[cand]                  # [B, W, 4]
     same_key = _rows_key_match(key_rows, reply_view)   # [B, W]
     orig_ports = _pack_ports(orig.src_port, orig.dst_port)
-    same_orig = (
-        same_key
-        & (val_rows[..., _V_OSRC] == orig.src_ip[:, None])
-        & (val_rows[..., _V_ODST] == orig.dst_ip[:, None])
-        & (val_rows[..., _V_OPORTS] == orig_ports[:, None])
+    # Valid slots hold UNIQUE keys (inserts reuse a same-key slot or
+    # punt on collision; intra-batch racers lose the scatter and punt),
+    # so same_key has at most ONE true way — gather the 16-byte value
+    # row at that single slot instead of all W ways (the session stages
+    # are gather-bound on TPU; this quarters the commit's value
+    # traffic).
+    w_sk = jnp.argmax(same_key, axis=1)                          # [B]
+    slot_sk = jnp.take_along_axis(cand, w_sk[:, None], axis=1)[:, 0]
+    any_sk = jnp.any(same_key, axis=1)
+    vals_sk = sessions.val_tbl[slot_sk]                # [B, 4]
+    same_orig_row = (
+        any_sk
+        & (vals_sk[:, _V_OSRC] == orig.src_ip)
+        & (vals_sk[:, _V_ODST] == orig.dst_ip)
+        & (vals_sk[:, _V_OPORTS] == orig_ports)
     )
     # Another live flow already owns this reply key -> ambiguous replies.
-    collision = jnp.any(same_key & ~same_orig, axis=1)
+    collision = any_sk & ~same_orig_row
     free = key_rows[..., _K_META] == 0
-    has_same = jnp.any(same_orig, axis=1)
+    has_same = same_orig_row
     has_free = jnp.any(free, axis=1)
     # Free-slot choice rotates per flow (hash bits above the slot mask):
     # concurrent same-bucket inserters in ONE batch cannot see each
@@ -880,9 +910,7 @@ def nat_commit_sessions_full(
     pref = ((rkh >> jnp.uint32(16)) % jnp.uint32(PROBE_WAYS)).astype(jnp.int32)
     rank = (jnp.arange(PROBE_WAYS, dtype=jnp.int32)[None, :] - pref[:, None]) % PROBE_WAYS
     free_rank = jnp.where(free, rank, PROBE_WAYS)
-    w_pick = jnp.where(
-        has_same, jnp.argmax(same_orig, axis=1), jnp.argmin(free_rank, axis=1)
-    )
+    w_pick = jnp.where(has_same, w_sk, jnp.argmin(free_rank, axis=1))
     ins_slot = jnp.take_along_axis(cand, w_pick[:, None], axis=1)[:, 0]
     # A protocol-0 flow cannot be recorded (r_meta=0 means EMPTY — its
     # write would produce an invisible session that neither restores
@@ -897,11 +925,15 @@ def nat_commit_sessions_full(
     w = jnp.where(can_insert, ins_slot, drop_sentinel)
     reply_ports = _pack_ports(reply_view.src_port, reply_view.dst_port)
     ts_col = jnp.broadcast_to(timestamp.astype(jnp.uint32), reply_ports.shape)
+    # tag_writes (static): mark this dispatch's writes in the meta word
+    # so the flat-safe reconcile can split its probe matches without a
+    # separate written-mask table; the caller MUST clear the tag before
+    # returning the table (its finalize scatter).
+    meta_col = reply_view.protocol.astype(jnp.uint32)
+    if tag_writes:
+        meta_col = meta_col | jnp.uint32(WRITE_TAG)
     new_keys = jnp.stack(
-        [
-            reply_view.protocol.astype(jnp.uint32),
-            reply_view.src_ip, reply_view.dst_ip, reply_ports,
-        ],
+        [meta_col, reply_view.src_ip, reply_view.dst_ip, reply_ports],
         axis=1,
     )  # [B, 4]
     new_vals = jnp.stack(
@@ -933,6 +965,7 @@ def nat_commit_sessions_full(
         punt=punt,
         committed=committed,
         ins_slot=ins_slot,
+        reused=committed & has_same,
     )
 
 

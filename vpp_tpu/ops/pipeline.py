@@ -37,6 +37,7 @@ from .nat import (
     _V_OPORTS,
     _V_OSRC,
     _V_SEEN,
+    WRITE_TAG,
     NatSessions,
     NatTables,
     combine_rewrite,
@@ -325,6 +326,27 @@ def pipeline_flat_safe(
     of which the scan would restore one dispatch later anyway), the
     same commit-race punts, and the same ACL gating.  A/B-tested
     against the scan and the sequential oracle in tests/test_pipeline.py.
+
+    COMMIT-FIRST layout (r4): the session stages are gather-bound on
+    TPU, so the discipline is arranged to touch the table as little as
+    possible.  Two facts make a pre-commit restore probe unnecessary:
+    (a) valid slots hold UNIQUE keys (inserts reuse a same-key slot or
+    punt; intra-batch racers lose the scatter and punt), and (b) a
+    fresh insert's key can never equal a pre-existing key (same key +
+    same orig would have REUSED the slot; same key + different orig
+    punts as a collision).  Therefore ONE probe of the post-commit
+    table, split by a this-batch written mask, classifies every row in
+    a single pass: a match on an unwritten slot is an organic reply to
+    a pre-dispatch session; a match on a written slot is a straggler
+    (its forward flow sits in this very dispatch) — the two are
+    mutually exclusive.  Commit therefore runs FIRST, on the stateless
+    rewrite (identical bytes for every row that can record — reply
+    rows' stateless DNAT/SNAT hits are rare and their bogus sessions
+    are undone, exactly like stragglers' always were).  vs the r3
+    layout this deletes the full pre-table key+value restore probe
+    ([B,W,4]+[B,4] random rows) — the session stage is now two key
+    probes total (insert-side + restore-side), the same count as the
+    UNSAFE flat step.
     """
     k, v = batches.src_ip.shape
 
@@ -333,47 +355,78 @@ def pipeline_flat_safe(
 
     flat = jax.tree_util.tree_map(flatten, batches)
     ts_rows = jnp.repeat(timestamps, v)
+    b = k * v
+    cap = sessions.capacity
+    cap_sentinel = jnp.int32(cap)
 
-    # ---- pass 1: the plain flat step --------------------------------
+    # ---- pass 1: session-independent compute ------------------------
     src_action = classify_src(acl, flat)
     stateless = nat_rewrite_stateless(nat, flat)
     dst_action = classify_dst(acl, stateless.batch)
     acl_ok = (src_action != _DENY) & (dst_action != _DENY)
-    rw = combine_rewrite(nat_reply_restore(sessions, flat), stateless)
-    allowed = acl_ok | rw.reply_hit
-    record = (rw.dnat_hit | rw.snat_hit) & allowed
+
+    # ---- pass 2: commit (insert-side probe) -------------------------
+    # Keep-alive touches for restored replies are deferred to pass 4
+    # (reply_hit=False here); scatter-max is order-independent.
+    no_reply = jnp.zeros(b, dtype=bool)
+    record0 = (stateless.dnat_hit | stateless.snat_hit) & acl_ok
     commit = nat_commit_sessions_full(
-        sessions, flat, rw.batch, record, rw.reply_hit, rw.reply_slot, ts_rows
+        sessions, flat, stateless.batch, record0, no_reply,
+        jnp.zeros(b, dtype=jnp.int32), ts_rows, tag_writes=True,
     )
 
-    # ---- pass 2: straggler detection + bogus-session undo -----------
-    # One 16-byte key-row gather; pass 3 reuses the key match (the undo
-    # clears only a slot's meta column; keys never change mid-dispatch)
-    # plus a meta-column re-gather, and restore values are read at the
-    # single selected slot.
-    km2, cand2 = nat_reply_probe(commit.sessions, flat)
+    # ---- pass 3: the ONE restore-side probe -------------------------
+    # tag_writes marked this batch's writes in the meta word, so the
+    # probe's own gathered rows split the matches — no separate
+    # written-mask table (the session stages are bound by the COUNT of
+    # small random-access ops, so every eliminated scatter/gather chain
+    # is throughput).
+    km2, cand2, meta2 = nat_reply_probe(commit.sessions, flat)
+    wm = (meta2 & jnp.uint32(WRITE_TAG)) != 0           # [B, W]
+    km_pre = km2 & ~wm        # matches against pre-dispatch sessions
+    km_new = km2 & wm         # matches against this batch's writes
+    # Valid slots hold unique keys, so km2 has at most ONE true way —
+    # km_pre and km_new are mutually exclusive per row and the argmax
+    # selections below are all over singleton sets.
+    reply_pre = jnp.any(km_pre, axis=1)
     hit2 = jnp.any(km2, axis=1)
     w2 = jnp.argmax(km2, axis=1)
     slot2 = jnp.take_along_axis(cand2, w2[:, None], axis=1)[:, 0]
     own_write = commit.committed & (slot2 == commit.ins_slot)
-    straggler = hit2 & ~rw.reply_hit & ~own_write
-    cap_sentinel = jnp.int32(sessions.capacity)
-    undo_slot = jnp.where(straggler & commit.committed, commit.ins_slot, cap_sentinel)
+    straggler = hit2 & ~reply_pre & ~own_write
+
+    # Undo bogus forward sessions: any FRESH commit by a row that is
+    # itself a reply (organic or straggler).  Reused slots are legit
+    # pre-existing sessions being refreshed — clearing those would
+    # destroy real state, so they are excluded (crafted corners only;
+    # organic replies never DNAT/SNAT-hit and so never commit).
+    # ONE finalize scatter serves undo AND tag clearing: every
+    # committed row's slot gets its final meta (0 when undone, the
+    # bare protocol otherwise).
+    undo_rows = commit.committed & ~commit.reused & (reply_pre | straggler)
+    fin_slot = jnp.where(commit.committed, commit.ins_slot, cap_sentinel)
+    fin_meta = jnp.where(
+        undo_rows, jnp.uint32(0), flat.protocol.astype(jnp.uint32)
+    )
     sessions2 = NatSessions(
-        key_tbl=commit.sessions.key_tbl.at[undo_slot, _K_META].set(
-            jnp.uint32(0), mode="drop"
+        key_tbl=commit.sessions.key_tbl.at[fin_slot, _K_META].set(
+            fin_meta, mode="drop"
         ),
         val_tbl=commit.sessions.val_tbl,
     )
 
-    # ---- pass 3: restore stragglers against the cleaned table -------
-    km3 = km2 & (sessions2.key_tbl[cand2, _K_META] > 0)
-    hit3 = jnp.any(km3, axis=1)
-    w3 = jnp.argmax(km3, axis=1)
-    slot3 = jnp.take_along_axis(cand2, w3[:, None], axis=1)[:, 0]
-    vals3 = sessions2.val_tbl[slot3]  # [B, 4]
-    restored_now = straggler & hit3
-    touch = jnp.where(restored_now, slot3, cap_sentinel)
+    # ---- pass 4: restores against the finalized table ---------------
+    # A straggler's single matched slot may be another straggler's
+    # undone bogus write — one scalar meta gather at the selected slot
+    # re-checks validity (organic replies matched unwritten slots,
+    # which the finalize scatter never clears).
+    slot_pre = slot2  # singleton match: the km2 selection IS the slot
+    rslot = jnp.where(reply_pre, slot_pre, slot2)
+    meta_chk = sessions2.key_tbl[rslot, _K_META]        # [B]
+    restored_strag = straggler & (meta_chk != 0)
+    reply_final = reply_pre | restored_strag
+    vals3 = sessions2.val_tbl[rslot]  # [B, 4] — one row per restore
+    touch = jnp.where(reply_final, rslot, cap_sentinel)
     # max, not set: duplicate slots with differing per-row timestamps
     # (two restored replies to one session) scatter in undefined order.
     sessions3 = NatSessions(
@@ -383,23 +436,24 @@ def pipeline_flat_safe(
         ),
     )
 
-    def merge(a, b):
-        return jnp.where(restored_now, a, b)
+    def merge(a, b_):
+        return jnp.where(reply_final, a, b_)
 
     # Restore mapping as in nat_reply_restore: src <- original dst
     # (VIP), dst <- original src (client), ports likewise (unpacked
     # from the packed-ports word of the selected value row).
     op3 = vals3[:, _V_OPORTS]
     final_batch = PacketBatch(
-        src_ip=merge(vals3[:, _V_ODST], rw.batch.src_ip),
-        dst_ip=merge(vals3[:, _V_OSRC], rw.batch.dst_ip),
+        src_ip=merge(vals3[:, _V_ODST], stateless.batch.src_ip),
+        dst_ip=merge(vals3[:, _V_OSRC], stateless.batch.dst_ip),
         protocol=flat.protocol,
-        src_port=merge((op3 & jnp.uint32(0xFFFF)).astype(jnp.int32), rw.batch.src_port),
-        dst_port=merge((op3 >> jnp.uint32(16)).astype(jnp.int32), rw.batch.dst_port),
+        src_port=merge((op3 & jnp.uint32(0xFFFF)).astype(jnp.int32),
+                       stateless.batch.src_port),
+        dst_port=merge((op3 >> jnp.uint32(16)).astype(jnp.int32),
+                       stateless.batch.dst_port),
     )
-    reply_final = rw.reply_hit | restored_now
-    allowed_final = allowed | restored_now
-    punt_final = (commit.punt & ~restored_now) | (straggler & ~hit3)
+    allowed_final = acl_ok | reply_final
+    punt_final = (commit.punt & ~reply_final) | (straggler & ~restored_strag)
     tag, node_id = _route_tags(route, final_batch.dst_ip, allowed_final)
 
     def unflatten(a):
@@ -411,14 +465,38 @@ def pipeline_flat_safe(
         allowed=unflatten(allowed_final),
         route=unflatten(tag),
         node_id=unflatten(node_id),
-        dnat_hit=unflatten(rw.dnat_hit & ~restored_now),
-        snat_hit=unflatten(rw.snat_hit & ~restored_now),
+        dnat_hit=unflatten(stateless.dnat_hit & ~reply_final),
+        snat_hit=unflatten(stateless.snat_hit & ~reply_final),
         reply_hit=unflatten(reply_final),
         punt=unflatten(punt_final),
     )
 
 
 pipeline_flat_safe_jit = jax.jit(pipeline_flat_safe, donate_argnums=(3,))
+
+
+def _with_ts0(fn):
+    """Wrap a [K, V] discipline to take a SCALAR base timestamp and
+    derive the per-vector ts inside the program, returning [K·V]-flat
+    leaves.  The host-side ``jnp.arange`` the raw signatures require is
+    an extra tiny device-array creation per dispatch — on a remote-TPU
+    tunnel that is one more round trip, measured at a 40-100% tax on
+    the whole 16k-packet dispatch (r4: it was misattributed to the
+    session stages for a full round).  Vector i gets ts0 + 1 + i."""
+
+    def stepped(acl, nat, route, sessions, batches, ts0):
+        k = batches.src_ip.shape[0]
+        tss = ts0 + jnp.arange(1, k + 1, dtype=jnp.int32)
+        return flatten_scan_result(fn(acl, nat, route, sessions, batches, tss))
+
+    return stepped
+
+
+# Production entry points: scalar base-ts in, flat leaves out (the
+# runner consumes flat [K·V] arrays; flattening inside the program
+# costs nothing and returns rank-1 buffers).
+pipeline_scan_ts0_jit = jax.jit(_with_ts0(pipeline_scan), donate_argnums=(3,))
+pipeline_flat_safe_ts0_jit = jax.jit(_with_ts0(pipeline_flat_safe), donate_argnums=(3,))
 
 
 def flatten_scan_result(res: PipelineResult) -> PipelineResult:
