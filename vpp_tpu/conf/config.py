@@ -120,10 +120,13 @@ class NetworkConfig:
     # Vectors the datapath runner may coalesce into one device program
     # (pow2-floored; sessions thread vector-to-vector on device).
     max_vectors: int = 64
-    # Multi-vector dispatch discipline: "scan" (sequential session
-    # semantics via lax.scan) or "flat-safe" (batch-parallel with
-    # post-commit reply reconciliation; see ops/pipeline.py).
-    dispatch: str = "flat-safe"
+    # Multi-vector dispatch discipline: "auto" picks per backend from
+    # the measured orderings (flat-safe on TPU, scan on CPU — on one
+    # CPU core the reconcile's extra probe passes compete with the
+    # pipeline for the same core and punt more rows, FRAMEBENCH r3);
+    # explicit "scan" / "flat-safe" override per node, the same
+    # trace-time pattern as the NAT lookup-discipline gate (use_hmap).
+    dispatch: str = "auto"
 
     @classmethod
     def from_dict(cls, data: Optional[dict]) -> "NetworkConfig":
@@ -138,7 +141,7 @@ class NetworkConfig:
             routing=RoutingConfig(**data.get("routing", {})),
             batch_size=data.get("batch_size", 256),
             max_vectors=data.get("max_vectors", 64),
-            dispatch=data.get("dispatch", "flat-safe"),
+            dispatch=data.get("dispatch", "auto"),
         )
 
     def overlay(self, **kw) -> "NetworkConfig":

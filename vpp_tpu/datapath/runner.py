@@ -155,11 +155,15 @@ class DataplaneRunner:
         # vector-to-vector with lax.scan (VPP's sequential-vector
         # semantics on device); "flat-safe" runs every vector batch-
         # parallel and recovers same-dispatch replies with post-commit
-        # re-probes (pipeline_flat_safe) — ~30% more throughput at the
-        # production coalesce, restores same-VECTOR replies the scan
+        # re-probes (pipeline_flat_safe) — faster at the production
+        # coalesce on TPU, restores same-VECTOR replies the scan
         # cannot, and punts crafted-aliasing corners to the host slow
-        # path instead of restoring them.
-        dispatch: str = "flat-safe",
+        # path instead of restoring them.  "auto" (default) picks per
+        # the backend this runner dispatches to: flat-safe on TPU,
+        # scan on CPU (where the reconcile's extra passes compete with
+        # the pipeline for the same cores and punt more — the measured
+        # orderings, FRAMEBENCH r3/r4).
+        dispatch: str = "auto",
         # Sharing hooks for the multi-shard engine (shards.py): a common
         # DeviceSessionState (one device session table for all shards),
         # a common host slow path + tracer, and the lock guarding them.
@@ -190,8 +194,12 @@ class DataplaneRunner:
         # so the effective cap is the power-of-two floor of max_vectors
         # (enforced by the property setter).
         self.max_vectors = max_vectors
-        if dispatch not in ("scan", "flat-safe"):
+        if dispatch not in ("auto", "scan", "flat-safe"):
             raise ValueError(f"unknown dispatch discipline: {dispatch!r}")
+        if dispatch == "auto":
+            dispatch = (
+                "flat-safe" if self._target_backend() == "tpu" else "scan"
+            )
         self.dispatch = dispatch
         self.max_inflight = max(1, max_inflight)
         self.sweep_interval = sweep_interval
