@@ -743,3 +743,191 @@ def test_ring_widen_cap_is_configurable_and_logged(caplog):
             bucket_size=64,
         ) == 64
     assert not caplog.records
+
+
+# ---------------------------------------------------------------------------
+# ClientIP affinity timeout (VERDICT r3 item 9)
+# ---------------------------------------------------------------------------
+
+
+def _affinity_tables(backends):
+    mapping = NatMapping(
+        external_ip=CLUSTER_IP, external_port=80, protocol=6,
+        backends=backends, twice_nat=TWICE_NAT_SELF,
+        session_affinity_timeout=30,  # seconds
+    )
+    return build_nat_tables(
+        [mapping], nat_loopback="10.1.1.254", snat_ip="192.168.16.1",
+        snat_enabled=True, pod_subnet="10.1.0.0/16",
+    ), mapping
+
+
+def _pick(tables, sessions, client, ts=0):
+    res = run_nat(tables, sessions, [(client, CLUSTER_IP, 6, 40000, 80)], ts=ts)
+    assert bool(res.dnat_hit[0])
+    return u32_to_ip(int(res.batch.dst_ip[0])), res.sessions
+
+
+def test_affinity_pick_survives_backend_change_until_expiry():
+    """The done criterion: with ClientIP affinity, a client's backend
+    pick must be STABLE across a backend-ring change before the
+    timeout, and re-pick from the new ring after sweep_affinity
+    expires the pin."""
+    from vpp_tpu.ops.nat import affinity_occupancy, sweep_affinity
+
+    two = [("10.1.1.2", 8080, 1), ("10.1.2.3", 8080, 1)]
+    tables, _ = _affinity_tables(two)
+    assert tables.has_affinity
+    sessions = empty_sessions(1024)
+
+    # Find a client whose pick CHANGES when the ring widens — proves
+    # the stability below comes from the pin, not hash luck.
+    many = two + [(f"10.1.3.{i + 2}", 8080, 1) for i in range(6)]
+    tables_many, _ = _affinity_tables(many)
+    client = None
+    for i in range(2, 60):
+        cand = f"10.2.0.{i}"
+        p1, _ = _pick(tables, empty_sessions(1024), cand)
+        p2, _ = _pick(tables_many, empty_sessions(1024), cand)
+        if p1 != p2:
+            client = cand
+            break
+    assert client is not None
+
+    # First packet pins the hash pick.
+    first, sessions = _pick(tables, sessions, client, ts=1)
+    assert affinity_occupancy(sessions) == 1
+
+    # Backend set changes (ring widens): the pin holds the pick stable.
+    stable, sessions = _pick(tables_many, sessions, client, ts=2)
+    assert stable == first
+
+    # Expire: 30s timeout at 1 ts/second, idle since ts=2 -> stale at
+    # ts=40.  After the sweep the client re-picks from the NEW ring.
+    sessions = sweep_affinity(sessions, tables_many, now=40, ts_per_second=1.0)
+    assert affinity_occupancy(sessions) == 0
+    fresh, sessions = _pick(tables_many, sessions, client, ts=41)
+    assert fresh != first  # the crafted client's new-ring hash pick
+    assert affinity_occupancy(sessions) == 1
+
+    # ...and before its timeout the NEW pin is stable too.
+    again, sessions = _pick(tables_many, sessions, client, ts=42)
+    assert again == fresh
+
+
+def test_affinity_keepalive_defers_expiry():
+    """Traffic refreshes last_seen: a client active within the timeout
+    window keeps its pin through a sweep."""
+    from vpp_tpu.ops.nat import affinity_occupancy, sweep_affinity
+
+    tables, _ = _affinity_tables(
+        [("10.1.1.2", 8080, 1), ("10.1.2.3", 8080, 1)])
+    sessions = empty_sessions(1024)
+    first, sessions = _pick(tables, sessions, "10.2.0.9", ts=1)
+    # Keep-alive at ts=25; sweep at ts=40 (age 15 < 30s timeout).
+    _, sessions = _pick(tables, sessions, "10.2.0.9", ts=25)
+    sessions = sweep_affinity(sessions, tables, now=40, ts_per_second=1.0)
+    assert affinity_occupancy(sessions) == 1
+
+
+def test_affinity_entries_and_sessions_coexist():
+    """Affinity rows share the table under AFFINITY_FLAG: they are
+    invisible to session metrics/GC, and reply restoration still works
+    with both row kinds live."""
+    from vpp_tpu.ops.nat import (
+        affinity_occupancy, session_occupancy, sweep_sessions,
+    )
+
+    tables, _ = _affinity_tables(
+        [("10.1.1.2", 8080, 1), ("10.1.2.3", 8080, 1)])
+    sessions = empty_sessions(1024)
+    res = run_nat(tables, sessions,
+                  [("10.2.0.9", CLUSTER_IP, 6, 40000, 80)], ts=1)
+    sessions = res.sessions
+    assert session_occupancy(sessions) == 1   # the NAT session
+    assert affinity_occupancy(sessions) == 1  # the pin
+    backend = u32_to_ip(int(res.batch.dst_ip[0]))
+    bport = int(res.batch.dst_port[0])
+
+    # Reply restores through the session while the pin is live.
+    reply = run_nat(tables, sessions, [(backend, "10.2.0.9", 6, bport, 40000)], ts=2)
+    assert bool(reply.reply_hit[0])
+    assert u32_to_ip(int(reply.batch.src_ip[0])) == CLUSTER_IP
+    # Session GC does not collect affinity rows.
+    swept = sweep_sessions(reply.sessions, now=1 << 20, max_age=1)
+    assert session_occupancy(swept) == 0
+    assert affinity_occupancy(swept) == 1
+
+
+def test_affinity_oracle_parity():
+    """Kernel vs MockNatEngine across pin, ring change, sweep, re-pin."""
+    from vpp_tpu.ops.nat import sweep_affinity
+
+    two = [("10.1.1.2", 8080, 1), ("10.1.2.3", 8080, 1)]
+    many = two + [(f"10.1.3.{i + 2}", 8080, 1) for i in range(6)]
+    tables, m_two = _affinity_tables(two)
+    tables_many, m_many = _affinity_tables(many)
+    engine = MockNatEngine(
+        nat_loopback="10.1.1.254", snat_ip="192.168.16.1",
+        snat_enabled=True, pod_subnet="10.1.0.0/16",
+        session_capacity=1024)
+    engine.set_mappings([m_two])
+    sessions = empty_sessions(1024)
+
+    clients = [f"10.2.1.{i}" for i in range(2, 12)]
+
+    def check(tbl, ts):
+        nonlocal sessions
+        for c in clients:
+            flow = (c, CLUSTER_IP, 6, 40000, 80)
+            got, sessions = _pick(tbl, sessions, c, ts=ts)
+            want = engine.process(Flow.make(*flow), timestamp=ts)
+            assert ip_to_u32(got) == want.flow.dst_ip, (c, ts)
+
+    check(tables, ts=1)
+    engine.set_mappings([m_many])
+    check(tables_many, ts=2)          # pins hold through the change
+    sessions = sweep_affinity(sessions, tables_many, now=50, ts_per_second=1.0)
+    engine.sweep_affinity(now=50, ts_per_second=1.0)
+    check(tables_many, ts=51)         # both re-pin from the new ring
+
+
+def test_affinity_all_disciplines_agree():
+    """flat / scan / flat-safe produce identical picks and pins with
+    affinity compiled in (same-dispatch duplicate clients included)."""
+    import jax
+
+    from vpp_tpu.ops.pipeline import (
+        make_route_config, pipeline_flat_safe, pipeline_scan, pipeline_step,
+    )
+    from vpp_tpu.conf import IPAMConfig
+    from vpp_tpu.ipam import IPAM
+    from vpp_tpu.ops.classify import build_rule_tables
+
+    tables, _ = _affinity_tables(
+        [("10.1.1.2", 8080, 1), ("10.1.2.3", 8080, 1)])
+    acl = build_rule_tables([], {})
+    route = make_route_config(IPAM(IPAMConfig(), node_id=1))
+    flows = []
+    for i in range(16):
+        c = f"10.2.2.{2 + i % 5}"   # duplicate clients in one dispatch
+        flows.append((c, CLUSTER_IP, 6, 41000 + i, 80))
+    batch = make_batch(flows)
+    vecs = jax.tree_util.tree_map(lambda a: a.reshape(4, 4), batch)
+    tss = jnp.arange(1, 5, dtype=jnp.int32)
+
+    flat_res = pipeline_step(acl, tables, route, empty_sessions(1024),
+                             batch, jnp.int32(4))
+    scan_res = pipeline_scan(acl, tables, route, empty_sessions(1024), vecs, tss)
+    safe_res = pipeline_flat_safe(acl, tables, route, empty_sessions(1024), vecs, tss)
+    flat_dst = np.asarray(flat_res.batch.dst_ip)
+    np.testing.assert_array_equal(
+        flat_dst, np.asarray(scan_res.batch.dst_ip).reshape(-1))
+    np.testing.assert_array_equal(
+        flat_dst, np.asarray(safe_res.batch.dst_ip).reshape(-1))
+    # One pin per distinct client, identical across disciplines.
+    from vpp_tpu.ops.nat import affinity_occupancy
+
+    assert affinity_occupancy(flat_res.sessions) == 5
+    assert affinity_occupancy(scan_res.sessions) == 5
+    assert affinity_occupancy(safe_res.sessions) == 5

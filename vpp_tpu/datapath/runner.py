@@ -30,8 +30,8 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.nat import (
-    NatSessions, NatTables, empty_sessions, retarget_tables,
-    session_occupancy, sweep_sessions,
+    NatSessions, NatTables, affinity_occupancy, empty_sessions,
+    retarget_tables, session_occupancy, sweep_affinity, sweep_sessions,
 )
 from ..ops.classify import RuleTables
 from ..ops.packets import PacketBatch
@@ -94,6 +94,10 @@ class DeviceSessionState:
         self.sessions: NatSessions = empty_sessions(capacity)
         self.ts = 0
         self.lock = threading.RLock()
+        # (ts, wall-time) of the last sweep — the affinity expiry
+        # converts per-mapping SECONDS to timestamp units at the rate
+        # measured between sweeps.
+        self.sweep_mark = None
 
 
 @dataclasses.dataclass
@@ -455,6 +459,19 @@ class DataplaneRunner:
             self.sessions = sweep_sessions(self.sessions, self._ts, self.sweep_max_age)
             with self._host_lock:  # slow-path dict is shared across shards
                 self.slow.sweep(self._ts, self.sweep_max_age)
+            # ClientIP affinity expiry: per-mapping timeouts are in
+            # SECONDS; convert at the ts rate measured between sweeps
+            # (first sweep only records the mark).
+            import time as _time
+
+            now = _time.monotonic()
+            mark = self._state.sweep_mark
+            if self.nat.has_affinity and mark is not None and now > mark[1]:
+                rate = (self._ts - mark[0]) / (now - mark[1])
+                self.sessions = sweep_affinity(
+                    self.sessions, self.nat, self._ts, rate
+                )
+            self._state.sweep_mark = (self._ts, now)
         return result
 
     # ------------------------------------------------------- native engine
@@ -725,6 +742,7 @@ class DataplaneRunner:
         out = self.counters.as_dict()
         out.update(self.slow.counters.as_dict())
         out["datapath_sessions_active"] = session_occupancy(self.sessions)
+        out["datapath_affinity_active"] = affinity_occupancy(self.sessions)
         out["datapath_slowpath_sessions_active"] = len(self.slow)
         out["datapath_inflight"] = len(self._inflight)
         return out

@@ -123,6 +123,9 @@ class NatTables:
     # Pod/service subnets for routing decisions (base, mask).
     pod_subnet_base: jnp.ndarray  # uint32 []
     pod_subnet_mask: jnp.ndarray  # uint32 []
+    # ClientIP affinity timeout per mapping, SECONDS (0 = disabled);
+    # the host sweep converts to timestamp units at its measured rate.
+    map_aff_timeout: jnp.ndarray = None  # int32 [M]
 
     num_mappings: int = 0
     bucket_size: int = 0
@@ -136,6 +139,9 @@ class NatTables:
     #     adversary since the hash is unseeded); only then is hmap_idx
     #     a 16-entry stub and the dense path the sole correct lookup.
     use_hmap: bool = True
+    # Static gate: ANY mapping has ClientIP affinity (compiles the
+    # affinity probe/commit into the program only when true).
+    has_affinity: bool = False
 
     def tree_flatten(self):
         children = (
@@ -144,12 +150,19 @@ class NatTables:
             self.backend_ip, self.backend_port, self.hmap_idx,
             self.nat_loopback, self.snat_ip, self.snat_enabled,
             self.pod_subnet_base, self.pod_subnet_mask,
+            self.map_aff_timeout,
         )
-        return children, (self.num_mappings, self.bucket_size, self.use_hmap)
+        return children, (
+            self.num_mappings, self.bucket_size, self.use_hmap,
+            self.has_affinity,
+        )
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children, num_mappings=aux[0], bucket_size=aux[1], use_hmap=aux[2])
+        return cls(
+            *children, num_mappings=aux[0], bucket_size=aux[1],
+            use_hmap=aux[2], has_affinity=aux[3],
+        )
 
 
 jax.tree_util.register_pytree_node(NatTables, NatTables.tree_flatten, NatTables.tree_unflatten)
@@ -169,6 +182,22 @@ _K_META = 0       # 0 = empty slot, else protocol
 # not their bytes.
 WRITE_TAG = 1 << 31
 _META_MASK = WRITE_TAG ^ 0xFFFFFFFF
+
+# Meta-column flag marking a CLIENT-IP AFFINITY entry.  Affinity state
+# (K8s ``ClientIP`` service affinity with a timeout) shares the session
+# table's slots: an entry pins (client, service) -> backend so the pick
+# survives backend-ring changes until the affinity EXPIRES (the
+# reference expires NAT affinity entries after session_affinity_timeout
+# — nat44's affinity timeout semantic).  Protocols are <= 255, so the
+# flag bit can never make an affinity row match a session probe (whose
+# meta compare masks only WRITE_TAG), and vice versa.
+AFFINITY_FLAG = 1 << 8
+
+# Affinity value-row columns (reinterpreting the session value row).
+_AV_BIP = 0       # pinned backend ip
+_AV_BPORT = 1     # pinned backend port
+_AV_MIDX = 2      # mapping row (for the per-mapping timeout sweep)
+_AV_SEEN = 3      # last_seen (same column as sessions' _V_SEEN)
 _K_RSRC = 1       # reply key: src ip (backend / server)
 _K_RDST = 2       # reply key: dst ip (client after twice-nat)
 _K_RPORTS = 3     # reply key: src_port << 16 | dst_port
@@ -209,7 +238,14 @@ class NatSessions:
 
     @property
     def valid(self) -> jnp.ndarray:
-        return self.key_tbl[:, _K_META] > 0
+        """Live SESSION rows (affinity entries excluded)."""
+        meta = self.key_tbl[:, _K_META]
+        return (meta > 0) & ((meta & jnp.uint32(AFFINITY_FLAG)) == 0)
+
+    @property
+    def aff_valid(self) -> jnp.ndarray:
+        """Live client-IP AFFINITY rows."""
+        return (self.key_tbl[:, _K_META] & jnp.uint32(AFFINITY_FLAG)) != 0
 
     @property
     def r_meta(self) -> jnp.ndarray:
@@ -485,6 +521,7 @@ def build_nat_tables(
     proto = np.zeros(padded, dtype=np.int32)
     twice = np.zeros(padded, dtype=np.int32)
     affinity = np.zeros(padded, dtype=np.int32)
+    aff_timeout = np.zeros(padded, dtype=np.int32)
     valid = np.zeros(padded, dtype=bool)
     b_ip = np.zeros((padded, bucket_size), dtype=np.uint32)
     b_port = np.zeros((padded, bucket_size), dtype=np.int32)
@@ -495,6 +532,7 @@ def build_nat_tables(
         proto[i] = mapping.protocol
         twice[i] = mapping.twice_nat
         affinity[i] = 1 if mapping.session_affinity_timeout > 0 else 0
+        aff_timeout[i] = mapping.session_affinity_timeout
         valid[i] = True
         if not mapping.backends:
             valid[i] = False
@@ -538,9 +576,11 @@ def build_nat_tables(
         snat_enabled=jnp.asarray(snat_enabled),
         pod_subnet_base=jnp.asarray(int(net.network_address), dtype=jnp.uint32),
         pod_subnet_mask=jnp.asarray(mask, dtype=jnp.uint32),
+        map_aff_timeout=jnp.asarray(aff_timeout),
         num_mappings=m,
         bucket_size=bucket_size,
         use_hmap=use_hmap,
+        has_affinity=bool(aff_timeout.any()),
     )
 
 
@@ -591,6 +631,8 @@ class NatRewrite(NamedTuple):
     reply_hit: jnp.ndarray
     snat_hit: jnp.ndarray
     reply_slot: jnp.ndarray  # int32 [B] resolved session slot of reply hits
+    midx: jnp.ndarray        # int32 [B] matched mapping row (dnat rows)
+    aff_want: jnp.ndarray    # bool [B] dnat hit on an affinity mapping
 
 
 def _probe_slots(base: jnp.ndarray, cap: int) -> jnp.ndarray:
@@ -629,11 +671,20 @@ class ReplyRestore(NamedTuple):
 class StatelessRewrite(NamedTuple):
     """Output of the session-INDEPENDENT rewrite phase (DNAT LB + SNAT
     computed on the original headers).  Valid for every row that is not
-    a reply hit; reply rows take the restored path instead."""
+    a reply hit; reply rows take the restored path instead.
+
+    With ClientIP affinity compiled in (``tables.has_affinity``) the
+    phase additionally reads the PRE-dispatch affinity pins — still
+    hoistable flat (scan) because in-dispatch pin inserts always equal
+    the deterministic client-IP hash pick a later vector would compute
+    anyway.  ``midx``/``aff_want`` feed the post-commit affinity write.
+    """
 
     batch: PacketBatch
     dnat_hit: jnp.ndarray
     snat_hit: jnp.ndarray
+    midx: jnp.ndarray      # int32 [B] matched mapping row (dnat rows)
+    aff_want: jnp.ndarray  # bool [B] dnat hit on an affinity mapping
 
 
 def nat_reply_probe(
@@ -720,10 +771,16 @@ def _dnat_lookup_dense(tables: NatTables, batch: PacketBatch) -> Tuple[jnp.ndarr
     return jnp.any(hit, axis=1), jnp.argmax(hit, axis=1)
 
 
-def nat_rewrite_stateless(tables: NatTables, batch: PacketBatch) -> StatelessRewrite:
+def nat_rewrite_stateless(
+    tables: NatTables,
+    batch: PacketBatch,
+    sessions: Optional[NatSessions] = None,
+) -> StatelessRewrite:
     """DNAT LB + twice-NAT + SNAT on the given headers — no session
-    reads, so the scan dispatch computes this flat over all vectors at
-    once (MXU/VPU-efficient wide shapes, Pallas-eligible batch sizes)."""
+    reads (so the scan dispatch computes this flat over all vectors at
+    once; MXU/VPU-efficient wide shapes, Pallas-eligible batch sizes),
+    EXCEPT when ClientIP affinity is compiled in: then the pre-dispatch
+    affinity pins override the hash pick (see StatelessRewrite)."""
     # --------------------------------------------------------- 1. DNAT LB
     # use_hmap is pytree aux data, so this branch resolves at trace
     # time — the compiled program contains exactly one lookup.
@@ -743,6 +800,16 @@ def nat_rewrite_stateless(tables: NatTables, batch: PacketBatch) -> StatelessRew
     new_dst_port = tables.backend_port[midx, k]
     # A mapping that lost all backends was compiled invalid -> no hit; a
     # zero backend entry inside a valid mapping cannot occur (ring filled).
+    aff_want = dnat_hit & use_aff
+    if tables.has_affinity and sessions is not None:
+        # A live pin overrides the hash pick — the pin survives
+        # backend-ring changes until it EXPIRES (sweep_affinity), the
+        # ClientIP-affinity timeout semantic.
+        aff_hit, pin_ip, pin_port = affinity_lookup(
+            sessions, tables, batch, midx, aff_want
+        )
+        new_dst_ip = jnp.where(aff_hit, pin_ip, new_dst_ip)
+        new_dst_port = jnp.where(aff_hit, pin_port, new_dst_port)
 
     dst_ip2 = jnp.where(dnat_hit, new_dst_ip, batch.dst_ip)
     dst_port2 = jnp.where(dnat_hit, new_dst_port, batch.dst_port)
@@ -775,7 +842,10 @@ def nat_rewrite_stateless(tables: NatTables, batch: PacketBatch) -> StatelessRew
         src_port=src_port3,
         dst_port=dst_port2,
     )
-    return StatelessRewrite(batch=out, dnat_hit=dnat_hit, snat_hit=snat_hit)
+    return StatelessRewrite(
+        batch=out, dnat_hit=dnat_hit, snat_hit=snat_hit,
+        midx=midx, aff_want=aff_want,
+    )
 
 
 def combine_rewrite(restore: ReplyRestore, stateless: StatelessRewrite) -> NatRewrite:
@@ -802,6 +872,8 @@ def combine_rewrite(restore: ReplyRestore, stateless: StatelessRewrite) -> NatRe
         reply_hit=rh,
         snat_hit=stateless.snat_hit & ~rh,
         reply_slot=restore.reply_slot,
+        midx=stateless.midx,
+        aff_want=stateless.aff_want & ~rh,
     )
 
 
@@ -819,7 +891,7 @@ def nat_rewrite(
     """
     return combine_rewrite(
         nat_reply_restore(sessions, batch),
-        nat_rewrite_stateless(tables, batch),
+        nat_rewrite_stateless(tables, batch, sessions),
     )
 
 
@@ -1007,6 +1079,14 @@ def nat_step(
     new_sessions, punt = nat_commit_sessions(
         sessions, batch, rw.batch, record, rw.reply_hit, rw.reply_slot, timestamp
     )
+    if tables.has_affinity:  # static gate — compiled in only when used
+        aff_record = rw.aff_want & rw.dnat_hit
+        if permit is not None:
+            aff_record = aff_record & permit
+        new_sessions = affinity_commit(
+            new_sessions, tables, batch, rw.midx, aff_record,
+            rw.batch.dst_ip, rw.batch.dst_port, timestamp,
+        )
     return NatResult(
         batch=rw.batch,
         sessions=new_sessions,
@@ -1027,10 +1107,150 @@ def session_occupancy(sessions: NatSessions) -> int:
 
 def sweep_sessions(sessions: NatSessions, now: int, max_age: int) -> NatSessions:
     """Host-side idle-session GC: invalidate entries not seen for
-    ``max_age`` batches (the reference's cleanup goroutine analog)."""
+    ``max_age`` batches (the reference's cleanup goroutine analog).
+    Affinity entries are excluded — they expire on their own
+    per-mapping timeout (:func:`sweep_affinity`)."""
     stale = sessions.valid & ((now - sessions.last_seen) > max_age)
     meta = jnp.where(stale, jnp.uint32(0), sessions.key_tbl[:, _K_META])
     return NatSessions(
         key_tbl=sessions.key_tbl.at[:, _K_META].set(meta),
         val_tbl=sessions.val_tbl,
     )
+
+
+# ---------------------------------------------------------------------------
+# ClientIP affinity (session_affinity_timeout enforcement)
+# ---------------------------------------------------------------------------
+#
+# K8s ``ClientIP`` service affinity pins a client to ONE backend until
+# the affinity times out; the pin must survive backend-ring changes
+# (that is its whole point — a pure client-IP hash would re-spread
+# clients on every endpoint update).  Affinity entries share the
+# session table's slots under AFFINITY_FLAG: key = (flag|proto,
+# client_ip, ext_ip, ext_port), value = (backend_ip, backend_port,
+# mapping_row, last_seen).  The DNAT stage probes them to override its
+# hash pick; commits happen AFTER the session commit of the same
+# dispatch (free slots are chosen against the post-commit table, so an
+# affinity insert can never clobber a just-written session); the HOST
+# sweeps expired entries at the per-mapping timeout (reference:
+# nat44's affinity timeout, exportDNATMappings/affinity semantics).
+# Affinity is deliberately best-effort under pressure: a full bucket
+# or a lost intra-batch scatter race falls back to the (deterministic)
+# client-IP hash pick — never a punt, never an eviction of a session.
+
+
+def _affinity_probe(
+    sessions: NatSessions, tables: NatTables, batch: PacketBatch,
+    midx: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(match [B, W], cand [B, W], key_rows [B, W, 4]) for the affinity
+    key of each row's (client, mapping-external) pair."""
+    cap = sessions.capacity
+    aff_proto = batch.protocol + jnp.int32(AFFINITY_FLAG)
+    ext_ip = tables.map_ext_ip[midx]
+    ext_port = tables.map_ext_port[midx]
+    h = flow_hash(batch.src_ip, ext_ip, aff_proto,
+                  jnp.zeros_like(ext_port), ext_port)
+    base = (h & jnp.uint32(cap - 1)).astype(jnp.int32)
+    cand = _probe_slots(base, cap)                      # [B, W]
+    key_rows = sessions.key_tbl[cand]                   # [B, W, 4]
+    match = (
+        (key_rows[..., _K_META] == aff_proto.astype(jnp.uint32)[:, None])
+        & (key_rows[..., _K_RSRC] == batch.src_ip[:, None])
+        & (key_rows[..., _K_RDST] == ext_ip[:, None])
+        & (key_rows[..., _K_RPORTS] == _pack_ports(
+            jnp.zeros_like(ext_port), ext_port)[:, None])
+    )
+    return match, cand, key_rows
+
+
+def affinity_lookup(
+    sessions: NatSessions, tables: NatTables, batch: PacketBatch,
+    midx: jnp.ndarray, want: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Pinned backend of each row's (client, mapping): ``(aff_hit [B],
+    backend_ip [B], backend_port [B])``.  ``want`` masks rows whose
+    mapping has affinity enabled (others never probe-hit)."""
+    match, cand, _rows = _affinity_probe(sessions, tables, batch, midx)
+    match = match & want[:, None]
+    hit = jnp.any(match, axis=1)
+    w = jnp.argmax(match, axis=1)
+    slot = jnp.take_along_axis(cand, w[:, None], axis=1)[:, 0]
+    vals = sessions.val_tbl[slot]  # [B, 4]
+    return hit, vals[:, _AV_BIP], vals[:, _AV_BPORT].astype(jnp.int32)
+
+
+def affinity_commit(
+    sessions: NatSessions, tables: NatTables, batch: PacketBatch,
+    midx: jnp.ndarray, record: jnp.ndarray,
+    backend_ip: jnp.ndarray, backend_port: jnp.ndarray,
+    timestamp: jnp.ndarray,
+) -> NatSessions:
+    """Insert/refresh affinity pins for ``record`` rows (dnat-hit rows
+    of affinity mappings), pinning the backend each row was ACTUALLY
+    sent to this dispatch.  Probes the CURRENT (post-session-commit)
+    table so fresh session writes are seen as occupied.  Intra-batch
+    duplicate clients write identical content (the hash pick is
+    deterministic per client); distinct clients racing for one free
+    slot resolve last-writer-wins with the losers silently unpinned —
+    they fall back to their deterministic hash pick next dispatch."""
+    cap = sessions.capacity
+    match, cand, key_rows = _affinity_probe(sessions, tables, batch, midx)
+    has_own = jnp.any(match, axis=1)
+    w_own = jnp.argmax(match, axis=1)
+    free = key_rows[..., _K_META] == 0
+    has_free = jnp.any(free, axis=1)
+    w_free = jnp.argmax(free, axis=1)
+    w_pick = jnp.where(has_own, w_own, w_free)
+    slot = jnp.take_along_axis(cand, w_pick[:, None], axis=1)[:, 0]
+    can_write = record & (has_own | has_free)
+    drop = jnp.int32(cap)
+    at = jnp.where(can_write, slot, drop)
+    aff_proto = (batch.protocol + jnp.int32(AFFINITY_FLAG)).astype(jnp.uint32)
+    ext_ip = tables.map_ext_ip[midx]
+    ext_port = tables.map_ext_port[midx]
+    new_keys = jnp.stack(
+        [aff_proto, batch.src_ip, ext_ip,
+         _pack_ports(jnp.zeros_like(ext_port), ext_port)],
+        axis=1,
+    )
+    new_vals = jnp.stack(
+        [backend_ip.astype(jnp.uint32),
+         backend_port.astype(jnp.uint32),
+         midx.astype(jnp.uint32),
+         jnp.broadcast_to(timestamp.astype(jnp.uint32), backend_ip.shape)],
+        axis=1,
+    )
+    return NatSessions(
+        key_tbl=sessions.key_tbl.at[at].set(new_keys, mode="drop"),
+        val_tbl=sessions.val_tbl.at[at].set(new_vals, mode="drop"),
+    )
+
+
+def sweep_affinity(
+    sessions: NatSessions, tables: NatTables, now: int, ts_per_second: float
+) -> NatSessions:
+    """Host-side affinity expiry: clear affinity entries idle longer
+    than their mapping's ``session_affinity_timeout`` (seconds),
+    converted to timestamp units at the caller's measured rate.  After
+    expiry the client re-picks from the CURRENT backend ring — the
+    timeout semantic K8s ClientIP affinity requires for rebalancing."""
+    if tables.map_aff_timeout is None:
+        return sessions
+    midx = sessions.val_tbl[:, _AV_MIDX].astype(jnp.int32)
+    midx = jnp.clip(midx, 0, tables.map_aff_timeout.shape[0] - 1)
+    timeout_ts = (
+        tables.map_aff_timeout[midx].astype(jnp.float32) * ts_per_second
+    ).astype(jnp.int32)
+    age = now - sessions.val_tbl[:, _AV_SEEN].astype(jnp.int32)
+    stale = sessions.aff_valid & (age > timeout_ts)
+    meta = jnp.where(stale, jnp.uint32(0), sessions.key_tbl[:, _K_META])
+    return NatSessions(
+        key_tbl=sessions.key_tbl.at[:, _K_META].set(meta),
+        val_tbl=sessions.val_tbl,
+    )
+
+
+def affinity_occupancy(sessions: NatSessions) -> int:
+    """Live affinity-entry count (for /metrics; host-side read)."""
+    return int(jnp.sum(sessions.aff_valid))

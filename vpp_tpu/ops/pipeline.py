@@ -40,6 +40,7 @@ from .nat import (
     WRITE_TAG,
     NatSessions,
     NatTables,
+    affinity_commit,
     combine_rewrite,
     nat_commit_sessions,
     nat_commit_sessions_full,
@@ -135,6 +136,7 @@ def _route_tags(route: RouteConfig, dst: jnp.ndarray, allowed: jnp.ndarray):
 
 
 def _commit_and_route(
+    nat: NatTables,
     route: RouteConfig,
     sessions: NatSessions,
     batch: PacketBatch,
@@ -143,9 +145,10 @@ def _commit_and_route(
     timestamp: jnp.ndarray,
 ):
     """Shared tail of both disciplines: ACL/reply gating, session
-    commit, and node-ID routing.  Returns (new_sessions, result) with
-    ``result.sessions`` left as a placeholder scalar — the caller
-    decides whether it carries the table (flat) or the scan threads it.
+    commit, affinity-pin commit, and node-ID routing.  Returns
+    (new_sessions, result) with ``result.sessions`` left as a
+    placeholder scalar — the caller decides whether it carries the
+    table (flat) or the scan threads it.
     """
     rewritten = rw.batch
     # Session-restored replies skip ACLs (reflective semantics — valid
@@ -158,6 +161,12 @@ def _commit_and_route(
     new_sessions, punt = nat_commit_sessions(
         sessions, batch, rewritten, record, rw.reply_hit, rw.reply_slot, timestamp
     )
+    if nat.has_affinity:  # static gate — compiled in only when used
+        new_sessions = affinity_commit(
+            new_sessions, nat, batch, rw.midx,
+            rw.aff_want & allowed, rewritten.dst_ip, rewritten.dst_port,
+            timestamp,
+        )
 
     # Routing on the post-NAT destination.
     tag, node_id = _route_tags(route, rewritten.dst_ip, allowed)
@@ -197,7 +206,7 @@ def pipeline_step(
     acl_ok = (src_action != _DENY) & (dst_action != _DENY)
 
     new_sessions, result = _commit_and_route(
-        route, sessions, batch, rw, acl_ok, timestamp
+        nat, route, sessions, batch, rw, acl_ok, timestamp
     )
     return result._replace(sessions=new_sessions)
 
@@ -256,7 +265,7 @@ def pipeline_scan(
 
     # ---- flat prepass: ingress ACL, stateless NAT, egress ACL --------
     src_action = classify_src(acl, flat)
-    stateless = nat_rewrite_stateless(nat, flat)
+    stateless = nat_rewrite_stateless(nat, flat, sessions)
     dst_action = classify_dst(acl, stateless.batch)
     acl_ok = (src_action != _DENY) & (dst_action != _DENY)
 
@@ -271,7 +280,7 @@ def pipeline_scan(
     def body(sess, xs):
         batch, sless, ok, ts = xs
         rw = combine_rewrite(nat_reply_restore(sess, batch), sless)
-        return _commit_and_route(route, sess, batch, rw, ok, ts)
+        return _commit_and_route(nat, route, sess, batch, rw, ok, ts)
 
     final_sessions, stacked = jax.lax.scan(body, sessions, per_vec)
     return stacked._replace(sessions=final_sessions)
@@ -361,7 +370,7 @@ def pipeline_flat_safe(
 
     # ---- pass 1: session-independent compute ------------------------
     src_action = classify_src(acl, flat)
-    stateless = nat_rewrite_stateless(nat, flat)
+    stateless = nat_rewrite_stateless(nat, flat, sessions)
     dst_action = classify_dst(acl, stateless.batch)
     acl_ok = (src_action != _DENY) & (dst_action != _DENY)
 
@@ -435,6 +444,12 @@ def pipeline_flat_safe(
             ts_rows.astype(jnp.uint32), mode="drop"
         ),
     )
+    if nat.has_affinity:  # static gate — compiled in only when used
+        sessions3 = affinity_commit(
+            sessions3, nat, flat, stateless.midx,
+            stateless.aff_want & acl_ok & ~reply_final,
+            stateless.batch.dst_ip, stateless.batch.dst_port, ts_rows,
+        )
 
     def merge(a, b_):
         return jnp.where(reply_final, a, b_)

@@ -93,6 +93,11 @@ class MockNatEngine:
         self.session_capacity = session_capacity
         # slot -> (reply key tuple, restore (src_ip, src_port, dst_ip, dst_port))
         self.sessions: Dict[int, Tuple[Tuple, Tuple]] = {}
+        # ClientIP affinity pins: (client_ip, mapping_row) ->
+        # (backend_ip, backend_port, last_seen).  Mirrors the kernel's
+        # AFFINITY_FLAG entries; expiry happens only via sweep_affinity
+        # (device entries likewise expire only via the host sweep).
+        self.affinity: Dict[Tuple[int, int], Tuple[int, int, int]] = {}
 
     # ---------------------------------------------------------- assertions
 
@@ -106,6 +111,17 @@ class MockNatEngine:
             bucket_ring(m, self._k_ring) if m.backends else None
             for m in self.mappings
         ]
+
+    def sweep_affinity(self, now: int, ts_per_second: float = 1.0) -> int:
+        """Expire affinity pins idle past their mapping's timeout
+        (mirror of ops.nat.sweep_affinity); returns entries removed."""
+        removed = 0
+        for key, (_bip, _bport, seen) in list(self.affinity.items()):
+            timeout = self.mappings[key[1]].session_affinity_timeout
+            if now - seen > timeout * ts_per_second:
+                del self.affinity[key]
+                removed += 1
+        return removed
 
     def has_static_mapping(self, external_ip: str, external_port: int, protocol: int) -> bool:
         ip = ip_to_u32(external_ip)
@@ -159,6 +175,13 @@ class MockNatEngine:
                     h = flow_hash_py(*f.key())
                 ring = self._rings[mi]
                 b_ip, b_port = ring[h % len(ring)]
+                if mapping.session_affinity_timeout > 0:
+                    # A live pin overrides the hash pick and refreshes;
+                    # a miss pins the pick made this packet.
+                    pin = self.affinity.get((f.src_ip, mi))
+                    if pin is not None:
+                        b_ip, b_port = pin[0], pin[1]
+                    self.affinity[(f.src_ip, mi)] = (b_ip, b_port, timestamp)
                 hairpin = (
                     mapping.twice_nat == TWICE_NAT_ENABLED
                     or (mapping.twice_nat == TWICE_NAT_SELF and b_ip == f.src_ip)
