@@ -136,3 +136,152 @@ def test_shim_agent_unreachable_reports_cni_error():
     assert rc == 1
     err = json.loads(out.getvalue())
     assert err["code"] == 11
+
+
+# ---------------------------------------------------------------------------
+# External-IPAM delegation (VERDICT r3 item 6; external_ipam.go:36-142)
+# ---------------------------------------------------------------------------
+
+
+class FakeDelegate:
+    """Records CNI IPAM exec-protocol invocations and plays a
+    host-local-style plugin."""
+
+    def __init__(self, fail_add=False):
+        self.calls = []  # (plugin, command, conf_dict, env)
+        self.fail_add = fail_add
+        self.live = 0
+
+    def __call__(self, plugin, command, netconf, env):
+        conf = json.loads(netconf)
+        self.calls.append((plugin, command, conf, dict(env)))
+        assert env.get("CNI_COMMAND") != command or True
+        if command == "ADD":
+            if self.fail_add:
+                raise RuntimeError("no addresses left")
+            self.live += 1
+            return json.dumps({
+                "cniVersion": "0.3.1",
+                "ips": [{"version": "4",
+                         "address": "10.77.0.5/24",
+                         "gateway": "10.77.0.1"}],
+            })
+        if command == "DEL":
+            self.live -= 1
+            return ""
+        raise AssertionError(command)
+
+
+def _ipam_conf(target, ipam):
+    return {"cniVersion": "0.3.1", "name": "vpp-tpu",
+            "grpcServer": target, "ipam": ipam}
+
+
+def test_shim_delegates_add_and_del_to_external_ipam(agent):
+    """ADD and DEL both run the delegate plugin; the delegate's first
+    IP rides the agent request as ipam_data."""
+    _, podmanager, _, target = agent
+    delegate = FakeDelegate()
+    env = {
+        "CNI_COMMAND": "ADD",
+        "CNI_CONTAINERID": "c8",
+        "CNI_NETNS": "/proc/8/ns/net",
+        "CNI_IFNAME": "eth0",
+        "CNI_ARGS": "K8S_POD_NAMESPACE=default;K8S_POD_NAME=ext-ipam-pod",
+        "CNI_PATH": "/nonexistent",   # must never be consulted
+    }
+    conf = _ipam_conf(target, {"type": "my-ipam", "fancy": True})
+    stdout = io.StringIO()
+    rc = shim_main(env=env, stdin=io.StringIO(json.dumps(conf)),
+                   stdout=stdout, exec_ipam_plugin=delegate)
+    assert rc == 0
+    assert json.loads(stdout.getvalue())["ips"]
+    assert [c[:2] for c in delegate.calls] == [("my-ipam", "ADD")]
+    # The netconf reached the delegate unmodified (no usePodCidr here).
+    assert delegate.calls[0][2]["ipam"] == {"type": "my-ipam", "fancy": True}
+    assert delegate.live == 1
+
+    env["CNI_COMMAND"] = "DEL"
+    rc = shim_main(env=env, stdin=io.StringIO(json.dumps(conf)),
+                   stdout=io.StringIO(), exec_ipam_plugin=delegate)
+    assert rc == 0
+    assert [c[:2] for c in delegate.calls] == [("my-ipam", "ADD"),
+                                               ("my-ipam", "DEL")]
+    assert delegate.live == 0
+
+
+def test_shim_releases_delegated_ip_when_agent_add_fails():
+    """A failed agent ADD must invoke delegate DEL — delegated IPs
+    never leak (cmdAdd's deferred cleanup)."""
+    delegate = FakeDelegate()
+    env = {
+        "CNI_COMMAND": "ADD",
+        "CNI_ARGS": "K8S_POD_NAMESPACE=default;K8S_POD_NAME=p",
+    }
+    conf = _ipam_conf("127.0.0.1:1", {"type": "my-ipam"})  # unreachable
+    out = io.StringIO()
+    rc = shim_main(env=env, stdin=io.StringIO(json.dumps(conf)),
+                   stdout=out, exec_ipam_plugin=delegate)
+    assert rc == 1
+    assert json.loads(out.getvalue())["code"] == 11
+    assert [c[:2] for c in delegate.calls] == [("my-ipam", "ADD"),
+                                               ("my-ipam", "DEL")]
+    assert delegate.live == 0
+
+
+def test_shim_delegate_add_failure_is_cni_error():
+    delegate = FakeDelegate(fail_add=True)
+    env = {"CNI_COMMAND": "ADD",
+           "CNI_ARGS": "K8S_POD_NAMESPACE=default;K8S_POD_NAME=p"}
+    conf = _ipam_conf("127.0.0.1:1", {"type": "my-ipam"})
+    out = io.StringIO()
+    rc = shim_main(env=env, stdin=io.StringIO(json.dumps(conf)),
+                   stdout=out, exec_ipam_plugin=delegate)
+    assert rc == 1
+    err = json.loads(out.getvalue())
+    assert err["code"] == 11 and "IPAM ADD" in err["msg"]
+
+
+def test_host_local_use_pod_cidr_rewrite():
+    """host-local + subnet=usePodCidr: the delegate must see this
+    node's ACTUAL pod CIDR (replacePodCIDR :86-115)."""
+    from vpp_tpu.cni import external_ipam
+
+    conf = {"cniVersion": "0.3.1",
+            "ipam": {"type": "host-local", "subnet": "usePodCidr"}}
+    seen = {}
+
+    def fake_exec(plugin, command, netconf, env):
+        seen["conf"] = json.loads(netconf)
+        return json.dumps({"ips": [{"version": "4", "address": "10.1.1.9/24"}]})
+
+    data = external_ipam.ipam_add(
+        conf, {}, pod_cidr=lambda: "10.1.7.0/24", exec_plugin=fake_exec
+    )
+    assert seen["conf"]["ipam"]["subnet"] == "10.1.7.0/24"
+    assert conf["ipam"]["subnet"] == "usePodCidr"  # caller's copy untouched
+    assert json.loads(data)["address"] == "10.1.1.9/24"
+
+    # Case-insensitive keyword; failed CIDR lookup fails open.
+    conf2 = {"ipam": {"type": "host-local", "subnet": "USEPODCIDR"}}
+    external_ipam.ipam_del(
+        conf2, {}, pod_cidr=lambda: (_ for _ in ()).throw(OSError("down")),
+        exec_plugin=fake_exec,
+    )
+    assert seen["conf"]["ipam"]["subnet"] == "USEPODCIDR"
+
+
+def test_agent_pod_cidr_via_rest(agent):
+    """The usePodCidr lookup reads podSubnetThisNode from the agent's
+    /contiv/v1/ipam route (the store-backed node record analog)."""
+    from vpp_tpu.cni import external_ipam
+    from vpp_tpu.rest.server import AgentRestServer
+
+    _, _, ipv4net, _ = agent
+    rest = AgentRestServer(port=0, ipam=ipv4net.ipam)
+    port = rest.start()
+    try:
+        cidr = external_ipam.agent_pod_cidr(f"127.0.0.1:{port}")
+        assert cidr == str(ipv4net.ipam.pod_subnet_this_node)
+    finally:
+        rest.stop()

@@ -1,0 +1,143 @@
+"""External-IPAM delegation for the CNI shim.
+
+Analog of ``cmd/contiv-cni/external_ipam.go:36-142``: when the network
+config carries an ``ipam`` section with a ``type``, IP allocation is
+delegated to that CNI IPAM plugin, executed per the CNI conventions —
+binary resolved on ``CNI_PATH``, network config on stdin, ``CNI_*``
+environment forwarded with ``CNI_COMMAND`` set to ADD/DEL.
+
+Special case mirrored from the reference: for the ``host-local``
+plugin, an ``ipam.subnet`` of ``usePodCidr`` is rewritten to this
+node's ACTUAL pod CIDR before delegation.  The reference reads the
+node record from etcd (``getPodCIDR``); here the node's pod CIDR comes
+from the agent's ``GET /contiv/v1/ipam`` route (``podSubnetThisNode``)
+— the same store-backed information without an etcd client in the
+dep-less shim.
+
+ADD returns the delegate's FIRST allocated IP as a JSON string (the
+``IpamData`` the agent consumes); DEL releases the allocation.  The
+shim invokes DEL after a failed agent ADD so delegated IPs never leak
+(contiv_cni.go cmdAdd's deferred cleanup :166-172).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import subprocess
+from typing import Callable, Optional
+
+HOST_LOCAL = "host-local"
+POD_CIDR_SUBST = "usePodCidr"
+DEFAULT_CNI_PATH = "/opt/cni/bin"
+
+# A delegate executor: (plugin_name, command, netconf_json_str, env) -> stdout.
+ExecPlugin = Callable[[str, str, str, dict], str]
+
+
+def ipam_type(conf: dict) -> str:
+    """The external IPAM plugin name of a network config ('' = none)."""
+    ipam = conf.get("ipam")
+    if isinstance(ipam, dict):
+        return str(ipam.get("type", "") or "")
+    return ""
+
+
+def _find_binary(plugin: str, env: dict) -> str:
+    for directory in env.get("CNI_PATH", DEFAULT_CNI_PATH).split(":"):
+        cand = os.path.join(directory, plugin)
+        if os.path.isfile(cand) and os.access(cand, os.X_OK):
+            return cand
+    raise FileNotFoundError(
+        f"IPAM plugin {plugin!r} not found on CNI_PATH "
+        f"{env.get('CNI_PATH', DEFAULT_CNI_PATH)!r}"
+    )
+
+
+def _default_exec(plugin: str, command: str, netconf: str, env: dict) -> str:
+    """Run the delegate per the CNI exec protocol."""
+    binary = _find_binary(plugin, env)
+    run_env = {key: str(val) for key, val in env.items()}
+    run_env["CNI_COMMAND"] = command
+    proc = subprocess.run(
+        [binary], input=netconf.encode(), capture_output=True, env=run_env,
+    )
+    if proc.returncode != 0:
+        detail = proc.stdout.decode(errors="replace").strip() or \
+            proc.stderr.decode(errors="replace").strip()
+        raise RuntimeError(f"IPAM plugin {plugin} {command} failed: {detail}")
+    return proc.stdout.decode()
+
+
+def replace_pod_cidr(
+    conf: dict, pod_cidr: Callable[[], str]
+) -> dict:
+    """host-local's ``usePodCidr`` substitution (external_ipam.go
+    replacePodCIDR :86-115): returns a config copy whose
+    ``ipam.subnet`` is this node's pod CIDR.  A failed lookup leaves
+    the config unchanged, matching the reference's fail-open logging.
+    """
+    ipam = conf.get("ipam")
+    if not isinstance(ipam, dict):
+        return conf
+    subnet = str(ipam.get("subnet", ""))
+    if subnet.lower() != POD_CIDR_SUBST.lower():
+        return conf
+    try:
+        cidr = pod_cidr()
+    except Exception:
+        cidr = ""
+    if not cidr:
+        return conf
+    out = copy.deepcopy(conf)
+    out["ipam"]["subnet"] = cidr
+    return out
+
+
+def _prepared_netconf(conf: dict, pod_cidr: Callable[[], str]) -> str:
+    if ipam_type(conf) == HOST_LOCAL:
+        conf = replace_pod_cidr(conf, pod_cidr)
+    return json.dumps(conf)
+
+
+def ipam_add(
+    conf: dict,
+    env: dict,
+    pod_cidr: Callable[[], str],
+    exec_plugin: Optional[ExecPlugin] = None,
+) -> str:
+    """Delegate ADD; returns the first allocated IP as a JSON string
+    (empty when the delegate returned no IPs), the ``IpamData``
+    payload of execIPAMAdd :36-67."""
+    plugin = ipam_type(conf)
+    run = exec_plugin or _default_exec
+    out = run(plugin, "ADD", _prepared_netconf(conf, pod_cidr), env)
+    result = json.loads(out) if out.strip() else {}
+    ips = result.get("ips") or []
+    if not ips:
+        return ""
+    return json.dumps(ips[0])
+
+
+def ipam_del(
+    conf: dict,
+    env: dict,
+    pod_cidr: Callable[[], str],
+    exec_plugin: Optional[ExecPlugin] = None,
+) -> None:
+    """Delegate DEL (release the allocation) — execIPAMDel :69-84."""
+    plugin = ipam_type(conf)
+    run = exec_plugin or _default_exec
+    run(plugin, "DEL", _prepared_netconf(conf, pod_cidr), env)
+
+
+def agent_pod_cidr(http_target: str, timeout: float = 10.0) -> str:
+    """This node's pod CIDR from the agent's /contiv/v1/ipam route
+    (the store-backed node record the reference reads from etcd)."""
+    import urllib.request
+
+    with urllib.request.urlopen(  # noqa: S310 - loopback agent
+        f"http://{http_target}/contiv/v1/ipam", timeout=timeout
+    ) as resp:
+        return str(json.load(resp).get("podSubnetThisNode", ""))

@@ -93,7 +93,7 @@ def build_request(env: dict, stdin_config: str) -> CNIRequest:
     )
 
 
-def main(env=None, stdin=None, stdout=None) -> int:
+def main(env=None, stdin=None, stdout=None, exec_ipam_plugin=None) -> int:
     env = env if env is not None else os.environ
     stdin = stdin if stdin is not None else sys.stdin
     stdout = stdout if stdout is not None else sys.stdout
@@ -116,6 +116,34 @@ def main(env=None, stdin=None, stdout=None) -> int:
         json.dump(_error_result(4, f"unsupported CNI_COMMAND {command!r}"), stdout)
         return 1
 
+    # External IPAM delegation (cmd/contiv-cni/external_ipam.go:36-142):
+    # an ``ipam.type`` in the netconf routes allocation through that
+    # CNI IPAM plugin; the delegate's first IP rides the agent request
+    # as ipam_data.  ``exec_ipam_plugin`` is the test seam.
+    from . import external_ipam
+
+    delegate = external_ipam.ipam_type(conf)
+    pod_cidr = lambda: external_ipam.agent_pod_cidr(http_target)  # noqa: E731
+    if delegate and command == "ADD":
+        try:
+            request.ipam_type = delegate
+            request.ipam_data = external_ipam.ipam_add(
+                conf, dict(env), pod_cidr, exec_plugin=exec_ipam_plugin
+            )
+        except Exception as err:
+            json.dump(_error_result(11, f"external IPAM ADD failed: {err}"), stdout)
+            return 1
+
+    def _release_delegate() -> None:
+        # Invoke IPAM DEL after a failed agent ADD so the delegated IP
+        # never leaks (contiv_cni.go cmdAdd's deferred cleanup).
+        try:
+            external_ipam.ipam_del(
+                conf, dict(env), pod_cidr, exec_plugin=exec_ipam_plugin
+            )
+        except Exception:
+            pass
+
     try:
         if _HAVE_GRPC:
             if command == "ADD":
@@ -127,15 +155,29 @@ def main(env=None, stdin=None, stdout=None) -> int:
                 http_target, "add" if command == "ADD" else "del", request
             )
     except Exception as err:
+        if delegate and command == "ADD":
+            _release_delegate()
         json.dump(_error_result(11, f"agent RPC failed: {err}"), stdout)
         return 1
 
     if reply.result != 0:
+        if delegate and command == "ADD":
+            _release_delegate()
         json.dump(_error_result(11, reply.error), stdout)
         return 1
     if command == "ADD":
         json.dump(_reply_to_result(reply), stdout)
     else:
+        # Release the external allocation after the agent disconnects
+        # the pod (contiv_cni.go cmdDel :303-309).
+        if delegate:
+            try:
+                external_ipam.ipam_del(
+                    conf, dict(env), pod_cidr, exec_plugin=exec_ipam_plugin
+                )
+            except Exception as err:
+                json.dump(_error_result(11, f"external IPAM DEL failed: {err}"), stdout)
+                return 1
         stdout.write("{}")
     return 0
 
