@@ -109,15 +109,44 @@ class Agent:
         self.scheduler.register_applicator(self.acl_applicator)
         self.scheduler.register_applicator(self.nat_applicator)
 
+        # BGP reflection: production kernel route watcher (iproute2
+        # monitor stream) in the same netns the hostnet applicator
+        # programs; mirrors BIRD-learned routes into the main VRF.
+        from .bgpreflector import BGPReflector
+        from .hostnet.monitor import IpRouteSource
+
+        bgp_netns = (
+            hostnet.split(":", 1)[1] if hostnet.startswith("netns:") else None
+        )
+        self.route_source = IpRouteSource(netns=bgp_netns) if hostnet != "off" else None
+        self.bgpreflector = BGPReflector(
+            self.config, route_source=self.route_source
+        )
+
         self.controller = Controller(
             handlers=[
                 self.nodesync, self.podmanager, self.ipv4net,
-                self.service, self.policy,
+                self.service, self.policy, self.bgpreflector,
             ],
             sink=self.scheduler,
         )
         self.podmanager.event_loop = self.controller
         self.nodesync.event_loop = self.controller
+        self.bgpreflector.event_loop = self.controller
+        self.bgpreflector.init()
+        # DHCP mode: watch the uplink's addresses for lease changes
+        # (the platform DHCP client installs them; we only observe).
+        self.dhcp_source = None
+        if uplink and (
+            self.config.interface.use_dhcp
+            or self.config.ipam.node_interconnect_dhcp
+        ):
+            from .hostnet.monitor import DhcpAddressSource
+
+            self.dhcp_source = DhcpAddressSource(
+                uplink, self.controller, netns=bgp_netns
+            )
+            self.dhcp_source.start()
         self.controller.start()
         self.watcher = DBWatcher(self.controller, store, mirror_path=mirror_path)
         self.watcher.start()
@@ -223,6 +252,10 @@ class Agent:
             self._dp_thread.join(timeout=2)
         if self._uplink_io is not None:
             self._uplink_io.close()
+        if self.route_source is not None:
+            self.route_source.close()
+        if self.dhcp_source is not None:
+            self.dhcp_source.stop()
         self.cni.stop()
         self.rest.stop()
         self.watcher.stop()

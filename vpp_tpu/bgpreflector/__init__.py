@@ -1,5 +1,17 @@
 """BGPReflector — mirrors BGP-learned host routes into the data plane."""
 
-from .plugin import BGPReflector, BGPRouteUpdate, RouteEvent, RouteSource
+from .plugin import (
+    BGPReflector,
+    BGPRouteUpdate,
+    RouteEvent,
+    RouteEventType,
+    RouteSource,
+)
 
-__all__ = ["BGPReflector", "BGPRouteUpdate", "RouteEvent", "RouteSource"]
+__all__ = [
+    "BGPReflector",
+    "BGPRouteUpdate",
+    "RouteEvent",
+    "RouteEventType",
+    "RouteSource",
+]
