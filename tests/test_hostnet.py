@@ -206,3 +206,54 @@ def test_procnode_with_hostnet_programs_kernel(tmp_path):
         server.stop()
         subprocess.run(["ip", "netns", "del", ns], capture_output=True)
         subprocess.run(["ip", "netns", "del", "pod-default-w1"], capture_output=True)
+
+
+def test_resync_100_pods_batched_under_one_second(hostnet):
+    """VERDICT r3 item 8: the applicator coalesces a transaction's
+    iproute2 operations into -batch executions — a 100-pod resync
+    (veth into per-pod netns + /32 route + ARP each) completes in
+    under a second instead of hundreds of forks."""
+    import time as _time
+
+    from vpp_tpu.models import PodID
+
+    scheduler = TxnScheduler()
+    scheduler.register_applicator(hostnet)
+
+    values = {}
+    vrf = VrfTable(id=1, label="pods")
+    values[vrf.key] = vrf
+    for i in range(100):
+        tap = f"tp-{i}"
+        ip = f"10.1.{1 + i // 200}.{(i % 200) + 2}"
+        iface = Interface(
+            name=tap, type=InterfaceType.TAP,
+            ip_addresses=(), host_if_name=f"eth{i}",
+            namespace=f"rsb-{i}", enabled=True,
+        )
+        values[iface.key] = iface
+        route = Route(dst_network=f"{ip}/32", next_hop="",
+                      outgoing_interface=tap, vrf=1)
+        values[route.key] = route
+        arp = ArpEntry(interface=tap, ip_address=ip,
+                       physical_address=f"02:fe:00:00:{i // 256:02x}:{i % 256:02x}")
+        values[arp.key] = arp
+    txn = RecordedTxn(seq_num=1, is_resync=True, values=values)
+    try:
+        t0 = _time.perf_counter()
+        scheduler.commit(txn)
+        elapsed = _time.perf_counter() - t0
+        # Everything programmed...
+        assert hostnet.link_exists("tp-0") and hostnet.link_exists("tp-99")
+        routes = {r.get("dst") for r in hostnet.routes(vrf=1)}
+        assert "10.1.1.2" in routes and len(routes) >= 100
+        # ...in few execs (netns adds dominate; iproute2 ops batched)
+        # and under the 1 s bar.
+        assert elapsed < 1.0, f"100-pod resync took {elapsed:.2f}s"
+        states = scheduler.dump()
+        bad = [s for s in states if s.state.name != "APPLIED"]
+        assert not bad, bad[:3]
+    finally:
+        for i in range(100):
+            subprocess.run(["ip", "netns", "del", f"rsb-{i}"],
+                           capture_output=True)
