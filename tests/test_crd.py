@@ -272,3 +272,128 @@ class TestCrdController:
             assert ctl.dropped == 1
         finally:
             ctl.stop()
+
+
+# ---------------------------------------------------------------------------
+# Reference-depth validation (VERDICT r3 item 10)
+# ---------------------------------------------------------------------------
+
+
+def test_stale_l2fib_entry_produces_dangling_report(cluster):
+    """The done criterion: a stale L2FIB entry injected into a REAL
+    node's applied state (a departed node's BVI MAC lingering in the
+    vxlan BD) produces the specific dangling-entry report the
+    reference's ValidateL2FibEntries emits (l2_validator.go :514)."""
+    store, a, b = cluster
+    crd = CRDPlugin(store, collection_interval=3600)
+    crd.register_agent("node-1", a["server"])
+    crd.register_agent("node-2", b["server"])
+    assert crd.run_validation().error_count == 0
+
+    cache = TelemetryCache()
+    snapshots = cache.collect(crd.agents)
+    stale_mac = "12:fe:0a:0a:0a:0a"  # no live node owns this BVI MAC
+    snapshots["node-1"].dump.append({
+        "key": f"/vpp-tpu/config/l2fib/vxlanBD/{stale_mac}",
+        "state": "APPLIED",
+        "applied": {"bridge_domain": "vxlanBD",
+                    "physical_address": stale_mac,
+                    "outgoing_interface": "vxlan2"},
+    })
+    findings = [e for r in L2Validator().validate(snapshots) for e in r.errors]
+    assert any(
+        f"dangling L2FIB entry vxlanBD/{stale_mac} - no node for entry found" == e
+        for e in findings), findings
+
+
+class TestReferenceDepthChecks:
+    """Unit coverage for the r4 cross-node sweeps (hand-built snaps)."""
+
+    def _snaps(self):
+        return TestValidatorUnits()._snaps()
+
+    def _l2(self, snaps):
+        return [e for r in L2Validator().validate(snaps) for e in r.errors]
+
+    def _l3(self, snaps):
+        return [e for r in L3Validator().validate(snaps) for e in r.errors]
+
+    def test_dangling_arp_entry(self):
+        snaps = self._snaps()
+        snaps["node-1"].dump.append({
+            "key": "/vpp-tpu/config/arp/vxlanBVI/10.2.0.9",
+            "state": "APPLIED",
+            "applied": {"physical_address": "12:fe:00:00:00:09"}})
+        errors = self._l2(snaps)
+        assert any("dangling ARP entry 10.2.0.9" in e for e in errors), errors
+
+    def test_arp_mac_and_ip_resolve_to_different_nodes(self):
+        snaps = self._snaps()
+        # node-1's ARP for node-2's BVI IP carries node-1's OWN MAC.
+        for v in snaps["node-1"].dump:
+            if v["key"].startswith("/vpp-tpu/config/arp/"):
+                v["applied"]["physical_address"] = "12:fe:c0:a8:10:01"
+        errors = self._l2(snaps)
+        assert any("MAC -> node node-1, IP -> node node-2" in e
+                   for e in errors), errors
+
+    def test_wrong_vni_detected(self):
+        snaps = self._snaps()
+        for v in snaps["node-1"].dump:
+            if "vxlan2" in v["key"] and "interface" in v["key"]:
+                v["applied"]["vxlan_vni"] = 99
+        errors = self._l2(snaps)
+        assert any("invalid VNI for vxlan2: got 99, expected 10" in e
+                   for e in errors), errors
+
+    def test_fib_exit_tunnel_leads_to_wrong_node(self):
+        snaps = self._snaps()
+        # Third node so the FIB MAC can belong to a node the tunnel
+        # does NOT lead to.
+        three = TestValidatorUnits()._snaps()
+        snaps["node-3"] = three["node-2"]
+        snaps["node-3"].name = "node-3"
+        snaps["node-3"].ipam = {
+            "nodeId": 3, "nodeIP": "192.168.16.3",
+            "podSubnetThisNode": "10.1.3.0/24", "allocatedPodIPs": {}}
+        for v in snaps["node-3"].dump:
+            if v["key"].endswith("vxlanBVI"):
+                v["applied"] = {"name": "vxlanBVI",
+                                "physical_address": "12:fe:c0:a8:10:03",
+                                "ip_addresses": ["10.2.0.3/24"]}
+        # node-1 has an L2FIB for node-3's MAC exiting the node-2 tunnel.
+        snaps["node-1"].dump.append({
+            "key": "/vpp-tpu/config/l2fib/vxlanBD/12:fe:c0:a8:10:03",
+            "state": "APPLIED",
+            "applied": {"outgoing_interface": "vxlan2"}})
+        errors = [e for e in self._l2(snaps)
+                  if "exit tunnel" in e and "node-3" in e]
+        assert errors, self._l2(snaps)
+
+    def test_remote_subnet_route_next_hop_checked(self):
+        snaps = self._snaps()
+        for v in snaps["node-1"].dump:
+            if v["key"].startswith("/vpp-tpu/config/route/"):
+                v["applied"]["next_hop"] = "10.2.0.9"  # not node-2's BVI
+        errors = self._l3(snaps)
+        assert any("next hop 10.2.0.9, expected that node's BVI 10.2.0.2" in e
+                   for e in errors), errors
+
+    def test_dangling_pod_route_and_tap(self):
+        snaps = self._snaps()
+        snaps["node-1"].dump += [
+            {"key": "/vpp-tpu/config/route/vrf1/10.1.1.9/32",
+             "state": "APPLIED", "applied": {"dst_network": "10.1.1.9/32"}},
+            {"key": "/vpp-tpu/config/interface/tap-default-ghost",
+             "state": "APPLIED", "applied": {"name": "tap-default-ghost"}},
+        ]
+        errors = self._l3(snaps)
+        assert any("dangling /32 route 10.1.1.9/32" in e for e in errors), errors
+        assert any("dangling pod-facing tap interface 'tap-default-ghost'" in e
+                   for e in errors), errors
+
+    def test_node_registry_unknown_node_detected(self):
+        snaps = self._snaps()
+        snaps["node-1"].nodes.append({"name": "node-ghost"})
+        errors = self._l2(snaps)
+        assert any("unknown nodes ['node-ghost']" in e for e in errors), errors

@@ -23,7 +23,7 @@ from ..ipv4net.model import (
     L2FIB_PREFIX,
     ROUTE_PREFIX,
 )
-from ..ipv4net.plugin import VXLAN_BD_NAME, VXLAN_BVI_NAME
+from ..ipv4net.plugin import VXLAN_BD_NAME, VXLAN_BVI_NAME, VXLAN_VNI
 from .models import ValidationReport
 from .telemetry import NodeSnapshot
 
@@ -69,6 +69,22 @@ class L2Validator:
             errors.append(f"bridge domain BVI is {bd.get('bvi_interface')!r}, "
                           f"expected {VXLAN_BVI_NAME!r}")
 
+        # Identity maps for the mark-and-sweep passes: every node's BVI
+        # MAC and IP, as each node itself configured them.
+        mac_to_node: Dict[str, str] = {}
+        ip_to_node: Dict[str, str] = {}
+        for node_name, other in all_snaps.items():
+            if other.errors:
+                continue
+            bvi = _bvi_iface(other)
+            mac = bvi.get("physical_address", "")
+            ips = bvi.get("ip_addresses") or []
+            if mac:
+                mac_to_node[mac] = node_name
+            if ips:
+                ip_to_node[str(ips[0]).split("/")[0]] = node_name
+
+        this_ip = snap.ipam.get("nodeIP", "")
         others = {n: s for n, s in all_snaps.items()
                   if n != snap.name and not s.errors}
         for other_name, other in sorted(others.items()):
@@ -85,6 +101,17 @@ class L2Validator:
                 errors.append(
                     f"vxlan{oid} dst {tunnel.get('vxlan_dst')} != node "
                     f"{other_name} IP {expect_dst}")
+            # VNI + source checks (ValidateBridgeDomains :247 VNI, :258
+            # src-address checks); fields default-pass when a snapshot
+            # predates them.
+            vni = tunnel.get("vxlan_vni", VXLAN_VNI)
+            if vni != VXLAN_VNI:
+                errors.append(f"invalid VNI for {vxlan_name}: got {vni}, "
+                              f"expected {VXLAN_VNI}")
+            src = tunnel.get("vxlan_src", this_ip)
+            if this_ip and src != this_ip:
+                errors.append(f"{vxlan_name} src {src} is not this node's "
+                              f"IP {this_ip}")
             if vxlan_name not in tuple(bd.get("interfaces", ())):
                 errors.append(f"vxlan{oid} not attached to {VXLAN_BD_NAME}")
 
@@ -114,11 +141,59 @@ class L2Validator:
                     f"ARP MAC for {other_name} is {arp.get('physical_address')}, "
                     f"node itself uses {other_mac}")
 
-        # K8s view vs collected view (ValidateK8sNodeInfo :525).
+        # Dangling-entry sweeps (the reference's mark-and-sweep passes).
+        #
+        # L2FIB entries in the vxlan BD whose MAC belongs to NO live
+        # node's BVI are stale state from departed/renumbered nodes
+        # (ValidateL2FibEntries :514 "dangling L2Fib entry").
+        for key, fib in sorted(fibs.items()):
+            if not key.startswith(f"{L2FIB_PREFIX}{VXLAN_BD_NAME}/"):
+                continue
+            mac = key.rsplit("/", 1)[1]
+            if mac not in mac_to_node:
+                errors.append(
+                    f"dangling L2FIB entry {VXLAN_BD_NAME}/{mac} - "
+                    f"no node for entry found")
+            else:
+                # The exit tunnel must lead to the node owning the MAC.
+                out_if = fib.get("outgoing_interface", "")
+                tun = ifaces.get(IF_PREFIX + out_if)
+                if tun is not None and "vxlan_dst" in tun:
+                    owner = mac_to_node[mac]
+                    owner_ip = all_snaps[owner].ipam.get("nodeIP", "")
+                    if tun["vxlan_dst"] != owner_ip:
+                        errors.append(
+                            f"L2FIB entry {VXLAN_BD_NAME}/{mac}: exit tunnel "
+                            f"{out_if} leads to {tun['vxlan_dst']}, but the "
+                            f"MAC belongs to node {owner} ({owner_ip})")
+
+        # ARP entries on the BVI whose IP/MAC map to no node, or to
+        # DIFFERENT nodes (ValidateArpTables :126 "MAC -> node X,
+        # IP -> node Y" and the stale-entry detection :62).
+        for key, arp in sorted(arps.items()):
+            if not key.startswith(f"{ARP_PREFIX}{VXLAN_BVI_NAME}/"):
+                continue
+            ip = key.rsplit("/", 1)[1]
+            mac = arp.get("physical_address", "")
+            mac_node = mac_to_node.get(mac)
+            ip_node = ip_to_node.get(ip)
+            if mac_node is None and ip_node is None:
+                errors.append(f"dangling ARP entry {ip} ({mac}) - "
+                              f"no node for entry found")
+            elif mac_node != ip_node:
+                errors.append(f"inconsistent ARP entry {ip}: MAC -> node "
+                              f"{mac_node}, IP -> node {ip_node}")
+
+        # K8s view vs collected view, BOTH directions
+        # (ValidateK8sNodeInfo :525).
         known = {n.get("name") for n in snap.nodes}
         expected = set(all_snaps)
         if not expected <= known:
             errors.append(f"node registry out of sync: missing {sorted(expected - known)}")
+        if known - expected:
+            errors.append(
+                f"node registry out of sync: unknown nodes "
+                f"{sorted(known - expected)} (no telemetry counterpart)")
         return errors
 
 
@@ -139,27 +214,78 @@ class L3Validator:
 
     def _validate_node(self, snap: NodeSnapshot,
                        all_snaps: Dict[str, NodeSnapshot]) -> List[str]:
+        import ipaddress
+
         errors: List[str] = []
         routes = snap.applied(ROUTE_PREFIX)
-        route_dsts = {r.get("dst_network") for r in routes.values()}
+        by_dst = {r.get("dst_network"): r for r in routes.values()}
+        route_dsts = set(by_dst)
         ifaces = snap.applied(IF_PREFIX)
 
-        # Route to every other node's pod subnet (l3_validator.go remote
-        # pod-subnet route check).
+        # Route to every other node's pod subnet, with the NEXT HOP
+        # checked against the other node's BVI address — the wrong next
+        # hop blackholes cross-node pod traffic just as surely as a
+        # missing route (l3_validator.go remote pod-subnet route check
+        # incl. next-hop validation :78).
         for other_name, other in sorted(all_snaps.items()):
             if other_name == snap.name or other.errors:
                 continue
             subnet = other.ipam.get("podSubnetThisNode", "")
-            if subnet and subnet not in route_dsts:
+            if not subnet:
+                continue
+            route = by_dst.get(subnet)
+            if route is None:
                 errors.append(f"no route to node {other_name} pod subnet {subnet}")
+                continue
+            other_ips = _bvi_iface(other).get("ip_addresses") or []
+            other_bvi_ip = str(other_ips[0]).split("/")[0] if other_ips else ""
+            next_hop = route.get("next_hop")
+            if other_bvi_ip and next_hop is not None and next_hop != other_bvi_ip:
+                errors.append(
+                    f"route to {other_name} pod subnet {subnet} has next hop "
+                    f"{next_hop}, expected that node's BVI {other_bvi_ip}")
 
         # Every locally allocated pod IP has a /32 route and a TAP
         # (ValidatePodInfo analog).
-        for pod, ip in sorted((snap.ipam.get("allocatedPodIPs") or {}).items()):
+        allocated = snap.ipam.get("allocatedPodIPs") or {}
+        for pod, ip in sorted(allocated.items()):
             if f"{ip}/32" not in route_dsts:
                 errors.append(f"no /32 route for pod {pod} ({ip})")
             ns, _, pname = pod.partition("/")
             tap_key = IF_PREFIX + f"tap-{ns}-{pname}"
             if tap_key not in ifaces:
                 errors.append(f"no TAP interface for pod {pod}")
+
+        # Dangling sweeps (the reference's mark-and-sweep over pod
+        # state, l2_validator.go :575-704 "dangling pod-facing tap"
+        # applied to our routes + taps):
+        allocated_ips = set(allocated.values())
+        this_subnet = snap.ipam.get("podSubnetThisNode", "")
+        try:
+            pod_net = ipaddress.ip_network(this_subnet) if this_subnet else None
+        except ValueError:
+            pod_net = None
+        for dst, route in sorted(by_dst.items()):
+            if not dst or not str(dst).endswith("/32") or pod_net is None:
+                continue
+            ip = str(dst)[:-3]
+            try:
+                in_pod_subnet = ipaddress.ip_address(ip) in pod_net
+            except ValueError:
+                continue
+            if in_pod_subnet and ip not in allocated_ips:
+                errors.append(f"dangling /32 route {dst} - "
+                              f"no allocated pod for entry found")
+        expected_taps = {
+            IF_PREFIX + "tap-{}-{}".format(*pod.partition("/")[::2])
+            for pod in allocated
+        }
+        for key, iface in sorted(ifaces.items()):
+            name = key[len(IF_PREFIX):]
+            if not name.startswith("tap-") or name.startswith("tap-vpp"):
+                continue
+            if key not in expected_taps:
+                errors.append(
+                    f"dangling pod-facing tap interface {name!r} - "
+                    f"no allocated pod for entry found")
         return errors
