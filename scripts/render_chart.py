@@ -1,0 +1,400 @@
+"""Render the vpp-tpu deployment manifests from chart values.
+
+The `helm template` role of the reference's k8s/contiv-vpp chart
+(Chart.yaml + values.yaml + templates/vpp.yaml) without requiring helm:
+defaults come from deploy/chart/values.yaml, user values deep-merge
+over them (-f file and/or --set dotted.key=value), and the full
+multi-document manifest prints to stdout.
+
+Usage:
+    python scripts/render_chart.py                      # defaults
+    python scripts/render_chart.py -f prod-values.yaml
+    python scripts/render_chart.py --set agent.stn.enabled=true \
+        --set agent.uplink=eth1 --set ui.nodePort=32500
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import pathlib
+import sys
+
+import yaml
+
+CHART_DIR = pathlib.Path(__file__).resolve().parent.parent / "deploy" / "chart"
+
+
+def deep_merge(base: dict, over: dict) -> dict:
+    out = copy.deepcopy(base)
+    for key, value in (over or {}).items():
+        if isinstance(value, dict) and isinstance(out.get(key), dict):
+            out[key] = deep_merge(out[key], value)
+        else:
+            out[key] = value
+    return out
+
+
+def set_path(values: dict, dotted: str, raw: str) -> None:
+    keys = dotted.split(".")
+    target = values
+    for key in keys[:-1]:
+        target = target.setdefault(key, {})
+    target[keys[-1]] = yaml.safe_load(raw)
+
+
+def _image(values: dict, component: str) -> str:
+    img = values["image"]
+    return f"{img['repository']}-{component}:{img['tag']}"
+
+
+def _tolerate_master():
+    return [{"key": "node-role.kubernetes.io/control-plane",
+             "effect": "NoSchedule"}]
+
+
+def config_map(values: dict) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": "vpp-tpu-cfg", "namespace": values["namespace"]},
+        "data": {
+            "vpp-tpu.conf": json.dumps(values["network"], indent=2),
+            "controller.conf": json.dumps(values["controller"], indent=2),
+        },
+    }
+
+
+def rbac(values: dict) -> list:
+    ns = values["namespace"]
+    return [
+        {"apiVersion": "v1", "kind": "ServiceAccount",
+         "metadata": {"name": "vpp-tpu-ksr", "namespace": ns}},
+        {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": "ClusterRole",
+         "metadata": {"name": "vpp-tpu-ksr"},
+         "rules": [
+             {"apiGroups": [""],
+              "resources": ["pods", "namespaces", "services", "endpoints",
+                            "nodes"],
+              "verbs": ["list", "watch"]},
+             {"apiGroups": ["networking.k8s.io"],
+              "resources": ["networkpolicies"],
+              "verbs": ["list", "watch"]},
+         ]},
+        {"apiVersion": "rbac.authorization.k8s.io/v1",
+         "kind": "ClusterRoleBinding",
+         "metadata": {"name": "vpp-tpu-ksr"},
+         "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                     "kind": "ClusterRole", "name": "vpp-tpu-ksr"},
+         "subjects": [{"kind": "ServiceAccount", "name": "vpp-tpu-ksr",
+                       "namespace": ns}]},
+    ]
+
+
+def store(values: dict) -> list:
+    ns = values["namespace"]
+    st = values["store"]
+    pod_spec = {
+        "tolerations": _tolerate_master(),
+        "nodeSelector": {"node-role.kubernetes.io/control-plane": ""},
+        "hostNetwork": True,
+        "containers": [{
+            "name": "store",
+            "image": _image(values, "store"),
+            "args": ["--host", "0.0.0.0", "--port", str(st["port"])],
+            "ports": [{"containerPort": st["port"], "name": "client"}],
+            "volumeMounts": [{"name": "data",
+                              "mountPath": "/var/lib/vpp-tpu"}],
+        }],
+    }
+    if st.get("enableLivenessProbe"):
+        pod_spec["containers"][0]["livenessProbe"] = {
+            "tcpSocket": {"port": st["port"]},
+            "initialDelaySeconds": 5, "periodSeconds": 3,
+        }
+    stateful = {
+        "apiVersion": "apps/v1", "kind": "StatefulSet",
+        "metadata": {"name": "vpp-tpu-store", "namespace": ns,
+                     "labels": {"k8s-app": "vpp-tpu-store"}},
+        "spec": {
+            "serviceName": "vpp-tpu-store", "replicas": 1,
+            "selector": {"matchLabels": {"k8s-app": "vpp-tpu-store"}},
+            "template": {
+                "metadata": {"labels": {"k8s-app": "vpp-tpu-store"}},
+                "spec": pod_spec,
+            },
+        },
+    }
+    if st.get("usePersistentVolume"):
+        stateful["spec"]["volumeClaimTemplates"] = [{
+            "metadata": {"name": "data"},
+            "spec": {"accessModes": ["ReadWriteOnce"],
+                     "resources": {"requests":
+                                   {"storage": st["persistentVolumeSize"]}}},
+        }]
+    else:
+        pod_spec["volumes"] = [{"name": "data",
+                                "hostPath": {"path": st["dataDir"]}}]
+    service = {
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": "vpp-tpu-store", "namespace": ns},
+        "spec": {"selector": {"k8s-app": "vpp-tpu-store"},
+                 "clusterIP": "None",
+                 "ports": [{"port": st["port"], "name": "client"}]},
+    }
+    return [stateful, service]
+
+
+def _store_target(values: dict) -> str:
+    return (f"vpp-tpu-store.{values['namespace']}.svc:"
+            f"{values['store']['port']}")
+
+
+def ksr(values: dict) -> dict:
+    return {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "vpp-tpu-ksr", "namespace": values["namespace"],
+                     "labels": {"k8s-app": "vpp-tpu-ksr"}},
+        "spec": {
+            "replicas": values["ksr"]["replicas"],
+            "selector": {"matchLabels": {"k8s-app": "vpp-tpu-ksr"}},
+            "template": {
+                "metadata": {"labels": {"k8s-app": "vpp-tpu-ksr"}},
+                "spec": {
+                    "serviceAccountName": "vpp-tpu-ksr",
+                    "tolerations": _tolerate_master(),
+                    "nodeSelector":
+                        {"node-role.kubernetes.io/control-plane": ""},
+                    "hostNetwork": True,
+                    "containers": [{
+                        "name": "ksr",
+                        "image": _image(values, "ksr"),
+                        "args": ["--store", _store_target(values)],
+                    }],
+                },
+            },
+        },
+    }
+
+
+def agent(values: dict) -> dict:
+    ns = values["namespace"]
+    ag = values["agent"]
+    args = [
+        f"--store={_store_target(values)}",
+        "--name=$(NODE_NAME)",
+        "--config=/etc/vpp-tpu/vpp-tpu.conf",
+        f"--mirror={ag['mirrorPath']}",
+        f"--hostnet={ag['hostnet']}",
+        f"--rest-port={ag['restPort']}",
+        f"--cni-port={ag['cniPort']}",
+    ]
+    if ag.get("uplink"):
+        args.append(f"--uplink={ag['uplink']}")
+    init_containers = [{
+        # Install the CNI shim + conflist onto the host (contiv-cni
+        # install pattern).
+        "name": "install-cni",
+        "image": _image(values, "agent"),
+        "command": ["/bin/sh", "-c"],
+        "args": [
+            "cp /opt/vpp-tpu/deploy/cni/10-vpp-tpu.conflist "
+            "/host/etc/cni/net.d/10-vpp-tpu.conflist && "
+            "cp -r /opt/vpp-tpu/vpp_tpu /host/opt/vpp-tpu/ && "
+            "printf '#!/bin/sh\\nexport PYTHONPATH=/opt/vpp-tpu\\n"
+            "exec python3 -m vpp_tpu.cni.shim \"$@\"\\n' "
+            "> /host/opt/cni/bin/vpp-tpu-cni && "
+            "chmod +x /host/opt/cni/bin/vpp-tpu-cni"
+        ],
+        "volumeMounts": [
+            {"name": "cni-cfg", "mountPath": "/host/etc/cni/net.d"},
+            {"name": "cni-bin", "mountPath": "/host/opt/cni/bin"},
+            {"name": "host-opt", "mountPath": "/host/opt/vpp-tpu"},
+        ],
+    }]
+    if ag["stn"]["enabled"]:
+        # Steal the uplink NIC before the agent starts (contiv-stn:
+        # stn-install.sh / stealFirstNIC in the reference values).
+        stn_args = ["--takeover"]
+        if ag["stn"].get("interface"):
+            stn_args.append(f"--interface={ag['stn']['interface']}")
+        init_containers.append({
+            "name": "stn-takeover",
+            "image": _image(values, "agent"),
+            "command": ["python3", "-m", "vpp_tpu.bootstrap.stn"],
+            "args": stn_args,
+            "securityContext": {"privileged": True},
+            "volumeMounts": [{"name": "data",
+                              "mountPath": "/var/lib/vpp-tpu"}],
+        })
+    container = {
+        "name": "agent",
+        "image": _image(values, "agent"),
+        "args": args,
+        "env": [{"name": "NODE_NAME",
+                 "valueFrom": {"fieldRef": {"fieldPath": "spec.nodeName"}}}],
+        "securityContext": {"privileged": True},
+        "volumeMounts": [
+            {"name": "cfg", "mountPath": "/etc/vpp-tpu"},
+            {"name": "data", "mountPath": "/var/lib/vpp-tpu"},
+            {"name": "run-netns", "mountPath": "/var/run/netns",
+             "mountPropagation": "Bidirectional"},
+            {"name": "tpu-lib", "mountPath": "/usr/lib/tpu",
+             "readOnly": True},
+        ],
+    }
+    if ag.get("enableLivenessReadinessProbes"):
+        container["readinessProbe"] = {
+            "httpGet": {"path": "/liveness", "port": ag["restPort"]},
+            "initialDelaySeconds": 5,
+        }
+        container["livenessProbe"] = {
+            "httpGet": {"path": "/liveness", "port": ag["restPort"]},
+            "initialDelaySeconds": 15, "periodSeconds": 10,
+        }
+    if ag.get("resources"):
+        container["resources"] = ag["resources"]
+    return {
+        "apiVersion": "apps/v1", "kind": "DaemonSet",
+        "metadata": {"name": "vpp-tpu-agent", "namespace": ns,
+                     "labels": {"k8s-app": "vpp-tpu-agent"}},
+        "spec": {
+            "selector": {"matchLabels": {"k8s-app": "vpp-tpu-agent"}},
+            "updateStrategy": {"type": "RollingUpdate"},
+            "template": {
+                "metadata": {"labels": {"k8s-app": "vpp-tpu-agent"}},
+                "spec": {
+                    "tolerations": [{"operator": "Exists"}],
+                    "hostNetwork": True,
+                    "hostPID": True,
+                    "initContainers": init_containers,
+                    "containers": [container],
+                    "volumes": [
+                        {"name": "cfg",
+                         "configMap": {"name": "vpp-tpu-cfg"}},
+                        {"name": "data",
+                         "hostPath": {"path": "/var/lib/vpp-tpu"}},
+                        {"name": "cni-cfg",
+                         "hostPath": {"path": "/etc/cni/net.d"}},
+                        {"name": "cni-bin",
+                         "hostPath": {"path": "/opt/cni/bin"}},
+                        {"name": "host-opt",
+                         "hostPath": {"path": "/opt/vpp-tpu"}},
+                        {"name": "run-netns",
+                         "hostPath": {"path": "/var/run/netns"}},
+                        {"name": "tpu-lib",
+                         "hostPath": {"path": "/usr/lib/tpu"}},
+                    ],
+                },
+            },
+        },
+    }
+
+
+def crd(values: dict) -> list:
+    if not values["crd"]["enabled"]:
+        return []
+    return [{
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "vpp-tpu-crd", "namespace": values["namespace"],
+                     "labels": {"k8s-app": "vpp-tpu-crd"}},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"k8s-app": "vpp-tpu-crd"}},
+            "template": {
+                "metadata": {"labels": {"k8s-app": "vpp-tpu-crd"}},
+                "spec": {
+                    "tolerations": _tolerate_master(),
+                    "nodeSelector":
+                        {"node-role.kubernetes.io/control-plane": ""},
+                    "hostNetwork": True,
+                    "containers": [{
+                        "name": "crd",
+                        "image": _image(values, "crd"),
+                        "args": [
+                            "--store", _store_target(values),
+                            "--interval",
+                            str(values["crd"]["collectionIntervalSeconds"]),
+                        ],
+                    }],
+                },
+            },
+        },
+    }]
+
+
+def ui(values: dict) -> list:
+    if not values["ui"]["enabled"]:
+        return []
+    ns = values["namespace"]
+    port = values["ui"]["port"]
+    service_spec = {
+        "selector": {"k8s-app": "vpp-tpu-ui"},
+        "ports": [{"port": port, "name": "http"}],
+    }
+    if values["ui"].get("nodePort"):
+        service_spec["type"] = "NodePort"
+        service_spec["ports"][0]["nodePort"] = values["ui"]["nodePort"]
+    return [
+        {"apiVersion": "apps/v1", "kind": "Deployment",
+         "metadata": {"name": "vpp-tpu-ui", "namespace": ns,
+                      "labels": {"k8s-app": "vpp-tpu-ui"}},
+         "spec": {
+             "replicas": 1,
+             "selector": {"matchLabels": {"k8s-app": "vpp-tpu-ui"}},
+             "template": {
+                 "metadata": {"labels": {"k8s-app": "vpp-tpu-ui"}},
+                 "spec": {
+                     "containers": [{
+                         "name": "ui",
+                         "image": _image(values, "ui"),
+                         "args": ["--port", str(port),
+                                  "--store", _store_target(values)],
+                         "ports": [{"containerPort": port}],
+                     }],
+                 },
+             },
+         }},
+        {"apiVersion": "v1", "kind": "Service",
+         "metadata": {"name": "vpp-tpu-ui", "namespace": ns},
+         "spec": service_spec},
+    ]
+
+
+def render(values: dict) -> list:
+    docs = [config_map(values)]
+    docs += rbac(values)
+    docs += store(values)
+    docs.append(ksr(values))
+    docs.append(agent(values))
+    docs += crd(values)
+    docs += ui(values)
+    return docs
+
+
+def load_values(files=(), sets=()) -> dict:
+    values = yaml.safe_load((CHART_DIR / "values.yaml").read_text())
+    for path in files:
+        values = deep_merge(values, yaml.safe_load(
+            pathlib.Path(path).read_text()) or {})
+    for item in sets:
+        dotted, _, raw = item.partition("=")
+        set_path(values, dotted, raw)
+    return values
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-f", "--values", action="append", default=[],
+                        help="values file(s) merged over the defaults")
+    parser.add_argument("--set", action="append", default=[],
+                        help="dotted.key=value override")
+    args = parser.parse_args(argv)
+    docs = render(load_values(args.values, args.set))
+    sys.stdout.write(yaml.safe_dump_all(docs, sort_keys=False))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -166,3 +166,58 @@ def test_dhcp_address_source_pushes_lease_events(netns):
         assert all(ev.interface == "up0" for ev in loop.events[n_before:])
     finally:
         src.stop()
+
+
+def test_linux_stn_steals_and_reverts_real_interface(netns):
+    """Production STN path (LinuxHostNetwork): steal a real interface's
+    identity (addresses + routes flushed, saved), persist it, and
+    revert it back — netns-confined."""
+    import json
+    import os
+    import tempfile
+
+    from vpp_tpu.bootstrap.stn import (
+        LinuxHostNetwork, STNDaemon, load_stolen, save_stolen,
+    )
+
+    ns, sh = netns
+    sh("route", "add", "default", "via", "10.0.0.254", "dev", "up0")
+    net = LinuxHostNetwork(netns=ns)
+    assert net.first_nic() == "up0"
+
+    daemon = STNDaemon(net)
+    stolen = daemon.steal_interface("up0")
+    assert stolen.addresses == ("10.0.0.1/24",)
+    assert any(r.dst in ("", "default") for r in stolen.routes)
+    # The kernel really lost the address (and with it the routes).
+    assert net.get_interface("up0").addresses == ()
+
+    state = os.path.join(tempfile.mkdtemp(), "stn.json")
+    save_stolen(state, stolen)
+    reloaded = load_stolen(state)
+    assert reloaded.addresses == stolen.addresses
+    assert json.load(open(state))["name"] == "up0"
+
+    daemon.release_interface("up0")
+    assert net.get_interface("up0").addresses == ("10.0.0.1/24",)
+    routes = {r.dst or "default" for r in net.interface_routes("up0")}
+    assert "default" in routes
+
+
+def test_stn_cli_oneshot_takeover(netns):
+    """python -m vpp_tpu.bootstrap.stn --takeover --oneshot: the
+    init-container mode of the chart's STN option."""
+    import json
+    import os
+    import tempfile
+
+    from vpp_tpu.bootstrap.stn import main as stn_main
+
+    ns, sh = netns
+    state = os.path.join(tempfile.mkdtemp(), "stn.json")
+    rc = stn_main(["--takeover", "--interface", "up0", "--netns", ns,
+                   "--state", state, "--oneshot"])
+    assert rc == 0
+    data = json.load(open(state))
+    assert data["name"] == "up0"
+    assert data["addresses"] == ["10.0.0.1/24"]

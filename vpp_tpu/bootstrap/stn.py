@@ -125,3 +125,196 @@ class STNDaemon:
     def _watchdog_loop(self, interval: float) -> None:
         while not self._stop.wait(interval):
             self.check_agent()
+
+
+# ---------------------------------------------------------------------------
+# Production host-network binding + daemon entrypoint
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class HostRoute:
+    """One host route attached to the stolen interface."""
+
+    dst: str
+    gateway: str = ""
+    interface: str = ""
+    scope: str = ""
+
+
+@dataclass
+class HostIface:
+    """Interface identity as read from the kernel."""
+
+    name: str
+    addresses: Tuple[str, ...] = ()
+    mac: str = ""
+    up: bool = True
+
+
+class LinuxHostNetwork:
+    """iproute2-backed host network access for the STN daemon — the
+    production implementation of the injected seam (the netlink calls
+    of cmd/contiv-stn/main.go unconfigureInterface :150 /
+    revertInterface :187), netns-confinable for tests.  Implements the
+    same contract as testing.netlink.FakeHostNetwork."""
+
+    def __init__(self, netns: Optional[str] = None):
+        self.netns = netns
+
+    def _ip(self, *args: str, check: bool = True, js: bool = False):
+        import json as _json
+        import subprocess
+
+        cmd = ["ip"]
+        if self.netns:
+            cmd += ["-n", self.netns]
+        if js:
+            cmd += ["-j"]
+        cmd += list(args)
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if check and proc.returncode != 0:
+            raise RuntimeError(f"{' '.join(cmd)}: {proc.stderr.strip()}")
+        if js:
+            return _json.loads(proc.stdout or "[]")
+        return proc.stdout
+
+    def first_nic(self) -> str:
+        """The interface carrying the default route (the reference's
+        steal-first-NIC discovery)."""
+        for route in self._ip("route", "show", "default", js=True):
+            if route.get("dev"):
+                return route["dev"]
+        raise RuntimeError("no default route: cannot pick a NIC to steal")
+
+    def get_interface(self, name: str) -> HostIface:
+        links = self._ip("link", "show", "dev", name, js=True)
+        if not links:
+            raise LookupError(f"no such interface {name}")
+        addrs = []
+        for entry in self._ip("addr", "show", "dev", name, js=True):
+            for a in entry.get("addr_info", []):
+                if a.get("family") == "inet":
+                    addrs.append(f"{a['local']}/{a['prefixlen']}")
+        return HostIface(
+            name=name, addresses=tuple(addrs),
+            mac=links[0].get("address", ""),
+            up="UP" in (links[0].get("flags") or []),
+        )
+
+    def interface_routes(self, name: str) -> List[HostRoute]:
+        routes = []
+        for r in self._ip("route", "show", "dev", name, js=True):
+            routes.append(HostRoute(
+                dst=r.get("dst", ""), gateway=r.get("gateway", ""),
+                interface=name, scope=str(r.get("scope", "")),
+            ))
+        return routes
+
+    def flush_interface(self, name: str) -> None:
+        """Remove all addresses (+ their attached routes) — the steal."""
+        self._ip("addr", "flush", "dev", name)
+
+    def configure_interface(self, name: str, addresses, routes,
+                            up: bool = True) -> None:
+        """Restore a saved identity onto the interface — the revert."""
+        for addr in addresses:
+            self._ip("addr", "replace", addr, "dev", name)
+        if up:
+            self._ip("link", "set", name, "up", check=False)
+        for route in routes:
+            args = ["route", "replace", route.dst or "default"]
+            if route.gateway:
+                args += ["via", route.gateway]
+            args += ["dev", name]
+            scope = getattr(route, "scope", "")
+            if scope and scope != "global":
+                args += ["scope", scope]
+            self._ip(*args, check=False)
+
+
+def _http_alive(url: str, timeout: float = 2.0) -> bool:
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout):  # noqa: S310
+            return True
+    except Exception:
+        return False
+
+
+def save_stolen(path: str, stolen: StolenInterface) -> None:
+    """Persist the stolen identity for the agent / a restarted daemon
+    (the reference's persisted config, main.go :95)."""
+    import dataclasses
+    import json as _json
+
+    data = dataclasses.asdict(stolen)
+    data["routes"] = [dataclasses.asdict(r) for r in stolen.routes]
+    with open(path, "w") as fh:
+        _json.dump(data, fh, indent=2)
+
+
+def load_stolen(path: str) -> Optional[StolenInterface]:
+    import json as _json
+    import os
+
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        data = _json.load(fh)
+    data["routes"] = [HostRoute(**r) for r in data.get("routes", [])]
+    data["addresses"] = tuple(data.get("addresses", ()))
+    return StolenInterface(**data)
+
+
+def main(argv=None) -> int:
+    """contiv-stn entrypoint: steal the NIC, persist its identity, and
+    (unless --oneshot) keep the agent-liveness watchdog running so the
+    host regains connectivity if the agent dies."""
+    import argparse
+
+    parser = argparse.ArgumentParser(description="steal-the-NIC daemon")
+    parser.add_argument("--takeover", action="store_true",
+                        help="steal the interface now")
+    parser.add_argument("--interface", default="",
+                        help="NIC to steal (default: first NIC — the one "
+                             "carrying the default route)")
+    parser.add_argument("--netns", default="",
+                        help="confine to a network namespace (tests)")
+    parser.add_argument("--state", default="/var/lib/vpp-tpu/stn.json",
+                        help="where to persist the stolen identity")
+    parser.add_argument("--agent-url",
+                        default="http://127.0.0.1:9999/liveness",
+                        help="agent liveness probe for the revert watchdog")
+    parser.add_argument("--revert-timeout", type=float, default=10.0)
+    parser.add_argument("--oneshot", action="store_true",
+                        help="steal + persist + exit (init-container mode; "
+                             "no watchdog)")
+    args = parser.parse_args(argv)
+
+    net = LinuxHostNetwork(netns=args.netns or None)
+    daemon = STNDaemon(
+        net, agent_alive=lambda: _http_alive(args.agent_url),
+        revert_timeout=args.revert_timeout,
+    )
+    if args.takeover:
+        name = args.interface or net.first_nic()
+        stolen = daemon.steal_interface(name)
+        save_stolen(args.state, stolen)
+        log.info("stole %s (%s)", name, ", ".join(stolen.addresses))
+    if args.oneshot:
+        return 0
+    daemon.start_watchdog()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        daemon.stop()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - CLI entry
+    import sys
+
+    sys.exit(main())
