@@ -299,3 +299,26 @@ def test_dispatch_auto_selects_per_backend():
     assert mk(dispatch="flat-safe").dispatch == "flat-safe"
     with pytest.raises(ValueError, match="dispatch"):
         mk(dispatch="bogus")
+
+
+def test_runner_constructs_before_first_table_commit():
+    """Race pinned by the r4 hunt: a runner may be constructed before
+    the renderer's first commit delivers NAT tables (FrameNode passes
+    nat=None; the swap arrives via update_tables).  The backend
+    retarget must pass None through instead of crashing."""
+    from vpp_tpu.ops.nat import retarget_tables
+
+    assert retarget_tables(None, "tpu") is None
+    rings = [NativeRing() for _ in range(4)]
+    runner = DataplaneRunner(
+        acl=build_rule_tables([], {}),
+        nat=None,
+        route=make_route(),
+        overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"),
+                             local_node_id=1),
+        source=rings[0], tx=rings[1], local=rings[2], host=rings[3],
+        batch_size=8, max_vectors=2,
+    )
+    assert runner.nat is None
+    runner.update_tables(nat=build_nat_tables([]))
+    assert runner.nat is not None

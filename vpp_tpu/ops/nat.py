@@ -473,7 +473,11 @@ def retarget_tables(tables: NatTables, target_backend: str) -> NatTables:
     crossover pick; use_hmap is pytree AUX data so this is free — no
     device arrays are touched, only retraces differ.  A dense-fallback
     table (hmap growth bound hit) is returned unchanged: its stub index
-    must never be re-enabled."""
+    must never be re-enabled.  ``None`` passes through: runners may be
+    constructed before the renderer's first commit delivers tables (the
+    table swap arrives via update_tables)."""
+    if tables is None:
+        return None
     if (
         not tables.use_hmap
         and tables.num_mappings > 0
