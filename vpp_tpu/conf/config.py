@@ -120,12 +120,12 @@ class NetworkConfig:
     # Vectors the datapath runner may coalesce into one device program
     # (pow2-floored; sessions thread vector-to-vector on device).
     max_vectors: int = 64
-    # Multi-vector dispatch discipline: "auto" picks per backend from
-    # the measured orderings (flat-safe on TPU, scan on CPU — on one
-    # CPU core the reconcile's extra probe passes compete with the
-    # pipeline for the same core and punt more rows, FRAMEBENCH r3);
-    # explicit "scan" / "flat-safe" override per node, the same
-    # trace-time pattern as the NAT lookup-discipline gate (use_hmap).
+    # Multi-vector dispatch discipline: "auto" picks from the measured
+    # per-backend orderings (as of r4: flat-safe on every backend —
+    # the commit-first restructure reversed r3's CPU ordering, see
+    # FRAMEBENCH_r04); explicit "scan" / "flat-safe" override per
+    # node, the same trace-time pattern as the NAT lookup-discipline
+    # gate (use_hmap).
     dispatch: str = "auto"
 
     @classmethod

@@ -163,10 +163,14 @@ class DataplaneRunner:
         # coalesce on TPU, restores same-VECTOR replies the scan
         # cannot, and punts crafted-aliasing corners to the host slow
         # path instead of restoring them.  "auto" (default) picks per
-        # the backend this runner dispatches to: flat-safe on TPU,
-        # scan on CPU (where the reconcile's extra passes compete with
-        # the pipeline for the same cores and punt more — the measured
-        # orderings, FRAMEBENCH r3/r4).
+        # the backend this runner dispatches to.  As of r4 the pick is
+        # flat-safe EVERYWHERE: the commit-first restructure deleted
+        # the pre-table restore probe, and the r3 CPU ordering (scan
+        # ~45% ahead) REVERSED — flat-safe now measures ~70% ahead of
+        # scan on CPU too (FRAMEBENCH_r04: 1.9-2.0 vs 1.1-1.2 Mpps
+        # e2e).  The knob stays: scan remains selectable per node and
+        # "auto" keeps the seam for backends where the ordering may
+        # differ again.
         dispatch: str = "auto",
         # Sharing hooks for the multi-shard engine (shards.py): a common
         # DeviceSessionState (one device session table for all shards),
@@ -201,9 +205,9 @@ class DataplaneRunner:
         if dispatch not in ("auto", "scan", "flat-safe"):
             raise ValueError(f"unknown dispatch discipline: {dispatch!r}")
         if dispatch == "auto":
-            dispatch = (
-                "flat-safe" if self._target_backend() == "tpu" else "scan"
-            )
+            # r4 measurement: flat-safe wins on BOTH backends since the
+            # commit-first restructure (it used to lose on CPU).
+            dispatch = "flat-safe"
         self.dispatch = dispatch
         self.max_inflight = max(1, max_inflight)
         self.sweep_interval = sweep_interval
