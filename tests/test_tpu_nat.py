@@ -680,7 +680,10 @@ def test_retarget_tables_rederives_lookup_gate():
         HMAP_MIN_MAPPINGS_TPU, retarget_tables,
     )
 
-    tables = simple_tables()  # built on CPU in tests -> hash on
+    # Build explicitly targeting CPU (platform-independent: the suite
+    # also runs on the real chip via VPP_TPU_TEST_PLATFORM=axon, where
+    # the builder's default would pick the TPU crossover).
+    tables = simple_tables(target_backend="cpu")
     assert tables.use_hmap
     # Shipped to a TPU worker: padded width (2) is far below the
     # crossover, the dense compare must take over.
