@@ -543,6 +543,51 @@ def test_native_python_engine_counter_parity():
     assert results["python"]["tx"] == results["native"]["tx"]
 
 
+def test_orphaned_affinity_pins_drain_after_service_deletion():
+    """Deleting the LAST ClientIP-affinity service must not leak its
+    pins: sweep_sessions deliberately skips affinity rows, so the
+    affinity sweep has to keep running on no-affinity tables until the
+    orphaned (now unmapped) pins have drained."""
+    from vpp_tpu.datapath import DataplaneRunner, InMemoryRing, VxlanOverlay
+    from vpp_tpu.ops.classify import build_rule_tables
+    from vpp_tpu.ops.nat import NatMapping, build_nat_tables
+    from vpp_tpu.ops.pipeline import RouteConfig
+
+    import jax.numpy as jnp
+
+    acl = build_rule_tables([], {})
+    aff = NatMapping("10.96.0.10", 80, 6,
+                     backends=[("10.1.1.3", 8080, 1)],
+                     session_affinity_timeout=3600)
+    kw = dict(snat_ip="192.168.16.1", snat_enabled=True,
+              pod_subnet="10.1.0.0/16")
+    route = RouteConfig(
+        pod_subnet_base=jnp.asarray(ip_to_u32("10.1.0.0"), dtype=jnp.uint32),
+        pod_subnet_mask=jnp.asarray(0xFFFF0000, dtype=jnp.uint32),
+        this_node_base=jnp.asarray(ip_to_u32("10.1.1.0"), dtype=jnp.uint32),
+        this_node_mask=jnp.asarray(0xFFFFFF00, dtype=jnp.uint32),
+        host_bits=jnp.asarray(8, dtype=jnp.int32),
+    )
+    rx, tx = InMemoryRing(), InMemoryRing()
+    runner = DataplaneRunner(
+        acl=acl, nat=build_nat_tables([aff], **kw), route=route,
+        overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"),
+                             local_node_id=1),
+        source=rx, tx=tx, batch_size=8, max_vectors=1, sweep_interval=1,
+    )
+    rx.send([build_frame("10.1.1.2", "10.96.0.10", 6, 40000, 80)])
+    runner.drain()
+    assert runner.metrics()["datapath_affinity_active"] == 1
+
+    # The service is deleted: tables rebuild with has_affinity=False.
+    runner.update_tables(nat=build_nat_tables([], **kw))
+    for sport in (41000, 42000):  # unrelated traffic drives sweeps
+        rx.send([build_frame("10.1.1.2", "10.1.1.3", 6, sport, 80)])
+        runner.drain()
+    assert runner.metrics()["datapath_affinity_active"] == 0
+    assert not runner._state.aff_pinned  # sweep stood down
+
+
 def test_afpacket_loopback_roundtrip():
     """Real AF_PACKET sockets (the DPDK-binding stand-in) on loopback:
     frames sent through one socket arrive on another bound to the same

@@ -818,6 +818,106 @@ def test_affinity_pick_survives_backend_change_until_expiry():
     assert again == fresh
 
 
+def test_affinity_pin_survives_unrelated_mapping_reorder():
+    """Advisor r4 (medium): the sweep must resolve a pin's mapping from
+    its KEY row against the CURRENT tables — never from the row index
+    cached at commit time.  An unrelated service add that reorders
+    mapping rows must not expire idle pins early (the cached index
+    would read another row's timeout, possibly 0 → instant expiry,
+    breaking the ClientIP stickiness guarantee)."""
+    from vpp_tpu.ops.nat import affinity_occupancy, sweep_affinity
+
+    kw = dict(nat_loopback="10.1.1.254", snat_ip="192.168.16.1",
+              snat_enabled=True, pod_subnet="10.1.0.0/16")
+    aff = NatMapping(
+        external_ip=CLUSTER_IP, external_port=80, protocol=6,
+        backends=[("10.1.1.2", 8080, 1), ("10.1.2.3", 8080, 1)],
+        twice_nat=TWICE_NAT_SELF, session_affinity_timeout=30)
+    tables = build_nat_tables([aff], **kw)
+    sessions = empty_sessions(1024)
+    first, sessions = _pick(tables, sessions, "10.2.0.9", ts=1)
+    assert affinity_occupancy(sessions) == 1
+
+    # Unrelated NO-affinity service lands at row 0, shifting the
+    # affinity mapping to row 1: the pin's commit-time row index now
+    # names a mapping whose affinity timeout is 0.
+    unrelated = NatMapping("10.96.9.9", 443, 6,
+                           backends=[("10.1.5.5", 8443, 1)])
+    tables2 = build_nat_tables([unrelated, aff], **kw)
+    assert int(tables2.map_ext_port[0]) == 443  # the reorder happened
+
+    # Idle pin, age 5 s << 30 s timeout: must SURVIVE the sweep and
+    # keep overriding the hash pick.
+    sessions = sweep_affinity(sessions, tables2, now=6, ts_per_second=1.0)
+    assert affinity_occupancy(sessions) == 1
+    stable, sessions = _pick(tables2, sessions, "10.2.0.9", ts=7)
+    assert stable == first
+
+    # ...and past its REAL timeout it still expires.
+    sessions = sweep_affinity(sessions, tables2, now=60, ts_per_second=1.0)
+    assert affinity_occupancy(sessions) == 0
+
+
+def test_affinity_pin_dropped_when_mapping_deleted():
+    """A pin whose external tuple no longer resolves to an affinity
+    mapping is discarded by the sweep regardless of age — its service
+    is gone, there is nothing left to pin."""
+    from vpp_tpu.ops.nat import affinity_occupancy, sweep_affinity
+
+    kw = dict(nat_loopback="10.1.1.254", snat_ip="192.168.16.1",
+              snat_enabled=True, pod_subnet="10.1.0.0/16")
+    aff = NatMapping(
+        external_ip=CLUSTER_IP, external_port=80, protocol=6,
+        backends=[("10.1.1.2", 8080, 1)], twice_nat=TWICE_NAT_SELF,
+        session_affinity_timeout=30)
+    other = NatMapping("10.96.9.9", 443, 6,
+                       backends=[("10.1.5.5", 8443, 1)],
+                       session_affinity_timeout=30)
+    tables = build_nat_tables([aff], **kw)
+    sessions = empty_sessions(1024)
+    _, sessions = _pick(tables, sessions, "10.2.0.9", ts=1)
+    assert affinity_occupancy(sessions) == 1
+    # The affinity service is deleted; an unrelated affinity service
+    # remains (so has_affinity stays compiled in).  Fresh pin, but its
+    # mapping no longer exists → dropped.
+    tables2 = build_nat_tables([other], **kw)
+    sessions = sweep_affinity(sessions, tables2, now=2, ts_per_second=1.0)
+    assert affinity_occupancy(sessions) == 0
+
+
+def test_affinity_pin_survives_transient_empty_backends():
+    """A mapping whose endpoints transiently empty (rolling restart)
+    compiles valid=False — but its pins must ride out the gap: clients
+    re-spreading on an endpoint flap is exactly what ClientIP affinity
+    exists to prevent (code-review r5)."""
+    from vpp_tpu.ops.nat import affinity_occupancy, sweep_affinity
+
+    kw = dict(nat_loopback="10.1.1.254", snat_ip="192.168.16.1",
+              snat_enabled=True, pod_subnet="10.1.0.0/16")
+    backends = [("10.1.1.2", 8080, 1), ("10.1.2.3", 8080, 1)]
+    aff = NatMapping(CLUSTER_IP, 80, 6, backends=backends,
+                     twice_nat=TWICE_NAT_SELF, session_affinity_timeout=30)
+    tables = build_nat_tables([aff], **kw)
+    sessions = empty_sessions(1024)
+    first, sessions = _pick(tables, sessions, "10.2.0.9", ts=1)
+    assert affinity_occupancy(sessions) == 1
+
+    # Endpoints gone: same mapping, zero backends -> valid=False.
+    empty = NatMapping(CLUSTER_IP, 80, 6, backends=[],
+                       twice_nat=TWICE_NAT_SELF, session_affinity_timeout=30)
+    tables_gap = build_nat_tables([empty], **kw)
+    assert not bool(tables_gap.map_valid[0])
+    sessions = sweep_affinity(sessions, tables_gap, now=6, ts_per_second=1.0)
+    assert affinity_occupancy(sessions) == 1  # pin rode out the flap
+
+    # Endpoints return: the pick is still the pinned backend.
+    stable, sessions = _pick(tables, sessions, "10.2.0.9", ts=7)
+    assert stable == first
+    # ...and the real timeout still applies through the gap tables.
+    sessions = sweep_affinity(sessions, tables_gap, now=60, ts_per_second=1.0)
+    assert affinity_occupancy(sessions) == 0
+
+
 def test_affinity_keepalive_defers_expiry():
     """Traffic refreshes last_seen: a client active within the timeout
     window keeps its pin through a sweep."""
@@ -893,6 +993,29 @@ def test_affinity_oracle_parity():
     sessions = sweep_affinity(sessions, tables_many, now=50, ts_per_second=1.0)
     engine.sweep_affinity(now=50, ts_per_second=1.0)
     check(tables_many, ts=51)         # both re-pin from the new ring
+
+    # Row REORDER (unrelated service lands first): pins must hold and
+    # sweeps must agree — both resolve by external tuple, not row index.
+    unrelated = NatMapping("10.96.9.9", 443, 6,
+                           backends=[("10.1.5.5", 8443, 1)])
+    tables_re = build_nat_tables(
+        [unrelated, m_many], nat_loopback="10.1.1.254",
+        snat_ip="192.168.16.1", snat_enabled=True, pod_subnet="10.1.0.0/16")
+    engine.set_mappings([unrelated, m_many])
+    sessions = sweep_affinity(sessions, tables_re, now=55, ts_per_second=1.0)
+    engine.sweep_affinity(now=55, ts_per_second=1.0)
+    check(tables_re, ts=56)           # pins held through the reorder
+
+    # Service DELETION: both sides drop the orphaned pins.
+    tables_del = build_nat_tables(
+        [unrelated], nat_loopback="10.1.1.254",
+        snat_ip="192.168.16.1", snat_enabled=True, pod_subnet="10.1.0.0/16")
+    engine.set_mappings([unrelated])
+    sessions = sweep_affinity(sessions, tables_del, now=57, ts_per_second=1.0)
+    engine.sweep_affinity(now=57, ts_per_second=1.0)
+    from vpp_tpu.ops.nat import affinity_occupancy
+    assert affinity_occupancy(sessions) == 0
+    assert not engine.affinity
 
 
 def test_affinity_all_disciplines_agree():

@@ -98,6 +98,14 @@ class DeviceSessionState:
         # converts per-mapping SECONDS to timestamp units at the rate
         # measured between sweeps.
         self.sweep_mark = None
+        # True once a has_affinity table has dispatched: pins may exist
+        # in the shared session table.  Keeps the affinity sweep alive
+        # after the LAST ClientIP service is deleted (tables rebuild
+        # with has_affinity=False) so orphaned pins drain instead of
+        # occupying slots forever — sweep_sessions deliberately skips
+        # affinity rows, so nothing else would ever free them.  Cleared
+        # when a sweep of a no-affinity table finds zero pins left.
+        self.aff_pinned = False
 
 
 @dataclasses.dataclass
@@ -149,6 +157,18 @@ class DataplaneRunner:
         max_vectors: int = 64,
         max_inflight: int = 2,
         session_capacity: int = 1 << 16,
+        # Sweeps (idle-session GC + ClientIP-affinity expiry) run every
+        # sweep_interval dispatched vectors.  Affinity timeouts are
+        # therefore enforced at HOST-SWEEP granularity, best-effort by
+        # design: a pin can overstay session_affinity_timeout by up to
+        # one sweep interval (plus ts-rate estimation error — the
+        # seconds→ts conversion uses the rate measured between the last
+        # two sweeps, so idle gaps skew it), and keeps overriding the
+        # hash pick until the sweep lands.  The in-dispatch lookup
+        # deliberately does no age check: the reference's nat44 affinity
+        # likewise expires on its cleanup scan, and an on-device bound
+        # would buy sub-sweep precision nobody observes at the cost of a
+        # per-packet gather of the timeout column.
         sweep_interval: int = 4096,
         sweep_max_age: int = 1 << 20,
         shim: Optional[HostShim] = None,
@@ -220,6 +240,8 @@ class DataplaneRunner:
         # scaling axis, driven by the SAME runner loop as single-chip.
         self.partition_sessions = partition_sessions
         self._state = state or DeviceSessionState(session_capacity)
+        if self.nat is not None and self.nat.has_affinity:
+            self._state.aff_pinned = True
         if mesh is not None:
             self._shard_state()
         self.slow = slow if slow is not None else HostSlowPath()
@@ -359,6 +381,11 @@ class DataplaneRunner:
             self.acl = acl
         if nat is not None:
             self.nat = retarget_tables(nat, self._target_backend())
+            if self.nat.has_affinity:
+                # Pins may be created from now on; the sweep keeps
+                # running (and draining orphans) even after a later
+                # swap to a no-affinity table — see DeviceSessionState.
+                self._state.aff_pinned = True
         if route is not None:
             self.route = route
         if self.mesh is not None and (
@@ -470,11 +497,21 @@ class DataplaneRunner:
 
             now = _time.monotonic()
             mark = self._state.sweep_mark
-            if self.nat.has_affinity and mark is not None and now > mark[1]:
+            if (
+                (self.nat.has_affinity or self._state.aff_pinned)
+                and mark is not None and now > mark[1]
+            ):
                 rate = (self._ts - mark[0]) / (now - mark[1])
                 self.sessions = sweep_affinity(
                     self.sessions, self.nat, self._ts, rate
                 )
+                if not self.nat.has_affinity:
+                    # Deleting the last ClientIP service leaves orphan
+                    # pins: every sweep drops the unmapped ones, and
+                    # once none remain the sweep stands down.
+                    self._state.aff_pinned = (
+                        affinity_occupancy(self.sessions) > 0
+                    )
             self._state.sweep_mark = (self._ts, now)
         return result
 

@@ -121,8 +121,10 @@ class ShardedDataplane:
         for key in (
             "datapath_sessions_active",
             "datapath_slowpath_sessions_active",
+            "datapath_affinity_active",
         ):
-            agg[key] = one[key]
+            if key in one:
+                agg[key] = one[key]
         for key, value in self.slow.counters.as_dict().items():
             agg[key] = value
         agg["datapath_inflight"] = sum(len(r._inflight) for r in self.shards)

@@ -270,7 +270,15 @@ class LinuxNetApplicator(Applicator):
         """All pod-namespace lines of this txn through ONE shell pass
         (`ip -n <pod> ...` per line; one fork per line inside a single
         subprocess instead of one Python subprocess per pod).  Failing
-        check=True lines re-run individually for their real stderr."""
+        check=True lines re-run individually for their real stderr.
+
+        The shell pass (and each retry) runs under the applicator's
+        confinement netns exactly like the immediate path: pod netns
+        NAMES resolve identically everywhere (the registry is per mount
+        namespace, shared), but `ip -n` still executes in the invoking
+        netns first — confinement-local state (e.g. which devices are
+        visible to a relative `link set ... netns` move) must not
+        diverge between txn and non-txn modes."""
         import shlex
 
         cmds = []
@@ -280,13 +288,29 @@ class LinuxNetApplicator(Applicator):
         script = "\n".join(
             "ip -n " + shlex.quote(ns) + " "
             + " ".join(shlex.quote(str(a)) for a in payload)
-            + f" 2>/dev/null || echo VTFAIL:{i}"
+            + f" || echo VTFAIL:{i}"
             for i, (ns, payload, _check) in enumerate(cmds)
         )
+        shell = ["sh", "-c", script]
+        if self.netns:
+            shell = ["ip", "netns", "exec", self.netns] + shell
         self.exec_count += 1
-        proc = subprocess.run(["sh", "-c", script],
-                              capture_output=True, text=True)
+        proc = subprocess.run(shell, capture_output=True, text=True)
+        if proc.stderr.strip():
+            log.debug("pod-ns batch stderr: %s", proc.stderr.strip())
         errors: List[str] = []
+        if proc.returncode != 0:
+            # Every script line is `cmd || echo VTFAIL:<i>`, so a clean
+            # pass exits 0 even when commands fail — a nonzero rc means
+            # the SHELL itself broke (confinement netns vanished, exec
+            # privilege lost, killed midway): un-marked lines may never
+            # have run at all.  Surface it so the txn fails and the
+            # scheduler retries; silence here would report success with
+            # nothing applied.  Marked lines still retry below for
+            # their real stderr.
+            errors.append(
+                f"pod-ns batch shell failed (rc={proc.returncode}): "
+                f"{proc.stderr.strip()}")
         for line in proc.stdout.splitlines():
             if not line.startswith("VTFAIL:"):
                 continue
@@ -294,11 +318,14 @@ class LinuxNetApplicator(Applicator):
             if not check:
                 continue
             self.exec_count += 1
-            retry = subprocess.run(["ip", "-n", ns] + list(payload),
-                                   capture_output=True, text=True)
+            retry_cmd = ["ip", "-n", ns] + [str(a) for a in payload]
+            if self.netns:
+                retry_cmd = ["ip", "netns", "exec", self.netns] + retry_cmd
+            retry = subprocess.run(retry_cmd, capture_output=True, text=True)
             if retry.returncode != 0:
                 errors.append(
-                    f"ip -n {ns} {' '.join(payload)}: {retry.stderr.strip()}")
+                    f"ip -n {ns} {' '.join(str(a) for a in payload)}: "
+                    f"{retry.stderr.strip()}")
         return errors
 
     def _run_batch_group(self, tool: str, pod_ns: Optional[str],

@@ -196,7 +196,9 @@ AFFINITY_FLAG = 1 << 8
 # Affinity value-row columns (reinterpreting the session value row).
 _AV_BIP = 0       # pinned backend ip
 _AV_BPORT = 1     # pinned backend port
-_AV_MIDX = 2      # mapping row (for the per-mapping timeout sweep)
+_AV_MIDX = 2      # mapping row AT COMMIT TIME (debug only — table
+                  # rebuilds reorder rows, so the sweep re-resolves the
+                  # mapping from the key row, never from this cache)
 _AV_SEEN = 3      # last_seen (same column as sessions' _V_SEEN)
 _K_RSRC = 1       # reply key: src ip (backend / server)
 _K_RDST = 2       # reply key: dst ip (client after twice-nat)
@@ -1238,19 +1240,47 @@ def sweep_affinity(
     than their mapping's ``session_affinity_timeout`` (seconds),
     converted to timestamp units at the caller's measured rate.  After
     expiry the client re-picks from the CURRENT backend ring — the
-    timeout semantic K8s ClientIP affinity requires for rebalancing."""
+    timeout semantic K8s ClientIP affinity requires for rebalancing.
+
+    The pin's mapping is resolved from its KEY row (ext ip/port live in
+    _K_RDST/_K_RPORTS, protocol in the meta low byte) against the
+    CURRENT tables — never from the _AV_MIDX cached at commit time:
+    service-table rebuilds reorder and shrink mapping rows, so a cached
+    row index can silently point an idle pin at another mapping's
+    timeout (possibly 0 → instant expiry, breaking the stickiness
+    guarantee the pin exists to provide).  Pins whose external tuple no
+    longer resolves to ANY affinity mapping are dropped outright —
+    their service was deleted or lost affinity, so there is nothing
+    left to pin (the reference likewise discards nat44 affinity with
+    its mapping).  The match deliberately IGNORES ``map_valid``: a
+    mapping whose backends transiently emptied (rolling restart)
+    compiles valid=False, but its pins must ride out the gap — clients
+    re-spreading on an endpoint flap is exactly what ClientIP affinity
+    exists to prevent.  Padded rows can never match (their proto is 0;
+    pinned protocols are 6/17), so a plain dense compare is safe, and
+    at sweep cadence its O(capacity × M) cost is irrelevant."""
     if tables.map_aff_timeout is None:
         return sessions
-    midx = sessions.val_tbl[:, _AV_MIDX].astype(jnp.int32)
-    midx = jnp.clip(midx, 0, tables.map_aff_timeout.shape[0] - 1)
+    key_tbl = sessions.key_tbl
+    ext_ip = key_tbl[:, _K_RDST]
+    ext_port = (key_tbl[:, _K_RPORTS] & jnp.uint32(0xFFFF)).astype(jnp.int32)
+    proto = (key_tbl[:, _K_META] & jnp.uint32(0xFF)).astype(jnp.int32)
+    hit = (
+        (ext_ip[:, None] == tables.map_ext_ip[None, :])
+        & (ext_port[:, None] == tables.map_ext_port[None, :])
+        & (proto[:, None] == tables.map_proto[None, :])
+        & (tables.map_affinity[None, :] == 1)
+    )  # [capacity, M]
+    mapped = jnp.any(hit, axis=1)
+    midx = jnp.argmax(hit, axis=1)
     timeout_ts = (
         tables.map_aff_timeout[midx].astype(jnp.float32) * ts_per_second
     ).astype(jnp.int32)
     age = now - sessions.val_tbl[:, _AV_SEEN].astype(jnp.int32)
-    stale = sessions.aff_valid & (age > timeout_ts)
-    meta = jnp.where(stale, jnp.uint32(0), sessions.key_tbl[:, _K_META])
+    stale = sessions.aff_valid & (~mapped | (age > timeout_ts))
+    meta = jnp.where(stale, jnp.uint32(0), key_tbl[:, _K_META])
     return NatSessions(
-        key_tbl=sessions.key_tbl.at[:, _K_META].set(meta),
+        key_tbl=key_tbl.at[:, _K_META].set(meta),
         val_tbl=sessions.val_tbl,
     )
 

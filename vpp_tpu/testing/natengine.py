@@ -93,11 +93,13 @@ class MockNatEngine:
         self.session_capacity = session_capacity
         # slot -> (reply key tuple, restore (src_ip, src_port, dst_ip, dst_port))
         self.sessions: Dict[int, Tuple[Tuple, Tuple]] = {}
-        # ClientIP affinity pins: (client_ip, mapping_row) ->
-        # (backend_ip, backend_port, last_seen).  Mirrors the kernel's
-        # AFFINITY_FLAG entries; expiry happens only via sweep_affinity
-        # (device entries likewise expire only via the host sweep).
-        self.affinity: Dict[Tuple[int, int], Tuple[int, int, int]] = {}
+        # ClientIP affinity pins: (client_ip, ext_ip, ext_port, proto)
+        # -> (backend_ip, backend_port, last_seen).  Mirrors the
+        # kernel's AFFINITY_FLAG entries, which key by the EXTERNAL
+        # tuple — never by mapping-row index, which table rebuilds
+        # reorder.  Expiry happens only via sweep_affinity (device
+        # entries likewise expire only via the host sweep).
+        self.affinity: Dict[Tuple[int, int, int, int], Tuple[int, int, int]] = {}
 
     # ---------------------------------------------------------- assertions
 
@@ -114,11 +116,25 @@ class MockNatEngine:
 
     def sweep_affinity(self, now: int, ts_per_second: float = 1.0) -> int:
         """Expire affinity pins idle past their mapping's timeout
-        (mirror of ops.nat.sweep_affinity); returns entries removed."""
+        (mirror of ops.nat.sweep_affinity); returns entries removed.
+
+        The pin's mapping is resolved from its external tuple against
+        the CURRENT mappings, exactly like the kernel: a pin whose
+        tuple no longer names an affinity mapping is dropped outright,
+        while a mapping whose backends transiently emptied still
+        anchors its pins (the ride-out-the-endpoint-flap semantic)."""
         removed = 0
         for key, (_bip, _bport, seen) in list(self.affinity.items()):
-            timeout = self.mappings[key[1]].session_affinity_timeout
-            if now - seen > timeout * ts_per_second:
+            _client, ext_ip, ext_port, proto = key
+            timeout = next(
+                (m.session_affinity_timeout for m in self.mappings
+                 if ip_to_u32(m.external_ip) == ext_ip
+                 and m.external_port == ext_port
+                 and m.protocol == proto
+                 and m.session_affinity_timeout > 0),
+                None,
+            )
+            if timeout is None or now - seen > timeout * ts_per_second:
                 del self.affinity[key]
                 removed += 1
         return removed
@@ -177,11 +193,13 @@ class MockNatEngine:
                 b_ip, b_port = ring[h % len(ring)]
                 if mapping.session_affinity_timeout > 0:
                     # A live pin overrides the hash pick and refreshes;
-                    # a miss pins the pick made this packet.
-                    pin = self.affinity.get((f.src_ip, mi))
+                    # a miss pins the pick made this packet.  Keyed by
+                    # the external tuple (like the kernel's key row).
+                    akey = (f.src_ip, f.dst_ip, f.dst_port, f.proto)
+                    pin = self.affinity.get(akey)
                     if pin is not None:
                         b_ip, b_port = pin[0], pin[1]
-                    self.affinity[(f.src_ip, mi)] = (b_ip, b_port, timestamp)
+                    self.affinity[akey] = (b_ip, b_port, timestamp)
                 hairpin = (
                     mapping.twice_nat == TWICE_NAT_ENABLED
                     or (mapping.twice_nat == TWICE_NAT_SELF and b_ip == f.src_ip)
