@@ -543,6 +543,167 @@ def test_native_python_engine_counter_parity():
     assert results["python"]["tx"] == results["native"]["tx"]
 
 
+def _permissive_state():
+    """Trivially-permissive tables: no ACL, no NAT, SNAT off — the
+    host-bypass eligibility conditions."""
+    from vpp_tpu.ops.classify import build_rule_tables
+    from vpp_tpu.ops.nat import build_nat_tables
+    from vpp_tpu.ops.pipeline import RouteConfig
+
+    import jax.numpy as jnp
+
+    acl = build_rule_tables([], {})
+    nat = build_nat_tables([], snat_enabled=False)
+    route = RouteConfig(
+        pod_subnet_base=jnp.asarray(ip_to_u32("10.1.0.0"), dtype=jnp.uint32),
+        pod_subnet_mask=jnp.asarray(0xFFFF0000, dtype=jnp.uint32),
+        this_node_base=jnp.asarray(ip_to_u32("10.1.1.0"), dtype=jnp.uint32),
+        this_node_mask=jnp.asarray(0xFFFFFF00, dtype=jnp.uint32),
+        host_bits=jnp.asarray(8, dtype=jnp.int32),
+    )
+    return acl, nat, route
+
+
+def _bypass_traffic(shim):
+    """local / remote / egress / unparseable / VXLAN-ingress (ours +
+    foreign) — every admit/harvest path the bypass must mirror."""
+    frames = []
+    frames += [build_frame("10.1.1.2", "10.1.1.3", 6, 40000 + i, 80)
+               for i in range(5)]
+    frames += [build_frame("10.1.1.2", "10.1.2.9", 6, 41000 + i, 80)
+               for i in range(4)]
+    frames += [build_frame("10.1.1.2", "10.1.9.9", 17, 42000, 53)]
+    frames += [build_frame("10.1.1.4", "93.184.216.34", 6, 43000 + i, 443)
+               for i in range(3)]
+    frames += [b"\xff" * 6 + b"\x02\x00\x00\x00\x00\x01" + b"\x08\x06"
+               + b"\x00" * 40]
+    inner = build_frame("10.1.2.7", "10.1.1.3", 6, 44000, 8080)
+    fb = shim.parse([inner], pad_to=None)
+    remote_ips = np.zeros(4, dtype=np.uint32)
+    remote_ips[1] = ip_to_u32("192.168.16.1")
+    for vni in (10, 99):
+        buf, off, lens, rows, _ = shim.vxlan_encap(
+            fb, np.array([1], np.uint8), np.array([1], np.uint8),
+            np.array([1], np.int32), remote_ips,
+            local_ip=ip_to_u32("192.168.16.2"), local_node_id=2, vni=vni,
+        )
+        frames += [buf[int(off[0]):int(off[0]) + int(lens[0])].tobytes()]
+    return frames
+
+
+def test_host_bypass_matches_full_pipeline():
+    """With trivially-permissive tables the native runner takes the
+    HOST BYPASS (fused admit→route→harvest, no device dispatch); its
+    outputs and counters must be identical to the full-pipeline python
+    engine on the same traffic."""
+    from vpp_tpu.datapath import DataplaneRunner, InMemoryRing, NativeRing, VxlanOverlay
+    from vpp_tpu.shim.hostshim import HostShim
+
+    acl, nat, route = _permissive_state()
+    shim = HostShim()
+    results = {}
+    for engine in ("python", "native"):
+        if engine == "native":
+            rings = [NativeRing() for _ in range(4)]
+        else:
+            rings = [InMemoryRing() for _ in range(4)]
+        rx, tx, local, host = rings
+        runner = DataplaneRunner(
+            acl=acl, nat=nat, route=route,
+            overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"),
+                                 local_node_id=1),
+            source=rx, tx=tx, local=local, host=host,
+            batch_size=8, max_vectors=2, shim=shim,
+        )
+        assert runner.engine == engine
+        runner.overlay.set_remote(2, ip_to_u32("192.168.16.2"))
+        if engine == "native":
+            assert runner._bypass_tables, "bypass must be eligible"
+        rx.send(_bypass_traffic(shim))
+        runner.drain()
+        results[engine] = {
+            "counters": dict(runner.counters.as_dict()),
+            "tx": tx.recv_batch(1 << 16),
+            "local": sorted(local.recv_batch(1 << 16)),
+            "host": host.recv_batch(1 << 16),
+        }
+    nc = results["native"]["counters"]
+    assert nc["datapath_bypass_batches_total"] > 0
+    assert nc["datapath_batches_total"] == 0  # never touched the device
+    pc = results["python"]["counters"]
+    for key, value in pc.items():
+        if key in ("datapath_batches_total", "datapath_bypass_batches_total"):
+            continue
+        assert nc[key] == value, f"{key}: {nc[key]} != {value}"
+    assert results["python"]["local"] == results["native"]["local"]
+    assert results["python"]["host"] == results["native"]["host"]
+    assert results["python"]["tx"] == results["native"]["tx"]
+
+
+def test_host_bypass_gating_and_transitions():
+    """The bypass must NOT engage with rules / NAT / SNAT / an enabled
+    tracer, and a table swap to a service config must re-enter the
+    dispatch path (and back)."""
+    from vpp_tpu.datapath import DataplaneRunner, NativeRing, VxlanOverlay
+    from vpp_tpu.ops.classify import build_rule_tables
+    from vpp_tpu.ops.nat import NatMapping, build_nat_tables
+
+    acl, nat, route = _permissive_state()
+    rx, tx, local, host = (NativeRing() for _ in range(4))
+    runner = DataplaneRunner(
+        acl=acl, nat=nat, route=route,
+        overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"),
+                             local_node_id=1),
+        source=rx, tx=tx, local=local, host=host,
+        batch_size=8, max_vectors=2,
+    )
+    assert runner._bypass_tables
+
+    # SNAT on -> ineligible.
+    runner.update_tables(nat=build_nat_tables([], snat_ip="192.168.16.1",
+                                              snat_enabled=True))
+    assert not runner._bypass_tables
+    # Back to permissive -> eligible again.
+    runner.update_tables(nat=build_nat_tables([], snat_enabled=False))
+    assert runner._bypass_tables
+    # A service mapping -> ineligible, and the dispatch path DNATs.
+    svc = NatMapping("10.96.0.10", 80, 6, backends=[("10.1.1.3", 8080, 1)])
+    runner.update_tables(nat=build_nat_tables([svc], snat_enabled=False))
+    assert not runner._bypass_tables
+    rx.send([build_frame("10.1.1.2", "10.96.0.10", 6, 40000, 80)])
+    runner.drain()
+    assert runner.counters.batches > 0
+    out = local.recv_batch(16)
+    assert len(out) == 1
+    assert frame_tuple(out[0]) == ("10.1.1.2", "10.1.1.3", 6, 40000, 8080)
+
+    # Sessions now live -> even back-to-permissive stays ineligible
+    # until they decay (replies of existing flows must keep restoring).
+    runner.update_tables(nat=build_nat_tables([], snat_enabled=False))
+    assert not runner._bypass_tables
+
+    # An enabled tracer suppresses the bypass dynamically.
+    rx2, tx2, local2, host2 = (NativeRing() for _ in range(4))
+    acl2, nat2, route2 = _permissive_state()
+    r2 = DataplaneRunner(
+        acl=acl2, nat=nat2, route=route2,
+        overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"),
+                             local_node_id=1),
+        source=rx2, tx=tx2, local=local2, host=host2,
+        batch_size=8, max_vectors=2,
+    )
+    r2.tracer.enable()
+    rx2.send([build_frame("10.1.1.2", "10.1.1.3", 6, 40000, 80)])
+    r2.drain()
+    assert r2.counters.bypass_batches == 0
+    assert r2.counters.batches > 0  # went through dispatch for tracing
+    assert len(r2.tracer.dump()) == 1
+    r2.tracer.disable()
+    rx2.send([build_frame("10.1.1.2", "10.1.1.3", 6, 41000, 80)])
+    r2.drain()
+    assert r2.counters.bypass_batches > 0
+
+
 def test_orphaned_affinity_pins_drain_after_service_deletion():
     """Deleting the LAST ClientIP-affinity service must not leak its
     pins: sweep_sessions deliberately skips affinity rows, so the
@@ -586,6 +747,53 @@ def test_orphaned_affinity_pins_drain_after_service_deletion():
         runner.drain()
     assert runner.metrics()["datapath_affinity_active"] == 0
     assert not runner._state.aff_pinned  # sweep stood down
+
+
+def test_host_bypass_waits_for_orphan_pins_then_engages():
+    """Code-review r5: trivially-permissive tables with residual
+    affinity pins (or sessions) must NOT engage the host bypass —
+    bypassing would park the drain sweep forever.  Once the sweeps
+    drain them, the stand-down re-evaluates and the bypass engages
+    without another table update."""
+    from vpp_tpu.datapath import DataplaneRunner, NativeRing, VxlanOverlay
+    from vpp_tpu.ops.classify import build_rule_tables
+    from vpp_tpu.ops.nat import NatMapping, build_nat_tables
+
+    _, _, route = _permissive_state()
+    acl = build_rule_tables([], {})
+    aff = NatMapping("10.96.0.10", 80, 6,
+                     backends=[("10.1.1.3", 8080, 1)],
+                     session_affinity_timeout=3600)
+    rx, tx, local, host = (NativeRing() for _ in range(4))
+    runner = DataplaneRunner(
+        acl=acl, nat=build_nat_tables([aff], snat_enabled=False,
+                                      pod_subnet="10.1.0.0/16"),
+        route=route,
+        overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"),
+                             local_node_id=1),
+        source=rx, tx=tx, local=local, host=host,
+        batch_size=8, max_vectors=1, sweep_interval=1, sweep_max_age=1,
+    )
+    rx.send([build_frame("10.1.1.2", "10.96.0.10", 6, 40000, 80)])
+    runner.drain()
+    assert runner.metrics()["datapath_affinity_active"] == 1
+
+    # All services deleted -> tables are trivially permissive, but the
+    # orphan pin (and the session until it ages out) must block bypass.
+    runner.update_tables(nat=build_nat_tables([], snat_enabled=False,
+                                              pod_subnet="10.1.0.0/16"))
+    assert not runner._bypass_tables
+    # Traffic drives sweeps: session expires (max_age=1), orphan pin
+    # drops (unmapped), and the sweep's stand-down re-evaluates bypass.
+    for sport in (41000, 42000, 43000):
+        rx.send([build_frame("10.1.1.2", "10.1.1.3", 6, sport, 80)])
+        runner.drain()
+    assert runner.metrics()["datapath_affinity_active"] == 0
+    assert runner._bypass_tables  # re-engaged without a table update
+    before = runner.counters.bypass_batches
+    rx.send([build_frame("10.1.1.2", "10.1.1.3", 6, 44000, 80)])
+    runner.drain()
+    assert runner.counters.bypass_batches > before
 
 
 def test_afpacket_loopback_roundtrip():

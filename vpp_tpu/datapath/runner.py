@@ -123,6 +123,7 @@ class RunnerCounters:
     punts: int = 0
     host_restores: int = 0
     batches: int = 0
+    bypass_batches: int = 0
 
     def as_dict(self) -> Dict[str, int]:
         return {f"datapath_{k}_total": v for k, v in dataclasses.asdict(self).items()}
@@ -281,6 +282,81 @@ class DataplaneRunner:
                 batch_size=self.batch_size, max_vectors=self.max_vectors,
                 vni=self.overlay.vni, n_slots=self._n_slots,
             )
+        self._bypass_tables = False
+        self._bypass_route = None
+        self._refresh_bypass()
+
+    # ------------------------------------------------------ host bypass
+
+    def _refresh_bypass(self) -> None:
+        """Precompute host-bypass eligibility — VPP's feature-less
+        interface path: with NO ACL rules or tables, NO NAT mappings,
+        SNAT off, and no residual session/slow-path state, EVERY frame
+        is pass-through (allowed, unrewritten, never punted) and
+        routing is pure subnet arithmetic.  Eligible polls skip the
+        device dispatch entirely and run the fused native
+        admit→route→harvest call (hs_loop_hostpath) — the loop's full
+        measured capacity instead of the XLA round trip.  Re-derived on
+        every table swap; the tracer is re-checked per poll (REST can
+        enable it any time), and residual sessions only ever decay, so
+        the one-shot occupancy check here stays valid."""
+        eligible = (
+            self._native is not None
+            and self.mesh is None
+            and self.acl is not None and self.nat is not None
+            and self.route is not None
+            and getattr(self.acl, "num_rules", 1) == 0
+            and getattr(self.acl, "num_tables", 1) == 0
+            and self.nat.num_mappings == 0
+            and not bool(np.asarray(self.nat.snat_enabled))
+            and not self.nat.has_affinity
+            and len(self.slow) == 0
+            and session_occupancy(self.sessions) == 0
+            # Orphaned ClientIP pins drain via the affinity sweep, which
+            # only runs on the DISPATCH path — bypassing while pins
+            # remain would park them in the table forever (and stale
+            # pins would resurrect dead backend picks if the service
+            # reappears).  The sweep's stand-down re-evaluates us.
+            and affinity_occupancy(self.sessions) == 0
+        )
+        if eligible:
+            self._bypass_route = (
+                int(np.asarray(self.route.pod_subnet_base)),
+                int(np.asarray(self.route.pod_subnet_mask)),
+                int(np.asarray(self.route.this_node_base)),
+                int(np.asarray(self.route.this_node_mask)),
+                int(np.asarray(self.route.host_bits)),
+            )
+        self._bypass_tables = eligible
+
+    def _bypass_ready(self) -> bool:
+        # In-flight dispatched batches must harvest first (arena pins
+        # release FIFO); an enabled tracer needs the dispatch path's
+        # verdict recording.
+        return (self._bypass_tables and not self._inflight
+                and not self.tracer.enabled)
+
+    def _bypass_once(self) -> Tuple[bool, int]:
+        """One fused bypass batch; returns (consumed_anything, sent)."""
+        ac = np.zeros(NativeLoop.ADMIT_COUNTERS, dtype=np.uint64)
+        hc = np.zeros(NativeLoop.HARVEST_COUNTERS, dtype=np.uint64)
+        n, sent = self._native.hostpath(
+            self._slot_next, *self._bypass_route,
+            self.overlay.remote_ips, self.overlay.local_ip,
+            self.overlay.local_node_id, ac, hc,
+        )
+        self.counters.rx_frames += int(ac[0])
+        self.counters.rx_decapped += int(ac[1])
+        self.counters.dropped_foreign_vni += int(ac[2])
+        if n > 0:
+            self.counters.bypass_batches += 1
+            self.counters.tx_remote += int(hc[0])
+            self.counters.tx_local += int(hc[1])
+            self.counters.tx_host += int(hc[2])
+            self.counters.dropped_denied += int(hc[3])
+            self.counters.dropped_unparseable += int(hc[4])
+            self.counters.dropped_unroutable += int(hc[5])
+        return (n > 0 or int(ac[0]) > 0), sent
 
     # ------------------------------------------------------ shared state
 
@@ -397,13 +473,26 @@ class DataplaneRunner:
                 self.mesh, self.acl, self.nat, self.route, self.sessions,
                 partition_sessions=self.partition_sessions,
             )
+        if acl is not None or nat is not None or route is not None:
+            self._refresh_bypass()
 
     # --------------------------------------------------------------- loop
 
     def poll(self) -> int:
         """One scheduling turn: admit new batches up to the in-flight
         window, then harvest the oldest completed batch.  Returns the
-        number of frames transmitted this turn."""
+        number of frames transmitted this turn.
+
+        With trivially-permissive tables the HOST BYPASS replaces the
+        whole turn: fused native admit→route→harvest batches until the
+        source idles — no device dispatch (see _refresh_bypass)."""
+        if self._bypass_ready():
+            sent_total = 0
+            while True:
+                consumed, sent = self._bypass_once()
+                sent_total += sent
+                if not consumed:
+                    return sent_total
         admitted = True
         while len(self._inflight) < self.max_inflight and admitted:
             admitted = self._admit()
@@ -421,6 +510,11 @@ class DataplaneRunner:
                 return total
 
     def _admit(self) -> bool:
+        if self._bypass_ready():
+            # Bypass turns run whole batches inside poll; here (the
+            # drain idle-probe) just report whether source frames are
+            # pending so the caller loops back into poll.
+            return len(self.source) > 0
         if self._native is not None:
             return self._admit_native()
         return self._admit_python()
@@ -513,6 +607,13 @@ class DataplaneRunner:
                         affinity_occupancy(self.sessions) > 0
                     )
             self._state.sweep_mark = (self._ts, now)
+            if not self._bypass_tables:
+                # Residual sessions/pins blocked bypass eligibility at
+                # the last table swap; they only decay via these
+                # sweeps, so re-evaluate as they drain (the table
+                # checks short-circuit before any device read when the
+                # tables are non-trivial anyway).
+                self._refresh_bypass()
         return result
 
     # ------------------------------------------------------- native engine

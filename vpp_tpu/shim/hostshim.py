@@ -117,6 +117,14 @@ def _load() -> ctypes.CDLL:
     lib.hs_loop_slot_frame.argtypes = [
         ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, _u8p, ctypes.c_uint32,
     ]
+    lib.hs_loop_hostpath.restype = ctypes.c_int32
+    lib.hs_loop_hostpath.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32,
+        ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32,
+        ctypes.c_uint32, _u32p, ctypes.c_int32,
+        ctypes.c_uint32, ctypes.c_uint32, _u64p, _u64p,
+        ctypes.POINTER(ctypes.c_int32),
+    ]
     lib.hs_afp_rx.restype = ctypes.c_int32
     lib.hs_afp_rx.argtypes = [ctypes.c_int32, ctypes.c_void_p, ctypes.c_int32]
     lib.hs_afp_tx.restype = ctypes.c_int32
@@ -313,6 +321,33 @@ class NativeLoop:
                 "release their arena pins FIFO)"
             )
         return sent
+
+    def hostpath(self, slot: int, pod_base: int, pod_mask: int,
+                 node_base: int, node_mask: int, host_bits: int,
+                 remote_ips: np.ndarray, local_ip: int, local_node_id: int,
+                 admit_counters: np.ndarray,
+                 harvest_counters: np.ndarray) -> tuple:
+        """Fused HOST-BYPASS batch — admit, subnet route classify, and
+        harvest in one native call (no device dispatch, no FFI between
+        phases).  Only valid when the datapath's tables are trivially
+        permissive: every frame is forwarded unrewritten on subnet
+        routing alone.  Returns ``(n_admitted, sent)``."""
+        remote_ips = np.ascontiguousarray(remote_ips, dtype=np.uint32)
+        sent = ctypes.c_int32(0)
+        n = int(self._lib.hs_loop_hostpath(
+            self._ptr, slot,
+            ctypes.c_uint32(pod_base), ctypes.c_uint32(pod_mask),
+            ctypes.c_uint32(node_base), ctypes.c_uint32(node_mask),
+            ctypes.c_uint32(host_bits),
+            remote_ips.ctypes.data_as(_u32p), len(remote_ips) - 1,
+            ctypes.c_uint32(local_ip), ctypes.c_uint32(local_node_id),
+            admit_counters.ctypes.data_as(_u64p),
+            harvest_counters.ctypes.data_as(_u64p),
+            ctypes.byref(sent),
+        ))
+        if n < 0:
+            raise RuntimeError(f"slot {slot} is still in flight (unharvested)")
+        return n, int(sent.value)
 
     def slot_frame(self, slot: int, row: int) -> bytes:
         """Copy one admitted frame back out (slow path / tracing only)."""
