@@ -135,6 +135,144 @@ def test_full_agent_drives_real_kernel(hostnet):
         subprocess.run(["ip", "netns", "del", pod_ns], capture_output=True)
 
 
+def _base_state(pod_ns):
+    bvi = Interface(name="vxlanBVI", type=InterfaceType.LOOPBACK,
+                    ip_addresses=("192.168.30.1/24",),
+                    physical_address="12:fe:c0:a8:1e:01", mtu=1450)
+    vxlan = Interface(name="vxlan2", type=InterfaceType.VXLAN,
+                      vxlan_src="192.168.16.1", vxlan_dst="192.168.16.2",
+                      vxlan_vni=10)
+    pod = Interface(name="tap-default-web", type=InterfaceType.TAP,
+                    ip_addresses=("10.1.1.2/32",), host_if_name="eth0",
+                    namespace=pod_ns, mtu=1450)
+    bd = BridgeDomain(name="vxlanBD", bvi_interface="vxlanBVI",
+                      interfaces=("vxlan2",))
+    route = Route(dst_network="10.1.2.0/24", next_hop="192.168.30.2",
+                  outgoing_interface="vxlanBVI", vrf=0)
+    arp = ArpEntry(interface="vxlanBVI", ip_address="192.168.30.2",
+                   physical_address="12:fe:c0:a8:1e:02")
+    return (bvi, vxlan, pod, bd, route, arp, VrfTable(id=0, label="main"))
+
+
+def test_downstream_resync_repairs_out_of_band_damage(hostnet):
+    """VERDICT r4 item 2 (done criterion): delete a pod's veth (and a
+    route, and an ARP entry) out-of-band → the drift-detecting
+    downstream resync finds and restores exactly the damaged values —
+    the healthy ones are NOT re-pushed (no full replay)."""
+    pod_ns = f"vt-pod-{uuid.uuid4().hex[:6]}"
+    sched = TxnScheduler()
+    sched.register_applicator(hostnet)
+    values = _base_state(pod_ns)
+    try:
+        sched.commit(RecordedTxn(seq_num=1, is_resync=True,
+                                 values={v.key: v for v in values}))
+        # Clean state: verify reports NO drift, downstream repairs nothing.
+        result = sched.resync_downstream()
+        assert result["repaired"] == []
+        assert result["replayed"] == []
+
+        # Out-of-band damage: the pod veth goes (taking the pod-side
+        # peer with it), a route vanishes, the ARP entry is flushed.
+        hostnet._ip(["link", "del", "tap-default-web"])
+        hostnet._ip(["route", "del", "10.1.2.0/24"])
+        hostnet._ip(["neigh", "del", "192.168.30.2", "dev", "vxlanBVI"])
+
+        result = sched.resync_downstream()
+        repaired = set(result["repaired"])
+        pod_key, route_key, arp_key = values[2].key, values[4].key, values[5].key
+        assert {pod_key, route_key, arp_key} <= repaired
+        # The healthy values stayed untouched — detection, not replay.
+        assert values[0].key not in repaired  # BVI
+        assert values[1].key not in repaired  # vxlan tunnel
+
+        # ...and the kernel is actually whole again.
+        assert hostnet.link_exists("tap-default-web")
+        out = subprocess.run(
+            ["ip", "netns", "exec", pod_ns, "ip", "-json", "addr", "show"],
+            capture_output=True, text=True)
+        assert '"10.1.1.2"' in out.stdout
+        assert any(r.get("dst") == "10.1.2.0/24" for r in hostnet.routes())
+        assert any(n.get("dst") == "192.168.30.2"
+                   for n in hostnet.neighbors())
+        assert sched.resync_downstream()["repaired"] == []
+    finally:
+        subprocess.run(["ip", "netns", "del", pod_ns], capture_output=True)
+
+
+def test_downstream_resync_cascades_to_dependents(hostnet):
+    """Repairing a drifted device re-creates it, which destroys the
+    kernel routes through it — the repair must cascade to applied
+    dependents so they come back too."""
+    pod_ns = f"vt-pod-{uuid.uuid4().hex[:6]}"
+    sched = TxnScheduler()
+    sched.register_applicator(hostnet)
+    values = _base_state(pod_ns)
+    try:
+        sched.commit(RecordedTxn(seq_num=1, is_resync=True,
+                                 values={v.key: v for v in values}))
+        # Damage the BVI only (flush its address): the BVI drifts; the
+        # route and ARP THROUGH it are intact now but die with the
+        # repair's delete+recreate — the cascade re-creates them.
+        hostnet._ip(["addr", "del", "192.168.30.1/24", "dev", "vxlanBVI"])
+        result = sched.resync_downstream()
+        repaired = set(result["repaired"])
+        assert values[0].key in repaired          # the BVI itself
+        assert values[4].key in repaired          # its route (cascade)
+        assert values[5].key in repaired          # its ARP (cascade)
+        assert any(a.get("local") == "192.168.30.1"
+                   for a in hostnet.addrs("vxlanBVI")[0]["addr_info"])
+        assert any(r.get("dst") == "10.1.2.0/24" for r in hostnet.routes())
+        assert sched.resync_downstream()["repaired"] == []
+    finally:
+        subprocess.run(["ip", "netns", "del", pod_ns], capture_output=True)
+
+
+def test_healing_resync_heals_southbound_drift_e2e(hostnet):
+    """The controller path: a periodic HealingResync runs the verify-
+    first downstream repair — delete a pod veth out-of-band, push the
+    event, watch the kernel heal."""
+    import time
+
+    from vpp_tpu.controller.api import HealingResync, HealingResyncType
+
+    store = KVStore()
+    nodesync = NodeSync(store, "node-1")
+    podmanager = PodManager()
+    ipv4net = IPv4Net(NetworkConfig(), nodesync, podmanager=podmanager)
+    sched = TxnScheduler()
+    sched.register_applicator(hostnet)
+    ctl = Controller([nodesync, podmanager, ipv4net], sched, healing_delay=0.05)
+    podmanager.event_loop = ctl
+    nodesync.event_loop = ctl
+    ctl.start()
+    watcher = DBWatcher(ctl, store)
+    watcher.start()
+    pod_ns = f"vt-pod-{uuid.uuid4().hex[:6]}"
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and not hostnet.link_exists("tap-vpp2"):
+            time.sleep(0.05)
+        reply = podmanager.add_pod("web", "default", network_namespace=pod_ns)
+        assert reply.ip_address == "10.1.1.2/32"
+        assert hostnet.link_exists("tap-default-web")
+
+        hostnet._ip(["link", "del", "tap-default-web"])  # out-of-band damage
+        assert not hostnet.link_exists("tap-default-web")
+        ctl.push_event(HealingResync(HealingResyncType.PERIODIC))
+        deadline = time.time() + 10
+        while time.time() < deadline and not hostnet.link_exists("tap-default-web"):
+            time.sleep(0.05)
+        assert hostnet.link_exists("tap-default-web")
+        out = subprocess.run(
+            ["ip", "netns", "exec", pod_ns, "ip", "-json", "addr", "show"],
+            capture_output=True, text=True)
+        assert '"10.1.1.2"' in out.stdout
+    finally:
+        watcher.stop()
+        ctl.stop()
+        subprocess.run(["ip", "netns", "del", pod_ns], capture_output=True)
+
+
 @pytest.mark.slow
 def test_procnode_with_hostnet_programs_kernel(tmp_path):
     """A separate-OS-process agent with --hostnet-netns connects to the

@@ -150,3 +150,63 @@ def test_replay_downstream_resync():
     eng.state.clear()
     s.replay()
     assert eng.state == {"/cfg/a": 1}
+
+
+class VerifiableEngine(MockEngine):
+    """A MockEngine whose verify() diffs its own state dict — the
+    southbound-readback contract."""
+
+    def verify(self, applied):
+        return {k for k, v in applied.items() if self.state.get(k) != v}
+
+
+def test_resync_downstream_repairs_only_drifted_values():
+    eng = VerifiableEngine("/cfg/")
+    s = TxnScheduler()
+    s.register_applicator(eng)
+    s.commit(resync({"/cfg/a": 1, "/cfg/b": 2, "/cfg/c": 3}))
+
+    # Out-of-band damage: one value deleted, one corrupted.
+    del eng.state["/cfg/a"]
+    eng.state["/cfg/b"] = 99
+    eng.ops.clear()
+    result = s.resync_downstream()
+    assert sorted(result["repaired"]) == ["/cfg/a", "/cfg/b"]
+    assert result["replayed"] == []
+    assert eng.state == {"/cfg/a": 1, "/cfg/b": 2, "/cfg/c": 3}
+    # The healthy value was never touched — detection, not replay.
+    assert not any(op[1] == "/cfg/c" for op in eng.ops)
+
+    # Clean state: nothing repaired, no backend ops at all.
+    eng.ops.clear()
+    assert s.resync_downstream()["repaired"] == []
+    assert eng.ops == []
+
+
+def test_resync_downstream_cascades_to_dependents():
+    eng = VerifiableEngine("/cfg/")
+    s = TxnScheduler()
+    s.register_applicator(eng)
+    s.register_dependencies(
+        "/cfg/route/", lambda key, value: {"/cfg/if/eth0"})
+    s.commit(resync({"/cfg/if/eth0": "up", "/cfg/route/r1": "10/8"}))
+
+    # Only the interface drifts; the dependent route's backend state is
+    # intact — but the repair re-creates the interface, so the route
+    # must ride along (kernel semantics: routes die with their device).
+    eng.state["/cfg/if/eth0"] = "corrupt"
+    result = s.resync_downstream()
+    assert sorted(result["repaired"]) == ["/cfg/if/eth0", "/cfg/route/r1"]
+    assert eng.state == {"/cfg/if/eth0": "up", "/cfg/route/r1": "10/8"}
+
+
+def test_resync_downstream_blind_repush_for_uninspectable_backend():
+    eng = MockEngine("/cfg/")  # base verify() -> None (no readback)
+    s = TxnScheduler()
+    s.register_applicator(eng)
+    s.commit(resync({"/cfg/a": 1}))
+    eng.state.clear()  # silent data loss the scheduler cannot see
+    result = s.resync_downstream()
+    assert result["repaired"] == []
+    assert result["replayed"] == ["/cfg/a"]
+    assert eng.state == {"/cfg/a": 1}

@@ -216,6 +216,11 @@ class Agent:
         # window exists where a compile is dropped.
         self.acl_applicator.on_compiled = lambda t: self.runner.update_tables(acl=t)
         self.nat_applicator.on_compiled = lambda t: self.runner.update_tables(nat=t)
+        # Southbound readback for the drift-detecting downstream resync:
+        # verify() fingerprints the runner's RESIDENT tables against the
+        # last compile (VERDICT r4 #2).
+        self.acl_applicator.installed_fn = lambda: self.runner.acl
+        self.nat_applicator.installed_fn = lambda: self.runner.nat
         self.runner.update_tables(
             acl=self.policy_renderer.tables, nat=self.nat_renderer.tables
         )

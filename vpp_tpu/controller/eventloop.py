@@ -324,6 +324,16 @@ class Controller:
             err = self._commit(Txn(is_resync=True), record, downstream=True)
         elif event.method.is_resync:
             err = self._process_resync(event, record)
+            if err is None and isinstance(event, HealingResync):
+                # A full HEALING resync re-derives desired state, but the
+                # scheduler's diff only re-pushes values whose desired
+                # CHANGED — out-of-band southbound damage (applied ==
+                # desired, backend diverged) would survive it.  Follow
+                # with the verify-first downstream repair, the point of
+                # healing (reference: healing rides on the kvscheduler
+                # SB refresh, plugin_controller.go:968).
+                err = self._commit(Txn(is_resync=True), record,
+                                   downstream=True)
         else:
             err = self._process_update(event, record)
 
@@ -427,13 +437,21 @@ class Controller:
         if txn.empty and not txn.is_resync:
             return None
         self._txn_seq += 1
-        record.txn = txn.record(self._txn_seq)
+        if record.txn is None:  # healing runs commit + downstream repair
+            record.txn = txn.record(self._txn_seq)
         try:
             if downstream:
-                # Ask the sink to re-apply its current desired state.
-                replay = getattr(self.sink, "replay", None)
-                if replay is not None:
-                    replay()
+                # Verify-first southbound repair when the sink supports
+                # readback (TxnScheduler.resync_downstream): detect
+                # out-of-band drift and fix only that; otherwise fall
+                # back to a blind re-apply of the desired state.
+                resync_sb = getattr(self.sink, "resync_downstream", None)
+                if resync_sb is not None:
+                    resync_sb()
+                else:
+                    replay = getattr(self.sink, "replay", None)
+                    if replay is not None:
+                        replay()
             else:
                 self.sink.commit(record.txn)
         except Exception as e:  # noqa: BLE001

@@ -328,11 +328,19 @@ class DataplaneRunner:
                 int(np.asarray(self.route.host_bits)),
             )
         self._bypass_tables = eligible
+        self._bypass_recheck = False
 
     def _bypass_ready(self) -> bool:
         # In-flight dispatched batches must harvest first (arena pins
         # release FIFO); an enabled tracer needs the dispatch path's
         # verdict recording.
+        if self._bypass_tables and getattr(self, "_bypass_recheck", False) \
+                and not self._inflight:
+            # A harvest merged dispatch results (sessions/punts may now
+            # exist) after eligibility was computed — an in-flight batch
+            # dispatched under the OLD tables can create state the
+            # table-swap-time check could not see.  Re-derive once.
+            self._refresh_bypass()
         return (self._bypass_tables and not self._inflight
                 and not self.tracer.enabled)
 
@@ -491,7 +499,12 @@ class DataplaneRunner:
             while True:
                 consumed, sent = self._bypass_once()
                 sent_total += sent
-                if not consumed:
+                # Re-check BETWEEN batches: a concurrent table swap
+                # installing real ACL/NAT state must take effect on the
+                # next batch, exactly as it would on the dispatch path —
+                # under sustained ingress this loop may otherwise never
+                # exit.
+                if not consumed or not self._bypass_ready():
                     return sent_total
         admitted = True
         while len(self._inflight) < self.max_inflight and admitted:
@@ -689,6 +702,11 @@ class DataplaneRunner:
         self.counters.dropped_denied += int(c[3]) - slow_drops
         self.counters.dropped_unparseable += int(c[4])
         self.counters.dropped_unroutable += int(c[5])
+        if self._bypass_tables:
+            # This batch was dispatched under PRE-swap tables and may
+            # have created sessions/punts the swap-time eligibility
+            # check could not see — re-derive before the next bypass.
+            self._bypass_recheck = True
         return sent
 
     # ------------------------------------------------------- python engine
@@ -807,6 +825,8 @@ class DataplaneRunner:
             self.host.send(frames)
             self.counters.tx_host += len(frames)
             sent += len(frames)
+        if self._bypass_tables:
+            self._bypass_recheck = True  # see _harvest_native
         return sent
 
     # ------------------------------------------------------ shared harvest

@@ -101,6 +101,19 @@ class Applicator:
     def end_txn(self) -> None:
         pass
 
+    # Southbound READBACK (the kvscheduler SB-refresh analog the
+    # reference's downstream/healing resyncs ride on —
+    # plugins/controller/plugin_controller.go:968).  Given this
+    # backend's currently-APPLIED key→value map, return the subset
+    # whose ACTUAL backend state is missing or materially diverged
+    # (someone deleted a veth out-of-band, a route vanished with its
+    # device, the device tables were swapped behind the scheduler's
+    # back), or None when the backend cannot be inspected — drift
+    # repair then degrades to a blind re-push of its keys, the old
+    # replay() behavior.
+    def verify(self, applied: Dict[str, Any]) -> Optional[Set[str]]:
+        return None
+
 
 @dataclass
 class _ValueRecord:
@@ -427,6 +440,114 @@ class TxnScheduler(TxnSink):
                 self._resolve_pending()
             finally:
                 self._end_txns()
+
+    def resync_downstream(self) -> Dict[str, List[str]]:
+        """Verify-first downstream resync: ask every applicator to READ
+        BACK its applied keys (:meth:`Applicator.verify`) and repair
+        only the DRIFTED ones — delete the divergent remnant (absorbed
+        if already gone; every hostnet delete tolerates absence), then
+        re-create through the ordinary dependency-gated apply.  Backends
+        that cannot be inspected fall back to the blind re-push
+        :meth:`replay` performs for all keys.  FAILED values and
+        unfinished removals recover exactly as in replay.  Returns
+        ``{"repaired": [...], "replayed": [...]}`` for the event record
+        / REST observability.
+
+        This is what the controller's DOWNSTREAM_RESYNC (healing) runs:
+        out-of-band damage is detected and fixed WITHOUT re-pushing
+        every healthy value (the reference's kvscheduler likewise
+        refreshes SB state and diffs, rather than blindly re-applying —
+        SURVEY §2.3 kvscheduler row)."""
+        with self._lock:
+            for a in self._applicators:
+                a.begin_txn()
+            repaired: List[str] = []
+            replayed: List[str] = []
+            try:
+                groups: Dict[int, Tuple[Applicator, Dict[str, Any]]] = {}
+                for key, rec in self._values.items():
+                    if rec.applied is None:
+                        continue
+                    a = self._applicator_for(key)
+                    if a is None:
+                        continue
+                    groups.setdefault(id(a), (a, {}))[1][key] = rec.applied
+                drifted_all: Set[str] = set()
+                for a, applied in groups.values():
+                    try:
+                        drifted = a.verify(dict(applied))
+                    except Exception as e:  # noqa: BLE001 - degrade, not die
+                        log.warning("verify of %s failed (%s); falling back "
+                                    "to blind re-push", type(a).__name__, e)
+                        drifted = None
+                    if drifted is None:
+                        # Uninspectable backend: blind re-push (replay
+                        # semantics) for its keys.
+                        for key in sorted(applied):
+                            rec = self._values[key]
+                            if rec.desired is None or rec.applied is None:
+                                continue
+                            try:
+                                a.update(key, rec.applied, rec.desired)
+                                rec.applied = rec.desired
+                                replayed.append(key)
+                            except Exception as e:  # noqa: BLE001
+                                if a.update_destroys_on_failure:
+                                    rec.applied = None
+                                rec.state = ValueState.FAILED
+                                rec.last_error = str(e)
+                                self._schedule_retry_for(key)
+                        continue
+                    drifted_all |= {k for k in drifted if k in applied}
+                # Re-creating a drifted value can destroy its INTACT
+                # dependents as a side effect (deleting a device drops
+                # the kernel routes through it), so the repair cascades
+                # to the applied-dependents closure — they re-create
+                # right after their dependency does.
+                changed = True
+                while changed:
+                    changed = False
+                    for key, rec in self._values.items():
+                        if key in drifted_all or rec.applied is None:
+                            continue
+                        if self._dependencies(key, rec.applied) & drifted_all:
+                            drifted_all.add(key)
+                            changed = True
+                for key in sorted(drifted_all):
+                    rec = self._values.get(key)
+                    if rec is None or rec.applied is None:
+                        continue
+                    a = self._applicator_for(key)
+                    # Clear the divergent remnant first so the re-create
+                    # starts clean even when the drift is "exists but
+                    # wrong" (every hostnet delete tolerates absence).
+                    if a is not None:
+                        try:
+                            a.delete(key, rec.applied)
+                        except Exception as e:  # noqa: BLE001
+                            log.debug("repair pre-delete of %s: %s", key, e)
+                    rec.applied = None
+                    rec.state = ValueState.PENDING
+                    rec.retries = 0
+                    repaired.append(key)
+                # FAILED values + unfinished removals recover as in replay.
+                for key, rec in list(self._values.items()):
+                    if rec.desired is None:
+                        if rec.applied is not None:
+                            self._unapply(key, rec)
+                            if rec.applied is None:
+                                self._values.pop(key, None)
+                        continue
+                    if rec.state is ValueState.FAILED:
+                        rec.retries = 0
+                        self._try_apply(key, rec)
+                self._resolve_pending()
+            finally:
+                self._end_txns()
+        if repaired:
+            log.info("downstream resync repaired %d drifted value(s): %s",
+                     len(repaired), ", ".join(repaired[:8]))
+        return {"repaired": repaired, "replayed": replayed}
 
     # ------------------------------------------------------------------ dump
 

@@ -54,11 +54,40 @@ class NatGlobalConfig:
     pod_subnet: str = "10.1.0.0/16"
 
 
+def table_fingerprint(tables: Any) -> int:
+    """Content checksum of a compiled table pytree, computed ON DEVICE
+    (one scalar transfer per leaf): uint32 wrap-sums of every array
+    leaf, folded with shapes.  Equal content → equal fingerprint on any
+    placement — retargeting (aux-only) and mesh re-sharding preserve
+    it, so the drift check compares what the data plane actually holds
+    against what the scheduler last compiled."""
+    import jax
+    import jax.numpy as jnp
+
+    fp = 0x811C9DC5
+    for leaf in jax.tree_util.tree_leaves(tables):
+        if not hasattr(leaf, "dtype"):
+            fp = (fp * 0x01000193) ^ (hash(leaf) & 0xFFFFFFFF)
+            continue
+        arr = jnp.asarray(leaf)
+        if arr.dtype == jnp.bool_:
+            arr = arr.astype(jnp.uint32)
+        elif arr.dtype.kind == "f":
+            arr = arr.view(jnp.uint32) if arr.dtype.itemsize == 4 else arr.astype(jnp.uint32)
+        else:
+            arr = arr.astype(jnp.uint32)
+        s = int(jnp.sum(arr)) & 0xFFFFFFFF
+        fp = (fp * 0x01000193) ^ s ^ (hash(arr.shape) & 0xFFFFFFFF)
+        fp &= 0xFFFFFFFFFFFFFFFF
+    return fp
+
+
 class _CompilingApplicator(Applicator):
     """Shared begin/end-txn bracket: subclasses mutate ``_state`` in
     create/update/delete and compile once per transaction."""
 
-    def __init__(self, on_compiled: Optional[Callable[[Any], None]] = None):
+    def __init__(self, on_compiled: Optional[Callable[[Any], None]] = None,
+                 installed_fn: Optional[Callable[[], Any]] = None):
         self._state: Dict[str, Any] = {}
         self._dirty = False
         self._compiled: Any = None
@@ -66,6 +95,9 @@ class _CompilingApplicator(Applicator):
         # Public hook: called with the freshly-compiled tables after each
         # transaction's atomic swap (the datapath runner attaches here).
         self.on_compiled = on_compiled
+        # Readback hook for drift detection: returns the tables the
+        # data plane is ACTUALLY running (runner.acl / runner.nat).
+        self.installed_fn = installed_fn
         self.compile_count = 0  # atomic-swap observability for tests/metrics
 
     update_destroys_on_failure = False  # swaps are atomic in-place updates
@@ -104,6 +136,27 @@ class _CompilingApplicator(Applicator):
 
     def _compile(self, state: Dict[str, Any]):
         raise NotImplementedError
+
+    def verify(self, applied: Dict[str, Any]):
+        """Device-table drift check: fingerprint the tables the data
+        plane is RUNNING (installed_fn → runner) against the last
+        compile.  The tables are one atomic artifact, so any divergence
+        drifts ALL keys — the repair recompiles and reswaps once (the
+        whole-txn bracket coalesces it).  Without a readback hook the
+        backend is uninspectable (None → blind re-push), which for a
+        compiling applicator is still just one recompile."""
+        if self.installed_fn is None:
+            return None
+        with self._lock:
+            expected = self._compiled
+        if expected is None:
+            return set(applied)
+        installed = self.installed_fn()
+        if installed is None or (
+            table_fingerprint(installed) != table_fingerprint(expected)
+        ):
+            return set(applied)
+        return set()
 
 
 class TpuAclApplicator(_CompilingApplicator):

