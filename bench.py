@@ -224,9 +224,8 @@ def main():
     # reconciliation, the production default; scan = K 256-packet
     # vectors with sessions threaded sequentially on device; flat = one
     # wide program WITHOUT same-dispatch reply safety, the raw upper
-    # bound).  The headline is the best sustained (median-of-5-rounds)
-    # configuration — which one wins varies with the shared tunnel's
-    # state, so all are reported.
+    # bound).  All are measured and reported; the HEADLINE is always
+    # the production configuration (see the pick rule below).
     configs = {
         "flatsafe-64x256": lambda: _measure_flat_safe(
             acl, nat, route, pod_ips, mappings, n_vectors=64
@@ -244,13 +243,23 @@ def main():
             acl, nat, route, pod_ips, mappings, batch_size=16384
         ),
     }
-    # Pick rule (stated, not implied): the headline is the dispatch
-    # configuration with the highest MEDIAN over 5 timed rounds in this
-    # one process; its median is the quoted value, with min/max spread
-    # reported per configuration.
+    # Pick rule (VERDICT r4 item 3): the HEADLINE is the PRODUCTION
+    # configuration — flat-safe at the runner's shipping coalesce
+    # (max_vectors=64), the config the agent actually runs (the latency
+    # budget holds K=64; see DataplaneRunner's max_vectors rationale).
+    # The best-of-all-configs number is reported separately as
+    # `capability` — what the chip can do when latency is no object
+    # (K=256), never the quoted figure.
     results = {name: fn() for name, fn in configs.items()}
-    best_name = max(results, key=lambda n: results[n][0])
-    median, peak, low = results[best_name]
+    production = "flatsafe-64x256"
+    median, peak, low = results[production]
+    # Capability is picked among the NON-production configurations only
+    # (the deep-coalesce/raw shapes): tunnel variance can make the
+    # production config's median the highest of a run, and `capability`
+    # must never silently alias the headline.
+    best_name = max((n for n in results if n != production),
+                    key=lambda n: results[n][0])
+    cap_median, cap_peak, cap_low = results[best_name]
 
     # Latency budget (VERDICT r2 item 2): p50 us of a single dispatch +
     # completion on the production discipline (flatsafe-64x256).
@@ -277,15 +286,26 @@ def main():
     print(
         json.dumps(
             {
-                "metric": "ACL+NAT44 full-pipeline median throughput, 10k rules + 1k services, "
-                          f"best dispatch ({best_name})",
+                "metric": "ACL+NAT44 full-pipeline median throughput, "
+                          "10k rules + 1k services, PRODUCTION dispatch "
+                          "(flat-safe, 64x256 coalesce)",
                 "value": round(median, 1),
                 "unit": "Mpps",
                 "vs_baseline": round(median / 40.0, 2),
                 "peak_mpps": round(peak, 1),
                 "min_mpps": round(low, 1),
                 "rounds": 5,
-                "pick_rule": "highest median over 5 timed rounds, one process",
+                "pick_rule": "the headline is the SHIPPING configuration "
+                             "(flat-safe, max_vectors=64), median over 5 "
+                             "timed rounds, one process; `capability` is "
+                             "the best configuration's median, reported "
+                             "separately and never quoted as the headline",
+                "capability": {
+                    "config": best_name,
+                    "median": round(cap_median, 1),
+                    "min": round(cap_low, 1),
+                    "max": round(cap_peak, 1),
+                },
                 "per_dispatch_mpps": {
                     name: {"median": round(m, 1), "min": round(lo, 1),
                            "max": round(pk, 1)}
