@@ -27,6 +27,7 @@ from vpp_tpu.controller import (
 )
 from vpp_tpu.kvstore import KVStore
 from vpp_tpu.models import Pod, key_for
+from vpp_tpu.testing.cluster import timeout_mult
 
 
 class MockSink(TxnSink):
@@ -171,7 +172,7 @@ def test_healing_resync_after_error():
     try:
         ctl.push_event(DBResync())
         ctl.push_event(KubeStateChange("pod", "/k", None, "v"))
-        deadline = time.time() + 3
+        deadline = time.time() + 3 * timeout_mult()
         while time.time() < deadline:
             names = [r.name for r in ctl.event_history]
             if HealingResync.name in names:
@@ -290,7 +291,7 @@ def test_dbwatcher_end_to_end():
     watcher = DBWatcher(ctl, store)
     try:
         watcher.start()
-        deadline = time.time() + 2
+        deadline = time.time() + 2 * timeout_mult()
         while time.time() < deadline and not seen:
             time.sleep(0.02)
         assert seen and seen[0][0] == "resync"
@@ -298,7 +299,7 @@ def test_dbwatcher_end_to_end():
 
         pod2 = Pod(name="db", namespace="default")
         store.put(key_for(pod2), pod2)
-        deadline = time.time() + 2
+        deadline = time.time() + 2 * timeout_mult()
         while time.time() < deadline and len(seen) < 2:
             time.sleep(0.02)
         assert seen[1][0] == "update" and seen[1][1] == key_for(pod2)
@@ -334,7 +335,7 @@ def test_periodic_healing_resyncs(monkeypatch):
     )
     try:
         ctl.push_event(DBResync())
-        deadline = time.time() + 3.0
+        deadline = time.time() + 3.0 * timeout_mult()
         while time.time() < deadline and sink.replayed < 2:
             time.sleep(0.02)
         # Periodic healing = downstream resync: southbound state replayed
@@ -358,7 +359,7 @@ def test_startup_resync_deadline_escalates():
     )
     ctl.start()
     try:
-        deadline = time.time() + 3.0
+        deadline = time.time() + 3.0 * timeout_mult()
         while time.time() < deadline and not fatal:
             time.sleep(0.02)
         assert fatal and "startup resync" in str(fatal[0])

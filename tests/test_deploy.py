@@ -22,7 +22,7 @@ import urllib.request
 import pytest
 
 from vpp_tpu.kvstore.remote import RemoteKVStore
-from vpp_tpu.testing.cluster import wait_for
+from vpp_tpu.testing.cluster import wait_for, timeout_mult
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 DEV_CONF = REPO / "deploy" / "dev" / "vpp-tpu.conf"
@@ -36,7 +36,7 @@ def _wait_line(proc, timeout=30.0):
     bytes parked in Python's buffer leave the fd not-ready.)"""
     import select
 
-    deadline = time.time() + timeout
+    deadline = time.time() + timeout * timeout_mult()
     buf = b""
     while time.time() < deadline:
         ready, _, _ = select.select([proc.stdout], [], [], 0.2)

@@ -18,6 +18,7 @@ from vpp_tpu.extconfig import (
 )
 from vpp_tpu.extconfig.plugin import ext_config_delete
 from vpp_tpu.kvstore import KVStore
+from vpp_tpu.testing.cluster import timeout_mult
 
 
 @pytest.fixture()
@@ -66,7 +67,7 @@ def test_changes_reach_controller_as_external_config(plugin):
     watcher.start()
     try:
         ext_config_put(target, "nat/pool", {"ip": "192.168.16.200"})
-        deadline = time.time() + 2
+        deadline = time.time() + 2 * timeout_mult()
         while time.time() < deadline and not ctl.external_config:
             time.sleep(0.02)
         assert EXTERNAL_CONFIG_PREFIX + "nat/pool" in ctl.external_config

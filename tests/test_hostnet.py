@@ -17,6 +17,7 @@ from vpp_tpu.nodesync import NodeSync
 from vpp_tpu.podmanager import PodManager
 from vpp_tpu.scheduler import TxnScheduler
 from vpp_tpu.controller.txn import RecordedTxn
+from vpp_tpu.testing.cluster import timeout_mult
 
 
 def _netns_available() -> bool:
@@ -109,7 +110,7 @@ def test_full_agent_drives_real_kernel(hostnet):
     pod_ns = f"vt-pod-{uuid.uuid4().hex[:6]}"
     try:
         import time
-        deadline = time.time() + 5
+        deadline = time.time() + 5 * timeout_mult()
         while time.time() < deadline and not (
             hostnet.link_exists("tap-vpp2") and hostnet.link_exists("vxlanBVI")
         ):
@@ -249,7 +250,7 @@ def test_healing_resync_heals_southbound_drift_e2e(hostnet):
     watcher.start()
     pod_ns = f"vt-pod-{uuid.uuid4().hex[:6]}"
     try:
-        deadline = time.time() + 5
+        deadline = time.time() + 5 * timeout_mult()
         while time.time() < deadline and not hostnet.link_exists("tap-vpp2"):
             time.sleep(0.05)
         reply = podmanager.add_pod("web", "default", network_namespace=pod_ns)
@@ -259,7 +260,7 @@ def test_healing_resync_heals_southbound_drift_e2e(hostnet):
         hostnet._ip(["link", "del", "tap-default-web"])  # out-of-band damage
         assert not hostnet.link_exists("tap-default-web")
         ctl.push_event(HealingResync(HealingResyncType.PERIODIC))
-        deadline = time.time() + 10
+        deadline = time.time() + 10 * timeout_mult()
         while time.time() < deadline and not hostnet.link_exists("tap-default-web"):
             time.sleep(0.05)
         assert hostnet.link_exists("tap-default-web")
@@ -301,7 +302,7 @@ def test_procnode_with_hostnet_programs_kernel(tmp_path):
     )
     app = LinuxNetApplicator(netns=ns)  # query-only handle
     try:
-        deadline = time.time() + 90
+        deadline = time.time() + 90 * timeout_mult()
         while time.time() < deadline and not app.link_exists("tap-vpp2"):
             time.sleep(0.2)
         assert app.link_exists("tap-vpp2"), "agent never programmed the kernel"
@@ -317,7 +318,7 @@ def test_procnode_with_hostnet_programs_kernel(tmp_path):
         server2 = KVStoreServer(store, port=port)
         server2.start()
         try:
-            deadline = time.time() + 30
+            deadline = time.time() + 30 * timeout_mult()
             while time.time() < deadline and not app.link_exists("tap-default-w1"):
                 time.sleep(0.2)
             assert app.link_exists("tap-default-w1")
@@ -329,7 +330,7 @@ def test_procnode_with_hostnet_programs_kernel(tmp_path):
                 except Exception:
                     return False
 
-            deadline = time.time() + 10
+            deadline = time.time() + 10 * timeout_mult()
             while time.time() < deadline and not pod_route():
                 time.sleep(0.2)
             assert pod_route()

@@ -14,6 +14,7 @@ from vpp_tpu.nodesync import NodeSync
 from vpp_tpu.podmanager import PodManager
 from vpp_tpu.scheduler import TxnScheduler
 from vpp_tpu.testing.hostfib import MockHostFIB
+from vpp_tpu.testing.cluster import timeout_mult
 
 
 def boot(store, node_name, config=None):
@@ -37,7 +38,7 @@ def boot(store, node_name, config=None):
 
 
 def wait_for(cond, timeout=3.0):
-    deadline = time.time() + timeout
+    deadline = time.time() + timeout * timeout_mult()
     while time.time() < deadline:
         if cond():
             return True

@@ -21,6 +21,7 @@ from vpp_tpu.models import (
 )
 from vpp_tpu.models.registry import key_for, resource
 from vpp_tpu.testing.k8s import FakeK8sCluster
+from vpp_tpu.testing.cluster import timeout_mult
 
 
 def k8s_pod(name, namespace="default", labels=None, ip="", host_ip="", containers=None):
@@ -142,7 +143,7 @@ class TestResync:
         assert store.get(key1) is None and store.get(key2) is None
         # Store recovers; the backoff loop reconciles both pods.
         broker.down = False
-        deadline = time.time() + 2.0
+        deadline = time.time() + 2.0 * timeout_mult()
         while not r.has_synced and time.time() < deadline:
             time.sleep(0.01)
         assert r.has_synced
@@ -274,7 +275,7 @@ class TestPlugin:
         # Recovery: up event reconciles everything.
         broker.down = False
         assert plugin.check_data_store() is True
-        deadline = time.time() + 2.0
+        deadline = time.time() + 2.0 * timeout_mult()
         while not plugin.has_synced() and time.time() < deadline:
             time.sleep(0.01)
         assert plugin.has_synced()

@@ -19,6 +19,7 @@ from vpp_tpu.ops.packets import make_batch
 from vpp_tpu.policy import PolicyPlugin
 from vpp_tpu.policy.renderer.tpu import TpuPolicyRenderer
 from vpp_tpu.testing.k8s import FakeK8sCluster
+from vpp_tpu.testing.cluster import timeout_mult
 
 
 class RecordingSink(TxnSink):
@@ -30,7 +31,7 @@ class RecordingSink(TxnSink):
 
 
 def _wait(predicate, timeout=3.0):
-    deadline = time.time() + timeout
+    deadline = time.time() + timeout * timeout_mult()
     while time.time() < deadline:
         if predicate():
             return True

@@ -11,6 +11,7 @@ import pytest
 from vpp_tpu.bgpreflector import BGPReflector, BGPRouteUpdate, RouteEventType
 from vpp_tpu.conf import NetworkConfig
 from vpp_tpu.hostnet.monitor import DhcpAddressSource, IpRouteSource
+from vpp_tpu.testing.cluster import timeout_mult
 
 
 def _netns_available() -> bool:
@@ -47,7 +48,7 @@ def netns():
 
 
 def _wait(predicate, timeout=5.0):
-    deadline = time.time() + timeout
+    deadline = time.time() + timeout * timeout_mult()
     while time.time() < deadline:
         if predicate():
             return True

@@ -132,3 +132,67 @@ class TestNetctl:
     def test_unreachable_server(self):
         rc = netctl_main(["nodes", "--server", "127.0.0.1:1"], out=io.StringIO())
         assert rc == 1
+
+
+def test_inspect_live_datapath_shows_session_after_flow():
+    """VERDICT r4 item 6 done criterion: `netctl inspect` interrogates
+    a RUNNING datapath — and a session appears in the view after a
+    service flow passes."""
+    import io as _io
+
+    from vpp_tpu.datapath import DataplaneRunner, NativeRing, VxlanOverlay
+    from vpp_tpu.ops.classify import build_rule_tables
+    from vpp_tpu.ops.nat import NatMapping, build_nat_tables
+    from vpp_tpu.ops.packets import ip_to_u32
+    from vpp_tpu.ops.pipeline import RouteConfig
+    from vpp_tpu.testing.frames import build_frame
+
+    import jax.numpy as jnp
+
+    svc = NatMapping("10.96.0.10", 80, 6, backends=[("10.1.1.3", 8080, 1)])
+    nat = build_nat_tables([svc], snat_enabled=False,
+                           pod_subnet="10.1.0.0/16")
+    route = RouteConfig(
+        pod_subnet_base=jnp.asarray(ip_to_u32("10.1.0.0"), dtype=jnp.uint32),
+        pod_subnet_mask=jnp.asarray(0xFFFF0000, dtype=jnp.uint32),
+        this_node_base=jnp.asarray(ip_to_u32("10.1.1.0"), dtype=jnp.uint32),
+        this_node_mask=jnp.asarray(0xFFFFFF00, dtype=jnp.uint32),
+        host_bits=jnp.asarray(8, dtype=jnp.int32),
+    )
+    rx, tx, local, host = (NativeRing() for _ in range(4))
+    runner = DataplaneRunner(
+        acl=build_rule_tables([], {}), nat=nat, route=route,
+        overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"),
+                             local_node_id=1),
+        source=rx, tx=tx, local=local, host=host,
+        batch_size=8, max_vectors=2,
+    )
+    rest = AgentRestServer(node_name="node-1", datapath=runner)
+    port = rest.start()
+    server = f"127.0.0.1:{port}"
+    try:
+        before = _get(server, "/contiv/v1/inspect")
+        assert before["sessions"]["active"] == 0
+        assert before["nat"]["mappings"] == 1
+        assert before["dispatch"]["discipline"] == "flat-safe"
+
+        rx.send([build_frame("10.1.1.2", "10.96.0.10", 6, 40000, 80)])
+        runner.drain()
+
+        after = _get(server, "/contiv/v1/inspect")
+        assert after["sessions"]["active"] == 1      # the flow's session
+        assert after["counters"]["datapath_tx_local_total"] == 1
+        assert after["rings"]["tx_local"]["frames"] == 1
+
+        # The netctl command renders the same view (plus --raw JSON).
+        out = _io.StringIO()
+        assert netctl_main(["inspect", "--server", server], out=out) == 0
+        text = out.getvalue()
+        assert "sessions: 1/" in text
+        assert "1 mappings" in text
+        out = _io.StringIO()
+        assert netctl_main(
+            ["inspect", "--server", server, "--raw"], out=out) == 0
+        assert json.loads(out.getvalue())["sessions"]["active"] == 1
+    finally:
+        rest.stop()

@@ -270,6 +270,132 @@ def test_dashboard_ships_config_views():
         assert ref in ids, f"script references missing element #{ref}"
     for ref in re.findall(r'fill\("([^"]+)"', script):
         assert ref in ids, f"fill() targets missing table #{ref}"
-    # The new views read the scheduler dump's config prefixes.
-    for prefix in ("bd/", "l2fib/", "arp/", "route/", "interface/"):
-        assert prefix in script
+    # The config/trace panels render SHAPED models from the backend
+    # (/api/views — the r5 factoring that made the pipelines testable);
+    # the page must fetch that route, never re-shape the dump itself.
+    assert "/api/views/" in script
+    assert "dumpByPrefix" not in script
+    # Click-a-pod trace drill-down is wired.
+    assert "setTraceFilter" in script and "trace_ip" in script
+
+
+# ------------------------------------------------ view models (r5 item 7)
+
+
+def _mini_dump():
+    """A scheduler-dump-shaped payload (what /scheduler/dump serves)."""
+    p = "/vpp-tpu/config/"
+    def kv(key, applied, state="APPLIED"):
+        return {"key": p + key, "state": state, "applied": applied}
+    return [
+        kv("interface/vxlanBVI",
+           {"type": "LOOPBACK", "ip_addresses": ["192.168.30.1/24"]}),
+        kv("interface/vxlan2",
+           {"type": "VXLAN", "vxlan_dst": "192.168.16.2", "vxlan_vni": 10}),
+        kv("interface/tap-vpp2",
+           {"type": "TAP", "ip_addresses": ["172.30.1.1/24"]}),
+        kv("interface/tap-default-web",
+           {"type": "TAP", "ip_addresses": ["10.1.1.2/32"]}),
+        kv("bd/vxlanBD",
+           {"bvi_interface": "vxlanBVI", "interfaces": ["vxlan2"]}),
+        kv("l2fib/vxlanBD/12:fe:c0:a8:1e:02",
+           {"outgoing_interface": "vxlan2"}),
+        kv("arp/vxlanBVI/192.168.30.2",
+           {"physical_address": "12:fe:c0:a8:1e:02"}),
+        kv("route/vrf1/10.1.1.2/32", {"dst_network": "10.1.1.2/32"}),
+        # A PENDING value must be EXCLUDED from every view.
+        kv("interface/tap-default-ghost", {"type": "TAP"}, state="PENDING"),
+    ]
+
+
+def test_view_models_shape_config_views():
+    """The dashboard's data pipelines (bridge-domain, L2FIB,
+    pod-network, vswitch-diagram) are pure Python now — a broken
+    pipeline fails HERE, not silently in a browser."""
+    from vpp_tpu.uibackend.views import shape_config_views
+
+    pod_ips = {"default/web": "10.1.1.2", "default/broken": "10.1.1.3"}
+    v = shape_config_views(_mini_dump(), pod_ips)
+
+    assert v["bds"] == [{"name": "vxlanBD", "bvi": "vxlanBVI",
+                         "members": ["vxlan2"]}]
+    assert v["l2fib"] == [{"mac": "12:fe:c0:a8:1e:02", "bd": "vxlanBD",
+                           "interface": "vxlan2"}]
+    rows = {r["pod"]: r for r in v["podnet"]}
+    assert rows["default/web"]["tap_ok"] and rows["default/web"]["route_ok"]
+    # The broken pod has no tap/route/arp -> flagged, not hidden.
+    assert not rows["default/broken"]["tap_ok"]
+    assert not rows["default/broken"]["route_ok"]
+    vs = v["vswitch"]
+    assert vs["bd"] == "vxlanBD" and vs["bvi"] == "vxlanBVI"
+    assert [t["name"] for t in vs["tunnels"]] == ["vxlan2"]
+    assert [t["name"] for t in vs["taps"]] == ["tap-default-web"]
+    assert [h["name"] for h in vs["host"]] == ["tap-vpp2"]
+    # PENDING values never reach a view.
+    assert "tap-default-ghost" not in [t["name"] for t in vs["taps"]]
+
+
+def test_view_models_trace_filter_drilldown():
+    """Click-a-pod → filtered trace: the filter matches the pod IP in
+    original OR rewritten src/dst, newest first."""
+    from vpp_tpu.uibackend.views import shape_trace
+
+    entries = [
+        {"seq": 1, "src": "10.1.1.2", "src_port": 1, "dst": "10.96.0.10",
+         "dst_port": 80, "rw_dst": "10.1.1.3", "rw_dst_port": 8080,
+         "allowed": True, "route": "local", "dnat": True},
+        {"seq": 2, "src": "10.1.9.9", "src_port": 2, "dst": "10.1.2.4",
+         "dst_port": 80, "rw_dst": "10.1.2.4", "rw_dst_port": 80,
+         "allowed": True, "route": "remote", "node_id": 2},
+    ]
+    all_rows = shape_trace(entries)
+    assert [r["seq"] for r in all_rows] == [2, 1]
+    assert all_rows[0]["route"] == "remote#2"
+    # Filter to the DNAT backend: matches via the REWRITTEN dst.
+    rows = shape_trace(entries, filter_ip="10.1.1.3")
+    assert [r["seq"] for r in rows] == [1]
+    assert rows[0]["flags"] == "dnat"
+    assert shape_trace(entries, filter_ip="10.9.9.9") == []
+
+
+def test_views_route_serves_shaped_models_live():
+    """/api/views/<node> end-to-end: proxy -> live agent REST ->
+    shaped view models, including the ?trace_ip drill-down filter."""
+    from vpp_tpu.rest import AgentRestServer
+    from vpp_tpu.testing.cluster import SimCluster
+
+    cluster = SimCluster()
+    rest = None
+    b = None
+    try:
+        n1 = cluster.add_node("node-1")
+        cluster.deploy_pod("node-1", "web")
+        rest = AgentRestServer(
+            node_name="node-1", controller=n1.controller,
+            dbwatcher=n1.watcher, ipam=n1.ipam, nodesync=n1.nodesync,
+            podmanager=n1.podmanager, scheduler=n1.scheduler,
+        )
+        directory = {"node-1": f"127.0.0.1:{rest.start()}"}
+        b = UIBackend(node_directory=directory.get,
+                      list_nodes=lambda: list(directory))
+        b.start()
+        status, body = get(b, "/api/views/node-1")
+        assert status == 200
+        v = json.loads(body)
+        assert {"bds", "l2fib", "podnet", "vswitch", "trace",
+                "config_kvs"} <= set(v)
+        assert v["config_kvs"] > 0
+        pods = {r["pod"]: r for r in v["podnet"]}
+        assert "default/web" in pods
+        assert pods["default/web"]["tap_ok"]
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            get(b, "/api/views/ghost")
+        assert exc.value.code == 404
+        status, body = get(b, "/api/views/node-1?trace_ip=10.1.1.2")
+        assert json.loads(body)["trace"]["filter_ip"] == "10.1.1.2"
+    finally:
+        if b is not None:
+            b.stop()
+        if rest is not None:
+            rest.stop()
+        cluster.stop()

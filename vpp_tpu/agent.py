@@ -172,6 +172,7 @@ class Agent:
             podmanager=self.podmanager,
             scheduler=self.scheduler,
             tracer=self.runner.tracer if self.runner else None,
+            datapath=lambda: self.runner,
             host="0.0.0.0" if rest_port else "127.0.0.1",
             port=rest_port,
         )

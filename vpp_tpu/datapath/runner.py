@@ -908,3 +908,89 @@ class DataplaneRunner:
         out["datapath_slowpath_sessions_active"] = len(self.slow)
         out["datapath_inflight"] = len(self._inflight)
         return out
+
+    def inspect(self) -> Dict[str, object]:
+        """Live-datapath introspection for `netctl inspect` (the vppcli
+        analog, reference plugins/netctl/cmd/root.go:55-134): classify
+        tables, NAT tables, session/affinity occupancy, ring depths,
+        dispatch configuration, punt/slow-path state — everything an
+        operator would interrogate on a running VPP with `show acl`,
+        `show nat44 sessions`, `show buffers`.
+
+        Note: occupancy reads are device→host transfers; on a
+        tunnel-attached TPU the first one switches the link into its
+        slower transfer mode.  That is inherent to any live occupancy
+        query (metrics() pays it too) — this is an operator endpoint,
+        not a hot path."""
+        acl = self.acl
+        nat = self.nat
+        return {
+            "engine": self.engine,
+            "dispatch": self.inspect_dispatch(),
+            "classify": {
+                "rules": getattr(acl, "num_rules", 0) if acl is not None else 0,
+                "tables": getattr(acl, "num_tables", 0) if acl is not None else 0,
+                "pods": getattr(acl, "num_pods", 0) if acl is not None else 0,
+            },
+            "nat": {
+                "mappings": nat.num_mappings if nat is not None else 0,
+                "bucket_size": nat.bucket_size if nat is not None else 0,
+                "use_hmap": bool(nat.use_hmap) if nat is not None else False,
+                "has_affinity": bool(nat.has_affinity) if nat is not None else False,
+                "snat_enabled": bool(np.asarray(nat.snat_enabled))
+                if nat is not None else False,
+            },
+            "sessions": {
+                "capacity": self.sessions.capacity,
+                "active": session_occupancy(self.sessions),
+                "affinity_pins": affinity_occupancy(self.sessions),
+                "sweep_interval": self.sweep_interval,
+                "sweep_max_age": self.sweep_max_age,
+            },
+            "slowpath": {
+                "sessions": len(self.slow),
+                **self.slow.counters.as_dict(),
+            },
+            "rings": self.inspect_rings(),
+            "counters": self.counters.as_dict(),
+            "trace": self.tracer.status(),
+        }
+
+    # Host-only inspect slices (NO device reads) — the sharded engine
+    # collects these per shard while paying the occupancy transfers
+    # exactly once, on the shard whose full inspect() it keeps.
+
+    def inspect_dispatch(self) -> Dict[str, object]:
+        return {
+            "discipline": self.dispatch,
+            "batch_size": self.batch_size,
+            "max_vectors": self.max_vectors,
+            "max_inflight": self.max_inflight,
+            "inflight": len(self._inflight),
+            "bypass_eligible": bool(self._bypass_tables),
+            "bypass_batches": self.counters.bypass_batches,
+            "device_batches": self.counters.batches,
+            "ts": self._ts,
+            "mesh": str(self.mesh.shape) if self.mesh is not None else "",
+        }
+
+    def inspect_rings(self) -> Dict[str, Dict[str, int]]:
+        def ring_info(ring) -> Dict[str, int]:
+            if ring is None:
+                return {}
+            info: Dict[str, int] = {}
+            try:
+                info["frames"] = len(ring)
+            except TypeError:
+                pass
+            dropped = getattr(ring, "dropped", None)
+            if dropped is not None:
+                info["dropped"] = int(dropped)
+            return info
+
+        return {
+            "rx": ring_info(self.source),
+            "tx_remote": ring_info(self.tx),
+            "tx_local": ring_info(self.local),
+            "tx_host": ring_info(self.host),
+        }

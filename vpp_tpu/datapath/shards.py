@@ -131,5 +131,19 @@ class ShardedDataplane:
         agg["datapath_shards"] = len(self.shards)
         return agg
 
+    def inspect(self) -> Dict[str, object]:
+        """Live introspection (netctl inspect): shard 0's FULL view
+        carries the shared state (device tables, sessions, slow path —
+        the occupancy device reads are paid exactly once); every shard
+        contributes only its host-side dispatch/ring/counter slices."""
+        base = self.shards[0].inspect()
+        base["shards"] = [
+            {"dispatch": r.inspect_dispatch(), "rings": r.inspect_rings(),
+             "counters": r.counters.as_dict()}
+            for r in self.shards
+        ]
+        base["counters"] = self.metrics()
+        return base
+
     def close(self) -> None:
         self._pool.shutdown(wait=True)

@@ -11,6 +11,10 @@ Analog of ``plugins/netctl`` + ``cmd/contiv-netctl`` (cmd/root.go
 - ``history``    controller event history
 - ``resync``     trigger an on-demand full resync
 - ``metrics``    Prometheus metrics passthrough
+- ``inspect``    live datapath interrogation (the ``vppcli`` analog):
+                 classify/NAT table stats, session + affinity
+                 occupancy, ring depths, punt counters; ``--watch N``
+                 streams
 
 Run: ``python -m vpp_tpu.netctl <command> [--server host:port]``.
 """
@@ -136,6 +140,58 @@ def cmd_trace(server: str, out, action: str = "", sample: int = 1) -> int:
     return 0
 
 
+def cmd_inspect(server: str, out, watch: float = 0.0, raw: bool = False) -> int:
+    """Live datapath interrogation (the ``vppcli`` analog, reference
+    plugins/netctl/cmd/root.go:55-134): classify/NAT table stats,
+    session + affinity occupancy, ring depths, punt counters and the
+    dispatch configuration of a RUNNING agent.  ``--watch N`` streams
+    a fresh snapshot every N seconds (Ctrl-C stops)."""
+    import time
+
+    def render() -> None:
+        d = _fetch(server, "/contiv/v1/inspect")
+        if raw:
+            print(json.dumps(d, indent=2), file=out)
+            return
+        dp, cl, nt = d["dispatch"], d["classify"], d["nat"]
+        se, sp, c = d["sessions"], d["slowpath"], d["counters"]
+        print(f"node {d.get('node', '?')}  engine={d['engine']}  "
+              f"dispatch={dp['discipline']} {dp['max_vectors']}x"
+              f"{dp['batch_size']}  inflight={dp['inflight']}/"
+              f"{dp['max_inflight']}  bypass="
+              f"{'on' if dp['bypass_eligible'] else 'off'}"
+              f"{'  mesh=' + dp['mesh'] if dp['mesh'] else ''}", file=out)
+        print(f"classify: {cl['rules']} rules / {cl['tables']} tables / "
+              f"{cl['pods']} pods    nat: {nt['mappings']} mappings "
+              f"ring={nt['bucket_size']} "
+              f"lookup={'hash' if nt['use_hmap'] else 'dense'}"
+              f"{' affinity' if nt['has_affinity'] else ''}"
+              f"{' snat' if nt['snat_enabled'] else ''}", file=out)
+        print(f"sessions: {se['active']}/{se['capacity']} active, "
+              f"{se['affinity_pins']} affinity pins   slowpath: "
+              f"{sp['sessions']} sessions", file=out)
+        rows = [[name, info.get("frames", "-"), info.get("dropped", "-")]
+                for name, info in d["rings"].items() if info]
+        if rows:
+            print(_table(rows, ["RING", "FRAMES", "DROPPED"]), file=out)
+        keys = ("datapath_rx_frames_total", "datapath_tx_local_total",
+                "datapath_tx_remote_total", "datapath_tx_host_total",
+                "datapath_dropped_denied_total", "datapath_punts_total",
+                "datapath_batches_total", "datapath_bypass_batches_total")
+        print("  ".join(f"{k.replace('datapath_', '').replace('_total', '')}"
+                        f"={c[k]}" for k in keys if k in c), file=out)
+
+    render()
+    try:
+        while watch > 0:
+            time.sleep(watch)
+            print("", file=out)
+            render()
+    except KeyboardInterrupt:
+        pass  # Ctrl-C stops the stream cleanly, as documented
+    return 0
+
+
 def main(argv: Optional[List[str]] = None, out=None) -> int:
     out = out if out is not None else sys.stdout
     common = argparse.ArgumentParser(add_help=False)
@@ -154,6 +210,11 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
                        choices=["", "enable", "disable", "clear"])
     trace.add_argument("--sample", type=int, default=1,
                        help="record every Nth packet")
+    inspect = sub.add_parser("inspect", parents=[common])
+    inspect.add_argument("--watch", type=float, default=0.0,
+                         help="stream a snapshot every N seconds")
+    inspect.add_argument("--raw", action="store_true",
+                         help="full JSON instead of the summary view")
     args = parser.parse_args(argv)
 
     try:
@@ -161,6 +222,8 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
             return cmd_dump(args.server, out, args.prefix)
         if args.command == "trace":
             return cmd_trace(args.server, out, args.action, args.sample)
+        if args.command == "inspect":
+            return cmd_inspect(args.server, out, args.watch, args.raw)
         return {
             "nodes": cmd_nodes,
             "pods": cmd_pods,

@@ -62,6 +62,7 @@ class AgentRestServer:
         scheduler=None,
         stats_registry=None,
         tracer=None,
+        datapath=None,
         host: str = "127.0.0.1",
         port: int = 0,
     ):
@@ -74,6 +75,10 @@ class AgentRestServer:
         self.scheduler = scheduler
         self.stats_registry = stats_registry
         self.tracer = tracer
+        # The live datapath (DataplaneRunner / ShardedDataplane), or a
+        # zero-arg callable resolving to it (the agent's runner attaches
+        # after REST construction when an uplink comes up).
+        self.datapath = datapath
         self.host = host
         self.port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -151,6 +156,15 @@ class AgentRestServer:
             raise FileNotFoundError(f"trace action {action!r}")
         return {"trace": action, **self.tracer.status()}
 
+    def get_inspect(self) -> dict:
+        """Live datapath introspection (`netctl inspect`, the vppcli
+        analog): classify/NAT table stats, session + affinity
+        occupancy, ring depths, punt counters, dispatch config."""
+        dp = self.datapath() if callable(self.datapath) else self.datapath
+        if dp is None:
+            raise LookupError("no datapath")
+        return {"node": self.node_name, **dp.inspect()}
+
     def get_metrics(self) -> str:
         from prometheus_client import generate_latest
 
@@ -184,6 +198,7 @@ class AgentRestServer:
             ("GET", "/contiv/v1/ipam"): self.get_ipam,
             ("GET", "/contiv/v1/nodes"): self.get_nodes,
             ("GET", "/contiv/v1/pods"): self.get_pods,
+            ("GET", "/contiv/v1/inspect"): self.get_inspect,
         }
         if (method, path) in routes:
             return routes[(method, path)]()

@@ -177,6 +177,52 @@ class UIBackend:
             names = sorted(self.list_nodes()) if self.list_nodes else []
             return 200, "application/json", json.dumps(names).encode()
 
+        if path.startswith("/api/views/"):
+            # Shaped dashboard view models (vpp_tpu/uibackend/views.py):
+            # the data pipelines behind the config/trace panels run HERE
+            # (testable Python), not in the page's JS.  ?trace_ip=<ip>
+            # filters the trace panel to one pod (click-a-pod
+            # drill-down).
+            node = path[len("/api/views/"):]
+            server = self.node_directory(node)
+            if server is None:
+                return 404, "text/plain", f"unknown node {node!r}".encode()
+            from urllib.parse import parse_qs
+
+            from .views import shape_views
+
+            trace_ip = (parse_qs(query).get("trace_ip") or [""])[0]
+            errors: dict = {}
+
+            def agent_json(label: str, agent_path: str):
+                status, _, payload = self._proxy(
+                    f"http://{server}/{agent_path}", "GET", None)
+                if status != 200:
+                    errors[label] = (
+                        f"HTTP {status}: "
+                        f"{payload.decode(errors='replace')[:200]}")
+                    return None
+                try:
+                    return json.loads(payload.decode())
+                except json.JSONDecodeError as exc:
+                    errors[label] = f"bad JSON: {exc}"
+                    return None
+
+            dump = agent_json("dump", "scheduler/dump")
+            ipam = agent_json("ipam", "contiv/v1/ipam")
+            trace = agent_json("trace", "contiv/v1/trace")
+            if len(errors) == 3:
+                # The agent is unreachable outright: surface it as an
+                # error, never as a healthy-looking empty dashboard.
+                return (502, "text/plain",
+                        f"agent {node!r}: {errors['dump']}".encode())
+            shaped = shape_views(dump or [], ipam or {}, trace or {},
+                                 trace_ip=trace_ip or None)
+            # Partial failures reach the page per panel (the JS renders
+            # them into the affected tables instead of empty rows).
+            shaped["errors"] = errors
+            return 200, "application/json", json.dumps(shaped).encode()
+
         if path == "/api/netctl":
             if method != "POST":
                 return 405, "text/plain", b"POST {\"args\": [...]}"
