@@ -49,16 +49,40 @@ def main(argv=None) -> int:
 
     import bench
     from vpp_tpu.ops.nat import empty_sessions
-    from vpp_tpu.ops.pipeline import pipeline_step_jit
-    from vpp_tpu.parallel import make_mesh, shard_dataplane, sharded_pipeline_step
+    from vpp_tpu.ops.pipeline import (
+        VECTOR_SIZE,
+        pipeline_flat_safe_ts0_jit,
+        pipeline_scan_ts0_jit,
+        pipeline_step_jit,
+    )
+    from vpp_tpu.parallel import make_mesh, shard_dataplane
     from vpp_tpu.parallel.mesh import shard_batch
 
     acl, nat, route, _, pod_ips, mappings = bench.build_stress_state(
         n_rules=10000, n_services=1000
     )
-    batch = bench.build_traffic(pod_ips, mappings, args.batch)
+    if args.batch % VECTOR_SIZE or args.batch < VECTOR_SIZE:
+        parser.error(f"--batch must be a positive multiple of "
+                     f"{VECTOR_SIZE} (the vector disciplines dispatch "
+                     f"[K, {VECTOR_SIZE}] shapes)")
+    flat_batch = bench.build_traffic(pod_ips, mappings, args.batch)
+    k = args.batch // VECTOR_SIZE
+    vec_batch = jax.tree_util.tree_map(
+        lambda a: a.reshape(k, VECTOR_SIZE), flat_batch
+    )
 
-    def measure(step, a, n, r, sessions, put_batch):
+    # The r4/r5 dispatch surface: the flat step (raw upper bound), the
+    # PRODUCTION flat-safe ts0 discipline (commit-first) and the
+    # sequential vector scan — each measured single-device and sharded
+    # per session placement, so the overhead story covers the shapes
+    # the runner actually dispatches.
+    disciplines = {
+        "flat": (pipeline_step_jit, flat_batch),
+        "flat-safe-ts0": (pipeline_flat_safe_ts0_jit, vec_batch),
+        "scan-ts0": (pipeline_scan_ts0_jit, vec_batch),
+    }
+
+    def measure(step, batch, a, n, r, sessions, put_batch):
         b = put_batch(batch)
         res = step(a, n, r, sessions, b, jnp.int32(0))
         res.allowed.block_until_ready()
@@ -66,7 +90,7 @@ def main(argv=None) -> int:
         lats = []
         for i in range(args.iters):
             t0 = time.perf_counter()
-            res = step(a, n, r, sess, b, jnp.int32(i + 1))
+            res = step(a, n, r, sess, b, jnp.int32((i + 1) * max(1, k)))
             res.allowed.block_until_ready()
             lats.append(time.perf_counter() - t0)
             sess = res.sessions
@@ -74,29 +98,35 @@ def main(argv=None) -> int:
         return lats[len(lats) // 2] * 1e6
 
     rows = []
-    single_us = measure(
-        pipeline_step_jit, acl, nat, route, empty_sessions(args.capacity),
-        put_batch=lambda b: b,
-    )
-    rows.append({"mode": "single-device", "p50_step_us": round(single_us, 1)})
+    singles = {}
+    for disc, (step, batch) in disciplines.items():
+        singles[disc] = measure(
+            step, batch, acl, nat, route, empty_sessions(args.capacity),
+            put_batch=lambda b: b,
+        )
+        rows.append({"mode": "single-device", "discipline": disc,
+                     "p50_step_us": round(singles[disc], 1)})
 
     mesh = make_mesh(args.devices)
     for partitioned in (False, True):
-        with mesh:
-            a, n, r, s = shard_dataplane(
-                mesh, acl, nat, route, empty_sessions(args.capacity),
-                partition_sessions=partitioned,
-            )
-            us = measure(
-                sharded_pipeline_step(mesh), a, n, r, s,
-                put_batch=lambda b: shard_batch(mesh, b),
-            )
-        rows.append({
-            "mode": ("mesh-8-partitioned-sessions" if partitioned
-                     else "mesh-8-replicated-sessions"),
-            "p50_step_us": round(us, 1),
-            "overhead_vs_single": round(us / single_us, 2),
-        })
+        for disc, (step, batch) in disciplines.items():
+            with mesh:
+                a, n, r, s = shard_dataplane(
+                    mesh, acl, nat, route, empty_sessions(args.capacity),
+                    partition_sessions=partitioned,
+                )
+                us = measure(
+                    step, batch, a, n, r, s,
+                    put_batch=lambda b: shard_batch(mesh, b),
+                )
+            rows.append({
+                "mode": (f"mesh-{args.devices}-partitioned-sessions"
+                         if partitioned
+                         else f"mesh-{args.devices}-replicated-sessions"),
+                "discipline": disc,
+                "p50_step_us": round(us, 1),
+                "overhead_vs_single": round(us / singles[disc], 2),
+            })
 
     meta = {
         "batch": args.batch,

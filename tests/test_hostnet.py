@@ -387,8 +387,12 @@ def test_resync_100_pods_batched_under_one_second(hostnet):
         routes = {r.get("dst") for r in hostnet.routes(vrf=1)}
         assert "10.1.1.2" in routes and len(routes) >= 100
         # ...in few execs (netns adds dominate; iproute2 ops batched)
-        # and under the 1 s bar.
-        assert elapsed < 1.0, f"100-pod resync took {elapsed:.2f}s"
+        # and under the 1 s bar — scaled like every wall-clock bound by
+        # the machine-speed multiplier (a competing full-load process
+        # on this 1-core box legitimately doubles elapsed time without
+        # saying anything about the batching under test).
+        bar = 1.0 * timeout_mult()
+        assert elapsed < bar, f"100-pod resync took {elapsed:.2f}s (bar {bar:.1f})"
         states = scheduler.dump()
         bad = [s for s in states if s.state.name != "APPLIED"]
         assert not bad, bad[:3]

@@ -14,7 +14,7 @@ from vpp_tpu.nodesync import NodeSync
 from vpp_tpu.podmanager import PodManager
 from vpp_tpu.scheduler import TxnScheduler
 from vpp_tpu.testing.hostfib import MockHostFIB
-from vpp_tpu.testing.cluster import timeout_mult
+from vpp_tpu.testing.cluster import wait_for as _shared_wait_for
 
 
 def boot(store, node_name, config=None):
@@ -37,13 +37,8 @@ def boot(store, node_name, config=None):
     }
 
 
-def wait_for(cond, timeout=3.0):
-    deadline = time.time() + timeout * timeout_mult()
-    while time.time() < deadline:
-        if cond():
-            return True
-        time.sleep(0.02)
-    return False
+# The shared helper scales by the machine-speed multiplier itself.
+wait_for = _shared_wait_for
 
 
 def test_single_node_base_config():

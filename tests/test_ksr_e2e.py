@@ -19,7 +19,7 @@ from vpp_tpu.ops.packets import make_batch
 from vpp_tpu.policy import PolicyPlugin
 from vpp_tpu.policy.renderer.tpu import TpuPolicyRenderer
 from vpp_tpu.testing.k8s import FakeK8sCluster
-from vpp_tpu.testing.cluster import timeout_mult
+from vpp_tpu.testing.cluster import wait_for as _shared_wait_for
 
 
 class RecordingSink(TxnSink):
@@ -30,13 +30,8 @@ class RecordingSink(TxnSink):
         self.txns.append(txn)
 
 
-def _wait(predicate, timeout=3.0):
-    deadline = time.time() + timeout * timeout_mult()
-    while time.time() < deadline:
-        if predicate():
-            return True
-        time.sleep(0.02)
-    return predicate()
+# Shared poll-until-deadline helper (machine-speed-scaled).
+_wait = _shared_wait_for
 
 
 def test_k8s_to_tpu_verdicts():

@@ -11,7 +11,7 @@ import pytest
 from vpp_tpu.bgpreflector import BGPReflector, BGPRouteUpdate, RouteEventType
 from vpp_tpu.conf import NetworkConfig
 from vpp_tpu.hostnet.monitor import DhcpAddressSource, IpRouteSource
-from vpp_tpu.testing.cluster import timeout_mult
+from vpp_tpu.testing.cluster import wait_for as _shared_wait_for
 
 
 def _netns_available() -> bool:
@@ -47,13 +47,8 @@ def netns():
     subprocess.run(["ip", "netns", "del", ns], capture_output=True)
 
 
-def _wait(predicate, timeout=5.0):
-    deadline = time.time() + timeout * timeout_mult()
-    while time.time() < deadline:
-        if predicate():
-            return True
-        time.sleep(0.05)
-    return predicate()
+# Shared poll-until-deadline helper (machine-speed-scaled).
+_wait = _shared_wait_for
 
 
 def test_route_source_lists_and_streams_bird_routes(netns):

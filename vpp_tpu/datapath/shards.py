@@ -134,15 +134,44 @@ class ShardedDataplane:
     def inspect(self) -> Dict[str, object]:
         """Live introspection (netctl inspect): shard 0's FULL view
         carries the shared state (device tables, sessions, slow path —
-        the occupancy device reads are paid exactly once); every shard
-        contributes only its host-side dispatch/ring/counter slices."""
+        the occupancy device reads are paid exactly once; the
+        aggregated counters reuse those very values instead of calling
+        metrics(), which would re-read them); every shard contributes
+        its host-side dispatch/ring/counter slices, and the top-level
+        rings/inflight aggregate across shards so the summary view
+        reflects the whole node."""
         base = self.shards[0].inspect()
         base["shards"] = [
             {"dispatch": r.inspect_dispatch(), "rings": r.inspect_rings(),
              "counters": r.counters.as_dict()}
             for r in self.shards
         ]
-        base["counters"] = self.metrics()
+        # Aggregate rings: sum frames/dropped per ring name.
+        rings: Dict[str, Dict[str, int]] = {}
+        for view in base["shards"]:
+            for name, info in view["rings"].items():
+                agg = rings.setdefault(name, {})
+                for key, value in info.items():
+                    agg[key] = agg.get(key, 0) + value
+        base["rings"] = rings
+        base["dispatch"]["inflight"] = sum(
+            len(r._inflight) for r in self.shards)
+        # Aggregated counters WITHOUT re-reading device occupancy:
+        # shard 0's inspect() above already transferred the gauges.
+        agg_counters: Dict[str, int] = {}
+        for r in self.shards:
+            for key, value in r.counters.as_dict().items():
+                agg_counters[key] = agg_counters.get(key, 0) + value
+        for key, value in self.slow.counters.as_dict().items():
+            agg_counters[key] = value
+        sessions = base["sessions"]
+        agg_counters["datapath_sessions_active"] = sessions["active"]
+        agg_counters["datapath_affinity_active"] = sessions["affinity_pins"]
+        agg_counters["datapath_slowpath_sessions_active"] = (
+            base["slowpath"]["sessions"])
+        agg_counters["datapath_inflight"] = base["dispatch"]["inflight"]
+        agg_counters["datapath_shards"] = len(self.shards)
+        base["counters"] = agg_counters
         return base
 
     def close(self) -> None:

@@ -155,11 +155,13 @@ def cmd_inspect(server: str, out, watch: float = 0.0, raw: bool = False) -> int:
             return
         dp, cl, nt = d["dispatch"], d["classify"], d["nat"]
         se, sp, c = d["sessions"], d["slowpath"], d["counters"]
+        n_shards = len(d.get("shards") or [])
         print(f"node {d.get('node', '?')}  engine={d['engine']}  "
               f"dispatch={dp['discipline']} {dp['max_vectors']}x"
               f"{dp['batch_size']}  inflight={dp['inflight']}/"
               f"{dp['max_inflight']}  bypass="
               f"{'on' if dp['bypass_eligible'] else 'off'}"
+              f"{'  shards=' + str(n_shards) if n_shards else ''}"
               f"{'  mesh=' + dp['mesh'] if dp['mesh'] else ''}", file=out)
         print(f"classify: {cl['rules']} rules / {cl['tables']} tables / "
               f"{cl['pods']} pods    nat: {nt['mappings']} mappings "
