@@ -58,13 +58,15 @@ def main(argv=None) -> int:
     from vpp_tpu.parallel import make_mesh, shard_dataplane
     from vpp_tpu.parallel.mesh import shard_batch
 
-    acl, nat, route, _, pod_ips, mappings = bench.build_stress_state(
-        n_rules=10000, n_services=1000
-    )
+    # Validate the CLI BEFORE the expensive stress-state build.
     if args.batch % VECTOR_SIZE or args.batch < VECTOR_SIZE:
         parser.error(f"--batch must be a positive multiple of "
                      f"{VECTOR_SIZE} (the vector disciplines dispatch "
                      f"[K, {VECTOR_SIZE}] shapes)")
+
+    acl, nat, route, _, pod_ips, mappings = bench.build_stress_state(
+        n_rules=10000, n_services=1000
+    )
     flat_batch = bench.build_traffic(pod_ips, mappings, args.batch)
     k = args.batch // VECTOR_SIZE
     vec_batch = jax.tree_util.tree_map(

@@ -397,3 +397,167 @@ class TestReferenceDepthChecks:
         snaps["node-1"].nodes.append({"name": "node-ghost"})
         errors = self._l2(snaps)
         assert any("unknown nodes ['node-ghost']" in e for e in errors), errors
+
+
+# -------------------------------------------- report lifecycle (r5 item 9)
+
+
+def test_telemetry_lifecycle_stale_retention_and_prune():
+    """telemetry_cache.go report lifecycle: unreachable nodes keep
+    their last-good data marked stale (a down agent is a finding, not
+    a blank); departed nodes are pruned; recovery clears staleness."""
+    snapshots_by_server = {
+        "a:1": {"/contiv/v1/ipam": {"nodeId": 1},
+                "/scheduler/dump": [], "/contiv/v1/nodes": [],
+                "/contiv/v1/pods": []},
+        "b:1": {"/contiv/v1/ipam": {"nodeId": 2},
+                "/scheduler/dump": [], "/contiv/v1/nodes": [],
+                "/contiv/v1/pods": []},
+    }
+    down = set()
+
+    def fetch(server, path):
+        if server in down:
+            raise OSError("connection refused")
+        payloads = snapshots_by_server[server]
+        if path not in payloads:
+            raise FileNotFoundError(path)  # e.g. the optional /inspect
+        return payloads[path]
+
+    cache = TelemetryCache(fetch=fetch)
+    agents = {"node-a": "a:1", "node-b": "b:1"}
+    snaps = cache.collect(agents)
+    assert snaps["node-a"].ipam == {"nodeId": 1}
+    assert not snaps["node-a"].stale and not snaps["node-a"].errors
+
+    # node-a goes down: data RETAINED, marked stale, errors current.
+    down.add("a:1")
+    snaps = cache.collect(agents)
+    assert snaps["node-a"].ipam == {"nodeId": 1}   # last-good data
+    assert snaps["node-a"].stale
+    assert snaps["node-a"].errors                  # this cycle's failure
+    assert snaps["node-a"].revision == 1           # data from cycle 1
+    assert not snaps["node-b"].stale
+    assert snaps["node-b"].revision == 2
+
+    # node-a recovers: fresh snapshot, staleness cleared.
+    down.clear()
+    snaps = cache.collect(agents)
+    assert not snaps["node-a"].stale and not snaps["node-a"].errors
+    assert snaps["node-a"].revision == 3
+
+    # node-b departs: pruned outright.
+    del agents["node-b"]
+    snaps = cache.collect(agents)
+    assert set(snaps) == {"node-a"}
+
+
+def test_report_carries_node_lifecycle_and_prunes_on_departure(cluster):
+    """The published TelemetryReport records per-node collection
+    status, and a node whose VppNode leaves the store is pruned from
+    the crawl (node-departure lifecycle)."""
+    store, a, b = cluster
+    crd = CRDPlugin(store)
+    crd.register_agent("node-1", a["server"])
+    crd.register_agent("node-2", b["server"])
+    report = crd.run_validation()
+    assert {n.node for n in report.nodes} == {"node-1", "node-2"}
+    assert all(n.reachable and not n.stale for n in report.nodes)
+
+    # node-2's VppNode leaves the store -> pruned from the next cycle.
+    from vpp_tpu.models.registry import NODESYNC_PREFIX
+
+    for key, node in store.list(NODESYNC_PREFIX + "vppnode/"):
+        if getattr(node, "name", "") == "node-2":
+            store.delete(key)
+    report2 = crd.run_validation()
+    assert {n.node for n in report2.nodes} == {"node-1"}
+    assert report2.revision == report.revision + 1
+
+
+@pytest.mark.slow
+def test_procnode_cluster_telemetry_updates_and_survives_restart(tmp_path):
+    """VERDICT r4 item 9 done criterion: a telemetry report for a
+    2-node PROCNODE cluster (separate OS processes, REST served per
+    agent) updates on a timer, and survives an agent restart — the
+    restarted agent's data goes stale-with-errors during the outage
+    and refreshes after."""
+    import os
+    import subprocess
+    import sys
+
+    from vpp_tpu.kvstore import KVStoreServer
+    from vpp_tpu.testing.cluster import wait_for
+    from vpp_tpu.testing.procnode import HEARTBEAT_PREFIX
+
+    store = KVStore()
+    server = KVStoreServer(store)
+    port = server.start()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def spawn(name):
+        return subprocess.Popen(
+            [sys.executable, "-m", "vpp_tpu.testing.procnode",
+             "--store", f"127.0.0.1:{port}", "--name", name,
+             "--rest-port", "0"],
+            env=env, cwd=repo,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+
+    def beat(name):
+        return store.get(HEARTBEAT_PREFIX + name) or {}
+
+    children = {n: spawn(n) for n in ("node-1", "node-2")}
+    crd = CRDPlugin(store, collection_interval=0.3)
+    try:
+        assert wait_for(lambda: beat("node-1").get("rest")
+                        and beat("node-2").get("rest"), timeout=60)
+        for n in ("node-1", "node-2"):
+            crd.register_agent(n, beat(n)["rest"])
+        crd.start()
+        # Reports update on the TIMER (revision advances by itself).
+        assert wait_for(lambda: (crd.latest_report() or
+                                 NodeSnapshot("x")).revision >= 2,
+                        timeout=30)
+        r = crd.latest_report()
+        assert {n.node for n in r.nodes} == {"node-1", "node-2"}
+        assert all(n.reachable for n in r.nodes)
+
+        # Kill node-2: its entry goes unreachable-stale, data retained.
+        children["node-2"].terminate()
+        children["node-2"].wait(timeout=10)
+
+        def node2_stale():
+            rep = crd.latest_report()
+            st = {n.node: n for n in (rep.nodes if rep else ())}
+            return "node-2" in st and not st["node-2"].reachable
+        assert wait_for(node2_stale, timeout=30)
+        st = {n.node: n for n in crd.latest_report().nodes}
+        assert st["node-2"].stale and st["node-2"].errors
+        assert st["node-1"].reachable
+
+        # Restart node-2 (fresh process, new ephemeral REST port).
+        old_rest = beat("node-2").get("rest")
+        children["node-2"] = spawn("node-2")
+        assert wait_for(lambda: beat("node-2").get("rest")
+                        and beat("node-2")["rest"] != old_rest, timeout=60)
+        crd.register_agent("node-2", beat("node-2")["rest"])
+
+        def node2_fresh():
+            rep = crd.latest_report()
+            st2 = {n.node: n for n in (rep.nodes if rep else ())}
+            return ("node-2" in st2 and st2["node-2"].reachable
+                    and not st2["node-2"].stale)
+        assert wait_for(node2_fresh, timeout=60)
+    finally:
+        crd.stop()
+        for child in children.values():
+            child.terminate()
+            try:
+                child.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                child.kill()
+        server.stop()

@@ -51,11 +51,25 @@ class ValidationReport:
 
 
 @dataclass(frozen=True)
+class NodeCollectionStatus:
+    """Per-node collection lifecycle in a report: whether the latest
+    crawl reached the agent, whether its data is retained-stale, and
+    which collection revision produced the data."""
+
+    node: str
+    reachable: bool = True
+    stale: bool = False
+    data_revision: int = 0
+    errors: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
 class TelemetryReport:
     """Cluster-wide validation outcome (telemetry/v1 TelemetryReport)."""
 
     revision: int = 0
     reports: Tuple[ValidationReport, ...] = ()
+    nodes: Tuple[NodeCollectionStatus, ...] = ()
 
     @property
     def error_count(self) -> int:

@@ -16,7 +16,8 @@ from typing import Dict, Optional
 
 from ..controller.api import UpdateEvent
 from ..kvstore import KVStore
-from .models import NodeConfig, TelemetryReport
+from ..models.registry import NODESYNC_PREFIX
+from .models import NodeCollectionStatus, NodeConfig, TelemetryReport
 from .telemetry import TelemetryCache
 from .validator import L2Validator, L3Validator
 
@@ -95,14 +96,47 @@ class CRDPlugin:
     def register_agent(self, node_name: str, server: str) -> None:
         self.agents[node_name] = server
 
+    def unregister_agent(self, node_name: str) -> None:
+        self.agents.pop(node_name, None)
+
+    def _prune_departed(self) -> None:
+        """Drop agents whose VppNode left the cluster store — node
+        departure prunes its telemetry (telemetry_cache.go report
+        lifecycle).  Only enforced when the store HAS a node registry:
+        a harness that registered agents without publishing VppNodes
+        keeps its explicit set."""
+        entries = self.store.list(NODESYNC_PREFIX + "vppnode/")
+        if not entries:
+            return
+        alive = {getattr(node, "name", "") for _, node in entries}
+        for name in list(self.agents):
+            if name not in alive:
+                log.info("telemetry: pruning departed node %s", name)
+                del self.agents[name]
+
     def run_validation(self) -> TelemetryReport:
-        """One collection + validation cycle (telemetry controller tick)."""
+        """One collection + validation cycle (telemetry controller
+        tick): prune departed nodes, crawl every agent (update-in-place
+        snapshots; unreachable nodes keep last-good data marked stale),
+        validate, publish the report update-in-place."""
+        self._prune_departed()
         snapshots = self.cache.collect(self.agents)
         reports = []
         for validator in self.validators:
             reports.extend(validator.validate(snapshots))
         self._revision += 1
-        report = TelemetryReport(revision=self._revision, reports=tuple(reports))
+        statuses = tuple(
+            NodeCollectionStatus(
+                node=name,
+                reachable=not snap.errors,
+                stale=snap.stale,
+                data_revision=snap.revision,
+                errors=tuple(snap.errors),
+            )
+            for name, snap in sorted(snapshots.items())
+        )
+        report = TelemetryReport(revision=self._revision,
+                                 reports=tuple(reports), nodes=statuses)
         self.store.put(TELEMETRY_KEY, report)
         if report.error_count:
             log.warning("telemetry validation: %d errors %s",

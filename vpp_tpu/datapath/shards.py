@@ -110,26 +110,35 @@ class ShardedDataplane:
 
     # ------------------------------------------------------------ metrics
 
-    def metrics(self) -> Dict[str, int]:
-        """Aggregated counters over all shards (shared gauges taken
-        once, per-shard totals summed)."""
+    def _aggregate_counters(self, sessions_active: int,
+                            affinity_active: int,
+                            slowpath_sessions: int) -> Dict[str, int]:
+        """ONE aggregation body for metrics() and inspect(): per-shard
+        totals summed, shared slow-path counters taken once, the
+        (caller-supplied, already-transferred) device gauges injected —
+        so the two views can never drift apart."""
         agg: Dict[str, int] = {}
         for r in self.shards:
             for key, value in r.counters.as_dict().items():
                 agg[key] = agg.get(key, 0) + value
-        one = self.shards[0].metrics()
-        for key in (
-            "datapath_sessions_active",
-            "datapath_slowpath_sessions_active",
-            "datapath_affinity_active",
-        ):
-            if key in one:
-                agg[key] = one[key]
         for key, value in self.slow.counters.as_dict().items():
             agg[key] = value
+        agg["datapath_sessions_active"] = sessions_active
+        agg["datapath_affinity_active"] = affinity_active
+        agg["datapath_slowpath_sessions_active"] = slowpath_sessions
         agg["datapath_inflight"] = sum(len(r._inflight) for r in self.shards)
         agg["datapath_shards"] = len(self.shards)
         return agg
+
+    def metrics(self) -> Dict[str, int]:
+        """Aggregated counters over all shards (shared gauges taken
+        once, per-shard totals summed)."""
+        one = self.shards[0].metrics()  # pays the device gauge reads
+        return self._aggregate_counters(
+            one.get("datapath_sessions_active", 0),
+            one.get("datapath_affinity_active", 0),
+            one.get("datapath_slowpath_sessions_active", 0),
+        )
 
     def inspect(self) -> Dict[str, object]:
         """Live introspection (netctl inspect): shard 0's FULL view
@@ -158,20 +167,11 @@ class ShardedDataplane:
             len(r._inflight) for r in self.shards)
         # Aggregated counters WITHOUT re-reading device occupancy:
         # shard 0's inspect() above already transferred the gauges.
-        agg_counters: Dict[str, int] = {}
-        for r in self.shards:
-            for key, value in r.counters.as_dict().items():
-                agg_counters[key] = agg_counters.get(key, 0) + value
-        for key, value in self.slow.counters.as_dict().items():
-            agg_counters[key] = value
         sessions = base["sessions"]
-        agg_counters["datapath_sessions_active"] = sessions["active"]
-        agg_counters["datapath_affinity_active"] = sessions["affinity_pins"]
-        agg_counters["datapath_slowpath_sessions_active"] = (
-            base["slowpath"]["sessions"])
-        agg_counters["datapath_inflight"] = base["dispatch"]["inflight"]
-        agg_counters["datapath_shards"] = len(self.shards)
-        base["counters"] = agg_counters
+        base["counters"] = self._aggregate_counters(
+            sessions["active"], sessions["affinity_pins"],
+            base["slowpath"]["sessions"],
+        )
         return base
 
     def close(self) -> None:

@@ -40,6 +40,7 @@ def run_agent(
     heartbeat_interval: float = 0.1,
     stop_event=None,
     hostnet_netns: str = "",
+    rest_port: int = -1,
 ) -> None:
     from .cluster import SimNode
 
@@ -48,6 +49,22 @@ def run_agent(
     # where the in-process store object sat.
     shim = types.SimpleNamespace(store=store)
     node = SimNode(shim, name, mirror_path=mirror_path or None)
+    rest = None
+    rest_bound = 0
+    if rest_port >= 0:
+        # Serve the agent REST API (ipam/dump/nodes/pods/...) so
+        # cross-process harnesses — the CRD telemetry crawl above all —
+        # can interrogate this agent like a production one.  The bound
+        # port rides the heartbeat for discovery (0 = ephemeral).
+        from ..rest.server import AgentRestServer
+
+        rest = AgentRestServer(
+            node_name=name, controller=node.controller,
+            dbwatcher=node.watcher, ipam=node.ipam,
+            nodesync=node.nodesync, podmanager=node.podmanager,
+            scheduler=node.scheduler, port=rest_port,
+        )
+        rest_bound = rest.start()
     hostnet = None
     if hostnet_netns:
         # Program REAL kernel networking (confined to the named netns):
@@ -77,6 +94,7 @@ def run_agent(
                 ),
                 "acl_swaps": node.acl_applicator.compile_count,
                 "nat_mappings": len(node.nat_applicator.mappings()),
+                "rest": f"127.0.0.1:{rest_bound}" if rest_bound else "",
             }
             try:
                 store.put(heartbeat_prefix + name, beat)
@@ -84,6 +102,8 @@ def run_agent(
                 pass
             time.sleep(heartbeat_interval)
     finally:
+        if rest is not None:
+            rest.stop()
         node.stop()
         store.close()
         if hostnet is not None:
@@ -98,13 +118,16 @@ def main(argv=None) -> int:
     parser.add_argument("--heartbeat-prefix", default=HEARTBEAT_PREFIX)
     parser.add_argument("--hostnet-netns", default="",
                         help="program real kernel networking inside this netns")
+    parser.add_argument("--rest-port", type=int, default=-1,
+                        help="serve the agent REST API (0 = ephemeral port, "
+                             "published in the heartbeat; -1 = off)")
     args = parser.parse_args(argv)
 
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
     print(json.dumps({"agent": args.name, "store": args.store}), flush=True)
     run_agent(args.store, args.name, mirror_path=args.mirror,
               heartbeat_prefix=args.heartbeat_prefix,
-              hostnet_netns=args.hostnet_netns)
+              hostnet_netns=args.hostnet_netns, rest_port=args.rest_port)
     return 0
 
 
