@@ -399,3 +399,88 @@ def test_views_route_serves_shaped_models_live():
         if rest is not None:
             rest.stop()
         cluster.stop()
+
+
+def test_view_models_services_and_policies():
+    """The services/policies panels (ui/src/app services + policies
+    analogs) shape from the scheduler dump's TPU keys."""
+    from vpp_tpu.uibackend.views import shape_policies, shape_services
+
+    dump = [
+        {"key": "tpu/nat/service/default/web", "state": "APPLIED",
+         "applied": [
+             {"external_ip": "10.96.0.10", "external_port": 80,
+              "protocol": 6,
+              "backends": [["10.1.1.3", 8080, 1], ["10.1.2.4", 8080, 3]],
+              "session_affinity_timeout": 30},
+         ]},
+        {"key": "tpu/acl/pod/default/web", "state": "APPLIED",
+         "applied": [168430083, [{"action": 1}, {"action": 2}],
+                     [{"action": 2}]]},
+        # PENDING entries never reach a view.
+        {"key": "tpu/nat/service/default/ghost", "state": "PENDING",
+         "applied": [{"external_ip": "10.96.9.9", "external_port": 1,
+                      "protocol": 6, "backends": []}]},
+    ]
+    svc = shape_services(dump)
+    assert svc == [{
+        "service": "default/web", "vip": "10.96.0.10:80",
+        "protocol": "tcp", "backends": "10.1.1.3:8080, 10.1.2.4:8080 x3",
+        "affinity": "30s",
+    }]
+    pol = shape_policies(dump)
+    assert pol == [{"pod": "default/web",
+                    "ingress_rules": 2, "egress_rules": 1}]
+
+
+def test_views_route_includes_services_live():
+    """A deployed service shows in /api/views through a live agent."""
+    from vpp_tpu.rest import AgentRestServer
+    from vpp_tpu.testing.cluster import SimCluster
+
+    cluster = SimCluster()
+    rest = None
+    b = None
+    try:
+        n1 = cluster.add_node("node-1")
+        web_ip = cluster.deploy_pod("node-1", "web", labels={"app": "web"})
+        cluster.apply_service({
+            "metadata": {"name": "websvc", "namespace": "default"},
+            "spec": {"clusterIP": "10.96.0.10",
+                     "selector": {"app": "web"},
+                     "ports": [{"name": "http", "protocol": "TCP",
+                                "port": 80, "targetPort": 8080}]},
+        })
+        cluster.apply_endpoints({
+            "metadata": {"name": "websvc", "namespace": "default"},
+            "subsets": [{
+                "addresses": [{"ip": web_ip, "nodeName": "node-1",
+                               "targetRef": {"kind": "Pod", "name": "web",
+                                             "namespace": "default"}}],
+                "ports": [{"name": "http", "port": 8080,
+                           "protocol": "TCP"}],
+            }],
+        })
+        from vpp_tpu.testing.cluster import wait_for
+        assert wait_for(lambda: len(n1.nat_renderer.mappings()) > 0)
+        rest = AgentRestServer(
+            node_name="node-1", controller=n1.controller,
+            dbwatcher=n1.watcher, ipam=n1.ipam, nodesync=n1.nodesync,
+            podmanager=n1.podmanager, scheduler=n1.scheduler,
+        )
+        directory = {"node-1": f"127.0.0.1:{rest.start()}"}
+        b = UIBackend(node_directory=directory.get,
+                      list_nodes=lambda: list(directory))
+        b.start()
+        _, body = get(b, "/api/views/node-1")
+        v = json.loads(body)
+        vips = [s["vip"] for s in v["services"]]
+        assert "10.96.0.10:80" in vips
+        assert v["policies"] == [] or all(
+            "pod" in p for p in v["policies"])
+    finally:
+        if b is not None:
+            b.stop()
+        if rest is not None:
+            rest.stop()
+        cluster.stop()

@@ -114,6 +114,48 @@ def shape_config_views(dump: List[dict],
     }
 
 
+def shape_services(dump: List[dict]) -> List[dict]:
+    """Service view rows (the ui/src/app services view analog): one row
+    per DNAT mapping under the scheduler's ``tpu/nat/service/`` keys —
+    VIP/port/proto, the weighted backend ring, ClientIP affinity."""
+    rows = []
+    for key, mappings in sorted(
+        _applied_by_prefix(dump, "tpu/nat/service/").items()
+    ):
+        for m in mappings or ():
+            backends = ", ".join(
+                f"{b[0]}:{b[1]}" + (f" x{b[2]}" if b[2] != 1 else "")
+                for b in (m.get("backends") or ())
+            )
+            rows.append({
+                "service": key,
+                "vip": f"{m.get('external_ip')}:{m.get('external_port')}",
+                "protocol": {6: "tcp", 17: "udp"}.get(
+                    m.get("protocol"), str(m.get("protocol"))),
+                "backends": backends,
+                "affinity": (f"{m.get('session_affinity_timeout')}s"
+                             if m.get("session_affinity_timeout") else ""),
+            })
+    return rows
+
+
+def shape_policies(dump: List[dict]) -> List[dict]:
+    """Policy view rows (the ui/src/app policies view analog): one row
+    per pod entry under ``tpu/acl/pod/`` — the compiled ingress/egress
+    rule counts the classify tables carry for it."""
+    rows = []
+    for key, entry in sorted(_applied_by_prefix(dump, "tpu/acl/pod/").items()):
+        # Entry shape: (pod_ip_u32, ingress_rules, egress_rules).
+        ingress = entry[1] if isinstance(entry, (list, tuple)) and len(entry) > 1 else ()
+        egress = entry[2] if isinstance(entry, (list, tuple)) and len(entry) > 2 else ()
+        rows.append({
+            "pod": key,
+            "ingress_rules": len(ingress or ()),
+            "egress_rules": len(egress or ()),
+        })
+    return rows
+
+
 def shape_trace(entries: List[dict],
                 filter_ip: Optional[str] = None,
                 limit: int = 20) -> List[dict]:
@@ -147,6 +189,8 @@ def shape_views(dump: List[dict], ipam: dict, trace: dict,
     """The full ``/api/views/<node>`` payload."""
     pod_ips = (ipam or {}).get("allocatedPodIPs") or {}
     out = shape_config_views(dump or [], pod_ips)
+    out["services"] = shape_services(dump or [])
+    out["policies"] = shape_policies(dump or [])
     out["config_kvs"] = len(dump or [])
     out["trace"] = {
         "status": (trace or {}).get("status") or {},
