@@ -94,6 +94,10 @@ class CRDPlugin:
     # ------------------------------------------------------------- telemetry
 
     def register_agent(self, node_name: str, server: str) -> None:
+        # Plain dict assignment: atomic under the GIL.  Readers snapshot
+        # (run_validation) — iterating the live dict from the timer
+        # thread while a registration lands would raise "dictionary
+        # changed size during iteration".
         self.agents[node_name] = server
 
     def unregister_agent(self, node_name: str) -> None:
@@ -112,7 +116,9 @@ class CRDPlugin:
         for name in list(self.agents):
             if name not in alive:
                 log.info("telemetry: pruning departed node %s", name)
-                del self.agents[name]
+                # pop, not del: a concurrent unregister_agent may have
+                # removed the name between the snapshot and here.
+                self.agents.pop(name, None)
 
     def run_validation(self) -> TelemetryReport:
         """One collection + validation cycle (telemetry controller
@@ -120,7 +126,7 @@ class CRDPlugin:
         snapshots; unreachable nodes keep last-good data marked stale),
         validate, publish the report update-in-place."""
         self._prune_departed()
-        snapshots = self.cache.collect(self.agents)
+        snapshots = self.cache.collect(dict(self.agents))
         reports = []
         for validator in self.validators:
             reports.extend(validator.validate(snapshots))
