@@ -324,3 +324,31 @@ def test_runner_constructs_before_first_table_commit():
     assert runner.nat is None
     runner.update_tables(nat=build_nat_tables([]))
     assert runner.nat is not None
+
+
+def test_sharded_engine_uses_host_bypass_when_permissive():
+    """The host bypass engages PER SHARD under the sharded engine:
+    trivially-permissive tables forward traffic on every shard without
+    a single device dispatch, and the inspect view aggregates the
+    bypass batches + per-shard rings."""
+    dp, ios = make_sharded(3)
+    dp.update_tables(nat=build_nat_tables([], snat_enabled=False,
+                                          pod_subnet="10.1.0.0/16"))
+    for r in dp.shards:
+        assert r._bypass_tables
+    frames = [build_frame("10.1.1.2", "10.1.1.3", 6, 40000 + i, 80)
+              for i in range(12)]
+    for i, f in enumerate(frames):
+        ios[i % 3][0].send([f])
+    dp.drain()
+    got = []
+    for io_set in ios:
+        got += io_set[2].recv_batch(1 << 10)
+    assert len(got) == len(frames)
+    m = dp.metrics()
+    assert m["datapath_bypass_batches_total"] >= 3   # every shard bypassed
+    assert m["datapath_batches_total"] == 0          # no device dispatch
+    view = dp.inspect()
+    assert len(view["shards"]) == 3
+    assert view["counters"]["datapath_bypass_batches_total"] >= 3
+    assert view["rings"]["tx_local"]["frames"] == 0  # drained
