@@ -484,3 +484,32 @@ def test_views_route_includes_services_live():
         if rest is not None:
             rest.stop()
         cluster.stop()
+
+
+def test_netctl_route_resolves_node_to_server(backend):
+    """The dashboard's netctl console sends {args, node}: the backend
+    resolves the node name to its agent address as --server (unless
+    the caller already chose one), and 404s unknown nodes."""
+    def post(payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{backend.port}/api/netctl",
+            data=json.dumps(payload).encode(), method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return json.loads(resp.read())
+
+    out = post({"args": ["nodes"], "node": "node1"})
+    assert out["output"].startswith("ran: nodes --server 127.0.0.1:")
+    # Explicit --server wins (either argparse form); node is not
+    # re-appended.
+    out = post({"args": ["nodes", "--server", "x:1"], "node": "node1"})
+    assert out["output"] == "ran: nodes --server x:1"
+    out = post({"args": ["nodes", "--server=x:1"], "node": "node1"})
+    assert out["output"] == "ran: nodes --server=x:1"
+    # A non-string node is a clean 400, not a handler crash.
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        post({"args": ["nodes"], "node": {"x": 1}})
+    assert exc.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        post({"args": ["nodes"], "node": "ghost"})
+    assert exc.value.code == 404

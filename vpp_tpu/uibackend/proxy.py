@@ -235,6 +235,24 @@ class UIBackend:
             ):
                 return 400, "text/plain", b'expected {"args": [...]}'
             args = payload_in.get("args", [])
+            # Optional node targeting (the dashboard's netctl console):
+            # resolve the node name to its agent address and pass it as
+            # --server, unless the caller already provided one (either
+            # argparse form — "--server host" or "--server=host").
+            target = payload_in.get("node", "")
+            if target and not isinstance(target, str):
+                return 400, "text/plain", b'"node" must be a string'
+            has_server = any(
+                isinstance(a, str) and (a == "--server"
+                                        or a.startswith("--server="))
+                for a in args
+            )
+            if target and not has_server:
+                server = self.node_directory(target)
+                if server is None:
+                    return (404, "text/plain",
+                            f"unknown node {target!r}".encode())
+                args = list(args) + ["--server", server]
             code, output = self.netctl_runner(args)
             payload = json.dumps({"exit_code": code, "output": output}).encode()
             return 200, "application/json", payload
