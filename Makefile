@@ -3,11 +3,23 @@
 
 PY ?= python
 
-.PHONY: test test-race lint bench bench-suite bench-sweep bench-scale \
-        bench-latency bench-frames images native
+.PHONY: test test-race verify-ha lint bench bench-suite bench-sweep \
+        bench-scale bench-latency bench-frames images native
 
 test:
 	$(PY) -m pytest tests/ -q
+
+# The HA-store verification subset under the tier-1 command's flags:
+# kvstore (incl. the ensemble + 3-OS-process leader-SIGKILL tests),
+# chaos (leader kill mid-traffic), and the deployment composition that
+# renders the 3-replica spec.  `not slow` mirrors tier-1; RUN_SLOW=1
+# adds the slow cross-process soaks.
+verify-ha:
+	JAX_PLATFORMS=cpu $(PY) -m pytest \
+	    tests/test_kvstore.py tests/test_kvstore_remote.py \
+	    tests/test_kvstore_ha.py tests/test_chaos.py tests/test_deploy.py \
+	    -q $(if $(RUN_SLOW),,-m 'not slow') --continue-on-collection-errors \
+	    -p no:cacheprovider -p no:xdist -p no:randomly
 
 # Race-amplified run: CPython has no Go-style race detector, so instead
 # the whole suite runs under dev mode (threading/resource warnings are

@@ -92,9 +92,35 @@ def rbac(values: dict) -> list:
     ]
 
 
+def _store_members(values: dict) -> list:
+    """Stable per-replica DNS names (the StatefulSet pod identity rides
+    the headless service) — the HA ensemble's --join member list."""
+    ns = values["namespace"]
+    st = values["store"]
+    return [
+        f"vpp-tpu-store-{i}.vpp-tpu-store.{ns}.svc:{st['port']}"
+        for i in range(st.get("replicas", 1))
+    ]
+
+
 def store(values: dict) -> list:
     ns = values["namespace"]
     st = values["store"]
+    replicas = st.get("replicas", 1)
+    args = ["--host", "0.0.0.0", "--port", str(st["port"])]
+    env = []
+    if replicas > 1:
+        # HA ensemble (kvstore/ha.py): every member gets the full
+        # member list and its own stable DNS identity to advertise.
+        args += [
+            "--join", ",".join(_store_members(values)),
+            "--advertise",
+            f"$(POD_NAME).vpp-tpu-store.{ns}.svc:{st['port']}",
+            "--heartbeat-interval", str(st["heartbeatIntervalSeconds"]),
+            "--lease-timeout", str(st["leaseTimeoutSeconds"]),
+        ]
+        env = [{"name": "POD_NAME",
+                "valueFrom": {"fieldRef": {"fieldPath": "metadata.name"}}}]
     pod_spec = {
         "tolerations": _tolerate_master(),
         "nodeSelector": {"node-role.kubernetes.io/control-plane": ""},
@@ -102,12 +128,14 @@ def store(values: dict) -> list:
         "containers": [{
             "name": "store",
             "image": _image(values, "store"),
-            "args": ["--host", "0.0.0.0", "--port", str(st["port"])],
+            "args": args,
             "ports": [{"containerPort": st["port"], "name": "client"}],
             "volumeMounts": [{"name": "data",
                               "mountPath": "/var/lib/vpp-tpu"}],
         }],
     }
+    if env:
+        pod_spec["containers"][0]["env"] = env
     if st.get("enableLivenessProbe"):
         pod_spec["containers"][0]["livenessProbe"] = {
             "tcpSocket": {"port": st["port"]},
@@ -118,7 +146,7 @@ def store(values: dict) -> list:
         "metadata": {"name": "vpp-tpu-store", "namespace": ns,
                      "labels": {"k8s-app": "vpp-tpu-store"}},
         "spec": {
-            "serviceName": "vpp-tpu-store", "replicas": 1,
+            "serviceName": "vpp-tpu-store", "replicas": replicas,
             "selector": {"matchLabels": {"k8s-app": "vpp-tpu-store"}},
             "template": {
                 "metadata": {"labels": {"k8s-app": "vpp-tpu-store"}},
@@ -126,6 +154,10 @@ def store(values: dict) -> list:
             },
         },
     }
+    if replicas > 1:
+        # Members elect among themselves — pods must start together,
+        # not gated on each other's readiness (the etcd pattern).
+        stateful["spec"]["podManagementPolicy"] = "Parallel"
     if st.get("usePersistentVolume"):
         stateful["spec"]["volumeClaimTemplates"] = [{
             "metadata": {"name": "data"},
@@ -141,12 +173,20 @@ def store(values: dict) -> list:
         "metadata": {"name": "vpp-tpu-store", "namespace": ns},
         "spec": {"selector": {"k8s-app": "vpp-tpu-store"},
                  "clusterIP": "None",
+                 # Peer DNS must resolve BEFORE a replica is Ready, or
+                 # the ensemble could never bootstrap.
+                 "publishNotReadyAddresses": True,
                  "ports": [{"port": st["port"], "name": "client"}]},
     }
     return [stateful, service]
 
 
 def _store_target(values: dict) -> str:
+    """What consumers pass as --store: the full member list for an HA
+    ensemble (RemoteKVStore follows the leader and fails over), the
+    headless service name for a single-replica store."""
+    if values["store"].get("replicas", 1) > 1:
+        return ",".join(_store_members(values))
     return (f"vpp-tpu-store.{values['namespace']}.svc:"
             f"{values['store']['port']}")
 

@@ -17,10 +17,15 @@ restores frame delivery:
   "VPP keeps switching while etcd is down" property), control-plane
   changes queue, and on store recovery the reconnect resync applies
   them; frame delivery reflects the new policy.
+- store-leader kill mid-traffic: the cluster store is a 3-replica HA
+  ensemble (the clustered-etcd analog, kvstore/ha.py); SIGKILL-ing the
+  leader elects a follower, the agents' clients fail over transparently,
+  KSR writes resume, and no policy/service state is lost.
 """
 
 from vpp_tpu.kvstore import KVStoreServer, RemoteKVStore
-from vpp_tpu.testing.cluster import wait_for
+from vpp_tpu.kvstore.ha import HAEnsemble
+from vpp_tpu.testing.cluster import timeout_mult, wait_for
 from vpp_tpu.testing.framecluster import FrameCluster, FrameNode
 from vpp_tpu.testing.frames import build_frame, frame_tuple, verify_checksums
 
@@ -195,5 +200,109 @@ def test_store_outage_mid_traffic_dataplane_survives_and_heals():
         cluster.run_datapaths()  # syncs tables, then drives the frames
         assert cluster.delivered_frames("node-1") == []  # now denied
         assert cluster.frame_nodes["node-1"].runner.counters.dropped_denied >= 1
+    finally:
+        cluster.stop()
+
+
+class HAStoreFrameCluster(FrameCluster):
+    """FrameCluster on a 3-replica HA store ensemble: the KSR and every
+    agent reach the store through leader-following multi-address
+    clients, so the LEADER can be killed mid-traffic."""
+
+    def __init__(self):
+        self.ensemble = HAEnsemble(3, heartbeat_interval=0.05,
+                                   lease_timeout=0.4 * timeout_mult())
+        self.ensemble.wait_leader()
+        self._clients = []
+        super().__init__(store=self._client())  # the KSR-side client
+
+    def _client(self):
+        client = self.ensemble.client(
+            timeout=1.0, failover_deadline=20.0 * timeout_mult())
+        self._clients.append(client)
+        return client
+
+    def add_node(self, name):
+        client = self._client()      # one leader-following client per agent
+        ksr_client = self.store
+        self.store = client          # SimNode consumes cluster.store
+        try:
+            return super().add_node(name)
+        finally:
+            self.store = ksr_client
+
+    def stop(self):
+        super().stop()
+        for client in self._clients:
+            client.close()
+        self.ensemble.stop()
+
+
+def test_store_leader_kill_mid_traffic_failover_and_no_lost_state():
+    """SIGKILL the store leader under service traffic: frames keep
+    flowing on the device tables during the election, a follower takes
+    over, KSR writes resume through the failed-over clients, and no
+    policy/service state is lost — the surviving replicas hold
+    identical state and a post-kill policy lands on the agents."""
+    cluster = HAStoreFrameCluster()
+    try:
+        n1 = cluster.add_node("node-1")
+        n2 = cluster.add_node("node-2")
+        client_ip = cluster.deploy_pod("node-1", "client")
+        backend_ip = cluster.deploy_pod("node-2", "web-1", labels=WEB)
+        _service_state(cluster, "node-2", backend_ip)
+        assert wait_for(lambda: len(n1.nat_renderer.mappings()) > 0)
+
+        # Service traffic flows before the chaos.
+        cluster.inject("node-1", [build_frame(client_ip, "10.96.0.10", 6, 43000, 80)])
+        cluster.run_datapaths()
+        out = cluster.delivered_frames("node-2")
+        assert len(out) == 1
+        assert frame_tuple(out[0]) == (client_ip, backend_ip, 6, 43000, 8080)
+
+        # ---- SIGKILL the store leader mid-traffic ----------------------
+        cluster.inject("node-1", [build_frame(client_ip, "10.96.0.10", 6,
+                                              43001 + i, 80) for i in range(4)])
+        dead = cluster.ensemble.kill_leader()
+        # The DATA PLANE keeps forwarding while the election runs —
+        # tables live on device, the reference's central resilience
+        # property, now under leader loss instead of full outage.
+        cluster.run_datapaths()
+        assert len(cluster.delivered_frames("node-2")) == 4
+
+        # A follower is elected within the lease window.
+        new = cluster.ensemble.wait_leader(timeout=10.0 * timeout_mult())
+        assert new.address != dead.address
+
+        # No lost service state: the surviving replicas hold identical
+        # contents, still including the reflected service + endpoints.
+        live = [r for r in cluster.ensemble.replicas
+                if r.address != dead.address]
+        assert wait_for(lambda: (
+            live[0].store.snapshot_with_revision([""])
+            == live[1].store.snapshot_with_revision([""])
+        ), timeout=10.0)
+        assert any("service" in k for k, _ in new.store.list(""))
+
+        # KSR writes resume: a policy applied AFTER the kill reaches the
+        # agents through the failed-over clients and is ENFORCED on
+        # frames (deny-all on the backend).
+        cluster.apply_policy({
+            "metadata": {"name": "deny-all", "namespace": "default"},
+            "spec": {"podSelector": {"matchLabels": WEB},
+                     "policyTypes": ["Ingress"], "ingress": []},
+        })
+        assert wait_for(
+            lambda: n2.policy_renderer.tables is not None
+            and int(n2.policy_renderer.tables.rule_valid.sum()) > 0,
+            timeout=15.0,
+        ), "post-kill policy never reached the agents"
+        cluster.inject("node-1", [build_frame(client_ip, "10.96.0.10", 6, 44000, 80)])
+        cluster.run_datapaths()
+        assert cluster.delivered_frames("node-2") == []  # denied
+        # Enforced wherever the reflected rule lands first (the source
+        # node drops at egress when its tables already carry it).
+        assert sum(fn.runner.counters.dropped_denied
+                   for fn in cluster.frame_nodes.values()) >= 1
     finally:
         cluster.stop()

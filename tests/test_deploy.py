@@ -297,6 +297,61 @@ def test_chart_default_render_is_complete_and_valid():
     assert "readinessProbe" in container and "livenessProbe" in container
 
 
+def test_chart_renders_ha_store_ensemble():
+    """Default render is the 3-replica HA store (the clustered-etcd
+    analog): each pod --joins the full member list under its stable
+    StatefulSet DNS identity, and every store consumer is handed the
+    member list so its client fails over on leader loss."""
+    docs = _render()
+    members = ",".join(
+        f"vpp-tpu-store-{i}.vpp-tpu-store.kube-system.svc:12379"
+        for i in range(3))
+
+    store = next(d for d in docs if d["kind"] == "StatefulSet")
+    assert store["spec"]["replicas"] == 3
+    assert store["spec"]["podManagementPolicy"] == "Parallel"
+    container = store["spec"]["template"]["spec"]["containers"][0]
+    args = container["args"]
+    assert args[args.index("--join") + 1] == members
+    assert args[args.index("--advertise") + 1] == (
+        "$(POD_NAME).vpp-tpu-store.kube-system.svc:12379")
+    assert any(e["name"] == "POD_NAME" for e in container["env"])
+    svc = next(d for d in docs if d["kind"] == "Service"
+               and d["metadata"]["name"] == "vpp-tpu-store")
+    assert svc["spec"]["publishNotReadyAddresses"] is True
+
+    # Every consumer gets the full member list.
+    ksr = next(d for d in docs if d["kind"] == "Deployment"
+               and d["metadata"]["name"] == "vpp-tpu-ksr")
+    assert members in ksr["spec"]["template"]["spec"]["containers"][0]["args"]
+    agent = next(d for d in docs if d["kind"] == "DaemonSet")
+    assert f"--store={members}" in (
+        agent["spec"]["template"]["spec"]["containers"][0]["args"])
+
+    # The static manifest carries the same ensemble shape.
+    import yaml
+
+    static = list(yaml.safe_load_all(
+        (REPO / "deploy" / "k8s" / "vpp-tpu.yaml").read_text()))
+    sstore = next(d for d in static if d and d["kind"] == "StatefulSet")
+    assert sstore["spec"]["replicas"] == 3
+    sargs = sstore["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert f"--join={members}" in sargs
+
+
+def test_chart_single_replica_store_renders_without_join():
+    """--set store.replicas=1 is the dev form: no ensemble flags, and
+    consumers address the plain headless service."""
+    docs = _render("--set", "store.replicas=1")
+    store = next(d for d in docs if d["kind"] == "StatefulSet")
+    assert store["spec"]["replicas"] == 1
+    args = store["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--join" not in args and "--advertise" not in args
+    agent = next(d for d in docs if d["kind"] == "DaemonSet")
+    assert "--store=vpp-tpu-store.kube-system.svc:12379" in (
+        agent["spec"]["template"]["spec"]["containers"][0]["args"])
+
+
 def test_chart_options_render(tmp_path):
     values = tmp_path / "values.yaml"
     values.write_text(

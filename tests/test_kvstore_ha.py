@@ -1,0 +1,386 @@
+"""HA replicated kvstore (VERDICT r5 "missing" #4): lease election,
+ordered log replication with identical revisions, snapshot catch-up,
+multi-address client failover, and the acceptance bar — a 3-replica
+ensemble surviving SIGKILL of its leader in separate OS processes."""
+
+import json
+import os
+import select
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from vpp_tpu.kvstore import KVStore, RemoteKVStore
+from vpp_tpu.kvstore.election import (
+    ElectionConfig,
+    ElectionState,
+    PeerStatus,
+    Role,
+    pick_leader,
+)
+from vpp_tpu.kvstore.ha import ELECTION_KEY, HAEnsemble
+from vpp_tpu.testing.cluster import timeout_mult, wait_for
+
+
+def _peer(rid, role="follower", term=1, last_index=0, last_term=0,
+          revision=0, leader="", address=None):
+    return PeerStatus(
+        replica_id=rid, address=address or f"127.0.0.1:{9000 + rid}",
+        role=role, term=term, last_index=last_index, last_term=last_term,
+        revision=revision, leader=leader,
+    )
+
+
+# ---------------------------------------------------------- election logic
+
+
+def test_candidate_needs_quorum_to_win():
+    el = ElectionState(0, ElectionConfig())
+    el.start_campaign()
+    # 1 of 3 reachable (itself): must NOT become leader.
+    assert el.decide(_peer(0, role="candidate"), [None, None], 3) \
+        is not Role.LEADER
+    # 2 of 3 reachable and self is max rank: wins, term bumps.
+    el.start_campaign()
+    role = el.decide(_peer(0, role="candidate", last_index=5),
+                     [_peer(1, last_index=3), None], 3)
+    assert role is Role.LEADER and el.term == 1
+
+
+def test_candidate_defers_to_higher_ranked_log():
+    """A replica missing committed entries can never take over — the
+    committed-write-survival invariant."""
+    el = ElectionState(0, ElectionConfig())
+    el.start_campaign()
+    role = el.decide(_peer(0, role="candidate", last_index=3),
+                     [_peer(1, last_index=7)], 3)
+    assert role is Role.FOLLOWER
+
+
+def test_candidate_defers_to_sitting_leader_and_ties_break_on_id():
+    el = ElectionState(0, ElectionConfig())
+    el.start_campaign()
+    role = el.decide(_peer(0, role="candidate"),
+                     [_peer(2, role="leader", term=4,
+                            address="127.0.0.1:9002")], 3)
+    assert role is Role.FOLLOWER and el.leader == "127.0.0.1:9002"
+    assert el.term == 4
+    # Equal logs: the higher replica_id outranks (deterministic tie).
+    el2 = ElectionState(1, ElectionConfig())
+    el2.start_campaign()
+    assert el2.decide(_peer(1, role="candidate"), [_peer(2)], 3) \
+        is Role.FOLLOWER
+
+
+def test_stale_leader_heartbeat_rejected():
+    el = ElectionState(0, ElectionConfig())
+    el.term = 5
+    assert not el.observe_heartbeat(4, "127.0.0.1:9001")
+    assert el.observe_heartbeat(5, "127.0.0.1:9001")
+    assert el.leader == "127.0.0.1:9001"
+
+
+def test_pick_leader_prefers_reported_then_followed_then_rank():
+    assert pick_leader([None, None]) is None
+    assert pick_leader([
+        _peer(0, role="leader", term=3, address="a:1"),
+        _peer(1, role="leader", term=5, address="b:2"),
+    ]) == "b:2"
+    assert pick_leader([
+        _peer(0, leader="c:3"), _peer(1, leader="c:3"), _peer(2, leader="d:4"),
+    ]) == "c:3"
+    assert pick_leader([
+        _peer(0, last_index=2, address="a:1"),
+        _peer(1, last_index=9, address="b:2"),
+    ]) == "b:2"
+
+
+# ------------------------------------------------------- store event replay
+
+
+def test_watch_since_replays_missed_events_atomically():
+    store = KVStore()
+    store.put("/a/1", {"v": 1})
+    store.put("/b/1", {"v": 1})   # other prefix: filtered from replay
+    store.put("/a/2", {"v": 2})
+    w, missed = store.watch_since(["/a/"], since_revision=1)
+    assert [ev.key for ev in missed] == ["/a/2"]
+    # Registered atomically: the next change streams live.
+    store.put("/a/3", {"v": 3})
+    assert w.get(timeout=2.0).key == "/a/3"
+
+
+def test_watch_since_gap_beyond_log_requires_resync():
+    store = KVStore(log_capacity=2)
+    for i in range(5):
+        store.put(f"/a/{i}", {"v": i})
+    w, missed = store.watch_since(["/a/"], since_revision=1)
+    assert missed is None  # revisions 2-3 fell off the bounded log
+    w2, missed2 = store.watch_since(["/a/"], since_revision=3)
+    assert [ev.revision for ev in missed2] == [4, 5]
+
+
+# --------------------------------------------------- in-process ensemble
+
+
+@pytest.fixture()
+def ensemble():
+    ens = HAEnsemble(3, heartbeat_interval=0.05,
+                     lease_timeout=0.4 * timeout_mult())
+    yield ens
+    ens.stop()
+
+
+def test_replication_keeps_replicas_identical(ensemble):
+    leader = ensemble.wait_leader()
+    client = ensemble.client(timeout=2.0)
+    try:
+        client.put("/vpp-tpu/ksr/pod/default/web-1", {"ip": "10.1.1.2"})
+        assert client.put_if_not_exists("/vpp-tpu/nodesync/vppnode/1", {"id": 1})
+        assert not client.put_if_not_exists("/vpp-tpu/nodesync/vppnode/1", {"id": 9})
+        client.put("/vpp-tpu/ksr/pod/default/web-2", {"ip": "10.1.1.3"})
+        assert client.delete("/vpp-tpu/ksr/pod/default/web-2")
+        assert client.compare_and_delete("/vpp-tpu/nodesync/vppnode/1", {"id": 1})
+        # Same ops in the same order -> identical contents AND revisions.
+        rev = leader.store.revision
+        assert wait_for(lambda: all(
+            r.store.snapshot_with_revision([""]) ==
+            leader.store.snapshot_with_revision([""])
+            for r in ensemble.replicas
+        ), timeout=5.0)
+        assert all(r.store.revision == rev for r in ensemble.replicas)
+        # The sitting leader published itself under the election key.
+        assert client.get(ELECTION_KEY)["address"] == leader.address
+    finally:
+        client.close()
+
+
+def test_follower_rejects_client_ops_with_leader_hint(ensemble):
+    leader = ensemble.wait_leader()
+    follower = next(r for r in ensemble.replicas if r is not leader)
+    import grpc
+
+    from vpp_tpu.kvstore.remote import not_leader_hint
+
+    # The follower adopts the leader on its first heartbeat — give the
+    # announcement a beat to land before asserting the hint's value.
+    assert wait_for(lambda: follower.status()["leader"] == leader.address)
+    direct = RemoteKVStore(follower.address, timeout=2.0)
+    try:
+        with pytest.raises(grpc.RpcError) as err:
+            direct.put("/x", {"v": 1})
+        assert not_leader_hint(err.value) == leader.address
+        with pytest.raises(grpc.RpcError):
+            direct.get("/x")  # reads are leader-gated too (lease reads)
+        # The follower-readable surface still serves its local view.
+        dump = direct.local_dump("")
+        assert dump["role"] == "follower"
+    finally:
+        direct.close()
+
+
+def test_client_failover_is_transparent_for_idempotent_ops(ensemble):
+    """Kill the leader while a client writes: no caller-visible
+    exception, the write lands on the new leader."""
+    ensemble.wait_leader()
+    client = ensemble.client(timeout=1.0,
+                             failover_deadline=15.0 * timeout_mult())
+    try:
+        client.put("/vpp-tpu/test/before", {"v": 1})
+        dead = ensemble.kill_leader()
+        client.put("/vpp-tpu/test/during", {"v": 2})  # must not raise
+        new = ensemble.wait_leader(timeout=10.0 * timeout_mult())
+        assert new.address != dead.address
+        assert client.get("/vpp-tpu/test/during") == {"v": 2}
+        assert client.get("/vpp-tpu/test/before") == {"v": 1}
+    finally:
+        client.close()
+
+
+def test_watcher_resumes_from_last_revision_across_failover(ensemble):
+    ensemble.wait_leader()
+    client = ensemble.client(timeout=1.0,
+                             failover_deadline=15.0 * timeout_mult())
+    try:
+        watcher = client.watch(["/vpp-tpu/test/"])
+        assert watcher.wait_subscribed(5.0)
+        client.put("/vpp-tpu/test/a", {"v": 1})
+        assert watcher.get(timeout=5.0).key == "/vpp-tpu/test/a"
+        ensemble.kill_leader()
+        # Committed while the watcher's stream is re-homing: the
+        # re-subscription replays it from the new leader's event log.
+        client.put("/vpp-tpu/test/b", {"v": 2})
+        client.put("/vpp-tpu/test/c", {"v": 3})
+        seen = []
+        deadline = time.time() + 15.0 * timeout_mult()
+        while len(seen) < 2 and time.time() < deadline:
+            ev = watcher.get(timeout=0.5)
+            if ev is not None:
+                seen.append(ev)
+        assert [ev.key for ev in seen] == ["/vpp-tpu/test/b", "/vpp-tpu/test/c"]
+        revs = [ev.revision for ev in seen]
+        assert revs == sorted(revs)
+    finally:
+        client.close()
+
+
+def test_killed_replica_rejoins_and_catches_up(ensemble):
+    ensemble.wait_leader()
+    client = ensemble.client(timeout=1.0,
+                             failover_deadline=15.0 * timeout_mult())
+    try:
+        client.put("/vpp-tpu/test/a", {"v": 1})
+        dead = ensemble.kill_leader()
+        client.put("/vpp-tpu/test/b", {"v": 2})
+        new = ensemble.wait_leader(timeout=10.0 * timeout_mult())
+        back = ensemble.restart(dead.address)
+        # Snapshot catch-up: contents AND revision converge to the
+        # leader's (read-your-writes for a rejoined follower's view).
+        assert wait_for(
+            lambda: back.store.snapshot_with_revision([""])
+            == new.store.snapshot_with_revision([""]),
+            timeout=10.0,
+        )
+        assert back.role is Role.FOLLOWER
+    finally:
+        client.close()
+
+
+# ------------------------------------------- OS-process SIGKILL acceptance
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _spawn_replica(port, members, lease):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "vpp_tpu.kvstore",
+         "--host", "127.0.0.1", "--port", str(port),
+         "--join", members,
+         "--heartbeat-interval", "0.1", "--lease-timeout", str(lease)],
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    # One JSON status line proves the server bound.
+    deadline = time.time() + 30 * timeout_mult()
+    buf = b""
+    while b"\n" not in buf and time.time() < deadline:
+        ready, _, _ = select.select([proc.stdout], [], [], 0.2)
+        if ready:
+            chunk = proc.stdout.read1(4096)
+            if not chunk and proc.poll() is not None:
+                raise RuntimeError(f"replica :{port} died rc={proc.returncode}")
+            buf += chunk
+    status = json.loads(buf.split(b"\n")[0])
+    assert status["ensemble"]
+    return proc
+
+
+def test_three_process_ensemble_survives_leader_sigkill(tmp_path):
+    """The acceptance bar: 3 OS-process replicas, SIGKILL the leader —
+    a follower is elected within the lease window, the multi-address
+    client fails over with no caller-visible exception, the watcher
+    resumes at its last revision, and after the corpse rejoins all
+    three replicas report identical revision and snapshot contents."""
+    lease = 0.6 * timeout_mult()
+    ports = _free_ports(3)
+    members = ",".join(f"127.0.0.1:{p}" for p in ports)
+    procs = {p: _spawn_replica(p, members, lease) for p in ports}
+    client = RemoteKVStore(members, timeout=1.0,
+                           failover_deadline=20.0 * timeout_mult())
+
+    def leader_address():
+        for addr in members.split(","):
+            try:
+                st = client.ha_status(addr)
+            except Exception:  # noqa: BLE001 - replica still starting
+                continue
+            if st["role"] == "leader":
+                return addr
+        return None
+
+    try:
+        assert wait_for(lambda: leader_address() is not None, timeout=30.0), \
+            "no initial leader"
+        watcher = client.watch(["/vpp-tpu/test/"])
+        assert watcher.wait_subscribed(10.0)
+
+        written = []
+        for i in range(5):
+            client.put(f"/vpp-tpu/test/k{i:02d}", {"v": i})
+            written.append(f"/vpp-tpu/test/k{i:02d}")
+
+        # ---- SIGKILL the leader -----------------------------------------
+        old_leader = leader_address()
+        old_port = int(old_leader.rsplit(":", 1)[1])
+        procs[old_port].kill()  # SIGKILL
+        procs[old_port].wait(timeout=10)
+        t_kill = time.time()
+
+        # Transparent failover: the idempotent writes keep landing with
+        # NO caller-visible exception while the election runs.
+        for i in range(5, 10):
+            client.put(f"/vpp-tpu/test/k{i:02d}", {"v": i})
+            written.append(f"/vpp-tpu/test/k{i:02d}")
+
+        # A follower took over within the lease window (generous x10
+        # margin: process scheduling + probe RPCs are in the path).
+        assert wait_for(
+            lambda: leader_address() not in (None, old_leader),
+            timeout=10 * lease + 5.0,
+        ), "no new leader elected"
+        elected_in = time.time() - t_kill
+        assert elected_in < 10 * lease + 5.0
+
+        # The watcher resumed from its last revision: every written key
+        # arrives exactly once, in revision order.
+        seen = []
+        deadline = time.time() + 20 * timeout_mult()
+        while len(seen) < len(written) and time.time() < deadline:
+            ev = watcher.get(timeout=0.5)
+            if ev is not None:
+                seen.append(ev)
+        assert [ev.key for ev in seen] == written
+        revs = [ev.revision for ev in seen]
+        assert revs == sorted(revs) and len(set(revs)) == len(revs)
+
+        # ---- rejoin the corpse ------------------------------------------
+        procs[old_port] = _spawn_replica(old_port, members, lease)
+
+        def converged():
+            views = []
+            for addr in members.split(","):
+                try:
+                    dump = client.local_dump("", address=addr)
+                except Exception:  # noqa: BLE001 - still catching up
+                    return False
+                views.append((dump["revision"], tuple(
+                    (k, json.dumps(v, sort_keys=True, default=str))
+                    for k, v in dump["items"]
+                )))
+            return len(set(views)) == 1
+
+        assert wait_for(converged, timeout=30.0), \
+            "replicas did not converge to identical revision + contents"
+    finally:
+        client.close()
+        for proc in procs.values():
+            proc.kill()
+            proc.wait(timeout=10)

@@ -3,6 +3,7 @@
 import io
 import json
 import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -54,6 +55,7 @@ def agent():
         podmanager=podmanager,
         scheduler=scheduler,
         stats_registry=registry,
+        store=store,
     )
     port = rest.start()
     yield store, podmanager, stats, f"127.0.0.1:{port}"
@@ -105,6 +107,54 @@ def test_metrics_exposition(agent):
     assert 'inPackets{interfaceName="tap-default-web-1"' in text
 
 
+def test_store_dump_and_classes(agent):
+    """`/contiv/v1/store` is the arbitrary-keyspace dump with key-class
+    selection the `netctl dump --key-class` verb rides (the reference's
+    vppdump data source): the agent's own view of the cluster store."""
+    store, _, _, server = agent
+    store.put("/vpp-tpu/ksr/k8s/pod/default/web-1", {"podIP": "10.1.1.3"})
+    everything = _get(server, "/contiv/v1/store?prefix=")
+    assert any(i["key"].endswith("pod/default/web-1") for i in everything)
+    pods_only = _get(server, "/contiv/v1/store?prefix=/vpp-tpu/ksr/k8s/pod/")
+    assert {i["key"] for i in pods_only} == {"/vpp-tpu/ksr/k8s/pod/default/web-1"}
+    assert pods_only[0]["value"] == {"podIP": "10.1.1.3"}
+    classes = _get(server, "/contiv/v1/store/classes")
+    by_keyword = {c["keyword"]: c["prefix"] for c in classes}
+    assert by_keyword["pod"] == "/vpp-tpu/ksr/k8s/pod/"
+    assert by_keyword["external-config"] == "/vpp-tpu/external-config/"
+
+
+def test_runtime_log_level_control(agent):
+    """GET /logging lists every vpp_tpu component logger; POST sets one
+    at runtime (the cn-infra logmanager analog)."""
+    import logging
+
+    _, _, _, server = agent
+    target = logging.getLogger("vpp_tpu.policy")
+    before = target.level
+    try:
+        levels = _get(server, "/logging")
+        assert "vpp_tpu" in levels
+        assert set(levels["vpp_tpu"]) == {"level", "inherited"}
+        req = urllib.request.Request(
+            f"http://{server}/logging?logger=vpp_tpu.policy&level=debug",
+            method="POST")
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert json.loads(r.read().decode()) == {
+                "logger": "vpp_tpu.policy", "level": "DEBUG"}
+        assert target.level == logging.DEBUG
+        after = _get(server, "/logging")["vpp_tpu.policy"]
+        assert after == {"level": "DEBUG", "inherited": False}
+        # Non-component loggers and junk levels are rejected, not set.
+        for bad in ("/logging?logger=urllib3&level=DEBUG",
+                    "/logging?logger=vpp_tpu.policy&level=LOUD"):
+            req = urllib.request.Request(f"http://{server}{bad}", method="POST")
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(req, timeout=5)
+    finally:
+        target.setLevel(before)
+
+
 def test_resync_trigger(agent):
     _, _, _, server = agent
     req = urllib.request.Request(f"http://{server}/controller/resync", method="POST")
@@ -128,6 +178,38 @@ class TestNetctl:
             rc = netctl_main(command + ["--server", server], out=out)
             assert rc == 0, command
             assert needle in out.getvalue(), (command, out.getvalue())
+
+    def test_dump_key_class_and_log_verbs(self, agent):
+        """`netctl dump --key-class` (the vppdump analog: arbitrary
+        keyspace, any node) and `netctl log` (runtime levels)."""
+        import logging
+
+        store, _, _, server = agent
+        store.put("/vpp-tpu/ksr/k8s/pod/default/web-1", {"podIP": "10.1.1.3"})
+        out = io.StringIO()
+        assert netctl_main(["dump", "--key-classes", "--server", server],
+                           out=out) == 0
+        assert "/vpp-tpu/ksr/k8s/pod/" in out.getvalue()
+        out = io.StringIO()
+        assert netctl_main(["dump", "--key-class", "/vpp-tpu/ksr/k8s/pod/",
+                            "--server", server], out=out) == 0
+        assert "web-1" in out.getvalue()
+        assert "10.1.1.3" in out.getvalue()
+
+        target = logging.getLogger("vpp_tpu.ipam")
+        before = target.level
+        try:
+            out = io.StringIO()
+            assert netctl_main(["log", "vpp_tpu.ipam", "warning",
+                                "--server", server], out=out) == 0
+            assert "vpp_tpu.ipam -> WARNING" in out.getvalue()
+            assert target.level == logging.WARNING
+            out = io.StringIO()
+            assert netctl_main(["log", "--server", server], out=out) == 0
+            assert "vpp_tpu.ipam" in out.getvalue()
+            assert "WARNING" in out.getvalue()
+        finally:
+            target.setLevel(before)
 
     def test_unreachable_server(self):
         rc = netctl_main(["nodes", "--server", "127.0.0.1:1"], out=io.StringIO())

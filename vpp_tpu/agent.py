@@ -173,6 +173,7 @@ class Agent:
             scheduler=self.scheduler,
             tracer=self.runner.tracer if self.runner else None,
             datapath=lambda: self.runner,
+            store=self.store,
             host="0.0.0.0" if rest_port else "127.0.0.1",
             port=rest_port,
         )

@@ -1,8 +1,8 @@
 from .store import KVStore, WatchEvent, Watcher, TxnFailed
 from .mirror import LocalMirror
-from .remote import KVStoreServer, RemoteKVStore
+from .remote import KVStoreServer, LeaderUnavailable, RemoteKVStore
 
 __all__ = [
     "KVStore", "WatchEvent", "Watcher", "TxnFailed",
-    "LocalMirror", "KVStoreServer", "RemoteKVStore",
+    "LocalMirror", "KVStoreServer", "RemoteKVStore", "LeaderUnavailable",
 ]

@@ -10,6 +10,18 @@ same gRPC surface the agents consume:
 ``--snapshot`` persists every change to a sqlite snapshot and reloads
 it on startup (the etcd-data-volume analog), so a store restart
 recovers the cluster state without waiting for KSR to re-reflect.
+
+HA mode (the CLUSTERED etcd analog — vpp_tpu/kvstore/ha.py):
+
+    python -m vpp_tpu.kvstore --port 12379 \\
+        --join host1:12379,host2:12379,host3:12379
+
+starts this process as one member of an N-replica ensemble: lease-based
+leader election, ordered log replication, follower snapshot catch-up.
+``--join`` lists EVERY member (self included — matched via
+``--advertise``, or inferred when exactly ONE member's port equals
+``--port``; ambiguous inference is an error, not a guess).  ``--replica-of host:port`` instead asks a running member
+for the ensemble list and joins it (the rejoin convenience).
 """
 
 from __future__ import annotations
@@ -20,17 +32,45 @@ import signal
 import sys
 import threading
 
-from .remote import DEFAULT_PORT, KVStoreServer
-from .store import KVStore
+
+def _resolve_advertise(args, members) -> str:
+    """The address this replica appears as inside --join."""
+    if args.advertise:
+        return args.advertise
+    candidates = [m for m in members if m.endswith(f":{args.port}")]
+    if len(candidates) == 1:
+        return candidates[0]
+    raise SystemExit(
+        "cannot infer this replica's address from --join "
+        f"(port {args.port} matches {len(candidates)} members); "
+        "pass --advertise host:port"
+    )
 
 
 def main(argv=None) -> int:
+    from .remote import DEFAULT_PORT, KVStoreServer, RemoteKVStore
+    from .store import KVStore
+
     parser = argparse.ArgumentParser(description="vpp-tpu cluster store server")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=DEFAULT_PORT)
     parser.add_argument("--snapshot", default="",
                         help="sqlite snapshot path (persistence across restarts)")
     parser.add_argument("--max-watchers", type=int, default=64)
+    parser.add_argument("--join", default="",
+                        help="comma-separated FULL ensemble member list "
+                             "(self included) — starts HA replica mode")
+    parser.add_argument("--replica-of", default="",
+                        help="address of a running ensemble member to "
+                             "fetch the member list from and join")
+    parser.add_argument("--advertise", default="",
+                        help="this replica's address as listed in --join "
+                             "(inferred from --port when unambiguous)")
+    parser.add_argument("--heartbeat-interval", type=float, default=0.1,
+                        help="leader heartbeat period, seconds")
+    parser.add_argument("--lease-timeout", type=float, default=0.5,
+                        help="leader lease; followers campaign after this "
+                             "long without a heartbeat")
     args = parser.parse_args(argv)
 
     store = KVStore()
@@ -63,17 +103,48 @@ def main(argv=None) -> int:
 
         threading.Thread(target=persist, name="store-persist", daemon=True).start()
 
-    server = KVStoreServer(store, host=args.host, port=args.port,
-                           max_watchers=args.max_watchers)
-    port = server.start()
+    members = [m.strip() for m in args.join.split(",") if m.strip()]
+    if args.replica_of and not members:
+        probe = RemoteKVStore(args.replica_of, timeout=5.0)
+        try:
+            members = probe.ha_status(args.replica_of)["peers"]
+        finally:
+            probe.close()
+
+    replica = None
+    if members:
+        from .ha import HAReplica
+
+        replica = HAReplica(
+            host=args.host, port=args.port,
+            advertise=_resolve_advertise(args, members),
+            store=store,
+            heartbeat_interval=args.heartbeat_interval,
+            lease_timeout=args.lease_timeout,
+            max_watchers=args.max_watchers,
+        )
+        replica.bind()
+        replica.join(members)
+        server = replica.server
+        port = server.port
+    else:
+        server = KVStoreServer(store, host=args.host, port=args.port,
+                               max_watchers=args.max_watchers)
+        port = server.start()
     print(json.dumps({"store": f"{args.host}:{port}",
-                      "snapshot": args.snapshot or None}), flush=True)
+                      "snapshot": args.snapshot or None,
+                      "ensemble": members or None,
+                      "advertise": replica.address if replica else None}),
+          flush=True)
 
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())
     stop.wait()
-    server.stop()
+    if replica is not None:
+        replica.stop()
+    else:
+        server.stop()
     return 0
 
 

@@ -1,0 +1,209 @@
+"""Lease-based leader election for the replicated cluster store.
+
+The reference rides clustered etcd, whose Raft gives it one leader per
+term and ordered replication (SURVEY layer map: "Cluster state store —
+etcd").  This module is the election half of the framework's analog
+(:mod:`.ha` holds the replication half): a deterministic, lease-based
+state machine kept free of I/O so every transition is unit-testable —
+the replica drives it with peer statuses gathered over gRPC.
+
+Protocol, in one paragraph: the leader asserts its lease by replicating
+(possibly empty) log heartbeats every ``heartbeat_interval``; a
+follower whose lease expires (no heartbeat for ``lease_timeout``)
+campaigns by polling every peer's status.  A candidate wins only when
+it can see a MAJORITY of the ensemble (itself included) and no
+reachable peer outranks it — rank is ``(last_term, last_index,
+revision, replica_id)``, so a replica missing committed log entries can
+never take over (the committed-write-survival invariant), and equal
+logs tie-break deterministically on replica id, converging concurrent
+candidacies without randomized retry.  A leader that cannot reach a
+majority for a full lease steps down (the partitioned-leader fence:
+its writes already fail the majority-ack gate, stepping down stops it
+serving stale reads forever).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+class Role(enum.Enum):
+    FOLLOWER = "follower"
+    CANDIDATE = "candidate"
+    LEADER = "leader"
+
+
+@dataclasses.dataclass(frozen=True)
+class PeerStatus:
+    """One replica's election-relevant state, as reported over gRPC."""
+
+    replica_id: int
+    address: str
+    role: str            # Role.value
+    term: int
+    last_index: int      # replication log position
+    last_term: int       # term of the last log entry
+    revision: int        # store revision (tie-breaker rank component)
+    leader: str = ""     # the leader this replica currently follows
+
+    def rank(self) -> Tuple[int, int, int, int]:
+        """Election rank: log position first (committed entries must
+        survive), then store revision, then id as the deterministic
+        tie-break."""
+        return (self.last_term, self.last_index, self.revision, self.replica_id)
+
+    @classmethod
+    def from_dict(cls, status: dict) -> "PeerStatus":
+        """Build from a ``HaStatus`` wire dict (ignores extra keys)."""
+        return cls(
+            replica_id=status["replica_id"], address=status["address"],
+            role=status["role"], term=status["term"],
+            last_index=status["last_index"], last_term=status["last_term"],
+            revision=status["revision"], leader=status.get("leader", ""),
+        )
+
+
+@dataclasses.dataclass
+class ElectionConfig:
+    heartbeat_interval: float = 0.1
+    lease_timeout: float = 0.5
+
+    def stagger(self, replica_id: int) -> float:
+        """Per-replica candidacy delay added to the lease check, so
+        replicas don't all campaign on the same tick (the deterministic
+        rank converges ties anyway; the stagger just avoids the poll
+        storm)."""
+        return 0.3 * self.heartbeat_interval * (replica_id % 8)
+
+
+class ElectionState:
+    """The per-replica election bookkeeping.
+
+    All methods are synchronous and side-effect-free beyond their own
+    fields; the owning replica supplies the clock (``now``) so tests
+    can drive time explicitly.
+    """
+
+    def __init__(self, replica_id: int, config: Optional[ElectionConfig] = None):
+        self.replica_id = replica_id
+        self.config = config or ElectionConfig()
+        self.role = Role.FOLLOWER
+        self.term = 0
+        self.leader: str = ""
+        self._lease_deadline = 0.0
+
+    # ------------------------------------------------------------- lease
+
+    def touch_lease(self, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        self._lease_deadline = (
+            now + self.config.lease_timeout + self.config.stagger(self.replica_id)
+        )
+
+    def lease_expired(self, now: Optional[float] = None) -> bool:
+        now = time.monotonic() if now is None else now
+        return now >= self._lease_deadline
+
+    # ------------------------------------------------------- transitions
+
+    def observe_heartbeat(self, term: int, leader: str,
+                          now: Optional[float] = None) -> bool:
+        """A replication call arrived from ``leader``.  Accept (renew
+        the lease, adopt the term, follow) iff the term is current or
+        newer; a stale leader is rejected so it learns to step down.
+
+        Within ONE term the first leader followed is sticky: an
+        equal-term heartbeat from a DIFFERENT leader is rejected while
+        our current leader's lease holds.  Without this, concurrent
+        same-term winners under an asymmetric partition would both
+        keep harvesting this replica's acks (each heartbeat re-homing
+        it), both sustain "quorum", and one could snapshot away
+        writes the other had already quorum-acknowledged.  The loser
+        bleeds acks, fails its quorum gate, and steps down instead."""
+        if term < self.term:
+            return False
+        if term == self.term and self.role is Role.FOLLOWER \
+                and self.leader and self.leader != leader:
+            return False
+        if term > self.term or self.role is not Role.FOLLOWER \
+                or self.leader != leader:
+            self.term = term
+            self.role = Role.FOLLOWER
+            self.leader = leader
+        self.touch_lease(now)
+        return True
+
+    def start_campaign(self) -> None:
+        self.role = Role.CANDIDATE
+        self.leader = ""
+
+    def decide(self, me: PeerStatus, peers: Iterable[Optional[PeerStatus]],
+               ensemble_size: int) -> Role:
+        """One candidacy round: given the statuses gathered from every
+        OTHER ensemble member (None = unreachable), either win, defer to
+        an existing leader, or stand down and wait.
+
+        Mutates role/term/leader accordingly and returns the new role.
+        """
+        reachable: List[PeerStatus] = [p for p in peers if p is not None]
+        # Defer to any live leader at our term or newer.
+        for p in reachable:
+            if p.role == Role.LEADER.value and p.term >= self.term:
+                self.observe_heartbeat(p.term, p.address)
+                return self.role
+            if p.leader and p.leader != me.address and p.term >= self.term:
+                # A peer follows an equal-or-newer-term leader we could
+                # not reach ourselves; wait for that leader's heartbeat
+                # (or the peer's lease on it to lapse) rather than
+                # elect AROUND it — winning here could seat a second
+                # same-or-next-term leader that snapshots away entries
+                # the followed leader already quorum-acknowledged.
+                self.term = max(self.term, p.term)
+                self.role = Role.FOLLOWER
+                self.touch_lease()
+                return self.role
+        if (len(reachable) + 1) * 2 <= ensemble_size:
+            # No quorum visible: keep candidating (a lone replica can
+            # never elect itself — the split-brain fence).
+            self.role = Role.CANDIDATE
+            return self.role
+        if any(p.rank() > me.rank() for p in reachable):
+            # An outranking replica is alive; let it win.  Refresh our
+            # lease so we re-campaign only if it fails to take over.
+            self.role = Role.FOLLOWER
+            self.touch_lease()
+            return self.role
+        self.role = Role.LEADER
+        self.term += 1
+        self.leader = me.address
+        return self.role
+
+    def step_down(self) -> None:
+        self.role = Role.FOLLOWER
+        self.leader = ""
+        self.touch_lease()
+
+
+def pick_leader(statuses: Iterable[Optional[PeerStatus]]) -> Optional[str]:
+    """The address a CLIENT should talk to, given whatever statuses it
+    could gather: a reported leader at the highest term wins; with no
+    self-reported leader, the highest-ranked replica is the best guess
+    (it is the one the ensemble will elect)."""
+    live = [s for s in statuses if s is not None]
+    if not live:
+        return None
+    leaders = [s for s in live if s.role == Role.LEADER.value]
+    if leaders:
+        return max(leaders, key=lambda s: s.term).address
+    followed = [s.leader for s in live if s.leader]
+    if followed:
+        # Majority-followed leader hint (the leader itself may be
+        # unreachable from the client but not from its followers).
+        counts: Dict[str, int] = {}
+        for addr in followed:
+            counts[addr] = counts.get(addr, 0) + 1
+        return max(counts, key=lambda a: counts[a])
+    return max(live, key=lambda s: s.rank()).address

@@ -1,0 +1,750 @@
+"""HA replicated cluster store — the clustered-etcd analog.
+
+The reference deploys etcd as a multi-member cluster (contiv-etcd
+StatefulSet) so the cluster state store survives a master crash; the
+framework's single ``KVStoreServer`` process had no such story
+(VERDICT r5 "missing" #4).  This module adds it:
+
+- an N-replica ensemble where ONE leader (elected by the lease protocol
+  in :mod:`.election`) serves every client op and replicates each
+  mutation as an ordered log of ``put`` / ``delete`` /
+  ``put_if_not_exists`` / ``compare_and_delete`` entries to its
+  followers — every replica applies the same ops in the same order to
+  the same starting state, so store contents AND revisions stay
+  bit-identical across the ensemble;
+- a quorum-ack commit gate: the leader answers a client write only
+  after a majority of replicas (itself included) hold the entry, so an
+  acknowledged write survives any single-replica SIGKILL — the next
+  leader is always the highest-ranked log, which must contain it;
+- snapshot catch-up: a follower whose log position cannot be reconciled
+  entry-by-entry (fresh join, rejoin after a crash, deposed leader with
+  an uncommitted suffix) receives one wholesale snapshot install and
+  then follows the log again;
+- follower client-op rejection with a leader hint
+  (``NOT_LEADER leader=<addr>``), which is what the multi-address
+  ``RemoteKVStore`` failover re-homes on.
+
+Leader reads are lease-bounded: a partitioned leader stops serving
+after ``lease_timeout`` without follower quorum (it steps down), so
+stale reads are bounded by the lease — the same trade clustered etcd
+makes for lease-based (non-quorum) reads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from concurrent import futures as _futures
+from typing import Any, Callable, Dict, List, Optional
+
+import grpc
+
+from .election import ElectionConfig, ElectionState, PeerStatus, Role
+from .remote import (
+    NO_QUORUM_PREFIX,
+    NOT_LEADER_PREFIX,
+    OUTAGE_CODES,
+    KVStoreServer,
+    _code_of,
+    _Target,
+    channel_ready,
+)
+from .store import KVStore
+
+log = logging.getLogger(__name__)
+
+# The replicated key the sitting leader publishes itself under — the
+# observability/debug surface for "who is leader" (clients re-home on
+# NOT_LEADER hints and need no key read; netctl and tests read this).
+ELECTION_KEY = "/vpp-tpu/ha/leader"
+
+
+class NotLeader(Exception):
+    """This replica cannot serve a client op; ``leader`` is its best
+    hint for who can ("" while an election is running)."""
+
+    def __init__(self, leader: str = ""):
+        super().__init__(f"not the leader (leader={leader or '?'})")
+        self.leader = leader
+
+
+class NoQuorum(Exception):
+    """A write could not be acknowledged by a replica majority."""
+
+
+@dataclasses.dataclass(frozen=True)
+class LogEntry:
+    """One replicated mutation.  ``index`` is dense and 1-based; the
+    (index, term) pair is the replication cursor replicas reconcile on."""
+
+    index: int
+    term: int
+    op: str
+    args: Dict[str, Any]
+
+    def to_wire(self) -> dict:
+        return {"index": self.index, "term": self.term,
+                "op": self.op, "args": self.args}
+
+    @staticmethod
+    def from_wire(msg: dict) -> "LogEntry":
+        return LogEntry(index=msg["index"], term=msg["term"],
+                        op=msg["op"], args=msg["args"])
+
+
+class _FollowerState:
+    """Leader-side bookkeeping for one follower.
+
+    Raft's nextIndex/matchIndex split: ``next`` is the optimistic push
+    cursor (where to slice the log for the next Replicate), ``match``
+    is confirmed replication — raised ONLY by a Replicate/
+    InstallSnapshot response.  commit() quorum-counts ``match`` alone;
+    counting an optimistic cursor would let a deposed-and-re-elected
+    leader acknowledge a write no follower holds."""
+
+    def __init__(self, next_index: int):
+        self.next = next_index        # optimistic log-slice cursor
+        self.match = 0                # highest index confirmed by an RPC ack
+        self.acked_at = 0.0           # monotonic time of the last ack
+        self.lock = threading.Lock()  # serializes pushes to this follower
+
+
+class HAReplica:
+    """One member of the replicated store ensemble."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        advertise: str = "",
+        store: Optional[KVStore] = None,
+        heartbeat_interval: float = 0.1,
+        lease_timeout: float = 0.5,
+        log_capacity: int = 4096,
+        max_watchers: int = 64,
+    ):
+        self.store = store if store is not None else KVStore()
+        self._advertise = advertise
+        self.server = ReplicaServer(self, host=host, port=port,
+                                    max_watchers=max_watchers)
+        self._config = ElectionConfig(heartbeat_interval=heartbeat_interval,
+                                      lease_timeout=lease_timeout)
+        # Follower pushes must give up well inside a heartbeat period,
+        # or one dead peer would stall the announcements that keep the
+        # OTHER followers' leases alive.
+        self._replicate_timeout = max(
+            0.05, min(heartbeat_interval, lease_timeout / 3.0))
+        # A client write may need several push rounds to find quorum — a
+        # follower can be mid-snapshot-install (its push lock held by
+        # the tick loop) right after an election, and one failed round
+        # must not surface as NO_QUORUM to the caller.
+        self._commit_timeout = 2.0 * lease_timeout
+        self.peers: List[str] = []
+        self.replica_id = 0
+        self._el: Optional[ElectionState] = None
+        self._state_lock = threading.RLock()
+        self._log: List[LogEntry] = []
+        self._log_capacity = log_capacity
+        self._base_index = 0   # the log starts after (base_index, base_term)
+        self._base_term = 0
+        self._last_index = 0
+        self._last_term = 0
+        # Election-rank cursor: the tail of entries KNOWN replicated —
+        # quorum-acked own writes, or entries received from a leader.
+        # A deposed leader's unacknowledged suffix is excluded, so it
+        # cannot outrank a follower holding a quorum-acked entry it
+        # lacks (the committed-write-survival invariant).
+        self._rank_index = 0
+        self._rank_term = 0
+        # A replica that has never reconciled with a leader in this
+        # process must take a snapshot install before following the log:
+        # its store may hold state (sqlite preseed) the log cursor knows
+        # nothing about, and a matching (0, 0) cursor would silently
+        # merge diverged stores.
+        self._virgin = True
+        self._followers: Dict[str, _FollowerState] = {}
+        self._peer_targets: Dict[str, _Target] = {}
+        self._last_quorum_at = 0.0
+        self._stop_event = threading.Event()
+        self._tick_thread: Optional[threading.Thread] = None
+        self._pool: Optional[_futures.ThreadPoolExecutor] = None
+
+    # ----------------------------------------------------------- lifecycle
+
+    @property
+    def address(self) -> str:
+        return self._advertise or self.server.address
+
+    def bind(self) -> str:
+        """Start the gRPC server; returns the advertised address (the
+        two-phase start lets an ensemble of port-0 replicas learn each
+        other's ports before any election begins)."""
+        port = self.server.start()
+        if not self._advertise:
+            host = self.server.host
+            self._advertise = f"{'127.0.0.1' if host == '0.0.0.0' else host}:{port}"
+        return self._advertise
+
+    def join(self, peers: List[str]) -> None:
+        """Enter the ensemble (the full member list, self included) and
+        start electing.  replica_id is the position in the sorted member
+        list — identical on every replica without coordination."""
+        if self.address not in peers:
+            raise ValueError(f"{self.address} not in ensemble {peers}")
+        self.peers = sorted(peers)
+        self.replica_id = self.peers.index(self.address)
+        self._el = ElectionState(self.replica_id, self._config)
+        self._el.touch_lease()
+        self._pool = _futures.ThreadPoolExecutor(
+            max_workers=max(2, 2 * len(self.peers)),
+            thread_name_prefix=f"ha-{self.replica_id}",
+        )
+        self._tick_thread = threading.Thread(
+            target=self._tick_loop, name=f"ha-tick-{self.replica_id}", daemon=True
+        )
+        self._tick_thread.start()
+
+    def stop(self) -> None:
+        """Graceful shutdown (process exit)."""
+        self.kill(grace=0.2)
+
+    def kill(self, grace: float = 0.0) -> None:
+        """Abrupt shutdown — the in-process SIGKILL analog: no step-down
+        courtesy, no final heartbeat; peers must detect the silence."""
+        self._stop_event.set()
+        self.server.stop(grace=grace)
+        if self._tick_thread is not None:
+            self._tick_thread.join(timeout=2.0)
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+        # Snapshot the dict: pool workers shut down with wait=False can
+        # still be inside _peer_call mutating it (a straggler's channel
+        # then leaks until process exit, which kill() is anyway).
+        for target in list(self._peer_targets.values()):
+            target.channel.close()
+        self._peer_targets.clear()
+
+    # ------------------------------------------------------------- queries
+
+    @property
+    def role(self) -> Role:
+        with self._state_lock:
+            return self._el.role if self._el is not None else Role.FOLLOWER
+
+    @property
+    def is_leader(self) -> bool:
+        return self.role is Role.LEADER
+
+    def status(self) -> dict:
+        with self._state_lock:
+            el = self._el
+            return {
+                "replica_id": self.replica_id,
+                "address": self.address,
+                "role": (el.role.value if el else Role.FOLLOWER.value),
+                "term": (el.term if el else 0),
+                # Election rank rides the KNOWN-replicated cursor, not
+                # the raw log tail — see _rank_index.
+                "last_index": self._rank_index,
+                "last_term": self._rank_term,
+                "revision": self.store.revision,
+                "leader": (el.leader if el else ""),
+                "peers": list(self.peers),
+            }
+
+    def _status_as_peer(self) -> PeerStatus:
+        return PeerStatus.from_dict(self.status())
+
+    def abort_if_not_leader(self, context) -> None:
+        with self._state_lock:
+            if self._el is not None and self._el.role is Role.LEADER:
+                return
+            leader = self._el.leader if self._el is not None else ""
+        if context is None:
+            raise NotLeader(leader)
+        context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                      NOT_LEADER_PREFIX + (leader if leader != self.address else ""))
+
+    # ------------------------------------------------------- the write path
+
+    def commit(self, op: str, args: Dict[str, Any]) -> Any:
+        """Apply one client mutation: local apply + log append under the
+        state lock, then parallel replication to followers, answering
+        only once a majority of the ensemble holds the entry.
+
+        A ``NoQuorum`` raise is INDETERMINATE, not a rollback: the
+        entry stays applied locally and keeps replicating on later
+        ticks, so it usually commits anyway (etcd's deadline-exceeded
+        semantics).  The client surfaces it as ``ABORTED NO_QUORUM``
+        and auto-retries only idempotent ops."""
+        with self._state_lock:
+            if self._el is None or self._el.role is not Role.LEADER:
+                raise NotLeader(self._el.leader if self._el else "")
+            entry = LogEntry(index=self._last_index + 1, term=self._el.term,
+                             op=op, args=args)
+            result = self._apply_op(op, args)
+            self._append(entry)
+        others = [p for p in self.peers if p != self.address]
+        needed = len(self.peers) // 2 + 1
+        deadline = time.monotonic() + self._commit_timeout
+        while True:
+            # A follower acks by its match cursor reaching the entry —
+            # however it got there (our push or a concurrent tick push).
+            followers = self._followers
+            acked = 1 + sum(
+                1 for addr in others
+                if (fs := followers.get(addr)) is not None
+                and fs.match >= entry.index
+            )
+            if acked >= needed:
+                break
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise NoQuorum(f"{acked}/{len(self.peers)} acks for {op}")
+            lagging = [
+                addr for addr in others
+                if (fs := followers.get(addr)) is None
+                or fs.match < entry.index
+            ]
+            _futures.wait(
+                [self._pool.submit(self._push, addr) for addr in lagging],
+                timeout=min(remaining, 4 * self._replicate_timeout),
+            )
+        with self._state_lock:
+            # A majority holds everything up to this entry: it (and all
+            # before it) now counts toward this replica's election rank.
+            if entry.index > self._rank_index:
+                self._rank_index, self._rank_term = entry.index, entry.term
+        return result
+
+    def _apply_op(self, op: str, args: Dict[str, Any]) -> Any:
+        s = self.store
+        if op == "put":
+            return s.put(args["key"], args["value"])
+        if op == "delete":
+            return s.delete(args["key"])
+        if op == "put_if_not_exists":
+            return s.put_if_not_exists(args["key"], args["value"])
+        if op == "compare_and_delete":
+            return s.compare_and_delete(args["key"], args["expected"])
+        raise ValueError(f"unknown replicated op {op!r}")
+
+    def _append(self, entry: LogEntry) -> None:
+        self._log.append(entry)
+        self._last_index = entry.index
+        self._last_term = entry.term
+        while len(self._log) > self._log_capacity:
+            dropped = self._log.pop(0)
+            self._base_index = dropped.index
+            self._base_term = dropped.term
+
+    # ----------------------------------------------------- leader → follower
+
+    def _peer_call(self, addr: str, method: str, request: dict,
+                   timeout: Optional[float] = None) -> Optional[dict]:
+        target = self._peer_targets.get(addr)
+        if target is None:
+            target = self._peer_targets[addr] = _Target(addr)
+        try:
+            return target.calls[method](
+                request, timeout=timeout or self._replicate_timeout)
+        except grpc.RpcError as e:
+            code = _code_of(e)
+            if code in OUTAGE_CODES and not channel_ready(target.channel):
+                # Redial the peer on the next tick: a connect attempt
+                # started before the peer's port was bound (ensemble
+                # cold-start, replica restart) can hang past any
+                # reconnect backoff, and the tick loop would keep
+                # riding the same doomed channel forever.  A deadline
+                # on a READY channel is just a slow peer — redialing
+                # a healthy transport buys nothing.
+                self._peer_targets.pop(addr, None)
+                try:
+                    target.channel.close()
+                except Exception:  # noqa: BLE001 - eviction is best-effort
+                    pass
+            elif code not in OUTAGE_CODES:
+                log.warning("peer %s %s failed: %s", addr, method, code)
+            return None
+
+    def _push(self, addr: str) -> bool:
+        """Bring one follower up to date (entries if its cursor is in
+        our log, a snapshot install otherwise); returns ack success.
+
+        The per-follower lock is acquired with a bounded wait: a
+        follower hung mid-snapshot-install would otherwise collect one
+        blocked pool thread per tick until the pool starves and
+        heartbeats to HEALTHY followers stop — deposing a live leader."""
+        fs = self._followers.get(addr)
+        if fs is None:
+            return False
+        if not fs.lock.acquire(timeout=self._replicate_timeout):
+            return False  # a push to this follower is already in flight
+        try:
+            with self._state_lock:
+                if self._el is None or self._el.role is not Role.LEADER:
+                    return False
+                term = self._el.term
+                cursor = fs.next
+                if cursor < self._base_index or cursor > self._last_index:
+                    entries = None  # cursor outside the retained log
+                else:
+                    entries = [e.to_wire()
+                               for e in self._log[cursor - self._base_index:]]
+                    prev_term = (self._base_term if cursor == self._base_index
+                                 else self._log[cursor - self._base_index - 1].term)
+            if entries is None:
+                return self._install_snapshot(addr, fs, term)
+            resp = self._peer_call(addr, "Replicate", {
+                "term": term,
+                "leader": self.address,
+                "prev_index": cursor,
+                "prev_term": prev_term,
+                "entries": entries,
+            })
+            if resp is None:
+                return False
+            if resp["term"] > term:
+                with self._state_lock:
+                    if self._el is not None and resp["term"] > self._el.term:
+                        self._el.term = resp["term"]
+                        self._el.step_down()
+                return False
+            if resp.get("ok"):
+                fs.next = fs.match = resp["last_index"]
+                fs.acked_at = time.monotonic()
+                return True
+            if resp.get("needs_snapshot"):
+                # The mismatch reply carries the follower's actual tail.
+                # A lost ack leaves fs.next stale while the follower
+                # really did apply — when its tail is still inside our
+                # retained log, a cursor reset + entry resend beats a
+                # wholesale snapshot.  A second mismatch AT the
+                # follower's own tail means diverged terms (or a virgin
+                # follower): only then ship the snapshot.
+                tail = resp.get("last_index", -1)
+                with self._state_lock:
+                    in_log = self._base_index <= tail <= self._last_index
+                if tail != cursor and in_log:
+                    fs.next = tail
+                    return False  # re-push from the new cursor next round
+                return self._install_snapshot(addr, fs, term)
+            # Rejected outright (e.g. the follower stays sticky to its
+            # same-term leader): no ack, and no point shipping a
+            # snapshot it would reject too.
+            return False
+        finally:
+            fs.lock.release()
+
+    def _install_snapshot(self, addr: str, fs: _FollowerState,
+                          term: int) -> bool:
+        with self._state_lock:
+            snap, rev = self.store.snapshot_with_revision([""])
+            payload = {
+                "term": term,
+                "leader": self.address,
+                "snapshot": snap,
+                "revision": rev,
+                "last_index": self._last_index,
+                "last_term": self._last_term,
+            }
+        resp = self._peer_call(addr, "InstallSnapshot", payload,
+                               timeout=4 * self._replicate_timeout)
+        if resp is None or not resp.get("ok"):
+            return False
+        fs.next = fs.match = payload["last_index"]
+        fs.acked_at = time.monotonic()
+        return True
+
+    # ----------------------------------------------------- follower handlers
+
+    def handle_replicate(self, request: dict) -> dict:
+        with self._state_lock:
+            if self._el is None or not self._el.observe_heartbeat(
+                    request["term"], request["leader"]):
+                return {"ok": False, "term": self._el.term if self._el else 0,
+                        "last_index": self._last_index}
+            if (self._virgin
+                    or request["prev_index"] != self._last_index
+                    or request["prev_term"] != self._last_term):
+                return {"ok": False, "term": self._el.term,
+                        "needs_snapshot": True, "last_index": self._last_index}
+            for raw in request["entries"]:
+                entry = LogEntry.from_wire(raw)
+                self._apply_op(entry.op, entry.args)
+                self._append(entry)
+            # Leader-fed entries count toward this replica's rank.
+            self._rank_index, self._rank_term = self._last_index, self._last_term
+            return {"ok": True, "term": self._el.term,
+                    "last_index": self._last_index,
+                    "revision": self.store.revision}
+
+    def handle_install_snapshot(self, request: dict) -> dict:
+        with self._state_lock:
+            if self._el is None or not self._el.observe_heartbeat(
+                    request["term"], request["leader"]):
+                return {"ok": False, "term": self._el.term if self._el else 0}
+            self.store.replace(request["snapshot"], request["revision"])
+            self._log = []
+            self._base_index = self._last_index = request["last_index"]
+            self._base_term = self._last_term = request["last_term"]
+            self._rank_index, self._rank_term = self._last_index, self._last_term
+            self._virgin = False
+            return {"ok": True, "term": self._el.term,
+                    "last_index": self._last_index,
+                    "revision": self.store.revision}
+
+    # ------------------------------------------------------------- election
+
+    def _tick_loop(self) -> None:
+        while not self._stop_event.is_set():
+            try:
+                self._tick()
+            except Exception:  # noqa: BLE001 - the loop must survive
+                log.exception("ha tick failed on %s", self.address)
+            self._stop_event.wait(self._config.heartbeat_interval)
+
+    def _tick(self) -> None:
+        with self._state_lock:
+            role = self._el.role
+        if role is Role.LEADER:
+            self._lead()
+        elif role is Role.FOLLOWER:
+            if self._el.lease_expired():
+                with self._state_lock:
+                    self._el.start_campaign()
+                self._campaign()
+        else:
+            self._campaign()
+
+    def _lead(self) -> None:
+        others = [p for p in self.peers if p != self.address]
+        if others:
+            # Bounded wait: a straggler (hung snapshot install, half-dead
+            # peer) keeps running on its pool thread, but heartbeats to
+            # the healthy followers must go out next tick regardless.
+            _futures.wait(
+                [self._pool.submit(self._push, p) for p in others],
+                timeout=self._config.heartbeat_interval,
+            )
+        now = time.monotonic()
+        fresh = sum(
+            1 for fs in self._followers.values()
+            if now - fs.acked_at < self._config.lease_timeout
+        )
+        with self._state_lock:
+            if (1 + fresh) * 2 > len(self.peers):
+                self._last_quorum_at = now
+            elif now - self._last_quorum_at > self._config.lease_timeout:
+                # Partitioned from the majority: writes already fail the
+                # quorum gate; stepping down also fences lease reads.
+                log.warning("%s: lost follower quorum, stepping down",
+                            self.address)
+                self._el.step_down()
+
+    def _campaign(self) -> None:
+        others = [p for p in self.peers if p != self.address]
+        statuses: List[Optional[PeerStatus]] = []
+        for resp in self._pool.map(
+                lambda a: self._peer_call(a, "HaStatus", {}), others):
+            statuses.append(None if resp is None else PeerStatus.from_dict(resp))
+        with self._state_lock:
+            role = self._el.decide(self._status_as_peer(), statuses,
+                                   len(self.peers))
+        if role is Role.LEADER:
+            self._on_elected()
+
+    def _on_elected(self) -> None:
+        with self._state_lock:
+            term = self._el.term
+            self._el.leader = self.address
+            self._virgin = False
+            # Optimistic push cursors (Raft-style): in-sync followers
+            # ack the first heartbeat untouched; stale ones reconcile
+            # down to a snapshot install.  match starts at 0 — nothing
+            # is quorum-countable until a follower actually responds.
+            self._followers = {
+                p: _FollowerState(next_index=self._last_index)
+                for p in self.peers if p != self.address
+            }
+            self._last_quorum_at = time.monotonic()
+        log.info("%s elected leader (term %d, log index %d)",
+                 self.address, term, self._last_index)
+        # Announce before anything else: the heartbeat freshens follower
+        # leases so their own candidacies stand down.
+        others = [p for p in self.peers if p != self.address]
+        if others:
+            _futures.wait(
+                [self._pool.submit(self._push, p) for p in others],
+                timeout=self._config.heartbeat_interval,
+            )
+        try:
+            self.commit("put", {
+                "key": ELECTION_KEY,
+                "value": {"address": self.address, "term": term,
+                          "replica_id": self.replica_id},
+            })
+        except (NotLeader, NoQuorum) as e:
+            # Best-effort observability write; losing it changes nothing
+            # (clients re-home on NOT_LEADER hints, not on this key).
+            log.warning("election key write skipped: %s", e)
+
+
+class ReplicaServer(KVStoreServer):
+    """The gRPC surface of one HA replica: the standard KVStore service
+    (leader-gated, writes through the replication commit) plus the
+    replica-to-replica protocol (HaStatus / Replicate / InstallSnapshot)
+    and the follower-readable LocalDump."""
+
+    def __init__(self, replica: HAReplica, host: str = "127.0.0.1",
+                 port: int = 0, max_watchers: int = 64):
+        super().__init__(replica.store, host=host, port=port,
+                         max_watchers=max_watchers)
+        self.replica = replica
+
+    # Leader gate for reads and watch registration/streaming.
+    def _gate(self, context) -> None:
+        self.replica.abort_if_not_leader(context)
+
+    def _get(self, request: dict, context=None) -> dict:
+        self._gate(context)
+        return super()._get(request, context)
+
+    def _list(self, request: dict, context=None) -> dict:
+        self._gate(context)
+        return super()._list(request, context)
+
+    def _snapshot(self, request: dict, context=None) -> dict:
+        self._gate(context)
+        return super()._snapshot(request, context)
+
+    def _revision(self, request: dict, context=None) -> dict:
+        self._gate(context)
+        return super()._revision(request, context)
+
+    # Writes ride the replicated commit.
+    def _commit(self, context, op: str, args: dict) -> Any:
+        try:
+            return self.replica.commit(op, args)
+        except NotLeader as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          NOT_LEADER_PREFIX + e.leader)
+        except NoQuorum as e:
+            # ABORTED, not UNAVAILABLE: the op is INDETERMINATE (applied
+            # locally, may still commit).  The client must not blindly
+            # retry non-idempotent ops on it — see remote._rpc.
+            context.abort(grpc.StatusCode.ABORTED, NO_QUORUM_PREFIX + str(e))
+
+    def _put(self, request: dict, context=None) -> dict:
+        return {"revision": self._commit(
+            context, "put", {"key": request["key"], "value": request["value"]})}
+
+    def _delete(self, request: dict, context=None) -> dict:
+        return {"deleted": self._commit(
+            context, "delete", {"key": request["key"]})}
+
+    def _put_if_not_exists(self, request: dict, context=None) -> dict:
+        return {"created": self._commit(
+            context, "put_if_not_exists",
+            {"key": request["key"], "value": request["value"]})}
+
+    def _compare_and_delete(self, request: dict, context=None) -> dict:
+        return {"deleted": self._commit(
+            context, "compare_and_delete",
+            {"key": request["key"], "expected": request["expected"]})}
+
+    # Replica-to-replica protocol + follower-readable introspection.
+    def _ha_status(self, request: dict, context=None) -> dict:
+        return self.replica.status()
+
+    def _replicate(self, request: dict, context=None) -> dict:
+        return self.replica.handle_replicate(request)
+
+    def _install_snapshot(self, request: dict, context=None) -> dict:
+        return self.replica.handle_install_snapshot(request)
+
+    def _local_dump(self, request: dict, context=None) -> dict:
+        return {
+            "items": self.store.list(request.get("prefix", "")),
+            "revision": self.store.revision,
+            "role": self.replica.role.value,
+            "address": self.replica.address,
+        }
+
+    def _unary_handlers(self) -> Dict[str, Callable]:
+        handlers = super()._unary_handlers()
+        handlers.update({
+            "HaStatus": self._ha_status,
+            "Replicate": self._replicate,
+            "InstallSnapshot": self._install_snapshot,
+            "LocalDump": self._local_dump,
+        })
+        return handlers
+
+
+class HAEnsemble:
+    """An in-process N-replica ensemble — the test/dev harness (the
+    OS-process form is ``python -m vpp_tpu.kvstore --join ...``)."""
+
+    def __init__(self, n: int = 3, host: str = "127.0.0.1",
+                 heartbeat_interval: float = 0.05,
+                 lease_timeout: float = 0.4, **replica_kw):
+        self.heartbeat_interval = heartbeat_interval
+        self.lease_timeout = lease_timeout
+        self._replica_kw = replica_kw
+        self._host = host
+        self.replicas: List[HAReplica] = [
+            HAReplica(host=host, heartbeat_interval=heartbeat_interval,
+                      lease_timeout=lease_timeout, **replica_kw)
+            for _ in range(n)
+        ]
+        self.addresses = [r.bind() for r in self.replicas]
+        for r in self.replicas:
+            r.join(list(self.addresses))
+
+    def client(self, **kw) -> "RemoteKVStore":
+        from .remote import RemoteKVStore
+
+        return RemoteKVStore(",".join(self.addresses), **kw)
+
+    def leader(self) -> Optional[HAReplica]:
+        for r in self.replicas:
+            if not r._stop_event.is_set() and r.is_leader:
+                return r
+        return None
+
+    def wait_leader(self, timeout: float = 10.0) -> HAReplica:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            leader = self.leader()
+            if leader is not None:
+                return leader
+            time.sleep(0.02)
+        raise TimeoutError("no leader elected")
+
+    def kill_leader(self) -> HAReplica:
+        """SIGKILL-equivalent on the sitting leader; returns the corpse
+        (its address stays in the ensemble for a later restart)."""
+        leader = self.wait_leader()
+        leader.kill()
+        return leader
+
+    def restart(self, address: str) -> HAReplica:
+        """Bring a killed replica back on its old address (the rejoin /
+        catch-up path)."""
+        host, port = address.rsplit(":", 1)
+        idx = self.addresses.index(address)
+        replica = HAReplica(host=host, port=int(port), advertise=address,
+                            heartbeat_interval=self.heartbeat_interval,
+                            lease_timeout=self.lease_timeout,
+                            **self._replica_kw)
+        replica.bind()
+        replica.join(list(self.addresses))
+        self.replicas[idx] = replica
+        return replica
+
+    def stop(self) -> None:
+        for r in self.replicas:
+            r.kill()

@@ -51,6 +51,85 @@ OUTAGE_CODES = frozenset((
     grpc.StatusCode.CANCELLED,
 ))
 
+# An HA follower rejects client ops with FAILED_PRECONDITION and this
+# details prefix, carrying the leader it currently follows ("" while an
+# election is in flight).  The client parses it to re-home (vpp_tpu/
+# kvstore/ha.py is the server side of the contract).
+NOT_LEADER_PREFIX = "NOT_LEADER leader="
+
+# An HA leader that applied a write locally but could not gather a
+# replica-majority ack rejects it ABORTED with this details prefix: the
+# op is INDETERMINATE (it stays in the leader's log and usually commits
+# on a later replication tick).  The failover client auto-retries it
+# only for idempotent ops.
+NO_QUORUM_PREFIX = "NO_QUORUM "
+
+# Ops safe to retry blindly on an indeterminate failure — re-running
+# them cannot change the END STATE the caller asked for.  PutIfNotExists
+# / CompareAndDelete are NOT here: a retry of an already-applied attempt
+# would report created=False / deleted=False for its own write, and
+# their returns gate conditional logic (id allocation, CAS loops) that
+# must never be lied to.  Delete IS here as a deliberate trade: the
+# retried end state (key absent) is identical, only the advisory
+# deleted-flag can read False for the caller's own delete — and raising
+# instead would turn every failover window into an exception in the
+# ksr/extconfig/nodesync delete paths this subsystem exists to keep
+# alive.
+IDEMPOTENT_METHODS = frozenset(
+    ("Get", "Put", "Delete", "List", "Snapshot", "Revision"))
+
+
+def _code_of(err: Exception) -> Optional[grpc.StatusCode]:
+    """The gRPC status code of an error, None when it has none (or
+    producing it fails) — defensive because non-RpcError exceptions
+    flow through the same handlers."""
+    code_fn = getattr(err, "code", None)
+    if code_fn is None:
+        return None
+    try:
+        return code_fn()
+    except Exception:  # noqa: BLE001 - errors without a code
+        return None
+
+
+def _status_of(err: Exception) -> Optional[tuple]:
+    """``(status_code, details)`` of a gRPC error, None for anything
+    that lacks either half."""
+    code = _code_of(err)
+    details_fn = getattr(err, "details", None)
+    if code is None or details_fn is None:
+        return None
+    try:
+        return code, (details_fn() or "")
+    except Exception:  # noqa: BLE001 - errors without details
+        return None
+
+
+def no_quorum(err: Exception) -> bool:
+    """True when ``err`` is an HA leader's NO_QUORUM rejection."""
+    status = _status_of(err)
+    return (status is not None
+            and status[0] is grpc.StatusCode.ABORTED
+            and status[1].startswith(NO_QUORUM_PREFIX))
+
+
+def not_leader_hint(err: Exception) -> Optional[str]:
+    """The leader address carried by a NOT_LEADER rejection, "" when the
+    rejecting replica knows no leader yet, None for any other error."""
+    status = _status_of(err)
+    if (status is None
+            or status[0] is not grpc.StatusCode.FAILED_PRECONDITION
+            or not status[1].startswith(NOT_LEADER_PREFIX)):
+        return None
+    return status[1][len(NOT_LEADER_PREFIX):]
+
+
+class LeaderUnavailable(ConnectionError):
+    """Raised when a failover client exhausted its retry window without
+    finding a serving leader.  Subclasses ConnectionError so the
+    dbwatcher's outage classifier treats it as a transport outage (fall
+    back to the local mirror), not a server bug."""
+
 
 def _encode(msg: dict) -> bytes:
     return codec.encode(msg)
@@ -110,11 +189,25 @@ class KVStoreServer:
     def _revision(self, request: dict, context=None) -> dict:
         return {"revision": self.store.revision}
 
+    def _gate(self, context) -> None:
+        """Pre-serve hook: the HA replica server aborts here when this
+        process is not the leader (client ops are leader-only).  The
+        standalone server serves unconditionally."""
+
     def _watch(self, request: dict, context) -> Iterable[dict]:
         """Server-streaming: a subscribe-ack, then one message per
         committed change.  The ack (empty key) proves the store-side
         watcher is registered, so a client that snapshots AFTER receiving
-        it cannot lose events between snapshot and stream."""
+        it cannot lose events between snapshot and stream.
+
+        ``since_revision`` (>= 0) asks for replay of the events committed
+        after that revision, delivered between the ack and the live
+        stream with nothing falling in between (store.watch_since is
+        atomic).  The ack's ``resync`` flag reports whether the bounded
+        event log still reached back that far; when it did not, the
+        client must snapshot instead (the dbwatcher's reconnect resync).
+        """
+        self._gate(context)
         with self._watch_lock:
             if self._active_watchers >= self.max_watchers:
                 log.error(
@@ -128,10 +221,20 @@ class KVStoreServer:
             self._active_watchers += 1
         watcher = None
         try:
-            watcher = self.store.watch(request["prefixes"])
+            since = request.get("since_revision", -1)
+            watcher, missed = self.store.watch_since(request["prefixes"], since)
             yield {"key": "", "value": None, "prev_value": None,
-                   "revision": self.store.revision}
+                   "revision": self.store.revision,
+                   "resync": missed is None}
+            for ev in (missed or ()):
+                yield {
+                    "key": ev.key,
+                    "value": ev.value,
+                    "prev_value": ev.prev_value,
+                    "revision": ev.revision,
+                }
             while context.is_active():
+                self._gate(context)
                 ev = watcher.get(timeout=0.2)
                 if ev is None:
                     continue
@@ -149,25 +252,33 @@ class KVStoreServer:
 
     # ------------------------------------------------------------ lifecycle
 
+    def _unary_handlers(self) -> Dict[str, Callable]:
+        """Method-name → handler; the HA replica server extends this."""
+        return {
+            "Get": self._get,
+            "Put": self._put,
+            "Delete": self._delete,
+            "PutIfNotExists": self._put_if_not_exists,
+            "CompareAndDelete": self._compare_and_delete,
+            "List": self._list,
+            "Snapshot": self._snapshot,
+            "Revision": self._revision,
+        }
+
+    def _stream_handlers(self) -> Dict[str, Callable]:
+        return {"Watch": self._watch}
+
     def start(self) -> int:
         unary = {
             name: grpc.unary_unary_rpc_method_handler(
                 fn, request_deserializer=_decode, response_serializer=_encode
             )
-            for name, fn in [
-                ("Get", self._get),
-                ("Put", self._put),
-                ("Delete", self._delete),
-                ("PutIfNotExists", self._put_if_not_exists),
-                ("CompareAndDelete", self._compare_and_delete),
-                ("List", self._list),
-                ("Snapshot", self._snapshot),
-                ("Revision", self._revision),
-            ]
+            for name, fn in self._unary_handlers().items()
         }
-        unary["Watch"] = grpc.unary_stream_rpc_method_handler(
-            self._watch, request_deserializer=_decode, response_serializer=_encode
-        )
+        for name, fn in self._stream_handlers().items():
+            unary[name] = grpc.unary_stream_rpc_method_handler(
+                fn, request_deserializer=_decode, response_serializer=_encode
+            )
         self._server = grpc.server(futures.ThreadPoolExecutor(
             max_workers=self.max_watchers + self.UNARY_WORKERS))
         self._server.add_generic_rpc_handlers(
@@ -180,7 +291,12 @@ class KVStoreServer:
 
     def stop(self, grace: float = 0.2) -> None:
         if self._server is not None:
-            self._server.stop(grace)
+            # Block until shutdown actually completes: grpc's stop() is
+            # async, and returning early leaves the listening socket
+            # alive — a server restarted on the same port would then
+            # share it via SO_REUSEPORT and old/new listeners would
+            # split incoming connections (clients land on the corpse).
+            self._server.stop(grace).wait(timeout=grace + 5.0)
             self._server = None
 
     @property
@@ -192,15 +308,21 @@ class RemoteWatcher(Watcher):
     """Client side of a Watch stream; same queue interface as Watcher.
 
     The stream thread reconnects with backoff; every successful
-    re-subscription after a drop invokes the owner's reconnect hooks
-    (events during the outage are NOT replayed — the owner must resync,
-    exactly like the reference after an etcd reconnect)."""
+    re-subscription after a drop invokes the owner's reconnect hooks so
+    the owner can resync, exactly like the reference after an etcd
+    reconnect.  Against an HA ensemble the re-subscription also carries
+    the watcher's LAST-SEEN revision: the (new) leader replays the
+    committed events after it from its bounded event log, so a leader
+    failover loses no events even before the resync lands — and when
+    the stream lands on a follower, the NOT_LEADER rejection re-homes
+    it exactly like a unary call."""
 
     def __init__(self, owner: "RemoteKVStore", prefixes: Tuple[str, ...]):
         super().__init__(prefixes)
         self._owner = owner
         self._subscribed = threading.Event()
         self._call = None  # current stream call, for cancel() on close
+        self.last_revision = -1  # highest event revision delivered
         self._thread = threading.Thread(
             target=self._stream_loop, name="kv-remote-watch", daemon=True
         )
@@ -223,23 +345,64 @@ class RemoteWatcher(Watcher):
         backoff = 0.05
         failed_before = False
         while not self.closed:
+            address = self._owner.address
             try:
-                stream = self._owner._stub_watch({"prefixes": list(self.prefixes)})
+                stream = self._owner._stub_watch(
+                    {"prefixes": list(self.prefixes),
+                     "since_revision": self.last_revision},
+                    address,
+                )
                 self._call = stream
                 for msg in stream:
                     if self.closed:
                         return
                     if msg["key"] == "":
                         # Subscribe-ack: the server-side watcher is live.
-                        # If we are recovering from an outage (including
-                        # one at startup), tell the owner so it can
-                        # resync — outage events are never replayed.
+                        # Recovering from an outage (including one at
+                        # startup) still tells the owner to resync —
+                        # replay covers this watcher's queue, the resync
+                        # covers snapshot consumers, and events the
+                        # bounded log no longer held (msg["resync"])
+                        # are covered ONLY by the resync.
+                        if msg.get("resync") and self.last_revision >= 0:
+                            # The bounded event log no longer reached
+                            # our cursor: the resync fired below is
+                            # load-bearing, not belt-and-braces — any
+                            # queue consumer without a resync hook has
+                            # a hole here.  Loud so soak logs show it.
+                            log.warning(
+                                "watch replay gap at revision %d on %s: "
+                                "resync is covering missed events",
+                                self.last_revision, address)
+                        diverged = self.last_revision > msg["revision"]
+                        if diverged:
+                            # Our cursor is AHEAD of the server: the
+                            # events that advanced it came from a
+                            # deposed leader's uncommitted writes,
+                            # rolled back by a snapshot install.  The
+                            # cursor means nothing on the survivors'
+                            # timeline — adopt the server's revision
+                            # (future replays anchor there) and resync,
+                            # which re-reads the authoritative state.
+                            #
+                            # A bare revision cannot catch EQUAL-height
+                            # divergence (new leader coincidentally at
+                            # our inflated revision).  In practice the
+                            # winner's election-key commit advances its
+                            # revision before any client write can land,
+                            # so the residue is a possible stale event
+                            # in this queue, not a lost one — and the
+                            # resync below heals every hook consumer
+                            # (dbwatcher).  A watertight guard needs
+                            # per-revision terms on the wire.
+                            self.last_revision = msg["revision"]
                         self._subscribed.set()
                         backoff = 0.05
-                        if failed_before:
+                        if failed_before or diverged:
                             failed_before = False
                             self._owner._fire_reconnect()
                         continue
+                    self.last_revision = max(self.last_revision, msg["revision"])
                     self.queue.put(
                         WatchEvent(
                             key=msg["key"],
@@ -249,13 +412,20 @@ class RemoteWatcher(Watcher):
                         )
                     )
             except grpc.RpcError as e:
-                code_fn = getattr(e, "code", None)
-                code = code_fn() if code_fn is not None else None
-                if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                code = _code_of(e)
+                hint = not_leader_hint(e)
+                if hint is not None:
+                    # Landed on an HA follower: re-home to its leader
+                    # (or rotate while the election is still running).
+                    self._owner._rehome(address, hint)
+                elif code == grpc.StatusCode.RESOURCE_EXHAUSTED:
                     # Server watcher limit hit — fail loudly (ADVICE r2);
                     # the backoff retry may still grab a freed slot.
                     log.error("watch stream rejected: %s", e)
-                elif code not in OUTAGE_CODES:
+                elif code in OUTAGE_CODES:
+                    self._owner._evict_target(address)
+                    self._owner._rehome(address, None)
+                else:
                     # Not an outage: a server-side handler crash
                     # (UNKNOWN/INTERNAL) would otherwise retry silently
                     # forever while the watch is effectively dead.
@@ -270,44 +440,239 @@ class RemoteWatcher(Watcher):
             backoff = min(backoff * 2, 2.0)
 
 
-class RemoteKVStore:
-    """Drop-in KVStore client talking to a KVStoreServer.
+def channel_ready(channel: grpc.Channel) -> bool:
+    """True when the channel's transport is connected (READY), read
+    without triggering a connect attempt.  False on any doubt — the
+    probe rides grpc internals, and doubt must let eviction proceed
+    (a wrongly-kept dead channel is the hung-connect bug; a wrongly
+    evicted one just redials)."""
+    try:
+        state = channel._channel.check_connectivity_state(False)
+        return state == grpc.ChannelConnectivity.READY.value[0]
+    except Exception:  # noqa: BLE001 - internal API probe
+        return False
 
-    Raises ``grpc.RpcError`` on unary calls while the server is
-    unreachable (callers like the dbwatcher fall back to their local
-    mirror, dbwatcher.go:309-333).
-    """
+
+class _Target:
+    """One server address: its channel and prepared call objects."""
 
     _METHODS = (
         "Get", "Put", "Delete", "PutIfNotExists", "CompareAndDelete",
         "List", "Snapshot", "Revision",
+        # HA replica surface (UNIMPLEMENTED on a standalone server).
+        "HaStatus", "LocalDump", "Replicate", "InstallSnapshot",
     )
 
-    def __init__(self, address: str, timeout: float = 5.0):
+    def __init__(self, address: str):
         self.address = address
-        self.timeout = timeout
-        self._channel = grpc.insecure_channel(address)
-        self._calls = {
-            m: self._channel.unary_unary(
+        # Cap gRPC's reconnect backoff (default grows 1s -> 120s): a
+        # channel that saw one refused connect during an ensemble
+        # cold-start or a replica restart would otherwise sit in
+        # backoff for tens of seconds while every RPC on it fails
+        # instantly — longer than the whole leader-failover window.
+        self.channel = grpc.insecure_channel(address, options=[
+            ("grpc.initial_reconnect_backoff_ms", 100),
+            ("grpc.max_reconnect_backoff_ms", 1000),
+        ])
+        self.calls = {
+            m: self.channel.unary_unary(
                 f"/{SERVICE_NAME}/{m}",
                 request_serializer=_encode,
                 response_deserializer=_decode,
             )
             for m in self._METHODS
         }
-        self._watch_call = self._channel.unary_stream(
+        self.watch_call = self.channel.unary_stream(
             f"/{SERVICE_NAME}/Watch",
             request_serializer=_encode,
             response_deserializer=_decode,
         )
+
+
+class RemoteKVStore:
+    """Drop-in KVStore client talking to one KVStoreServer or an HA
+    ensemble of them.
+
+    Single address (the historical form): unary calls raise
+    ``grpc.RpcError`` while the server is unreachable (callers like the
+    dbwatcher fall back to their local mirror, dbwatcher.go:309-333).
+
+    Multiple addresses ("a:1,b:2,c:3" or a list): the client follows
+    the ensemble's leader.  A NOT_LEADER rejection re-homes to the
+    hinted leader; an outage rotates to the next replica; both retry
+    with bounded backoff until ``failover_deadline`` elapses, so a
+    leader crash is invisible to callers of the idempotent ops as long
+    as a new leader is elected inside the window.  Exhausting the
+    window raises :class:`LeaderUnavailable` (a ConnectionError —
+    classified as an outage by the dbwatcher, never as a server bug).
+
+    A leader's ``NO_QUORUM`` rejection (ABORTED) is indeterminate — the
+    write is applied on the leader and usually still commits — so it is
+    auto-retried only for idempotent ops; ``put_if_not_exists`` /
+    ``compare_and_delete`` surface it to the caller, whose retry could
+    otherwise mis-read its own write as someone else's.
+    """
+
+    def __init__(self, address, timeout: float = 5.0,
+                 failover_deadline: float = 8.0):
+        if isinstance(address, str):
+            addresses = [a.strip() for a in address.split(",") if a.strip()]
+        else:
+            addresses = [str(a) for a in address]
+        if not addresses:
+            raise ValueError("at least one store address required")
+        self._addresses = addresses
+        # Fixed at construction: a single-address client NEVER grows
+        # into failover mode (a stray NOT_LEADER hint must not quietly
+        # replace its documented fail-fast semantics).
+        self._failover = len(addresses) > 1
+        self.timeout = timeout
+        self.failover_deadline = failover_deadline
+        self._target_lock = threading.Lock()
+        self._targets: Dict[str, _Target] = {}
+        self._active = addresses[0]
         self._watchers: List[RemoteWatcher] = []
         self._reconnect_cbs: List[Callable[[], None]] = []
 
-    def _rpc(self, method: str, request: dict) -> dict:
-        return self._calls[method](request, timeout=self.timeout)
+    @property
+    def address(self) -> str:
+        """The address currently served (the leader, once discovered)."""
+        return self._active
 
-    def _stub_watch(self, request: dict):
-        return self._watch_call(request)
+    @property
+    def addresses(self) -> List[str]:
+        return list(self._addresses)
+
+    def _target(self, address: Optional[str] = None) -> _Target:
+        address = address or self._active
+        with self._target_lock:
+            target = self._targets.get(address)
+            if target is None:
+                target = self._targets[address] = _Target(address)
+            return target
+
+    def _rehome(self, failed: str, hint: Optional[str]) -> str:
+        """Pick the next address after ``failed`` misbehaved: the
+        NOT_LEADER hint wins; otherwise rotate through the ensemble.
+        Serialized so concurrent failures converge on one choice.
+        No-op for a single-address client — it stays pointed at its
+        configured server, fail-fast, forever."""
+        if not self._failover:
+            return self._active
+        with self._target_lock:
+            if hint:
+                if hint not in self._addresses:
+                    self._addresses.append(hint)
+                self._active = hint
+            elif self._active == failed and len(self._addresses) > 1:
+                idx = self._addresses.index(failed) if failed in self._addresses else -1
+                self._active = self._addresses[(idx + 1) % len(self._addresses)]
+            return self._active
+
+    def _evict_target(self, address: str) -> None:
+        """Drop the cached channel of an address that failed with a
+        TRANSPORT outage, so the next attempt dials a fresh one.  A
+        connect attempt started while the server port was not yet bound
+        (ensemble cold-start, replica restart) can hang in some network
+        stacks past any reconnect backoff, and every later RPC on the
+        channel rides the same doomed attempt — a fresh channel
+        connects immediately once the server is up.
+
+        A deadline/cancel on a READY channel is exempt: the transport
+        is healthy (the server is just slow), and closing the channel
+        would also cancel a live Watch stream riding it — one slow
+        Snapshot would then cost a full dbwatcher resync."""
+        with self._target_lock:
+            target = self._targets.get(address)
+            if target is not None and channel_ready(target.channel):
+                return
+            self._targets.pop(address, None)
+        if target is not None:
+            try:
+                target.channel.close()
+            except Exception:  # noqa: BLE001 - eviction is best-effort
+                pass
+
+    def _rpc(self, method: str, request: dict) -> dict:
+        if not self._failover:
+            # Historical single-server semantics: one attempt, errors
+            # surface immediately (the dbwatcher's mirror fallback and
+            # the chaos tests depend on fail-fast here) — but an outage
+            # still evicts the channel so the NEXT attempt redials.
+            address = self._active
+            try:
+                return self._target(address).calls[method](
+                    request, timeout=self.timeout)
+            except grpc.RpcError as e:
+                if _code_of(e) in OUTAGE_CODES:
+                    self._evict_target(address)
+                raise
+        deadline = time.monotonic() + self.failover_deadline
+        backoff = 0.05
+        last: Optional[Exception] = None
+        while True:
+            address = self._active
+            try:
+                return self._target(address).calls[method](
+                    request, timeout=self.timeout)
+            except grpc.RpcError as e:
+                hint = not_leader_hint(e)
+                code = _code_of(e)
+                outage = hint is None and code in OUTAGE_CODES
+                if no_quorum(e):
+                    # Indeterminate: the leader applied the op but could
+                    # not prove a majority holds it (it usually still
+                    # commits on a later tick).  Retrying is only safe
+                    # for ops whose re-run observes the same outcome.
+                    if method not in IDEMPOTENT_METHODS:
+                        raise
+                    # Stay homed: the rejecting replica IS the leader —
+                    # rotating away would bounce off a follower's
+                    # NOT_LEADER right back here, two wasted RPCs per
+                    # retry during exactly the degraded window.
+                    last = e
+                elif (outage and code is not grpc.StatusCode.UNAVAILABLE
+                        and method not in IDEMPOTENT_METHODS):
+                    # DEADLINE_EXCEEDED / CANCELLED are just as
+                    # indeterminate as NO_QUORUM: the request may have
+                    # reached the leader and applied, and a blind re-run
+                    # of a conditional op would mis-read its own write
+                    # (created=False).  Only UNAVAILABLE — a connect-
+                    # level failure, the request (almost certainly)
+                    # never processed — stays retryable for them.
+                    self._evict_target(address)
+                    raise
+                elif hint is None and not outage:
+                    raise  # a real server bug — never masked by failover
+                else:
+                    last = e
+                    if outage:
+                        self._evict_target(address)
+                    self._rehome(address, hint)
+            if time.monotonic() >= deadline:
+                raise LeaderUnavailable(
+                    f"no serving leader among {self._addresses} within "
+                    f"{self.failover_deadline:.1f}s"
+                ) from last
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 0.5)
+
+    def _stub_watch(self, request: dict, address: Optional[str] = None):
+        return self._target(address).watch_call(request)
+
+    # --------------------------------------------------------- HA helpers
+
+    def ha_status(self, address: Optional[str] = None) -> dict:
+        """The HA election status of one replica (UNIMPLEMENTED on a
+        standalone server)."""
+        return self._target(address).calls["HaStatus"]({}, timeout=self.timeout)
+
+    def local_dump(self, prefix: str = "",
+                   address: Optional[str] = None) -> dict:
+        """A replica's LOCAL store view (served by followers too —
+        possibly stale; the replication-lag observability surface)."""
+        return self._target(address).calls["LocalDump"](
+            {"prefix": prefix}, timeout=self.timeout)
 
     # ------------------------------------------------------------ interface
 
@@ -374,4 +739,7 @@ class RemoteKVStore:
     def close(self) -> None:
         for w in list(self._watchers):
             self.unwatch(w)
-        self._channel.close()
+        with self._target_lock:
+            for target in self._targets.values():
+                target.channel.close()
+            self._targets.clear()

@@ -18,10 +18,11 @@ Thread-safe; watchers receive events in commit order.
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 from dataclasses import dataclass
-from typing import Any, Dict, Iterable, List, Optional, Tuple
+from typing import Any, Deque, Dict, Iterable, List, Optional, Tuple
 
 
 class TxnFailed(Exception):
@@ -64,13 +65,21 @@ class Watcher:
 
 
 class KVStore:
-    """The in-memory store."""
+    """The in-memory store.
 
-    def __init__(self):
+    Keeps a bounded log of the most recent watch events (every revision
+    bump appends exactly one, so retained revisions are contiguous).
+    ``watch_since`` uses it to hand a re-subscribing watcher the events
+    it missed — the etcd watch-from-revision semantics the HA client
+    failover rides (see :mod:`.ha`).
+    """
+
+    def __init__(self, log_capacity: int = 4096):
         self._lock = threading.RLock()
         self._data: Dict[str, Any] = {}
         self._revision = 0
         self._watchers: List[Watcher] = []
+        self._log: Deque[WatchEvent] = collections.deque(maxlen=log_capacity)
 
     # ------------------------------------------------------------------ basic
 
@@ -152,6 +161,38 @@ class KVStore:
             self._watchers.append(watcher)
         return watcher
 
+    def watch_since(
+        self, prefixes: Iterable[str], since_revision: int
+    ) -> Tuple[Watcher, Optional[List[WatchEvent]]]:
+        """Register a watcher AND collect the matching events committed
+        after ``since_revision``, atomically — nothing can fall between
+        the replay and the live stream.
+
+        Returns ``(watcher, missed)``.  ``missed`` is ``None`` when the
+        bounded log no longer reaches back to ``since_revision`` (the
+        caller must resync from a snapshot instead); a negative
+        ``since_revision`` requests no replay at all (fresh subscribe).
+        """
+        with self._lock:
+            watcher = Watcher(tuple(prefixes))
+            self._watchers.append(watcher)
+            if since_revision < 0:
+                return watcher, []
+            # Retained log revisions are contiguous: coverage holds iff
+            # the caller's revision reaches the oldest retained event
+            # (or the log is empty because nothing changed since).
+            if self._log:
+                covered = since_revision >= self._log[0].revision - 1
+            else:
+                covered = since_revision >= self._revision
+            if not covered:
+                return watcher, None
+            missed = [
+                ev for ev in self._log
+                if ev.revision > since_revision and watcher.matches(ev.key)
+            ]
+            return watcher, missed
+
     def unwatch(self, watcher: Watcher) -> None:
         with self._lock:
             watcher.closed = True
@@ -160,6 +201,19 @@ class KVStore:
 
     def _notify(self, key: str, value: Any, prev: Any) -> None:
         ev = WatchEvent(key=key, value=value, prev_value=prev, revision=self._revision)
+        self._log.append(ev)
         for watcher in self._watchers:
             if not watcher.closed and watcher.matches(key):
                 watcher.queue.put(ev)
+
+    # ------------------------------------------------------------ HA hooks
+
+    def replace(self, snapshot: Dict[str, Any], revision: int) -> None:
+        """Wholesale state install (HA snapshot catch-up): the follower's
+        contents, revision, and event log are replaced, NOT diffed —
+        watchers see no events (the installing replica resyncs its
+        consumers, exactly like a reconnecting remote client)."""
+        with self._lock:
+            self._data = dict(snapshot)
+            self._revision = revision
+            self._log.clear()

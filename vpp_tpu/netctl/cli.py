@@ -6,8 +6,13 @@ Analog of ``plugins/netctl`` + ``cmd/contiv-netctl`` (cmd/root.go
 - ``nodes``      cluster nodes and their data-plane IPs
 - ``pods``       local pods of an agent
 - ``ipam``       the agent's IPAM state
-- ``dump``       data-plane config dump from the txn scheduler
-                 (the ``vppdump`` analog)
+- ``dump``       data-plane config dump from the txn scheduler; with
+                 ``--key-class <prefix>`` an arbitrary keyspace dump of
+                 the agent's cluster-store view instead (the full
+                 ``vppdump`` analog: any key class, any node), and
+                 ``--key-classes`` lists the selectable classes
+- ``log``        runtime log levels: list all components, or set one
+                 (``netctl log vpp_tpu.policy DEBUG``)
 - ``history``    controller event history
 - ``resync``     trigger an on-demand full resync
 - ``metrics``    Prometheus metrics passthrough
@@ -82,6 +87,43 @@ def cmd_dump(server: str, out, prefix: str = "") -> int:
         for v in values
     ]
     print(_table(sorted(rows), ["KEY", "STATE", "ERROR"]), file=out)
+    return 0
+
+
+def cmd_store_dump(server: str, out, key_class: str) -> int:
+    """Arbitrary keyspace dump with key-class selection (the reference's
+    ``netctl vppdump <class>``, plugins/netctl/cmdimpl/vppdump.go):
+    reads the agent's own view of the cluster store, so it works
+    against ANY node — leader-served for remote-store agents, local for
+    in-process ones."""
+    from urllib.parse import quote
+
+    items = _fetch(server, f"/contiv/v1/store?prefix={quote(key_class)}")
+    rows = [[i["key"], json.dumps(i["value"], sort_keys=True, default=str)]
+            for i in items]
+    print(_table(sorted(rows), ["KEY", "VALUE"]), file=out)
+    return 0
+
+
+def cmd_store_classes(server: str, out) -> int:
+    classes = _fetch(server, "/contiv/v1/store/classes")
+    rows = [[c["keyword"], c["prefix"]] for c in classes]
+    print(_table(sorted(rows), ["CLASS", "PREFIX"]), file=out)
+    return 0
+
+
+def cmd_log(server: str, out, logger: str = "", level: str = "") -> int:
+    """Runtime log-level control (cn-infra logmanager analog)."""
+    if logger and level:
+        res = _fetch(server, f"/logging?logger={logger}&level={level}",
+                     method="POST")
+        print(f"{res['logger']} -> {res['level']}", file=out)
+        return 0
+    levels = _fetch(server, "/logging")
+    rows = [[name, v["level"] + (" (inherited)" if v["inherited"] else "")]
+            for name, v in sorted(levels.items())
+            if not logger or name.startswith(logger)]
+    print(_table(rows, ["LOGGER", "LEVEL"]), file=out)
     return 0
 
 
@@ -207,6 +249,18 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
         sub.add_parser(name, parents=[common])
     dump = sub.add_parser("dump", parents=[common])
     dump.add_argument("prefix", nargs="?", default="")
+    dump.add_argument("--key-class", default=None,
+                      help="dump the agent's cluster-store view under this "
+                           "key prefix instead of the scheduler state "
+                           "('' dumps every key)")
+    dump.add_argument("--key-classes", action="store_true",
+                      help="list the selectable key classes")
+    logcmd = sub.add_parser("log", parents=[common])
+    logcmd.add_argument("logger", nargs="?", default="",
+                        help="component logger (prefix filter when listing)")
+    logcmd.add_argument("level", nargs="?", default="",
+                        help="new level (DEBUG/INFO/WARNING/ERROR); "
+                             "omit to list")
     trace = sub.add_parser("trace", parents=[common])
     trace.add_argument("action", nargs="?", default="",
                        choices=["", "enable", "disable", "clear"])
@@ -221,7 +275,13 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
 
     try:
         if args.command == "dump":
+            if args.key_classes:
+                return cmd_store_classes(args.server, out)
+            if args.key_class is not None:
+                return cmd_store_dump(args.server, out, args.key_class)
             return cmd_dump(args.server, out, args.prefix)
+        if args.command == "log":
+            return cmd_log(args.server, out, args.logger, args.level)
         if args.command == "trace":
             return cmd_trace(args.server, out, args.action, args.sample)
         if args.command == "inspect":

@@ -11,7 +11,13 @@ Analog of the reference's per-node REST surfaces (SURVEY.md §5.5):
   data sources);
 - ``GET /metrics`` — Prometheus text exposition (cn-infra prometheus
   plugin analog);
-- ``GET /liveness`` — the statuscheck probe.
+- ``GET /liveness`` — the statuscheck probe;
+- ``GET /contiv/v1/store?prefix=`` + ``GET /contiv/v1/store/classes``
+  — arbitrary keyspace dump of this agent's cluster-store view with
+  key-class selection (the ``netctl vppdump`` data source, reference
+  plugins/netctl/cmdimpl/vppdump.go);
+- ``GET|POST /logging`` — runtime per-component log levels (the
+  cn-infra logmanager analog, cmd/contiv-agent/main.go:71,231).
 
 Implemented on the stdlib threading HTTP server; components are
 injected and every endpoint degrades to 404 when its component is
@@ -63,6 +69,7 @@ class AgentRestServer:
         stats_registry=None,
         tracer=None,
         datapath=None,
+        store=None,
         host: str = "127.0.0.1",
         port: int = 0,
     ):
@@ -79,6 +86,9 @@ class AgentRestServer:
         # zero-arg callable resolving to it (the agent's runner attaches
         # after REST construction when an uplink comes up).
         self.datapath = datapath
+        # This agent's cluster-store handle (KVStore or RemoteKVStore):
+        # the data source for the arbitrary-keyspace dump.
+        self.store = store
         self.host = host
         self.port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -172,6 +182,61 @@ class AgentRestServer:
             raise LookupError("no stats registry")
         return generate_latest(self.stats_registry).decode()
 
+    def get_store_dump(self, prefix: str = "") -> list:
+        """Arbitrary keyspace dump of this agent's cluster-store view
+        (the `netctl vppdump` analog): every (key, value) under the
+        selected key class, through whatever handle the agent has —
+        in-process store or leader-following remote client."""
+        if self.store is None:
+            raise LookupError("no store")
+        return [{"key": k, "value": _jsonable(v)}
+                for k, v in self.store.list(prefix)]
+
+    def get_store_classes(self) -> list:
+        """The key classes a dump can select on: every registered DB
+        resource prefix plus the external-config space."""
+        from ..controller.dbwatcher import EXTERNAL_CONFIG_PREFIX
+        from ..models import registry
+
+        classes = [
+            {"keyword": r.keyword, "prefix": r.key_prefix}
+            for r in registry.DB_RESOURCES
+        ]
+        classes.append({"keyword": "external-config",
+                        "prefix": EXTERNAL_CONFIG_PREFIX})
+        return classes
+
+    def get_logging(self) -> dict:
+        """Effective level of every vpp_tpu component logger (the
+        cn-infra logmanager list surface).  Values are structured —
+        ``{"level": "INFO", "inherited": true}`` — so programmatic
+        consumers compare clean level names; display decoration is
+        netctl's job."""
+        root = logging.getLogger("vpp_tpu")
+        out = {"vpp_tpu": {
+            "level": logging.getLevelName(root.getEffectiveLevel()),
+            "inherited": not root.level,
+        }}
+        for name in sorted(logging.root.manager.loggerDict):
+            if not name.startswith("vpp_tpu."):
+                continue
+            logger = logging.getLogger(name)
+            out[name] = {
+                "level": logging.getLevelName(logger.getEffectiveLevel()),
+                "inherited": not logger.level,
+            }
+        return out
+
+    def post_logging(self, logger_name: str, level: str) -> dict:
+        """Set one component logger's level at runtime."""
+        if not (logger_name == "vpp_tpu" or logger_name.startswith("vpp_tpu.")):
+            raise ValueError(f"not a vpp_tpu component logger: {logger_name!r}")
+        numeric = logging.getLevelName(level.upper())
+        if not isinstance(numeric, int):
+            raise ValueError(f"unknown log level: {level!r}")
+        logging.getLogger(logger_name).setLevel(numeric)
+        return {"logger": logger_name, "level": level.upper()}
+
     def post_cni(self, action: str, body: bytes) -> dict:
         """CNI Add/Del over plain HTTP — the stdlib fallback transport
         for host shims whose system python has no grpcio (the gRPC
@@ -206,6 +271,16 @@ class AgentRestServer:
             return self.post_cni(path.rsplit("/", 1)[1], body)
         if method == "GET" and path == "/scheduler/dump":
             return self.get_scheduler_dump(query.get("prefix", ""))
+        if method == "GET" and path == "/contiv/v1/store":
+            return self.get_store_dump(query.get("prefix", ""))
+        if method == "GET" and path == "/contiv/v1/store/classes":
+            return self.get_store_classes()
+        if method == "GET" and path == "/logging":
+            return self.get_logging()
+        if method == "POST" and path == "/logging":
+            if "logger" not in query or "level" not in query:
+                raise ValueError("need logger= and level= query parameters")
+            return self.post_logging(query["logger"], query["level"])
         if method == "GET" and path == "/metrics":
             return self.get_metrics()
         if method == "GET" and path == "/contiv/v1/trace":

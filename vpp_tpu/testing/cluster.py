@@ -181,10 +181,17 @@ class SimNode:
 
 
 class SimCluster:
-    """The cluster: shared state store, K8s API + KSR, N agent nodes."""
+    """The cluster: shared state store, K8s API + KSR, N agent nodes.
 
-    def __init__(self):
-        self.store = KVStore()
+    ``store`` defaults to an in-process :class:`KVStore`; chaos/HA
+    harnesses inject a networked client instead (a ``RemoteKVStore``
+    pointed at a ``KVStoreServer`` or at an HA ensemble's member list),
+    and every component — KSR writes, nodesync allocation, dbwatcher
+    streams — crosses the socket exactly as in a real deployment.
+    """
+
+    def __init__(self, store=None):
+        self.store = store if store is not None else KVStore()
         self.k8s = FakeK8sCluster()
         self.ksr = KSRPlugin(self.k8s, KVBroker(self.store))
         self.ksr.init(start_monitor=False)

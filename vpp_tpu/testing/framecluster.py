@@ -120,8 +120,8 @@ class FrameNode:
 class FrameCluster(SimCluster):
     """SimCluster whose nodes also carry frame-level datapaths."""
 
-    def __init__(self):
-        super().__init__()
+    def __init__(self, store=None):
+        super().__init__(store=store)
         self.wire = VirtualWire()
         self.frame_nodes: Dict[str, FrameNode] = {}
         self._shim = HostShim()  # shared library handle for all nodes

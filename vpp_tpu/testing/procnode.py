@@ -62,7 +62,7 @@ def run_agent(
             node_name=name, controller=node.controller,
             dbwatcher=node.watcher, ipam=node.ipam,
             nodesync=node.nodesync, podmanager=node.podmanager,
-            scheduler=node.scheduler, port=rest_port,
+            scheduler=node.scheduler, store=store, port=rest_port,
         )
         rest_bound = rest.start()
     hostnet = None
@@ -112,7 +112,10 @@ def run_agent(
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--store", required=True, help="host:port of KVStoreServer")
+    parser.add_argument("--store", required=True,
+                        help="host:port of the KVStoreServer, or a comma-"
+                             "separated HA ensemble member list (the client "
+                             "follows the leader and fails over on its own)")
     parser.add_argument("--name", required=True)
     parser.add_argument("--mirror", default="")
     parser.add_argument("--heartbeat-prefix", default=HEARTBEAT_PREFIX)
