@@ -3,8 +3,9 @@
 
 PY ?= python
 
-.PHONY: test test-race verify-ha lint bench bench-suite bench-sweep \
-        bench-scale bench-latency bench-frames images native
+.PHONY: test test-race verify-ha verify-churn lint bench bench-suite \
+        bench-sweep bench-scale bench-latency bench-frames bench-churn \
+        images native
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -20,6 +21,21 @@ verify-ha:
 	    tests/test_kvstore_ha.py tests/test_chaos.py tests/test_deploy.py \
 	    -q $(if $(RUN_SLOW),,-m 'not slow') --continue-on-collection-errors \
 	    -p no:cacheprovider -p no:xdist -p no:randomly
+
+# Incremental-table-compile verification: the randomized churn property
+# suite (delta-built tables ≡ from-scratch rebuilds after every step,
+# swap-under-traffic atomicity) + a fast CPU bench_churn smoke that
+# checks delta beats full rebuilds AND ships O(changed) rows.  The
+# full-scale (64k rules / 4k pods, ≥10x) run is `make bench-churn`.
+verify-churn:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_table_delta.py \
+	    -q $(if $(RUN_SLOW),,-m 'not slow') --continue-on-collection-errors \
+	    -p no:cacheprovider -p no:xdist -p no:randomly
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_churn.py --smoke --check \
+	    --min-speedup 1.5
+
+bench-churn:
+	$(PY) scripts/bench_churn.py --check
 
 # Race-amplified run: CPython has no Go-style race detector, so instead
 # the whole suite runs under dev mode (threading/resource warnings are

@@ -1,0 +1,337 @@
+"""Churn-convergence benchmark — commit→installed latency, full vs delta.
+
+Measures what a control-plane transaction COSTS at scale: one pod add /
+delete / policy flip (ACL side) or one service endpoint add / remove
+(NAT side) against a cluster-sized table set, comparing
+
+- **full**:  the legacy path — recompile the whole state from Python
+  objects (``compile_pod_tables`` / ``build_nat_tables``) and upload
+  every tensor;
+- **delta**: the persistent incremental builders
+  (ops/classify_delta, ops/nat_delta) — diff the dirty keys, patch host
+  mirrors, scatter only changed rows to the device.
+
+Commit→installed latency is wall time from the state mutation to the
+new tables being device-ready (``block_until_ready`` on every leaf).
+Bytes/rows shipped come from the builders' DeltaStats counters — the
+O(changed) claim is asserted on COUNTERS, not timing.
+
+Emits one JSONL line per (side, op, mode) with p50/p99 latency and
+shipped-rows/bytes percentiles, plus a summary line with the
+delta-vs-full speedups; ``--check`` exits nonzero unless delta wins by
+>= --min-speedup on every op AND ships O(changed) rows.
+
+Usage:
+    python scripts/bench_churn.py                   # full scale: 4k pods / 64k rules
+    python scripts/bench_churn.py --smoke --check   # CPU CI smoke (make verify-churn)
+    python scripts/bench_churn.py --out BENCHCHURN.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import random
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def _ready(tables) -> None:
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(tables):
+        leaf.block_until_ready()
+
+
+def _pct(values, q: float) -> float:
+    values = sorted(values)
+    if not values:
+        return 0.0
+    idx = min(len(values) - 1, int(round(q * (len(values) - 1))))
+    return values[idx]
+
+
+def _full_nbytes(tables) -> int:
+    import jax
+
+    return sum(leaf.nbytes for leaf in jax.tree_util.tree_leaves(tables))
+
+
+# ------------------------------------------------------------------ ACL side
+
+
+def _acl_state(pods: int, rules_per_pod: int, rng: random.Random):
+    from vpp_tpu.policy.renderer.api import Action, ContivRule
+
+    def entry(i: int):
+        # Unique per-pod table: interning must not collapse the scale.
+        rules = tuple(
+            ContivRule(action=Action.DENY, dst_port=(i * rules_per_pod + j) % 60000 + 1)
+            for j in range(rules_per_pod)
+        )
+        return (0x0A010000 + i + 1, rules, ())
+
+    return {f"tpu/acl/pod/default/p{i:06d}": entry(i) for i in range(pods)}
+
+
+def _acl_ops(state, rules_per_pod: int, rng: random.Random, n_ops: int):
+    """Yield (op_name, mutate_fn) single-key churn ops."""
+    from vpp_tpu.policy.renderer.api import Action, ContivRule
+
+    next_id = [len(state)]
+
+    def fresh_rules(tag: int):
+        return tuple(
+            ContivRule(action=Action.DENY, dst_port=(tag * 31 + j) % 60000 + 1)
+            for j in range(rules_per_pod)
+        )
+
+    def pod_add():
+        i = next_id[0]
+        next_id[0] += 1
+        state[f"tpu/acl/pod/default/x{i:06d}"] = (
+            0x0A020000 + i, fresh_rules(i + 100000), ())
+
+    def pod_del():
+        key = rng.choice([k for k in state])
+        del state[key]
+
+    def policy_flip():
+        key = rng.choice(list(state))
+        ip, _, eg = state[key]
+        state[key] = (ip, fresh_rules(next_id[0] + 200000), eg)
+        next_id[0] += 1
+
+    ops = [("pod_add", pod_add), ("pod_del", pod_del),
+           ("policy_flip", policy_flip)]
+    for i in range(n_ops):
+        yield ops[i % len(ops)]
+
+
+def bench_acl(args, emit) -> dict:
+    from vpp_tpu.ops.classify_delta import AclTableBuilder
+    from vpp_tpu.policy.renderer.tpu import compile_pod_tables
+
+    rng = random.Random(args.seed)
+    results = {}
+    for mode in ("full", "delta"):
+        state = _acl_state(args.pods, args.rules_per_pod, rng)
+        builder = AclTableBuilder()
+        if mode == "delta":
+            _ready(builder.sync(state))  # steady state: first build paid
+        else:
+            _ready(compile_pod_tables(dict(state)))
+        per_op: dict = {}
+        ops = list(_acl_ops(state, args.rules_per_pod,
+                            random.Random(args.seed + 1),
+                            args.ops + 3))
+        # Warmup: one op of each kind, unmeasured — compiles the
+        # scatter programs for this scale's index buckets.
+        for name, mutate in ops[:3]:
+            mutate()
+            _ready(builder.sync(state) if mode == "delta"
+                   else compile_pod_tables(dict(state)))
+        for name, mutate in ops[3:]:
+            mutate()
+            t0 = time.perf_counter()
+            if mode == "delta":
+                builder.stats.begin_build()
+                tables = builder.sync(state)
+            else:
+                tables = compile_pod_tables(dict(state))
+            _ready(tables)
+            lat = (time.perf_counter() - t0) * 1e3
+            rec = per_op.setdefault(name, {"lat": [], "rows": [], "bytes": []})
+            rec["lat"].append(lat)
+            if mode == "delta":
+                rec["rows"].append(builder.stats.last_rows_shipped)
+                rec["bytes"].append(builder.stats.last_bytes_shipped)
+            else:
+                rec["rows"].append(
+                    int(tables.rule_valid.shape[0]) + int(tables.pod_ip.shape[0]))
+                rec["bytes"].append(_full_nbytes(tables))
+        for name, rec in per_op.items():
+            line = {
+                "bench": "churn", "side": "acl", "mode": mode, "op": name,
+                "pods": args.pods, "rules": args.pods * args.rules_per_pod,
+                "n_ops": len(rec["lat"]),
+                "p50_ms": round(_pct(rec["lat"], 0.5), 3),
+                "p99_ms": round(_pct(rec["lat"], 0.99), 3),
+                "rows_shipped_p50": _pct(rec["rows"], 0.5),
+                "bytes_shipped_p50": _pct(rec["bytes"], 0.5),
+            }
+            emit(line)
+            results[(("acl", name, mode))] = line
+    return results
+
+
+# ------------------------------------------------------------------ NAT side
+
+
+def _nat_services(n_services: int, backends: int, rng: random.Random):
+    from vpp_tpu.ops.nat import NatMapping
+
+    def svc(i: int):
+        return (NatMapping(
+            external_ip=f"10.96.{i // 250}.{i % 250 + 1}",
+            external_port=80, protocol=6,
+            backends=[
+                (f"10.1.{(i * backends + b) // 250 % 250 + 1}.{(i * backends + b) % 250 + 1}",
+                 8080, 1)
+                for b in range(backends)
+            ],
+        ),)
+
+    return {f"tpu/nat/service/default/s{i:05d}": svc(i)
+            for i in range(n_services)}
+
+
+def bench_nat(args, emit) -> dict:
+    import dataclasses
+
+    from vpp_tpu.ops.nat import build_nat_tables
+    from vpp_tpu.ops.nat_delta import NatTableBuilder
+
+    rng = random.Random(args.seed)
+    glob = dict(nat_loopback="10.1.255.254", snat_ip="192.168.16.1",
+                snat_enabled=True, pod_subnet="10.1.0.0/16")
+
+    def flatten(svcs):
+        out = []
+        for k in sorted(svcs):
+            out.extend(svcs[k])
+        return out
+
+    results = {}
+    for mode in ("full", "delta"):
+        services = _nat_services(args.services, args.backends, rng)
+        builder = NatTableBuilder()
+        if mode == "delta":
+            _ready(builder.sync(services, **glob))
+        else:
+            _ready(build_nat_tables(flatten(services), **glob))
+        per_op: dict = {}
+        opred = random.Random(args.seed + 2)
+        for i in range(-2, args.ops):  # i<0: unmeasured warmup ops
+            key = opred.choice(list(services))
+            m = services[key][0]
+            if i % 2 == 0:
+                name = "ep_add"
+                nm = dataclasses.replace(
+                    m, backends=m.backends + [("10.1.250.250", 9999, 1)])
+            else:
+                name = "ep_del"
+                nm = dataclasses.replace(m, backends=m.backends[:-1] or m.backends)
+            services[key] = (nm,) + services[key][1:]
+            t0 = time.perf_counter()
+            if mode == "delta":
+                builder.stats.begin_build()
+                tables = builder.sync(services, **glob)
+            else:
+                tables = build_nat_tables(flatten(services), **glob)
+            _ready(tables)
+            lat = (time.perf_counter() - t0) * 1e3
+            if i < 0:
+                continue  # warmup: scatter programs now compiled
+            rec = per_op.setdefault(name, {"lat": [], "rows": [], "bytes": []})
+            rec["lat"].append(lat)
+            if mode == "delta":
+                rec["rows"].append(builder.stats.last_rows_shipped)
+                rec["bytes"].append(builder.stats.last_bytes_shipped)
+            else:
+                rec["rows"].append(int(tables.map_valid.shape[0]))
+                rec["bytes"].append(_full_nbytes(tables))
+        for name, rec in per_op.items():
+            line = {
+                "bench": "churn", "side": "nat", "mode": mode, "op": name,
+                "services": args.services,
+                "mappings": args.services,
+                "n_ops": len(rec["lat"]),
+                "p50_ms": round(_pct(rec["lat"], 0.5), 3),
+                "p99_ms": round(_pct(rec["lat"], 0.99), 3),
+                "rows_shipped_p50": _pct(rec["rows"], 0.5),
+                "bytes_shipped_p50": _pct(rec["bytes"], 0.5),
+            }
+            emit(line)
+            results[("nat", name, mode)] = line
+    return results
+
+
+# --------------------------------------------------------------------- main
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--pods", type=int, default=4096)
+    parser.add_argument("--rules-per-pod", type=int, default=16)
+    parser.add_argument("--services", type=int, default=512)
+    parser.add_argument("--backends", type=int, default=4)
+    parser.add_argument("--ops", type=int, default=30,
+                        help="churn ops measured per mode")
+    parser.add_argument("--seed", type=int, default=3)
+    parser.add_argument("--smoke", action="store_true",
+                        help="small CPU CI scale (512 pods / 4k rules)")
+    parser.add_argument("--check", action="store_true",
+                        help="exit nonzero unless delta >= --min-speedup "
+                             "on every op and ships O(changed) rows")
+    parser.add_argument("--min-speedup", type=float, default=10.0)
+    parser.add_argument("--out", default=None,
+                        help="append JSONL lines to this file too")
+    args = parser.parse_args()
+    if args.smoke:
+        args.pods = min(args.pods, 512)
+        args.rules_per_pod = min(args.rules_per_pod, 8)
+        args.services = min(args.services, 128)
+        args.ops = min(args.ops, 18)
+
+    out_file = open(args.out, "a") if args.out else None
+
+    def emit(line: dict) -> None:
+        print(json.dumps(line))
+        if out_file:
+            out_file.write(json.dumps(line) + "\n")
+
+    results = {}
+    results.update(bench_acl(args, emit))
+    results.update(bench_nat(args, emit))
+
+    failures = []
+    summary = {"bench": "churn", "summary": True,
+               "pods": args.pods, "rules": args.pods * args.rules_per_pod,
+               "services": args.services, "speedups": {}}
+    total_rows = {"acl": args.pods * args.rules_per_pod + args.pods,
+                  "nat": args.services}
+    for (side, op, mode), line in list(results.items()):
+        if mode != "delta":
+            continue
+        full = results.get((side, op, "full"))
+        if not full:
+            continue
+        speedup = (full["p50_ms"] / line["p50_ms"]) if line["p50_ms"] else float("inf")
+        summary["speedups"][f"{side}.{op}"] = round(speedup, 1)
+        if args.check and speedup < args.min_speedup:
+            failures.append(
+                f"{side}.{op}: delta speedup {speedup:.1f}x < {args.min_speedup}x")
+        # O(changed): a single-key op must ship a small fraction of the
+        # table (pod-slot suffix memmoves dominate the worst case).
+        if args.check and line["rows_shipped_p50"] > max(
+            64, total_rows[side] // 4
+        ):
+            failures.append(
+                f"{side}.{op}: shipped {line['rows_shipped_p50']} rows "
+                f"p50 of {total_rows[side]} total — not O(changed)")
+    emit(summary)
+    if out_file:
+        out_file.close()
+    if failures:
+        for f in failures:
+            print(f"CHECK FAILED: {f}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

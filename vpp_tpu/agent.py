@@ -223,6 +223,13 @@ class Agent:
         # last compile (VERDICT r4 #2).
         self.acl_applicator.installed_fn = lambda: self.runner.acl
         self.nat_applicator.installed_fn = lambda: self.runner.nat
+        # Compile observability: full-vs-delta compile counts, rows/bytes
+        # shipped per swap — surfaced by runner.inspect() → REST
+        # /contiv/v1/inspect → `netctl inspect`.
+        self.runner.compile_stats_fn = lambda: {
+            "acl": self.acl_applicator.stats().get("compile", {}),
+            "nat": self.nat_applicator.stats().get("compile", {}),
+        }
         self.runner.update_tables(
             acl=self.policy_renderer.tables, nat=self.nat_renderer.tables
         )

@@ -22,7 +22,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import threading
-from typing import Deque, Dict, Optional, Tuple
+from typing import Callable, Deque, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -124,6 +124,13 @@ class RunnerCounters:
     host_restores: int = 0
     batches: int = 0
     bypass_batches: int = 0
+    # Control→data plane swap observability: one tick per update_tables
+    # table adoption (delta swaps included — the swap itself is always
+    # atomic whole-object; what shrinks is the bytes shipped, counted by
+    # the builders' DeltaStats surfaced via inspect()["compile"]).
+    acl_swaps: int = 0
+    nat_swaps: int = 0
+    route_swaps: int = 0
 
     def as_dict(self) -> Dict[str, int]:
         return {f"datapath_{k}_total": v for k, v in dataclasses.asdict(self).items()}
@@ -254,6 +261,11 @@ class DataplaneRunner:
         # path there.  Solo runners keep the zero-copy fast path.
         self._shared_host = host_lock is not None
         self.counters = RunnerCounters()
+        # Optional zero-arg provider of control-plane compile stats (the
+        # agent attaches the applicators' stats() here) — surfaced by
+        # inspect() so `netctl inspect` shows full-vs-delta compile
+        # counts and rows shipped next to the tables they produced.
+        self.compile_stats_fn: Optional[Callable[[], Dict]] = None
         # Sampled per-packet verdict traces (vpptrace analog), enabled on
         # demand via REST/netctl.
         self.tracer = tracer if tracer is not None else PacketTracer()
@@ -288,7 +300,37 @@ class DataplaneRunner:
 
     # ------------------------------------------------------ host bypass
 
-    def _refresh_bypass(self) -> None:
+    def _bypass_static_ok(self) -> bool:
+        """The device-read-free half of bypass eligibility: trivially
+        permissive tables on a native, mesh-less runner."""
+        return (
+            self._native is not None
+            and self.mesh is None
+            and self.acl is not None and self.nat is not None
+            and self.route is not None
+            and getattr(self.acl, "num_rules", 1) == 0
+            and getattr(self.acl, "num_tables", 1) == 0
+            and self.nat.num_mappings == 0
+            and not bool(np.asarray(self.nat.snat_enabled))
+            and not self.nat.has_affinity
+        )
+
+    def _bypass_state_clear(self) -> bool:
+        """The residual-state half (PAYS device occupancy reads): no
+        slow-path flows, no live sessions, no ClientIP affinity pins.
+        Orphaned pins drain via the affinity sweep, which only runs on
+        the DISPATCH path — bypassing while pins remain would park them
+        in the table forever (and stale pins would resurrect dead
+        backend picks if the service reappears).  The sharded engine
+        computes this ONCE per table swap (the session state is shared)
+        and hands it to every shard's _refresh_bypass."""
+        return (
+            len(self.slow) == 0
+            and session_occupancy(self.sessions) == 0
+            and affinity_occupancy(self.sessions) == 0
+        )
+
+    def _refresh_bypass(self, state_clear: Optional[bool] = None) -> None:
         """Precompute host-bypass eligibility — VPP's feature-less
         interface path: with NO ACL rules or tables, NO NAT mappings,
         SNAT off, and no residual session/slow-path state, EVERY frame
@@ -299,25 +341,11 @@ class DataplaneRunner:
         measured capacity instead of the XLA round trip.  Re-derived on
         every table swap; the tracer is re-checked per poll (REST can
         enable it any time), and residual sessions only ever decay, so
-        the one-shot occupancy check here stays valid."""
-        eligible = (
-            self._native is not None
-            and self.mesh is None
-            and self.acl is not None and self.nat is not None
-            and self.route is not None
-            and getattr(self.acl, "num_rules", 1) == 0
-            and getattr(self.acl, "num_tables", 1) == 0
-            and self.nat.num_mappings == 0
-            and not bool(np.asarray(self.nat.snat_enabled))
-            and not self.nat.has_affinity
-            and len(self.slow) == 0
-            and session_occupancy(self.sessions) == 0
-            # Orphaned ClientIP pins drain via the affinity sweep, which
-            # only runs on the DISPATCH path — bypassing while pins
-            # remain would park them in the table forever (and stale
-            # pins would resurrect dead backend picks if the service
-            # reappears).  The sweep's stand-down re-evaluates us.
-            and affinity_occupancy(self.sessions) == 0
+        the one-shot occupancy check here stays valid.  ``state_clear``
+        lets a caller that already paid the device occupancy reads
+        (ShardedDataplane.update_tables) pass the result in."""
+        eligible = self._bypass_static_ok() and (
+            self._bypass_state_clear() if state_clear is None else state_clear
         )
         if eligible:
             self._bypass_route = (
@@ -460,11 +488,40 @@ class DataplaneRunner:
     ) -> None:
         """Atomic table swap: takes effect for the NEXT dispatched batch
         (in-flight batches complete against the tables they saw — the
-        same semantics as VPP's ACL/NAT table swap under traffic)."""
+        same semantics as VPP's ACL/NAT table swap under traffic).  This
+        contract is what makes DELTA-BUILT tables safe: the builders'
+        scatter produces new arrays without touching the old buffers, so
+        a swap here can never mutate tables an in-flight dispatch still
+        references."""
+        if acl is not None or nat is not None or route is not None:
+            # Disarm the host bypass BEFORE the new tables land: a
+            # concurrent poll must never forward under a stale
+            # bypass=eligible flag once deny rules exist.  The refresh
+            # below re-arms it when the new tables are still trivial.
+            self._bypass_tables = False
+        self._adopt_tables(
+            acl,
+            retarget_tables(nat, self._target_backend())
+            if nat is not None else None,
+            route,
+        )
+        if acl is not None or nat is not None or route is not None:
+            self._refresh_bypass()
+
+    def _adopt_tables(
+        self,
+        acl: Optional[RuleTables],
+        nat: Optional[NatTables],
+        route: Optional[RouteConfig],
+    ) -> None:
+        """The swap body minus retarget/bypass derivation — the sharded
+        engine retargets ONCE and adopts on every shard (shards.py)."""
         if acl is not None:
             self.acl = acl
+            self.counters.acl_swaps += 1
         if nat is not None:
-            self.nat = retarget_tables(nat, self._target_backend())
+            self.nat = nat
+            self.counters.nat_swaps += 1
             if self.nat.has_affinity:
                 # Pins may be created from now on; the sweep keeps
                 # running (and draining orphans) even after a later
@@ -472,6 +529,7 @@ class DataplaneRunner:
                 self._state.aff_pinned = True
         if route is not None:
             self.route = route
+            self.counters.route_swaps += 1
         if self.mesh is not None and (
             acl is not None or nat is not None or route is not None
         ):
@@ -481,8 +539,6 @@ class DataplaneRunner:
                 self.mesh, self.acl, self.nat, self.route, self.sessions,
                 partition_sessions=self.partition_sessions,
             )
-        if acl is not None or nat is not None or route is not None:
-            self._refresh_bypass()
 
     # --------------------------------------------------------------- loop
 
@@ -924,9 +980,17 @@ class DataplaneRunner:
         not a hot path."""
         acl = self.acl
         nat = self.nat
+        compile_stats: Dict[str, object] = {
+            "acl_swaps": self.counters.acl_swaps,
+            "nat_swaps": self.counters.nat_swaps,
+            "route_swaps": self.counters.route_swaps,
+        }
+        if self.compile_stats_fn is not None:
+            compile_stats.update(self.compile_stats_fn())
         return {
             "engine": self.engine,
             "dispatch": self.inspect_dispatch(),
+            "compile": compile_stats,
             "classify": {
                 "rules": getattr(acl, "num_rules", 0) if acl is not None else 0,
                 "tables": getattr(acl, "num_tables", 0) if acl is not None else 0,

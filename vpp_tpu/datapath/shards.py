@@ -90,6 +90,16 @@ class ShardedDataplane:
     def engine(self) -> str:
         return self.shards[0].engine
 
+    # Control-plane compile stats rider: inspect() is served from shard
+    # 0's full view, so the provider lives there.
+    @property
+    def compile_stats_fn(self):
+        return self.shards[0].compile_stats_fn
+
+    @compile_stats_fn.setter
+    def compile_stats_fn(self, fn) -> None:
+        self.shards[0].compile_stats_fn = fn
+
     # --------------------------------------------------------------- loop
 
     def poll(self) -> int:
@@ -105,8 +115,32 @@ class ShardedDataplane:
     # ------------------------------------------------------------- tables
 
     def update_tables(self, acl=None, nat=None, route=None) -> None:
+        """One swap for all shards: the backend retarget and the
+        bypass-eligibility device reads (session/affinity occupancy on
+        the SHARED state) are computed ONCE and handed to every shard,
+        instead of once per shard — at 8+ shards the per-shard device
+        round trips used to dominate the swap latency."""
+        if not (acl is not None or nat is not None or route is not None):
+            return
+        from ..ops.nat import retarget_tables
+
+        r0 = self.shards[0]
+        if nat is not None:
+            nat = retarget_tables(nat, r0._target_backend())
+        # Disarm every shard's host bypass BEFORE any shard adopts: the
+        # adopt + shared occupancy reads below take multiple batches'
+        # worth of wall time, and a concurrent poll must not keep
+        # forwarding via the bypass once deny rules are being installed.
         for r in self.shards:
-            r.update_tables(acl=acl, nat=nat, route=route)
+            r._bypass_tables = False
+        for r in self.shards:
+            r._adopt_tables(acl, nat, route)
+        # Shared-state occupancy reads only when the static half can
+        # pass at all (the checks short-circuit before any device read
+        # when the tables are non-trivial).
+        state_clear = r0._bypass_state_clear() if r0._bypass_static_ok() else False
+        for r in self.shards:
+            r._refresh_bypass(state_clear=state_clear)
 
     # ------------------------------------------------------------ metrics
 
@@ -121,6 +155,12 @@ class ShardedDataplane:
         for r in self.shards:
             for key, value in r.counters.as_dict().items():
                 agg[key] = agg.get(key, 0) + value
+        # Table-swap ticks are per SWAP, not per shard: every shard
+        # adopts the same tables in one update_tables call, so summing
+        # would report N_shards x the true count — take shard 0's.
+        for key, value in self.shards[0].counters.as_dict().items():
+            if key.endswith("_swaps_total"):
+                agg[key] = value
         for key, value in self.slow.counters.as_dict().items():
             agg[key] = value
         agg["datapath_sessions_active"] = sessions_active

@@ -214,6 +214,20 @@ def cmd_inspect(server: str, out, watch: float = 0.0, raw: bool = False) -> int:
         print(f"sessions: {se['active']}/{se['capacity']} active, "
               f"{se['affinity_pins']} affinity pins   slowpath: "
               f"{sp['sessions']} sessions", file=out)
+        comp = d.get("compile") or {}
+        if comp:
+            parts = [f"swaps acl={comp.get('acl_swaps', 0)} "
+                     f"nat={comp.get('nat_swaps', 0)}"]
+            for name in ("acl", "nat"):
+                cs = comp.get(name) or {}
+                if cs:
+                    parts.append(
+                        f"{name}: {cs.get('delta_builds', 0)} delta / "
+                        f"{cs.get('full_builds', 0)} full compiles, "
+                        f"{cs.get('rows_shipped', 0)} rows "
+                        f"({cs.get('bytes_shipped', 0)} B) shipped"
+                    )
+            print("compile: " + "   ".join(parts), file=out)
         rows = [[name, info.get("frames", "-"), info.get("dropped", "-")]
                 for name, info in d["rings"].items() if info]
         if rows:

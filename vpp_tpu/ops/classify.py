@@ -107,6 +107,30 @@ def _prefix_mask(net: Optional[ipaddress.IPv4Network]) -> Tuple[int, int]:
 
 _PERMIT_ALL = ContivRule(action=Action.PERMIT)
 
+# Pod-slot padding IP (255.255.255.255 — never a pod IP; keeps the
+# sorted binary search well-defined past the live slots).
+POD_PAD_IP = 0xFFFFFFFF
+
+_ACTION_CODE = {
+    Action.DENY: _DENY,
+    Action.PERMIT: _PERMIT,
+    Action.PERMIT_REFLECT: _PERMIT_REFLECT,
+}
+
+
+def rule_fields(rule: ContivRule) -> Tuple[int, int, int, int, int, int, int, int]:
+    """One rule's tensor row sans table id: (src_base, src_mask,
+    dst_base, dst_mask, proto, src_port, dst_port, action).  Shared by
+    the full build and the incremental builder (classify_delta) so the
+    two encode bit-identically by construction."""
+    src_base, src_mask = _prefix_mask(rule.src_network)
+    dst_base, dst_mask = _prefix_mask(rule.dst_network)
+    return (
+        src_base, src_mask, dst_base, dst_mask,
+        int(rule.protocol), rule.src_port, rule.dst_port,
+        _ACTION_CODE[rule.action],
+    )
+
 
 def _next_pow2(n: int, minimum: int = 8) -> int:
     """Shared static-shape bucketing policy for ACL and NAT tables:
@@ -134,19 +158,7 @@ def build_rule_tables(
     for tid, table in enumerate(tables):
         rules = list(table) if table else [_PERMIT_ALL]
         for rule in rules:
-            src_base, src_mask = _prefix_mask(rule.src_network)
-            dst_base, dst_mask = _prefix_mask(rule.dst_network)
-            action = {
-                Action.DENY: _DENY,
-                Action.PERMIT: _PERMIT,
-                Action.PERMIT_REFLECT: _PERMIT_REFLECT,
-            }[rule.action]
-            rows.append(
-                (
-                    tid, src_base, src_mask, dst_base, dst_mask,
-                    int(rule.protocol), rule.src_port, rule.dst_port, action,
-                )
-            )
+            rows.append((tid,) + rule_fields(rule))
 
     n = len(rows)
     padded = _next_pow2(max(n, 1), bucket_min)
@@ -161,7 +173,7 @@ def build_rule_tables(
     p_padded = _next_pow2(max(p, 1), bucket_min)
     # Sorted ascending with 255.255.255.255 padding (never a pod IP), so
     # the lookup is a binary search instead of a dense [B, P] compare.
-    pod_ip = np.full(p_padded, 0xFFFFFFFF, dtype=np.uint32)
+    pod_ip = np.full(p_padded, POD_PAD_IP, dtype=np.uint32)
     pod_in = np.full(p_padded, NO_TABLE, dtype=np.int32)
     pod_eg = np.full(p_padded, NO_TABLE, dtype=np.int32)
     for i, (ip, (in_tid, eg_tid)) in enumerate(pods):

@@ -1,0 +1,626 @@
+"""Persistent incremental RuleTables builder — O(changed) ACL compiles.
+
+``compile_pod_tables`` rebuilds EVERYTHING from Python objects on every
+transaction: every rule re-encoded, every tensor re-uploaded, for any
+single-key change.  At the roadmap scale (64k rules / 4k pods with
+constant pod churn) that makes control-plane convergence O(cluster) per
+event — the classifier-update wall RVH identifies (PAPERS.md).
+
+:class:`AclTableBuilder` keeps the host-side numpy mirrors and the
+table-interning map alive across transactions:
+
+- **diff**: ``sync(state)`` diffs the incoming pod-entry dict against
+  the builder's copy (identity check first, so unchanged keys cost one
+  ``is``), and only dirty keys are touched;
+- **interning**: identical rule lists share one table id with a
+  refcount (the reference ACL renderer's table sharing); a policy flip
+  re-interns one list — rules of other pods are never re-encoded;
+- **rule rows**: each table owns a contiguous row span from a first-fit
+  free-span allocator (spans keep the within-table first-match order);
+  freed spans are zeroed (so padding stays canonical) and recycled;
+- **pod slots**: the pod arrays stay IP-sorted (the device lookup is a
+  binary search), so a pod add/delete memmoves the host suffix and
+  ships only the slots whose values changed;
+- **bucketing**: the pow2 rule/pod buckets grow on overflow (full-group
+  reship, same XLA-recompile discipline as before) and shrink ONLY with
+  4x hysteresis via a compacting full rebuild — churn at a bucket
+  boundary cannot thrash device programs;
+- **delta apply**: dirty rows ship through one jitted scatter per
+  (group, pow2-index-bucket) — ``ops/delta.apply_rows`` — producing new
+  device arrays without touching the old buffers (in-flight dispatches
+  keep theirs);
+- **incremental fingerprint**: per-leaf uint32 wrap-sums are maintained
+  under every patch, so the applicator's expected-side fingerprint is a
+  host fold, not a device reduction.
+
+A FULL build (first sync, or a shrink compaction) resets the builder
+through the same canonical insertion order as ``compile_pod_tables``
+(pods sorted by str(key), ingress interned before egress), so a fresh
+builder's arrays are bit-identical to the from-scratch compile.  After
+arbitrary churn the delta layout may permute rows and table ids —
+:func:`canonical_rule_tables` maps any layout back to the canonical one
+for the equivalence property tests.
+"""
+
+from __future__ import annotations
+
+import bisect
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .classify import (
+    NO_TABLE,
+    POD_PAD_IP,
+    RuleTables,
+    _next_pow2,
+    rule_fields,
+)
+from .delta import apply_rows, fold_fingerprint, group_nbytes, u32_wrap_sum
+from .delta import DeltaStats  # re-exported: builder.stats type
+
+_U32 = 0xFFFFFFFF
+
+# Column (name, dtype, pad value) specs — ORDER MUST MATCH
+# RuleTables.tree_flatten (the fingerprint folds leaves in that order).
+RULE_LEAVES: Tuple[Tuple[str, type], ...] = (
+    ("rule_valid", np.bool_),
+    ("rule_tid", np.int32),
+    ("rule_src_base", np.uint32),
+    ("rule_src_mask", np.uint32),
+    ("rule_dst_base", np.uint32),
+    ("rule_dst_mask", np.uint32),
+    ("rule_proto", np.int32),
+    ("rule_src_port", np.int32),
+    ("rule_dst_port", np.int32),
+    ("rule_action", np.int32),
+)
+POD_LEAVES: Tuple[Tuple[str, type, int], ...] = (
+    ("pod_ip", np.uint32, POD_PAD_IP),
+    ("pod_ingress_tid", np.int32, NO_TABLE),
+    ("pod_egress_tid", np.int32, NO_TABLE),
+)
+# rule_fields() order -> rule column names 2..9.
+_FIELD_COLS = (
+    "rule_src_base", "rule_src_mask", "rule_dst_base", "rule_dst_mask",
+    "rule_proto", "rule_src_port", "rule_dst_port", "rule_action",
+)
+
+
+class _SpanAlloc:
+    """First-fit free-span allocator over ``[0, cap)`` row indices."""
+
+    def __init__(self, cap: int):
+        self.cap = cap
+        self._spans: List[List[int]] = [[0, cap]]  # sorted [start, len]
+
+    def alloc(self, n: int) -> Optional[int]:
+        for i, (start, length) in enumerate(self._spans):
+            if length >= n:
+                if length == n:
+                    self._spans.pop(i)
+                else:
+                    self._spans[i] = [start + n, length - n]
+                return start
+        return None
+
+    def free(self, start: int, n: int) -> None:
+        spans = self._spans
+        i = bisect.bisect_left(spans, [start, 0])
+        spans.insert(i, [start, n])
+        if i + 1 < len(spans) and spans[i][0] + spans[i][1] == spans[i + 1][0]:
+            spans[i][1] += spans[i + 1][1]
+            spans.pop(i + 1)
+        if i > 0 and spans[i - 1][0] + spans[i - 1][1] == spans[i][0]:
+            spans[i - 1][1] += spans[i][1]
+            spans.pop(i)
+
+    def grow(self, newcap: int) -> None:
+        self.free(self.cap, newcap - self.cap)
+        self.cap = newcap
+
+    @property
+    def used(self) -> int:
+        return self.cap - sum(length for _, length in self._spans)
+
+
+@dataclass
+class _TableRec:
+    tid: int
+    start: int
+    n: int
+    refs: int
+
+
+class AclTableBuilder:
+    """Incremental compiler for the classify RuleTables."""
+
+    def __init__(self, bucket_min: int = 8):
+        self.bucket_min = bucket_min
+        self.stats = DeltaStats()
+        self.last_tables: Optional[RuleTables] = None
+        self.fingerprint: Optional[int] = None
+        self._state: Dict[object, tuple] = {}
+        self._reset(bucket_min, bucket_min)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _reset(self, rule_cap: int, pod_cap: int) -> None:
+        self._r: Dict[str, np.ndarray] = {
+            name: np.zeros(rule_cap, dtype=dt) for name, dt in RULE_LEAVES
+        }
+        self._p: Dict[str, np.ndarray] = {
+            name: np.full(pod_cap, pad, dtype=dt) for name, dt, pad in POD_LEAVES
+        }
+        self._spans = _SpanAlloc(rule_cap)
+        self._tables: Dict[tuple, _TableRec] = {}
+        self._free_tids: List[int] = []
+        self._next_tid = 0
+        # pod ip -> {state key -> (ingress, egress, in_tid, eg_tid)}:
+        # multiple pod keys can claim one IP; the winner matches
+        # compile_pod_tables' dict-overwrite (largest str(key) wins).
+        self._claims: Dict[int, Dict[object, tuple]] = {}
+        self._p_live = 0
+        self._sums: Dict[str, int] = {}
+        for name, _ in RULE_LEAVES:
+            self._sums[name] = u32_wrap_sum(self._r[name])
+        for name, _, _ in POD_LEAVES:
+            self._sums[name] = u32_wrap_sum(self._p[name])
+        self._dirty_rules: set = set()
+        self._dirty_pods: set = set()
+        self._reship_rules = True
+        self._reship_pods = True
+
+    # ----------------------------------------------------------------- sync
+
+    def sync(self, state: Mapping[object, tuple]) -> RuleTables:
+        """Bring the compiled tables to ``state`` (key -> (pod_ip_u32,
+        ingress rules, egress rules)); returns the new RuleTables with
+        only changed rows shipped to the device."""
+        t0 = time.perf_counter()
+        self.stats.begin_build()
+        changes: Dict[object, Optional[tuple]] = {}
+        for key, entry in state.items():
+            old = self._state.get(key)
+            if old is not entry and old != entry:
+                changes[key] = entry
+        for key in self._state:
+            if key not in state:
+                changes[key] = None
+        if self.last_tables is None:
+            tables = self._full(dict(state))
+        elif changes:
+            tables = self._delta(changes)
+        else:
+            tables = self.last_tables
+        dt = time.perf_counter() - t0
+        self.stats.build_seconds += dt
+        self.stats.last_build_seconds = dt
+        return tables
+
+    # ---------------------------------------------------------- delta build
+
+    def _delta(self, changes: Dict[object, Optional[tuple]]) -> RuleTables:
+        self._dirty_rules = set()
+        self._dirty_pods = set()
+        self._reship_rules = False
+        self._reship_pods = False
+        for key, entry in sorted(changes.items(), key=lambda kv: str(kv[0])):
+            self._apply_change(key, entry)
+        live = self._spans.used
+        pod_cap = len(self._p["pod_ip"])
+        if (self._spans.cap > self.bucket_min and live * 4 <= self._spans.cap) or (
+            pod_cap > self.bucket_min and self._p_live * 4 <= pod_cap
+        ):
+            # Hysteresis shrink: compact through a full rebuild, landing
+            # at 2x headroom so a regrow needs the live set to double.
+            self.stats.shrinks += 1
+            return self._full(
+                self._state,
+                rule_cap_min=_next_pow2(max(2 * live, 1), self.bucket_min),
+                pod_cap_min=_next_pow2(max(2 * self._p_live, 1), self.bucket_min),
+            )
+        self.stats.delta_builds += 1
+        return self._ship()
+
+    def _apply_change(self, key: object, entry: Optional[tuple]) -> None:
+        old = self._state.get(key)
+        if entry is None:
+            if old is not None:
+                self._remove_pod(key, old)
+                del self._state[key]
+            return
+        ip, ing, eg = int(entry[0]), tuple(entry[1]), tuple(entry[2])
+        if old is not None:
+            if int(old[0]) == ip:
+                self._update_pod(key, ip, ing, eg)
+                self._state[key] = entry
+                return
+            self._remove_pod(key, old)
+        self._add_pod(key, ip, ing, eg)
+        self._state[key] = entry
+
+    def _add_pod(self, key: object, ip: int, ing: tuple, eg: tuple) -> None:
+        in_tid = self._intern(ing)
+        eg_tid = self._intern(eg)
+        self._claims.setdefault(ip, {})[key] = (ing, eg, in_tid, eg_tid)
+        self._set_slot(ip)
+
+    def _update_pod(self, key: object, ip: int, ing: tuple, eg: tuple) -> None:
+        claims = self._claims[ip]
+        oing, oeg, _, _ = claims[key]
+        # Intern BEFORE deref: a flip back to identical content must
+        # keep the shared table alive instead of freeing + reallocating.
+        in_tid = self._intern(ing)
+        eg_tid = self._intern(eg)
+        self._deref(oing)
+        self._deref(oeg)
+        claims[key] = (ing, eg, in_tid, eg_tid)
+        self._set_slot(ip)
+
+    def _remove_pod(self, key: object, old: tuple) -> None:
+        ip = int(old[0])
+        claims = self._claims.get(ip, {})
+        rec = claims.pop(key, None)
+        if rec is not None:
+            self._deref(rec[0])
+            self._deref(rec[1])
+        if not claims:
+            self._claims.pop(ip, None)
+            self._del_slot(ip)
+        else:
+            self._set_slot(ip)
+
+    # ------------------------------------------------------------ interning
+
+    def _intern(self, rules: tuple) -> int:
+        if not rules:
+            return NO_TABLE  # no rules = allow: no table attached
+        rec = self._tables.get(rules)
+        if rec is not None:
+            rec.refs += 1
+            return rec.tid
+        n = len(rules)
+        while True:
+            start = self._spans.alloc(n)
+            if start is not None:
+                break
+            target = _next_pow2(self._spans.used + n, self.bucket_min)
+            if target <= self._spans.cap:  # fragmentation, not capacity
+                target = self._spans.cap * 2
+            self._grow_rules(target)
+        tid = self._free_tids.pop() if self._free_tids else self._alloc_tid()
+        self._tables[rules] = _TableRec(tid, start, n, 1)
+        sl = slice(start, start + n)
+        rows = np.array([rule_fields(r) for r in rules], dtype=np.int64)
+        self._patch_r("rule_valid", sl, np.ones(n, dtype=np.bool_))
+        self._patch_r("rule_tid", sl, np.full(n, tid, dtype=np.int32))
+        for j, col in enumerate(_FIELD_COLS):
+            self._patch_r(col, sl, rows[:, j])
+        return tid
+
+    def _alloc_tid(self) -> int:
+        tid = self._next_tid
+        self._next_tid += 1
+        return tid
+
+    def _deref(self, rules: tuple) -> None:
+        if not rules:
+            return
+        rec = self._tables[rules]
+        rec.refs -= 1
+        if rec.refs:
+            return
+        del self._tables[rules]
+        self._free_tids.append(rec.tid)
+        sl = slice(rec.start, rec.start + rec.n)
+        for name, dt in RULE_LEAVES:
+            self._patch_r(name, sl, np.zeros(rec.n, dtype=dt))
+        self._spans.free(rec.start, rec.n)
+
+    # ------------------------------------------------------------ pod slots
+
+    def _winner(self, ip: int) -> Tuple[int, int]:
+        claims = self._claims[ip]
+        _, _, in_tid, eg_tid = claims[max(claims, key=str)]
+        return in_tid, eg_tid
+
+    def _set_slot(self, ip: int) -> None:
+        in_tid, eg_tid = self._winner(ip)
+        live = self._p_live
+        pos = int(np.searchsorted(self._p["pod_ip"][:live], np.uint32(ip)))
+        if pos < live and int(self._p["pod_ip"][pos]) == ip:
+            if int(self._p["pod_ingress_tid"][pos]) != in_tid:
+                self._patch_p("pod_ingress_tid", slice(pos, pos + 1),
+                              np.full(1, in_tid, dtype=np.int32))
+            if int(self._p["pod_egress_tid"][pos]) != eg_tid:
+                self._patch_p("pod_egress_tid", slice(pos, pos + 1),
+                              np.full(1, eg_tid, dtype=np.int32))
+            return
+        if live + 1 > len(self._p["pod_ip"]):
+            self._grow_pods(_next_pow2(live + 1, self.bucket_min))
+        for name, value in (("pod_ip", ip), ("pod_ingress_tid", in_tid),
+                            ("pod_egress_tid", eg_tid)):
+            arr = self._p[name]
+            seg = np.concatenate(
+                [np.asarray([value], dtype=arr.dtype), arr[pos:live]]
+            )
+            self._patch_p(name, slice(pos, live + 1), seg)
+        self._p_live += 1
+
+    def _del_slot(self, ip: int) -> None:
+        live = self._p_live
+        pos = int(np.searchsorted(self._p["pod_ip"][:live], np.uint32(ip)))
+        if pos >= live or int(self._p["pod_ip"][pos]) != ip:
+            return
+        for (name, _, pad) in POD_LEAVES:
+            arr = self._p[name]
+            seg = np.concatenate(
+                [arr[pos + 1:live], np.asarray([pad], dtype=arr.dtype)]
+            )
+            self._patch_p(name, slice(pos, live), seg)
+        self._p_live -= 1
+
+    # ------------------------------------------------------- array plumbing
+
+    def _patch_r(self, name: str, sl: slice, values: np.ndarray) -> None:
+        arr = self._r[name]
+        old_sum = u32_wrap_sum(arr[sl])
+        arr[sl] = values
+        self._sums[name] = (
+            self._sums[name] + u32_wrap_sum(arr[sl]) - old_sum
+        ) & _U32
+        self._dirty_rules.update(range(sl.start, sl.stop))
+
+    def _patch_p(self, name: str, sl: slice, values: np.ndarray) -> None:
+        arr = self._p[name]
+        old_sum = u32_wrap_sum(arr[sl])
+        arr[sl] = values
+        self._sums[name] = (
+            self._sums[name] + u32_wrap_sum(arr[sl]) - old_sum
+        ) & _U32
+        self._dirty_pods.update(range(sl.start, sl.stop))
+
+    def _grow_rules(self, newcap: int) -> None:
+        for name, dt in RULE_LEAVES:
+            arr = np.zeros(newcap, dtype=dt)
+            arr[: self._spans.cap] = self._r[name]
+            self._r[name] = arr  # appended zeros: sums unchanged
+        self._spans.grow(newcap)
+        self._reship_rules = True
+        self.stats.grows += 1
+
+    def _grow_pods(self, newcap: int) -> None:
+        oldcap = len(self._p["pod_ip"])
+        for name, dt, pad in POD_LEAVES:
+            arr = np.full(newcap, pad, dtype=dt)
+            arr[:oldcap] = self._p[name]
+            self._p[name] = arr
+            self._sums[name] = (
+                self._sums[name]
+                + (newcap - oldcap) * u32_wrap_sum(np.asarray(pad, dtype=dt))
+            ) & _U32
+        self._reship_pods = True
+        self.stats.grows += 1
+
+    # --------------------------------------------------------- device apply
+
+    def _ship(self) -> RuleTables:
+        prev = self.last_tables
+        if self._reship_rules or prev is None:
+            rule_leaves = tuple(
+                jnp.asarray(self._r[name]) for name, _ in RULE_LEAVES
+            )
+            self.stats.ship(self._spans.cap,
+                            sum(self._r[name].nbytes for name, _ in RULE_LEAVES))
+        elif self._dirty_rules:
+            idx = np.asarray(sorted(self._dirty_rules), dtype=np.int32)
+            rows = tuple(self._r[name][idx] for name, _ in RULE_LEAVES)
+            prev_leaves = tuple(getattr(prev, name) for name, _ in RULE_LEAVES)
+            rule_leaves = apply_rows(prev_leaves, idx, rows)
+            self.stats.ship(len(idx), group_nbytes(idx, rows))
+        else:
+            rule_leaves = tuple(getattr(prev, name) for name, _ in RULE_LEAVES)
+        if self._reship_pods or prev is None:
+            pod_leaves = tuple(
+                jnp.asarray(self._p[name]) for name, _, _ in POD_LEAVES
+            )
+            self.stats.ship(len(self._p["pod_ip"]),
+                            sum(self._p[name].nbytes for name, _, _ in POD_LEAVES))
+        elif self._dirty_pods:
+            idx = np.asarray(sorted(self._dirty_pods), dtype=np.int32)
+            rows = tuple(self._p[name][idx] for name, _, _ in POD_LEAVES)
+            prev_leaves = tuple(getattr(prev, name) for name, _, _ in POD_LEAVES)
+            pod_leaves = apply_rows(prev_leaves, idx, rows)
+            self.stats.ship(len(idx), group_nbytes(idx, rows))
+        else:
+            pod_leaves = tuple(getattr(prev, name) for name, _, _ in POD_LEAVES)
+        tables = RuleTables(
+            *rule_leaves, *pod_leaves,
+            num_rules=self._spans.used,
+            num_tables=len(self._tables),
+            num_pods=self._p_live,
+        )
+        self.last_tables = tables
+        self.fingerprint = fold_fingerprint(
+            [(self._sums[name], self._r[name].shape) for name, _ in RULE_LEAVES]
+            + [(self._sums[name], self._p[name].shape) for name, _, _ in POD_LEAVES]
+        )
+        self._dirty_rules = set()
+        self._dirty_pods = set()
+        self._reship_rules = False
+        self._reship_pods = False
+        return tables
+
+    # ----------------------------------------------------------- full build
+
+    def _full(
+        self,
+        state: Dict[object, tuple],
+        rule_cap_min: Optional[int] = None,
+        pod_cap_min: Optional[int] = None,
+    ) -> RuleTables:
+        """From-scratch rebuild in the CANONICAL layout (interning in
+        sorted-key order, rows concatenated in table-id order, pods
+        IP-sorted) — bit-identical to compile_pod_tables, built
+        VECTORIZED: one pass to intern, one array fill, registries
+        re-derived, no per-pod suffix memmoves (the incremental insert
+        path would make a 4k-pod resync O(P^2) host work).
+        ``*_cap_min`` keep shrink compactions at 2x headroom."""
+        self.stats.full_builds += 1
+        tables: Dict[tuple, _TableRec] = {}
+        order: List[tuple] = []  # table contents in tid order
+        claims: Dict[int, Dict[object, tuple]] = {}
+        assignments: Dict[int, Tuple[int, int]] = {}
+
+        def intern(rules: tuple) -> int:
+            if not rules:
+                return NO_TABLE
+            rec = tables.get(rules)
+            if rec is not None:
+                rec.refs += 1
+                return rec.tid
+            tid = len(order)
+            tables[rules] = _TableRec(tid, 0, len(rules), 1)
+            order.append(rules)
+            return tid
+
+        for key, entry in sorted(state.items(), key=lambda kv: str(kv[0])):
+            ip, ing, eg = int(entry[0]), tuple(entry[1]), tuple(entry[2])
+            in_tid = intern(ing)
+            eg_tid = intern(eg)
+            claims.setdefault(ip, {})[key] = (ing, eg, in_tid, eg_tid)
+            assignments[ip] = (in_tid, eg_tid)  # last sorted key wins
+
+        n_rows = sum(rec.n for rec in tables.values())
+        rule_cap = max(_next_pow2(max(n_rows, 1), self.bucket_min),
+                       rule_cap_min or 0)
+        p = len(assignments)
+        pod_cap = max(_next_pow2(max(p, 1), self.bucket_min),
+                      pod_cap_min or 0)
+        self._reset(rule_cap, pod_cap)
+
+        rows: List[Tuple] = []
+        start = 0
+        for rules in order:
+            rec = tables[rules]
+            rec.start = start
+            start += rec.n
+            for r in rules:
+                rows.append((rec.tid,) + rule_fields(r))
+        if rows:
+            arr = np.asarray(rows, dtype=np.int64)
+            self._r["rule_valid"][:n_rows] = True
+            self._r["rule_tid"][:n_rows] = arr[:, 0]
+            for j, col in enumerate(_FIELD_COLS):
+                self._r[col][:n_rows] = arr[:, j + 1]
+        for i, (ip, (in_tid, eg_tid)) in enumerate(sorted(assignments.items())):
+            self._p["pod_ip"][i] = ip
+            self._p["pod_ingress_tid"][i] = in_tid
+            self._p["pod_egress_tid"][i] = eg_tid
+
+        self._state = dict(state)
+        self._tables = tables
+        self._claims = claims
+        self._next_tid = len(order)
+        self._p_live = p
+        if n_rows:
+            self._spans.alloc(n_rows)  # rows occupy one canonical prefix
+        for name, _ in RULE_LEAVES:
+            self._sums[name] = u32_wrap_sum(self._r[name])
+        for name, _, _ in POD_LEAVES:
+            self._sums[name] = u32_wrap_sum(self._p[name])
+        self.last_tables = None
+        return self._ship()
+
+    # -------------------------------------------------------------- queries
+
+    @property
+    def num_rules(self) -> int:
+        return self._spans.used
+
+    @property
+    def num_tables(self) -> int:
+        return len(self._tables)
+
+    @property
+    def num_pods(self) -> int:
+        return self._p_live
+
+
+# --------------------------------------------------------------------------
+# Canonicalization (equivalence testing)
+# --------------------------------------------------------------------------
+
+
+def canonical_rule_tables(t: RuleTables) -> RuleTables:
+    """Map ANY RuleTables layout (delta-permuted rows / recycled table
+    ids / hysteresis padding) to the canonical from-scratch layout:
+    table ids relabeled by first appearance in pod-slot order, rows
+    repacked contiguously in that order, pow2 padding recomputed.  Two
+    tables are semantically identical iff their canonical forms are
+    array-identical — the equivalence property the churn tests assert."""
+    valid = np.asarray(t.rule_valid)
+    tid = np.asarray(t.rule_tid)
+    field_cols = {name: np.asarray(getattr(t, name)) for name in _FIELD_COLS}
+    pod_ip = np.asarray(t.pod_ip)
+    pod_in = np.asarray(t.pod_ingress_tid)
+    pod_eg = np.asarray(t.pod_egress_tid)
+    live = pod_ip != POD_PAD_IP
+
+    order: List[int] = []
+    seen = set()
+    for side in zip(pod_in[live], pod_eg[live]):
+        for old_tid in side:
+            old_tid = int(old_tid)
+            if old_tid != NO_TABLE and old_tid not in seen:
+                seen.add(old_tid)
+                order.append(old_tid)
+    remap = {old: new for new, old in enumerate(order)}
+
+    rows: List[Tuple] = []
+    for old_tid in order:
+        for i in np.nonzero(valid & (tid == old_tid))[0]:
+            rows.append(
+                (remap[old_tid],)
+                + tuple(int(field_cols[name][i]) for name in _FIELD_COLS)
+            )
+    n = len(rows)
+    padded = _next_pow2(max(n, 1), 8)
+    arr = np.zeros((padded, 9), dtype=np.int64)
+    if rows:
+        arr[:n] = np.asarray(rows, dtype=np.int64)
+    new_valid = np.zeros(padded, dtype=bool)
+    new_valid[:n] = True
+
+    p = int(live.sum())
+    p_padded = _next_pow2(max(p, 1), 8)
+    new_ip = np.full(p_padded, POD_PAD_IP, dtype=np.uint32)
+    new_in = np.full(p_padded, NO_TABLE, dtype=np.int32)
+    new_eg = np.full(p_padded, NO_TABLE, dtype=np.int32)
+    new_ip[:p] = pod_ip[live]
+    new_in[:p] = [remap.get(int(x), NO_TABLE) for x in pod_in[live]]
+    new_eg[:p] = [remap.get(int(x), NO_TABLE) for x in pod_eg[live]]
+
+    return RuleTables(
+        rule_valid=jnp.asarray(new_valid),
+        rule_tid=jnp.asarray(arr[:, 0].astype(np.int32)),
+        rule_src_base=jnp.asarray(arr[:, 1].astype(np.uint32)),
+        rule_src_mask=jnp.asarray(arr[:, 2].astype(np.uint32)),
+        rule_dst_base=jnp.asarray(arr[:, 3].astype(np.uint32)),
+        rule_dst_mask=jnp.asarray(arr[:, 4].astype(np.uint32)),
+        rule_proto=jnp.asarray(arr[:, 5].astype(np.int32)),
+        rule_src_port=jnp.asarray(arr[:, 6].astype(np.int32)),
+        rule_dst_port=jnp.asarray(arr[:, 7].astype(np.int32)),
+        rule_action=jnp.asarray(arr[:, 8].astype(np.int32)),
+        pod_ip=jnp.asarray(new_ip),
+        pod_ingress_tid=jnp.asarray(new_in),
+        pod_egress_tid=jnp.asarray(new_eg),
+        num_rules=n,
+        num_tables=len(order),
+        num_pods=p,
+    )

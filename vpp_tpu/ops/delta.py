@@ -1,0 +1,157 @@
+"""Delta device apply — ship only changed table rows to the device.
+
+The control→data plane path used to re-upload WHOLE table tensors on
+every transaction (a 64k×9 rule tensor for a one-pod change).  The
+incremental builders (:mod:`classify_delta`, :mod:`nat_delta`) patch
+host-side numpy mirrors in place and call :func:`apply_rows` to scatter
+only the dirty rows into the previous device arrays:
+
+- the scatter is ONE jitted program per (column-group signature, index
+  bucket) — indices are padded to a power-of-two bucket with an
+  out-of-range sentinel (``mode="drop"``), so churny transactions reuse
+  a handful of compiled programs instead of recompiling per delta size;
+- the scatter COPIES on device (functional ``.at[].set``): the previous
+  arrays stay valid, so in-flight dispatched batches keep the tables
+  they saw and the runner's swap semantics are untouched — only the
+  host→device traffic shrinks to O(changed rows);
+- nothing here donates buffers, deliberately: donation would invalidate
+  the tables an in-flight batch still references.
+
+Also home to the host-side fingerprint arithmetic: the device
+fingerprint (scheduler/tpu_applicators.table_fingerprint) folds per-leaf
+uint32 wrap-sums, which are ADDITIVE — a builder patching row ``i`` from
+``old`` to ``new`` maintains each leaf's sum with
+``sum += u32(new) - u32(old)``, keeping the expected-side fingerprint a
+pure host computation (O(1) per verify, no device reduction).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, Iterable, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# ONE pow2 bucketing policy for tables and scatter-index buckets alike.
+from .classify import _next_pow2 as next_pow2
+
+# Fingerprint fold constants (FNV-1a 32-bit), shared by the device
+# reduction and the host mirror — the two must stay in lockstep.
+FP_SEED = 0x811C9DC5
+FP_PRIME = 0x01000193
+_U32 = 0xFFFFFFFF
+
+# Smallest scatter-index bucket: deltas of 1..16 rows share one program.
+IDX_BUCKET_MIN = 16
+
+
+# --------------------------------------------------------------------------
+# Jitted row scatter
+# --------------------------------------------------------------------------
+
+
+@jax.jit
+def _scatter(arrs: Tuple[jnp.ndarray, ...], idx: jnp.ndarray,
+             rows: Tuple[jnp.ndarray, ...]) -> Tuple[jnp.ndarray, ...]:
+    # Out-of-range padding indices drop; duplicate indices cannot occur
+    # (callers pass a de-duplicated sorted dirty set).
+    return tuple(a.at[idx].set(r, mode="drop") for a, r in zip(arrs, rows))
+
+
+def apply_rows(
+    arrs: Sequence[jnp.ndarray],
+    idx: np.ndarray,
+    rows: Sequence[np.ndarray],
+) -> Tuple[jnp.ndarray, ...]:
+    """Scatter changed rows into a group of same-length device arrays.
+
+    ``arrs`` share their leading dimension; ``rows[j][k]`` is the new
+    content of ``arrs[j][idx[k]]``.  Returns NEW device arrays (the old
+    buffers are untouched — in-flight consumers keep theirs).  The
+    index vector is padded to a pow2 bucket so XLA compiles one scatter
+    program per bucket, not per delta size.
+    """
+    cap = int(arrs[0].shape[0])
+    n = len(idx)
+    bucket = next_pow2(max(n, 1), IDX_BUCKET_MIN)
+    idx_p = np.full(bucket, cap, dtype=np.int32)  # sentinel: dropped
+    idx_p[:n] = idx
+    rows_p = []
+    for r in rows:
+        pad = np.zeros((bucket,) + r.shape[1:], dtype=r.dtype)
+        pad[:n] = r
+        rows_p.append(jnp.asarray(pad))
+    return _scatter(tuple(arrs), jnp.asarray(idx_p), tuple(rows_p))
+
+
+# --------------------------------------------------------------------------
+# Host-side fingerprint arithmetic
+# --------------------------------------------------------------------------
+
+
+def u32_wrap_sum(arr) -> int:
+    """uint32 wrap-sum of an array, matching the device fingerprint's
+    per-leaf conversion rules exactly (bool→u32, f32 bit-view, anything
+    else astype-u32 with two's-complement wraparound)."""
+    a = np.asarray(arr)
+    if a.dtype == np.bool_:
+        a = a.astype(np.uint32)
+    elif a.dtype.kind == "f":
+        a = a.view(np.uint32) if a.dtype.itemsize == 4 else a.astype(np.uint32)
+    else:
+        a = a.astype(np.uint32)
+    return int(a.sum(dtype=np.uint64)) & _U32
+
+
+def fold_fingerprint(parts: Iterable[Tuple[int, object]]) -> int:
+    """Fold per-leaf (u32 wrap-sum, shape) pairs — IN PYTREE LEAF ORDER
+    — into the table fingerprint.  Must mirror the device reduction in
+    tpu_applicators.table_fingerprint (property-tested)."""
+    fp = FP_SEED
+    for s, shape in parts:
+        fp = (((fp * FP_PRIME) & _U32) ^ (s & _U32) ^ (hash(shape) & _U32)) & _U32
+    return fp
+
+
+# --------------------------------------------------------------------------
+# Build/ship observability
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class DeltaStats:
+    """Compile/ship counters of one incremental table builder — the
+    observability the churn bench and `netctl inspect` read."""
+
+    full_builds: int = 0
+    delta_builds: int = 0
+    rows_shipped: int = 0        # cumulative table rows sent host→device
+    bytes_shipped: int = 0       # cumulative payload bytes (rows + indices)
+    last_rows_shipped: int = 0   # rows of the most recent build
+    last_bytes_shipped: int = 0
+    grows: int = 0               # pow2 bucket growths (full-group reships)
+    shrinks: int = 0             # hysteresis shrink compactions
+    build_seconds: float = 0.0   # cumulative host build wall time
+    last_build_seconds: float = 0.0
+
+    def ship(self, rows: int, nbytes: int) -> None:
+        self.rows_shipped += rows
+        self.bytes_shipped += nbytes
+        self.last_rows_shipped += rows
+        self.last_bytes_shipped += nbytes
+
+    def begin_build(self) -> None:
+        self.last_rows_shipped = 0
+        self.last_bytes_shipped = 0
+
+    def as_dict(self) -> Dict[str, float]:
+        return dataclasses.asdict(self)
+
+
+def group_nbytes(idx: np.ndarray, rows: Sequence[np.ndarray]) -> int:
+    """Payload bytes of one delta group ship: row data + index vector."""
+    return int(sum(r.nbytes for r in rows)) + int(idx.nbytes)

@@ -39,7 +39,7 @@ from __future__ import annotations
 import ipaddress
 import logging
 from dataclasses import dataclass, replace as _dc_replace
-from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -395,6 +395,7 @@ def effective_bucket_size(
     mappings: Sequence[NatMapping],
     bucket_size: int = 64,
     max_bucket_size: int = 4096,
+    log_widen: bool = True,
 ) -> int:
     """Table-wide backend-ring width: auto-widened (pow2) to fit the
     largest weighted-expanded backend list, capped at ``max_bucket_size``
@@ -421,7 +422,7 @@ def effective_bucket_size(
         k = max(k, _next_pow2(min(need, max_bucket_size)))
     if n_max > k:
         k = _next_pow2(n_max)
-    if k > bucket_size:
+    if k > bucket_size and log_widen:
         logger.info(
             "NAT backend ring auto-widened %d -> %d slots "
             "(largest weighted expansion %d, largest backend count %d; "
@@ -515,6 +516,54 @@ def build_nat_tables(
     is perf-only — both lookups are bit-equal — but the wrong pick
     costs the measured crossover margin).
     """
+    host = build_nat_host(
+        mappings,
+        nat_loopback=nat_loopback,
+        snat_ip=snat_ip,
+        snat_enabled=snat_enabled,
+        pod_subnet=pod_subnet,
+        bucket_size=bucket_size,
+    )
+    use_hmap = (
+        _pick_use_hmap(host["map_ext_ip"].shape[0], target_backend)
+        if host["hmap_ok"] else False
+    )
+    return NatTables(
+        map_ext_ip=jnp.asarray(host["map_ext_ip"]),
+        map_ext_port=jnp.asarray(host["map_ext_port"]),
+        map_proto=jnp.asarray(host["map_proto"]),
+        map_twice_nat=jnp.asarray(host["map_twice_nat"]),
+        map_affinity=jnp.asarray(host["map_affinity"]),
+        map_valid=jnp.asarray(host["map_valid"]),
+        backend_ip=jnp.asarray(host["backend_ip"]),
+        backend_port=jnp.asarray(host["backend_port"]),
+        hmap_idx=jnp.asarray(host["hmap_idx"]),
+        nat_loopback=jnp.asarray(host["nat_loopback"]),
+        snat_ip=jnp.asarray(host["snat_ip"]),
+        snat_enabled=jnp.asarray(host["snat_enabled"]),
+        pod_subnet_base=jnp.asarray(host["pod_subnet_base"]),
+        pod_subnet_mask=jnp.asarray(host["pod_subnet_mask"]),
+        map_aff_timeout=jnp.asarray(host["map_aff_timeout"]),
+        num_mappings=host["num_mappings"],
+        bucket_size=host["bucket_size"],
+        use_hmap=use_hmap,
+        has_affinity=host["has_affinity"],
+    )
+
+
+def build_nat_host(
+    mappings: Sequence[NatMapping],
+    nat_loopback: str = "0.0.0.0",
+    snat_ip: str = "0.0.0.0",
+    snat_enabled: bool = False,
+    pod_subnet: str = "10.1.0.0/16",
+    bucket_size: int = 64,
+) -> Dict[str, Any]:
+    """The host-array core of :func:`build_nat_tables`: numpy columns +
+    aux, no device transfers.  Shared with the incremental builder
+    (:mod:`vpp_tpu.ops.nat_delta`) so full and delta compiles encode
+    rows through ONE code path.  ``hmap_ok`` is False when the hash
+    build hit its growth bound (dense fallback, stub index)."""
     m = len(mappings)
     padded = _next_pow2(max(m, 1))
     # Auto-widen the ring: a fixed width would silently drop backends
@@ -561,33 +610,31 @@ def build_nat_tables(
         ],
         start_capacity=_next_pow2(max(2 * n_valid, 8), minimum=16),
     )
+    hmap_ok = hmap is not None
     if hmap is None:  # adversarial hash-collision set: dense fallback
         hmap = np.full(16, -1, dtype=np.int32)
-        use_hmap = False
-    else:
-        use_hmap = _pick_use_hmap(padded, target_backend)
 
-    return NatTables(
-        map_ext_ip=jnp.asarray(ext_ip),
-        map_ext_port=jnp.asarray(ext_port),
-        map_proto=jnp.asarray(proto),
-        map_twice_nat=jnp.asarray(twice),
-        map_affinity=jnp.asarray(affinity),
-        map_valid=jnp.asarray(valid),
-        backend_ip=jnp.asarray(b_ip),
-        backend_port=jnp.asarray(b_port),
-        hmap_idx=jnp.asarray(hmap),
-        nat_loopback=jnp.asarray(ip_to_u32(nat_loopback), dtype=jnp.uint32),
-        snat_ip=jnp.asarray(ip_to_u32(snat_ip), dtype=jnp.uint32),
-        snat_enabled=jnp.asarray(snat_enabled),
-        pod_subnet_base=jnp.asarray(int(net.network_address), dtype=jnp.uint32),
-        pod_subnet_mask=jnp.asarray(mask, dtype=jnp.uint32),
-        map_aff_timeout=jnp.asarray(aff_timeout),
-        num_mappings=m,
-        bucket_size=bucket_size,
-        use_hmap=use_hmap,
-        has_affinity=bool(aff_timeout.any()),
-    )
+    return {
+        "map_ext_ip": ext_ip,
+        "map_ext_port": ext_port,
+        "map_proto": proto,
+        "map_twice_nat": twice,
+        "map_affinity": affinity,
+        "map_valid": valid,
+        "backend_ip": b_ip,
+        "backend_port": b_port,
+        "hmap_idx": hmap,
+        "nat_loopback": np.asarray(ip_to_u32(nat_loopback), dtype=np.uint32),
+        "snat_ip": np.asarray(ip_to_u32(snat_ip), dtype=np.uint32),
+        "snat_enabled": np.asarray(snat_enabled),
+        "pod_subnet_base": np.asarray(int(net.network_address), dtype=np.uint32),
+        "pod_subnet_mask": np.asarray(mask, dtype=np.uint32),
+        "map_aff_timeout": aff_timeout,
+        "num_mappings": m,
+        "bucket_size": bucket_size,
+        "hmap_ok": hmap_ok,
+        "has_affinity": bool(aff_timeout.any()),
+    }
 
 
 # ---------------------------------------------------------------------------

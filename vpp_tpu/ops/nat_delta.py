@@ -1,0 +1,743 @@
+"""Persistent incremental NatTables builder — O(changed) NAT compiles.
+
+The HyperNAT problem (PAPERS.md): NAT table churn at cloud scale —
+endpoint adds/removes arrive continuously, and rebuilding the whole
+mapping set (plus a full device upload) per change makes convergence
+O(cluster).  :class:`NatTableBuilder` keeps numpy mirrors of every
+NatTables leaf alive across transactions and patches in place:
+
+- **service diff**: ``sync`` takes the per-service mapping dict; only
+  changed services are diffed, mapping-by-mapping on the external
+  (ip, port, proto) key.  An endpoint add/remove rewrites ONE backend
+  ring row; policy knobs (twice-NAT, affinity) patch single columns;
+- **row slots**: mapping rows come from a free list; freed rows are
+  zeroed (canonical padding) and recycled;
+- **ring width**: the table-wide backend-ring width K is semantic
+  (``flow_hash % K`` picks the slot), so it tracks
+  ``effective_bucket_size`` exactly — a K crossing rebuilds all rings
+  (one wide reship), never silently diverges from a full build;
+- **exact-match index**: the open-addressed hmap is maintained
+  incrementally — the device lookup gathers ALL ``MAP_PROBE_WAYS``
+  slots unconditionally, so a delete simply clears the slot and an
+  insert takes any empty slot in the probe window; growth (or the
+  adversarial same-hash bound) falls back to the canonical rebuild;
+- **buckets**: the pow2 row bucket grows on overflow and shrinks only
+  with 4x hysteresis via a compacting full rebuild;
+- **fingerprint**: per-leaf uint32 wrap-sums are maintained under every
+  patch (host fold == device ``table_fingerprint``, property-tested).
+
+Correctness fallbacks (rare, full-rebuild-per-txn until they clear):
+duplicate external keys (within or across services — first-match-wins
+needs the canonical row order) and the hmap's adversarial growth bound.
+
+``canonical_nat_tables`` maps any layout to a canonical row-sorted form
+for the equivalence property tests.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .classify import _next_pow2
+from .delta import apply_rows, fold_fingerprint, group_nbytes, u32_wrap_sum
+from .nat import (
+    MAP_PROBE_WAYS,
+    NatMapping,
+    NatTables,
+    _build_map_hash,
+    _map_key_hash_py,
+    _pick_use_hmap,
+    bucket_ring,
+    build_nat_host,
+)
+from .packets import ip_to_u32
+
+_U32 = 0xFFFFFFFF
+
+# Per-mapping-row columns (name, dtype) — subset of the NatTables leaves
+# scattered together as one group.
+ROW_LEAVES: Tuple[Tuple[str, type], ...] = (
+    ("map_ext_ip", np.uint32),
+    ("map_ext_port", np.int32),
+    ("map_proto", np.int32),
+    ("map_twice_nat", np.int32),
+    ("map_affinity", np.int32),
+    ("map_valid", np.bool_),
+    ("map_aff_timeout", np.int32),
+)
+RING_LEAVES: Tuple[Tuple[str, type], ...] = (
+    ("backend_ip", np.uint32),
+    ("backend_port", np.int32),
+)
+SCALAR_LEAVES: Tuple[str, ...] = (
+    "nat_loopback", "snat_ip", "snat_enabled",
+    "pod_subnet_base", "pod_subnet_mask",
+)
+# NatTables.tree_flatten leaf order (the fingerprint fold order).
+NAT_LEAF_ORDER: Tuple[str, ...] = (
+    "map_ext_ip", "map_ext_port", "map_proto", "map_twice_nat",
+    "map_affinity", "map_valid", "backend_ip", "backend_port", "hmap_idx",
+    "nat_loopback", "snat_ip", "snat_enabled",
+    "pod_subnet_base", "pod_subnet_mask", "map_aff_timeout",
+)
+
+ExtKey = Tuple[int, int, int]  # (ext_ip_u32, ext_port, proto)
+
+
+def _ext_key(m: NatMapping) -> ExtKey:
+    return (ip_to_u32(m.external_ip), int(m.external_port), int(m.protocol))
+
+
+def _sorted_keys(services: Mapping) -> list:
+    try:
+        return sorted(services)
+    except TypeError:  # mixed/unorderable keys: fall back to str order
+        return sorted(services, key=str)
+
+
+class NatTableBuilder:
+    """Incremental compiler for the NAT44 NatTables."""
+
+    def __init__(self, bucket_size: int = 64):
+        self.bucket_base = bucket_size
+        from .delta import DeltaStats
+
+        self.stats = DeltaStats()
+        self.last_tables: Optional[NatTables] = None
+        self.fingerprint: Optional[int] = None
+        self._services: Dict[object, Tuple[NatMapping, ...]] = {}
+        self._glob: Optional[tuple] = None
+        self._claim_count: Dict[ExtKey, int] = {}
+        self._ndup = 0  # ext keys with >1 claim -> full-rebuild mode
+        # True while the LAST build ran in a correctness-fallback mode
+        # (dups / hmap growth bound): the incremental registries are
+        # stale then, so the first post-fallback sync must also be full.
+        self._fallback_prev = False
+        self._hmap_ok = True
+
+    # ----------------------------------------------------------------- sync
+
+    def sync(
+        self,
+        services: Mapping[object, Sequence[NatMapping]],
+        nat_loopback: str = "0.0.0.0",
+        snat_ip: str = "0.0.0.0",
+        snat_enabled: bool = False,
+        pod_subnet: str = "10.1.0.0/16",
+    ) -> NatTables:
+        """Bring the compiled NatTables to the given per-service mapping
+        dict + global knobs, shipping only changed rows."""
+        t0 = time.perf_counter()
+        self.stats.begin_build()
+        services = {k: tuple(v) for k, v in services.items()}
+        glob = (nat_loopback, snat_ip, bool(snat_enabled), pod_subnet)
+        changed = [
+            k for k in set(services) | set(self._services)
+            if self._services.get(k) != services.get(k)
+        ]
+        # Claim accounting first: duplicate external keys (within or
+        # across services) force the canonical full build, because
+        # first-match-wins depends on the canonical row order.
+        for key in changed:
+            for m in self._services.get(key, ()):
+                self._claim(_ext_key(m), -1)
+            for m in services.get(key, ()):
+                self._claim(_ext_key(m), +1)
+        if self.last_tables is not None and not changed and glob == self._glob:
+            tables = self.last_tables  # no-op txn
+        elif (
+            self.last_tables is None
+            or self._ndup
+            or not self._hmap_ok
+            or self._fallback_prev
+        ):
+            tables = self._full(services, glob)
+            self._fallback_prev = bool(self._ndup) or not self._hmap_ok
+        else:
+            tables = self._delta(services, changed, glob)
+            self._fallback_prev = not self._hmap_ok
+        dt = time.perf_counter() - t0
+        self.stats.build_seconds += dt
+        self.stats.last_build_seconds = dt
+        return tables
+
+    def _claim(self, ek: ExtKey, d: int) -> None:
+        c = self._claim_count.get(ek, 0)
+        n = c + d
+        if c > 1 and n <= 1:
+            self._ndup -= 1
+        elif c <= 1 and n > 1:
+            self._ndup += 1
+        if n:
+            self._claim_count[ek] = n
+        else:
+            self._claim_count.pop(ek, None)
+
+    # ---------------------------------------------------------- delta build
+
+    def _delta(self, services: Dict[object, tuple], changed: list,
+               glob: tuple) -> NatTables:
+        self._dirty_rows: set = set()
+        self._dirty_rings: set = set()
+        self._dirty_hslots: set = set()
+        self._reship_rows = False
+        self._reship_rings = False
+        self._reship_hmap = False
+        self._reship_scalars = False
+        # Removals first across all services: a mapping moving between
+        # services in one txn must free its row before the add claims it.
+        adds: List[Tuple[ExtKey, NatMapping]] = []
+        patches: List[Tuple[ExtKey, NatMapping]] = []
+        for key in _sorted_keys({k: None for k in changed}):
+            old_by = {_ext_key(m): m for m in self._services.get(key, ())}
+            new_by = {_ext_key(m): m for m in services.get(key, ())}
+            for ek, m in old_by.items():
+                if ek not in new_by:
+                    self._remove_mapping(ek)
+            for ek, m in new_by.items():
+                if ek not in old_by:
+                    adds.append((ek, m))
+                elif old_by[ek] != m:
+                    patches.append((ek, m))
+            if key in services:
+                self._services[key] = services[key]
+            else:
+                self._services.pop(key, None)
+        # Ring width is semantic (flow_hash % K) and must track the
+        # canonical effective_bucket_size exactly — and it must be
+        # decided BEFORE any ring row is written: a txn that raises a
+        # mapping's backend count past the current K would otherwise
+        # feed bucket_ring a too-narrow ring (its one-slot-per-backend
+        # floor can't fit) mid-apply.  The maxes are maintained
+        # incrementally (O(changed) per txn; a rescan only when the
+        # argmax row itself left), with the pending adds/patches folded
+        # into the prospective maximum here.
+        for ek, m in patches:
+            self._set_weights(self._row_of[ek], m)
+        need_max, n_max = self._current_maxes()
+        for _, m in adds:
+            need_max = max(need_max, self._need(m))
+            n_max = max(n_max, len(m.backends))
+        k_target = self._k_from(need_max, n_max)
+        if k_target != self._K:
+            # Rebuild with the PENDING patch content in place of stale
+            # rows: on a shrink the old content may not fit the new
+            # width (that is exactly why K is shrinking).
+            self._rebuild_rings(
+                k_target,
+                override={self._row_of[ek]: m for ek, m in patches},
+            )
+
+        for ek, m in adds:
+            self._add_mapping(ek, m)
+        for ek, m in patches:
+            self._patch_mapping(ek, m)
+        self._maybe_shrink_hmap()
+        if glob != self._glob:
+            self._set_glob(glob)
+
+        live = len(self._map_of)
+        cap = len(self._cols["map_valid"])
+        if cap > _next_pow2(1) and live * 4 <= cap:
+            self.stats.shrinks += 1
+            return self._full(
+                dict(self._services), self._glob,
+                row_cap_min=_next_pow2(max(2 * live, 1)),
+            )
+        self.stats.delta_builds += 1
+        return self._ship()
+
+    # --------------------------------------------------- ring-width (K)
+
+    @staticmethod
+    def _need(m: NatMapping) -> int:
+        """One mapping's weighted-expansion demand (0 when backend-less)
+        — the per-mapping term of effective_bucket_size."""
+        return sum(max(1, w) for _, _, w in m.backends) if m.backends else 0
+
+    def _k_from(self, need: int, n_max: int) -> int:
+        """effective_bucket_size over maintained maxima — must stay in
+        lockstep with the canonical formula (the churn property test
+        compares bucket_size against full builds every step)."""
+        k = self.bucket_base
+        if need > k:
+            k = max(k, _next_pow2(min(need, 4096)))
+        if n_max > k:
+            k = _next_pow2(n_max)
+        return k
+
+    def _set_weights(self, row: int, m: NatMapping) -> None:
+        old = self._weights.get(row)
+        new = (self._need(m), len(m.backends))
+        self._weights[row] = new
+        if old is not None and (
+            old[0] >= self._need_max or old[1] >= self._nmax
+        ) and (new[0] < old[0] or new[1] < old[1]):
+            self._max_dirty = True  # the argmax row may have shrunk
+        self._need_max = max(self._need_max, new[0])
+        self._nmax = max(self._nmax, new[1])
+
+    def _drop_weights(self, row: int) -> None:
+        old = self._weights.pop(row, None)
+        if old is not None and (
+            old[0] >= self._need_max or old[1] >= self._nmax
+        ):
+            self._max_dirty = True
+
+    def _current_maxes(self) -> Tuple[int, int]:
+        if self._max_dirty:
+            self._need_max = max(
+                (v[0] for v in self._weights.values()), default=0)
+            self._nmax = max(
+                (v[1] for v in self._weights.values()), default=0)
+            self._max_dirty = False
+        return self._need_max, self._nmax
+
+    # ------------------------------------------------------- mapping CRUD
+
+    def _alloc_row(self) -> int:
+        if self._free_rows:
+            return self._free_rows.pop()
+        row = self._row_high
+        cap = len(self._cols["map_valid"])
+        if row >= cap:
+            self._grow_rows(cap * 2)
+        self._row_high += 1
+        return row
+
+    def _add_mapping(self, ek: ExtKey, m: NatMapping) -> None:
+        row = self._alloc_row()
+        valid = bool(m.backends)
+        self._patch_row(row, {
+            "map_ext_ip": ek[0], "map_ext_port": ek[1], "map_proto": ek[2],
+            "map_twice_nat": m.twice_nat,
+            "map_affinity": 1 if m.session_affinity_timeout > 0 else 0,
+            "map_valid": valid,
+            "map_aff_timeout": m.session_affinity_timeout,
+        })
+        self._write_ring(row, m if valid else None)
+        self._row_of[ek] = row
+        self._map_of[row] = m
+        self._set_weights(row, m)
+        if valid:
+            self._n_valid += 1
+            self._hmap_add(ek, row)
+        if m.session_affinity_timeout > 0:
+            self._n_affinity += 1
+
+    def _patch_mapping(self, ek: ExtKey, m: NatMapping) -> None:
+        row = self._row_of[ek]
+        old = self._map_of[row]
+        was_valid = bool(old.backends)
+        valid = bool(m.backends)
+        self._patch_row(row, {
+            "map_twice_nat": m.twice_nat,
+            "map_affinity": 1 if m.session_affinity_timeout > 0 else 0,
+            "map_valid": valid,
+            "map_aff_timeout": m.session_affinity_timeout,
+        })
+        if old.backends != m.backends:
+            self._write_ring(row, m if valid else None)
+        self._map_of[row] = m
+        self._n_valid += int(valid) - int(was_valid)
+        self._n_affinity += int(m.session_affinity_timeout > 0) - int(
+            old.session_affinity_timeout > 0)
+        if valid and not was_valid:
+            self._hmap_add(ek, row)
+        elif was_valid and not valid:
+            self._hmap_remove(ek)
+
+    def _remove_mapping(self, ek: ExtKey) -> None:
+        row = self._row_of.pop(ek)
+        old = self._map_of.pop(row)
+        self._patch_row(row, {name: 0 for name, _ in ROW_LEAVES})
+        self._write_ring(row, None)
+        self._drop_weights(row)
+        if bool(old.backends):
+            self._n_valid -= 1
+            self._hmap_remove(ek)
+        if old.session_affinity_timeout > 0:
+            self._n_affinity -= 1
+        self._free_rows.append(row)
+
+    # -------------------------------------------------------- row plumbing
+
+    def _patch_row(self, row: int, values: Dict[str, Any]) -> None:
+        for name, value in values.items():
+            arr = self._cols[name]
+            old = u32_wrap_sum(arr[row:row + 1])
+            arr[row] = value
+            self._sums[name] = (
+                self._sums[name] + u32_wrap_sum(arr[row:row + 1]) - old
+            ) & _U32
+        self._dirty_rows.add(row)
+
+    def _write_ring(self, row: int, m: Optional[NatMapping]) -> None:
+        ring = bucket_ring(m, self._K) if m is not None else None
+        for j, (name, dt) in enumerate(RING_LEAVES):
+            arr = self._cols[name]
+            old = u32_wrap_sum(arr[row])
+            if ring is None:
+                arr[row] = 0
+            else:
+                arr[row] = np.asarray([e[j] for e in ring], dtype=dt)
+            self._sums[name] = (
+                self._sums[name] + u32_wrap_sum(arr[row]) - old
+            ) & _U32
+        self._dirty_rings.add(row)
+
+    def _grow_rows(self, newcap: int) -> None:
+        oldcap = len(self._cols["map_valid"])
+        for name, dt in ROW_LEAVES:
+            arr = np.zeros(newcap, dtype=dt)
+            arr[:oldcap] = self._cols[name]
+            self._cols[name] = arr
+        for name, dt in RING_LEAVES:
+            arr = np.zeros((newcap, self._K), dtype=dt)
+            arr[:oldcap] = self._cols[name]
+            self._cols[name] = arr
+        self._reship_rows = True
+        self._reship_rings = True
+        self.stats.grows += 1
+
+    def _rebuild_rings(self, k_new: int,
+                       override: Optional[Dict[int, NatMapping]] = None) -> None:
+        cap = len(self._cols["map_valid"])
+        for name, dt in RING_LEAVES:
+            self._cols[name] = np.zeros((cap, k_new), dtype=dt)
+        self._K = k_new
+        for row, m in self._map_of.items():
+            if override and row in override:
+                m = override[row]  # this txn's pending patch content
+            if not m.backends:
+                continue
+            ring = bucket_ring(m, k_new)
+            for j, (name, dt) in enumerate(RING_LEAVES):
+                self._cols[name][row] = np.asarray(
+                    [e[j] for e in ring], dtype=dt
+                )
+        for name, _ in RING_LEAVES:
+            self._sums[name] = u32_wrap_sum(self._cols[name])
+        self._reship_rings = True
+
+    # ------------------------------------------------------- hmap plumbing
+
+    def _hmap_patch(self, slot: int, value: int) -> None:
+        arr = self._cols["hmap_idx"]
+        old = u32_wrap_sum(arr[slot:slot + 1])
+        arr[slot] = value
+        self._sums["hmap_idx"] = (
+            self._sums["hmap_idx"] + u32_wrap_sum(arr[slot:slot + 1]) - old
+        ) & _U32
+        self._dirty_hslots.add(slot)
+
+    def _hmap_add(self, ek: ExtKey, row: int) -> None:
+        # The device lookup gathers ALL probe-window slots
+        # unconditionally (no early termination), so any empty slot in
+        # the window is a correct home and deletes can simply clear.
+        hmap = self._cols["hmap_idx"]
+        cap = len(hmap)
+        base = _map_key_hash_py(*ek) & (cap - 1)
+        for w in range(MAP_PROBE_WAYS):
+            slot = (base + w) & (cap - 1)
+            if hmap[slot] < 0:
+                self._hmap_patch(slot, row)
+                self._hmap_slot[ek] = slot
+                return
+        self._rebuild_hmap(start=cap * 2)
+
+    def _hmap_remove(self, ek: ExtKey) -> None:
+        slot = self._hmap_slot.pop(ek, None)
+        if slot is not None:
+            self._hmap_patch(slot, -1)
+
+    def _hmap_entries(self) -> List[Tuple[int, ExtKey]]:
+        return sorted(
+            (row, ek) for ek, row in self._row_of.items()
+            if bool(self._map_of[row].backends)
+        )
+
+    def _canonical_hmap_start(self) -> int:
+        return _next_pow2(max(2 * self._n_valid, 8), minimum=16)
+
+    def _rebuild_hmap(self, start: int) -> None:
+        hmap = _build_map_hash(self._hmap_entries(), start_capacity=start)
+        if hmap is None:
+            # Adversarial same-hash key set: canonical dense fallback.
+            # Ship the STUB index (a stale partial index would let
+            # retarget_tables re-enable use_hmap on another backend);
+            # subsequent syncs run the canonical full build until the
+            # colliding keys leave.
+            self._hmap_ok = False
+            self._cols["hmap_idx"] = np.full(16, -1, dtype=np.int32)
+            self._sums["hmap_idx"] = u32_wrap_sum(self._cols["hmap_idx"])
+            self._hmap_slot = {}
+            self._reship_hmap = True
+            return
+        self._cols["hmap_idx"] = hmap
+        self._sums["hmap_idx"] = u32_wrap_sum(hmap)
+        self._hmap_slot = {
+            ek: slot
+            for row, ek in self._hmap_entries()
+            for slot in np.nonzero(hmap == row)[0][:1]
+        }
+        self._reship_hmap = True
+
+    def _maybe_shrink_hmap(self) -> None:
+        cap = len(self._cols["hmap_idx"])
+        want = self._canonical_hmap_start()
+        if not (cap > 16 and want * 4 <= cap):
+            return
+        if getattr(self, "_hmap_no_shrink", None) == (cap, want):
+            return  # this exact shrink already failed: keys need cap
+        cand = _build_map_hash(self._hmap_entries(), start_capacity=want)
+        if cand is None or len(cand) >= cap:
+            # The probe-window invariant needs the current capacity (or
+            # the build hit its bound): remember and stop retrying every
+            # txn until the key set or capacity changes.
+            self._hmap_no_shrink = (cap, want)
+            return
+        self._hmap_no_shrink = None
+        self._cols["hmap_idx"] = cand
+        self._sums["hmap_idx"] = u32_wrap_sum(cand)
+        self._hmap_slot = {
+            ek: slot
+            for row, ek in self._hmap_entries()
+            for slot in np.nonzero(cand == row)[0][:1]
+        }
+        self._reship_hmap = True
+
+    # ------------------------------------------------------------- scalars
+
+    def _set_glob(self, glob: tuple) -> None:
+        import ipaddress
+
+        nat_loopback, snat_ip, snat_enabled, pod_subnet = glob
+        net = ipaddress.ip_network(pod_subnet)
+        mask = (
+            (0xFFFFFFFF << (32 - net.prefixlen)) & 0xFFFFFFFF
+            if net.prefixlen else 0
+        )
+        self._cols["nat_loopback"] = np.asarray(
+            ip_to_u32(nat_loopback), dtype=np.uint32)
+        self._cols["snat_ip"] = np.asarray(ip_to_u32(snat_ip), dtype=np.uint32)
+        self._cols["snat_enabled"] = np.asarray(bool(snat_enabled))
+        self._cols["pod_subnet_base"] = np.asarray(
+            int(net.network_address), dtype=np.uint32)
+        self._cols["pod_subnet_mask"] = np.asarray(mask, dtype=np.uint32)
+        for name in SCALAR_LEAVES:
+            self._sums[name] = u32_wrap_sum(self._cols[name])
+        self._glob = glob
+        self._reship_scalars = True
+
+    # --------------------------------------------------------- device apply
+
+    def _group(self, names, reship, dirty) -> tuple:
+        prev = self.last_tables
+        if reship or prev is None:
+            leaves = tuple(jnp.asarray(self._cols[n]) for n in names)
+            self.stats.ship(
+                len(self._cols[names[0]]),
+                sum(self._cols[n].nbytes for n in names),
+            )
+        elif dirty:
+            idx = np.asarray(sorted(dirty), dtype=np.int32)
+            rows = tuple(self._cols[n][idx] for n in names)
+            leaves = apply_rows(
+                tuple(getattr(prev, n) for n in names), idx, rows
+            )
+            self.stats.ship(len(idx), group_nbytes(idx, rows))
+        else:
+            leaves = tuple(getattr(prev, n) for n in names)
+        return leaves
+
+    def _ship(self) -> NatTables:
+        row_names = tuple(n for n, _ in ROW_LEAVES)
+        ring_names = tuple(n for n, _ in RING_LEAVES)
+        rows = dict(zip(row_names, self._group(
+            row_names, self._reship_rows, self._dirty_rows)))
+        rings = dict(zip(ring_names, self._group(
+            ring_names, self._reship_rings, self._dirty_rings)))
+        (hmap_leaf,) = self._group(
+            ("hmap_idx",), self._reship_hmap, self._dirty_hslots)
+        prev = self.last_tables
+        if self._reship_scalars or prev is None:
+            scalars = {n: jnp.asarray(self._cols[n]) for n in SCALAR_LEAVES}
+            self.stats.ship(
+                len(SCALAR_LEAVES),
+                sum(self._cols[n].nbytes for n in SCALAR_LEAVES),
+            )
+        else:
+            scalars = {n: getattr(prev, n) for n in SCALAR_LEAVES}
+        cap = len(self._cols["map_valid"])
+        tables = NatTables(
+            **rows, **rings, hmap_idx=hmap_leaf, **scalars,
+            num_mappings=len(self._map_of),
+            bucket_size=self._K,
+            use_hmap=_pick_use_hmap(cap, None) if self._hmap_ok else False,
+            has_affinity=self._n_affinity > 0,
+        )
+        self.last_tables = tables
+        self.fingerprint = fold_fingerprint(
+            (self._sums[n], self._cols[n].shape) for n in NAT_LEAF_ORDER
+        )
+        self._dirty_rows = set()
+        self._dirty_rings = set()
+        self._dirty_hslots = set()
+        self._reship_rows = self._reship_rings = False
+        self._reship_hmap = self._reship_scalars = False
+        return tables
+
+    # ----------------------------------------------------------- full build
+
+    def _full(self, services: Dict[object, tuple], glob: tuple,
+              row_cap_min: Optional[int] = None) -> NatTables:
+        """Canonical rebuild via build_nat_host (mappings flattened in
+        sorted-service order — bit-identical to build_nat_tables), then
+        re-derive the incremental registries from the result."""
+        self.stats.full_builds += 1
+        nat_loopback, snat_ip, snat_enabled, pod_subnet = glob
+        flat: List[NatMapping] = []
+        for key in _sorted_keys(services):
+            flat.extend(services[key])
+        host = build_nat_host(
+            flat, nat_loopback=nat_loopback, snat_ip=snat_ip,
+            snat_enabled=snat_enabled, pod_subnet=pod_subnet,
+            bucket_size=self.bucket_base,
+        )
+        self._cols = {n: host[n] for n in NAT_LEAF_ORDER}
+        self._K = host["bucket_size"]
+        self._hmap_ok = host["hmap_ok"]
+        cap = len(self._cols["map_valid"])
+        if row_cap_min and row_cap_min > cap:
+            # Shrink compactions keep 2x headroom over the canonical cap
+            # so boundary churn cannot thrash XLA shape buckets.
+            self._grow_rows(row_cap_min)
+            cap = row_cap_min
+            self.stats.grows -= 1  # not a churn grow, just the hint
+        self._services = dict(services)
+        self._glob = glob
+        self._row_of = {}
+        self._map_of = {}
+        self._hmap_slot = {}
+        for i, m in enumerate(flat):
+            ek = _ext_key(m)
+            if ek not in self._row_of:  # first claim wins (dense argmax)
+                self._row_of[ek] = i
+            self._map_of[i] = m
+        hmap = self._cols["hmap_idx"]
+        for slot in np.nonzero(hmap >= 0)[0]:
+            row = int(hmap[slot])
+            self._hmap_slot[_ext_key(self._map_of[row])] = int(slot)
+        # Incremental aggregates (K maxima, valid/affinity counts) —
+        # re-derived here, maintained O(changed) by the delta mutators.
+        self._weights = {
+            row: (self._need(m), len(m.backends))
+            for row, m in self._map_of.items()
+        }
+        self._max_dirty = True
+        self._current_maxes()
+        self._n_valid = sum(1 for m in self._map_of.values() if m.backends)
+        self._n_affinity = sum(
+            1 for m in self._map_of.values()
+            if m.session_affinity_timeout > 0
+        )
+        self._free_rows = list(range(cap - 1, len(flat) - 1, -1))
+        self._row_high = cap  # everything beyond flat is on the free list
+        self._sums = {n: u32_wrap_sum(self._cols[n]) for n in NAT_LEAF_ORDER}
+        self._dirty_rows = set()
+        self._dirty_rings = set()
+        self._dirty_hslots = set()
+        self._reship_rows = self._reship_rings = True
+        self._reship_hmap = self._reship_scalars = True
+        self.last_tables = None
+        return self._ship()
+
+    # -------------------------------------------------------------- queries
+
+    @property
+    def num_mappings(self) -> int:
+        return len(getattr(self, "_map_of", {}))
+
+
+# --------------------------------------------------------------------------
+# Canonicalization (equivalence testing)
+# --------------------------------------------------------------------------
+
+
+def canonical_nat_tables(t: NatTables) -> NatTables:
+    """Map ANY NatTables layout (delta row permutation / recycled rows /
+    hysteresis padding / incremental hmap layout) to a canonical form:
+    live rows sorted by full content, pow2 padding recomputed, the
+    exact-match index rebuilt canonically from the sorted rows.  Two
+    tables are semantically identical iff their canonical forms are
+    array-identical (the backend pick depends only on row CONTENT and
+    the shared ring width K, which canonicalization preserves)."""
+    cols = {n: np.asarray(getattr(t, n)) for n in NAT_LEAF_ORDER}
+    cap = len(cols["map_valid"])
+    live = cols["map_valid"].copy()
+    for n in ("map_ext_ip", "map_ext_port", "map_proto", "map_twice_nat",
+              "map_affinity", "map_aff_timeout"):
+        live |= cols[n] != 0
+    live |= cols["backend_ip"].any(axis=1)
+    live |= cols["backend_port"].any(axis=1)
+    rows = sorted(
+        (
+            tuple(int(cols[n][i]) for n, _ in ROW_LEAVES[:5])
+            + (bool(cols["map_valid"][i]), int(cols["map_aff_timeout"][i]))
+            + tuple(cols["backend_ip"][i].tolist())
+            + tuple(cols["backend_port"][i].tolist())
+        )
+        for i in range(cap) if live[i]
+    )
+    m = len(rows)
+    k = cols["backend_ip"].shape[1]
+    padded = _next_pow2(max(m, 1))
+    out = {name: np.zeros(padded, dtype=dt) for name, dt in ROW_LEAVES}
+    b_ip = np.zeros((padded, k), dtype=np.uint32)
+    b_port = np.zeros((padded, k), dtype=np.int32)
+    for i, row in enumerate(rows):
+        for j, (name, _) in enumerate(ROW_LEAVES[:5]):
+            out[name][i] = row[j]
+        out["map_valid"][i] = row[5]
+        out["map_aff_timeout"][i] = row[6]
+        b_ip[i] = row[7:7 + k]
+        b_port[i] = row[7 + k:7 + 2 * k]
+    n_valid = int(out["map_valid"].sum())
+    hmap = _build_map_hash(
+        [
+            (i, (int(out["map_ext_ip"][i]), int(out["map_ext_port"][i]),
+                 int(out["map_proto"][i])))
+            for i in range(m) if out["map_valid"][i]
+        ],
+        start_capacity=_next_pow2(max(2 * n_valid, 8), minimum=16),
+    )
+    hmap_ok = hmap is not None
+    if hmap is None:
+        hmap = np.full(16, -1, dtype=np.int32)
+    return NatTables(
+        map_ext_ip=jnp.asarray(out["map_ext_ip"]),
+        map_ext_port=jnp.asarray(out["map_ext_port"]),
+        map_proto=jnp.asarray(out["map_proto"]),
+        map_twice_nat=jnp.asarray(out["map_twice_nat"]),
+        map_affinity=jnp.asarray(out["map_affinity"]),
+        map_valid=jnp.asarray(out["map_valid"]),
+        backend_ip=jnp.asarray(b_ip),
+        backend_port=jnp.asarray(b_port),
+        hmap_idx=jnp.asarray(hmap),
+        nat_loopback=jnp.asarray(cols["nat_loopback"]),
+        snat_ip=jnp.asarray(cols["snat_ip"]),
+        snat_enabled=jnp.asarray(cols["snat_enabled"]),
+        pod_subnet_base=jnp.asarray(cols["pod_subnet_base"]),
+        pod_subnet_mask=jnp.asarray(cols["pod_subnet_mask"]),
+        map_aff_timeout=jnp.asarray(out["map_aff_timeout"]),
+        num_mappings=m,
+        bucket_size=k,
+        use_hmap=_pick_use_hmap(padded, None) if hmap_ok else False,
+        has_affinity=bool(out["map_aff_timeout"].any()),
+    )

@@ -6,12 +6,16 @@ and vpptcp renderers, plugins/policy/renderer/).  It maintains the
 per-pod ingress/egress rule lists rendered by the configurator,
 de-duplicates identical tables across pods (the reference ACL
 renderer's table sharing, docs/dev-guide/POLICIES.md:394-400 — pods
-with the same policy set share one table), and on every commit compiles
-the whole state into ``RuleTables`` tensors for the data plane.
+with the same policy set share one table), and on every commit brings
+the ``RuleTables`` tensors up to date INCREMENTALLY through a
+persistent builder (ops/classify_delta).
 
-Commit cost model: content changes re-build host arrays and swap them
-onto the device; the classify program itself only recompiles when the
-pow2 rule-bucket size changes.
+Commit cost model: O(what changed) — dirty rule rows and pod slots are
+patched in the host mirrors and shipped with a jitted scatter; the
+first commit (and hysteresis shrink compactions) pays a full canonical
+build; the classify program itself only recompiles when the pow2
+rule-bucket size changes.  See docs/ARCHITECTURE.md "Table compile &
+swap" for the full cost model.
 """
 
 from __future__ import annotations
@@ -60,10 +64,14 @@ class TpuPolicyRenderer(PolicyRendererAPI):
     """Keeps rendered pod tables; compiles tensors on commit."""
 
     def __init__(self, on_compiled: Optional[Callable[[RuleTables], None]] = None):
+        from ...ops.classify_delta import AclTableBuilder
+
         # pod -> (pod_ip_u32, ingress rules, egress rules)
         self._pods: Dict[PodID, Tuple[int, Tuple[ContivRule, ...], Tuple[ContivRule, ...]]] = {}
         self._lock = threading.Lock()
         self._compiled: Optional[RuleTables] = None
+        # Persistent incremental compiler: commits cost O(dirty keys).
+        self._builder = AclTableBuilder()
         # Hook for the runtime: called with fresh tables after each commit.
         self._on_compiled = on_compiled
 
@@ -80,13 +88,14 @@ class TpuPolicyRenderer(PolicyRendererAPI):
         with self._lock:
             return self._compiled
 
-    def stats(self) -> Dict[str, int]:
+    def stats(self) -> Dict[str, object]:
         with self._lock:
             compiled = self._compiled
             return {
                 "pods": len(self._pods),
                 "tables": compiled.num_tables if compiled else 0,
                 "rules": compiled.num_rules if compiled else 0,
+                "compile": self._builder.stats.as_dict(),
             }
 
     # ---------------------------------------------------------------- commit
@@ -109,10 +118,12 @@ class TpuPolicyRenderer(PolicyRendererAPI):
             self._on_compiled(compiled)
 
     def _compile(self) -> RuleTables:
-        compiled = compile_pod_tables(self._pods)
+        compiled = self._builder.sync(self._pods)
         log.debug(
-            "compiled %d rules in %d tables for %d pods",
+            "compiled %d rules in %d tables for %d pods "
+            "(%d rows shipped this commit)",
             compiled.num_rules, compiled.num_tables, compiled.num_pods,
+            self._builder.stats.last_rows_shipped,
         )
         return compiled
 

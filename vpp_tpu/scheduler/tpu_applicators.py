@@ -32,8 +32,7 @@ import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..ops.classify import RuleTables
-from ..ops.nat import NatMapping, NatTables, build_nat_tables
-from ..policy.renderer.tpu import compile_pod_tables
+from ..ops.nat import NatMapping, NatTables
 from .scheduler import Applicator
 
 ACL_POD_PREFIX = "tpu/acl/pod/"
@@ -54,32 +53,68 @@ class NatGlobalConfig:
     pod_subnet: str = "10.1.0.0/16"
 
 
+def _fp_fold_device(arr_leaves: tuple, plan: tuple):
+    """The fused fingerprint program: per-leaf uint32 wrap-sums folded
+    ON DEVICE with the static shape/aux constants, returning ONE uint32
+    scalar.  ``plan`` is static: ``(is_array, const)`` per pytree leaf
+    (const = hash(shape) for arrays, hash(leaf) otherwise)."""
+    import jax.numpy as jnp
+
+    from ..ops.delta import FP_PRIME, FP_SEED
+
+    fp = jnp.uint32(FP_SEED)
+    it = iter(arr_leaves)
+    for is_array, const in plan:
+        fp = fp * jnp.uint32(FP_PRIME)
+        if is_array:
+            arr = next(it)
+            if arr.dtype == jnp.bool_:
+                arr = arr.astype(jnp.uint32)
+            elif arr.dtype.kind == "f":
+                arr = (
+                    arr.view(jnp.uint32) if arr.dtype.itemsize == 4
+                    else arr.astype(jnp.uint32)
+                )
+            else:
+                arr = arr.astype(jnp.uint32)
+            fp = fp ^ jnp.sum(arr, dtype=jnp.uint32) ^ jnp.uint32(const)
+        else:
+            fp = fp ^ jnp.uint32(const)
+    return fp
+
+
+_fp_fold_jit = None  # lazily jitted (keeps module import light)
+
+
 def table_fingerprint(tables: Any) -> int:
     """Content checksum of a compiled table pytree, computed ON DEVICE
-    (one scalar transfer per leaf): uint32 wrap-sums of every array
-    leaf, folded with shapes.  Equal content → equal fingerprint on any
-    placement — retargeting (aux-only) and mesh re-sharding preserve
-    it, so the drift check compares what the data plane actually holds
+    as ONE fused reduction returning a single uint32 scalar — exactly
+    one host transfer per fingerprint.  (The per-leaf ``int(jnp.sum)``
+    predecessor did one device→host sync per leaf; NOTES_r05 measured
+    that flipping a remote TPU tunnel into its ~100x degraded d2h
+    mode.)  uint32 wrap-sums are permutation-invariant per leaf and
+    ADDITIVE, so the incremental builders maintain the expected-side
+    value on the host (ops/delta.fold_fingerprint — the two folds are
+    property-tested equal).  Equal content → equal fingerprint on any
+    placement: retargeting (aux-only) and mesh re-sharding preserve it,
+    so the drift check compares what the data plane actually holds
     against what the scheduler last compiled."""
     import jax
     import jax.numpy as jnp
 
-    fp = 0x811C9DC5
+    global _fp_fold_jit
+    if _fp_fold_jit is None:
+        _fp_fold_jit = jax.jit(_fp_fold_device, static_argnums=(1,))
+
+    plan = []
+    arrs = []
     for leaf in jax.tree_util.tree_leaves(tables):
-        if not hasattr(leaf, "dtype"):
-            fp = (fp * 0x01000193) ^ (hash(leaf) & 0xFFFFFFFF)
-            continue
-        arr = jnp.asarray(leaf)
-        if arr.dtype == jnp.bool_:
-            arr = arr.astype(jnp.uint32)
-        elif arr.dtype.kind == "f":
-            arr = arr.view(jnp.uint32) if arr.dtype.itemsize == 4 else arr.astype(jnp.uint32)
+        if hasattr(leaf, "dtype"):
+            arrs.append(jnp.asarray(leaf))
+            plan.append((True, hash(tuple(leaf.shape)) & 0xFFFFFFFF))
         else:
-            arr = arr.astype(jnp.uint32)
-        s = int(jnp.sum(arr)) & 0xFFFFFFFF
-        fp = (fp * 0x01000193) ^ s ^ (hash(arr.shape) & 0xFFFFFFFF)
-        fp &= 0xFFFFFFFFFFFFFFFF
-    return fp
+            plan.append((False, hash(leaf) & 0xFFFFFFFF))
+    return int(_fp_fold_jit(tuple(arrs), tuple(plan)))
 
 
 class _CompilingApplicator(Applicator):
@@ -106,6 +141,7 @@ class _CompilingApplicator(Applicator):
         with self._lock:
             self._state[key] = value
             self._dirty = True
+            self._keyset_changed(key)
 
     def update(self, key: str, old_value: Any, new_value: Any) -> None:
         with self._lock:
@@ -116,6 +152,11 @@ class _CompilingApplicator(Applicator):
         with self._lock:
             self._state.pop(key, None)
             self._dirty = True
+            self._keyset_changed(key)
+
+    def _keyset_changed(self, key: str) -> None:
+        """Hook: a key appeared/disappeared (updates keep the keyset).
+        Subclasses caching key-order artifacts invalidate here."""
 
     def begin_txn(self) -> None:
         pass
@@ -137,6 +178,22 @@ class _CompilingApplicator(Applicator):
     def _compile(self, state: Dict[str, Any]):
         raise NotImplementedError
 
+    def _expected_fingerprint(self, expected: Any) -> int:
+        """Fingerprint of the last compile.  When the tables came from
+        this applicator's incremental builder, the builder maintained
+        the per-leaf wrap-sums under its delta patches — the expected
+        side is a pure host fold, O(1), no device reduction.  Anything
+        else (e.g. a test subclass compiling directly) pays the one
+        fused device reduction."""
+        builder = getattr(self, "_builder", None)
+        if (
+            builder is not None
+            and builder.last_tables is expected
+            and builder.fingerprint is not None
+        ):
+            return builder.fingerprint
+        return table_fingerprint(expected)
+
     def verify(self, applied: Dict[str, Any]):
         """Device-table drift check: fingerprint the tables the data
         plane is RUNNING (installed_fn → runner) against the last
@@ -153,40 +210,67 @@ class _CompilingApplicator(Applicator):
             return set(applied)
         installed = self.installed_fn()
         if installed is None or (
-            table_fingerprint(installed) != table_fingerprint(expected)
+            table_fingerprint(installed) != self._expected_fingerprint(expected)
         ):
             return set(applied)
         return set()
 
 
 class TpuAclApplicator(_CompilingApplicator):
-    """Compiles ``tpu/acl/pod/*`` entries into classify RuleTables."""
+    """Compiles ``tpu/acl/pod/*`` entries into classify RuleTables
+    through a PERSISTENT incremental builder: the host numpy mirrors
+    and the table-interning map live across transactions, so a txn
+    costs O(its dirty keys) — dirty rule rows and pod slots ship to the
+    device via a jitted scatter instead of a full tensor re-upload
+    (ops/classify_delta)."""
 
     prefix = ACL_POD_PREFIX
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        from ..ops.classify_delta import AclTableBuilder
+
+        self._builder = AclTableBuilder()
 
     @property
     def tables(self) -> Optional[RuleTables]:
         with self._lock:
             return self._compiled
 
-    def stats(self) -> Dict[str, int]:
+    def stats(self) -> Dict[str, Any]:
         with self._lock:
             compiled = self._compiled
             return {
                 "pods": len(self._state),
                 "tables": compiled.num_tables if compiled else 0,
                 "rules": compiled.num_rules if compiled else 0,
+                "compile": {
+                    "swaps": self.compile_count,
+                    **self._builder.stats.as_dict(),
+                },
             }
 
     def _compile(self, state: Dict[str, Any]) -> RuleTables:
-        return compile_pod_tables(state)
+        return self._builder.sync(state)
 
 
 class TpuNatApplicator(_CompilingApplicator):
     """Compiles ``tpu/nat/*`` (global + per-service mapping lists) into
-    NatTables for the rewrite kernel."""
+    NatTables for the rewrite kernel — incrementally: the persistent
+    builder diffs only the dirty service keys and patches mapping rows /
+    backend rings / hash-index slots in place (ops/nat_delta)."""
 
     prefix = NAT_PREFIX
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        from ..ops.nat_delta import NatTableBuilder
+
+        self._builder = NatTableBuilder()
+        # Sorted-service-key cache: _flatten used to re-sort the FULL
+        # service keyspace on every call; the keyset only changes on
+        # create/delete, so sort once and invalidate on those.
+        self._sorted_services: Optional[List[str]] = None
 
     @property
     def tables(self) -> Optional[NatTables]:
@@ -197,18 +281,43 @@ class TpuNatApplicator(_CompilingApplicator):
         with self._lock:
             return self._flatten(dict(self._state))
 
-    @staticmethod
-    def _flatten(state: Dict[str, Any]) -> List[NatMapping]:
+    def _keyset_changed(self, key: str) -> None:
+        self._sorted_services = None
+
+    def _service_keys(self) -> List[str]:
+        if self._sorted_services is None:
+            self._sorted_services = sorted(
+                k for k in self._state if k.startswith(NAT_SERVICE_PREFIX)
+            )
+        return self._sorted_services
+
+    def _flatten(self, state: Dict[str, Any]) -> List[NatMapping]:
         out: List[NatMapping] = []
-        for key in sorted(state):
-            if key.startswith(NAT_SERVICE_PREFIX):
-                out.extend(state[key])
+        for key in self._service_keys():
+            out.extend(state.get(key, ()))
         return out
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            compiled = self._compiled
+            return {
+                "services": sum(
+                    1 for k in self._state if k.startswith(NAT_SERVICE_PREFIX)
+                ),
+                "mappings": compiled.num_mappings if compiled else 0,
+                "compile": {
+                    "swaps": self.compile_count,
+                    **self._builder.stats.as_dict(),
+                },
+            }
 
     def _compile(self, state: Dict[str, Any]) -> NatTables:
         glob: NatGlobalConfig = state.get(NAT_GLOBAL_KEY) or NatGlobalConfig()
-        return build_nat_tables(
-            self._flatten(state),
+        services = {
+            k: v for k, v in state.items() if k.startswith(NAT_SERVICE_PREFIX)
+        }
+        return self._builder.sync(
+            services,
             nat_loopback=glob.nat_loopback,
             snat_ip=glob.snat_ip,
             snat_enabled=glob.snat_enabled,

@@ -26,7 +26,6 @@ from ...ops.nat import (
     NatTables,
     TWICE_NAT_ENABLED,
     TWICE_NAT_SELF,
-    build_nat_tables,
 )
 from .api import ContivService, ServiceRendererAPI, TrafficPolicy
 
@@ -113,6 +112,19 @@ class TpuNatRenderer(ServiceRendererAPI):
         self._lock = threading.Lock()
         self._compiled: Optional[NatTables] = None
         self._on_compiled = on_compiled
+        # Persistent incremental compiler: a service/endpoint change
+        # patches its mapping rows and backend rings in place instead of
+        # rebuilding (and re-uploading) the whole table (ops/nat_delta).
+        from ...ops.nat_delta import NatTableBuilder
+
+        self._builder = NatTableBuilder()
+        # Exported-mapping cache per service: _recompile hands the
+        # builder the SAME tuple objects for untouched services, so its
+        # diff is an identity check, not a value compare of every
+        # mapping — the host side stays O(changed) too.  Invalidated
+        # per-service on CRUD, wholesale when node IPs change (NodePort
+        # exports depend on them).
+        self._export_cache: Dict[ServiceID, tuple] = {}
         self._recompile()
 
     # --------------------------------------------------------------- queries
@@ -131,23 +143,30 @@ class TpuNatRenderer(ServiceRendererAPI):
     def add_service(self, service: ContivService) -> None:
         with self._lock:
             self._services[service.id] = service
+            self._export_cache.pop(service.id, None)
         self._recompile()
 
     def update_service(self, old: ContivService, new: ContivService) -> None:
         with self._lock:
             self._services[new.id] = new
+            self._export_cache.pop(old.id, None)
+            self._export_cache.pop(new.id, None)
         self._recompile()
 
     def delete_service(self, service: ContivService) -> None:
         with self._lock:
             self._services.pop(service.id, None)
+            self._export_cache.pop(service.id, None)
         self._recompile()
 
     def update_node_port_services(self, node_ips, np_services) -> None:
         with self._lock:
+            if list(node_ips) != self._node_ips:
+                self._export_cache.clear()  # NodePort exports shift
             self._node_ips = list(node_ips)
             for svc in np_services:
                 self._services[svc.id] = svc
+                self._export_cache.pop(svc.id, None)
         self._recompile()
 
     def update_local_frontends(self, frontends: Set[str]) -> None:
@@ -161,6 +180,7 @@ class TpuNatRenderer(ServiceRendererAPI):
     def resync(self, services, node_ips, frontends, backends) -> None:
         with self._lock:
             self._services = {s.id: s for s in services}
+            self._export_cache.clear()
             self._node_ips = list(node_ips)
             self._frontends = set(frontends)
             self._backends = set(backends)
@@ -179,8 +199,20 @@ class TpuNatRenderer(ServiceRendererAPI):
 
     def _recompile(self) -> None:
         with self._lock:
-            compiled = build_nat_tables(
-                self._export_all(),
+            # Per-service mapping dict (sorted-service flatten order is
+            # the builder's canonical order, matching build_nat_tables
+            # over _export_all()).  Untouched services come from the
+            # export cache — same tuple objects, so the builder's diff
+            # short-circuits on identity.
+            exported = {}
+            for sid in self._services:
+                cached = self._export_cache.get(sid)
+                if cached is None:
+                    cached = tuple(self._export_service(self._services[sid]))
+                    self._export_cache[sid] = cached
+                exported[sid] = cached
+            compiled = self._builder.sync(
+                exported,
                 nat_loopback=self.nat_loopback,
                 snat_ip=self.snat_ip,
                 snat_enabled=self.snat_enabled,
@@ -189,3 +221,12 @@ class TpuNatRenderer(ServiceRendererAPI):
             self._compiled = compiled
         if self._on_compiled is not None:
             self._on_compiled(compiled)
+
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            compiled = self._compiled
+            return {
+                "services": len(self._services),
+                "mappings": compiled.num_mappings if compiled else 0,
+                "compile": self._builder.stats.as_dict(),
+            }
