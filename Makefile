@@ -3,9 +3,9 @@
 
 PY ?= python
 
-.PHONY: test test-race verify-ha verify-churn lint bench bench-suite \
-        bench-sweep bench-scale bench-latency bench-frames bench-churn \
-        images native
+.PHONY: test test-race verify-ha verify-churn verify-faults lint bench \
+        bench-suite bench-sweep bench-scale bench-latency bench-frames \
+        bench-churn images native
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -36,6 +36,18 @@ verify-churn:
 
 bench-churn:
 	$(PY) scripts/bench_churn.py --check
+
+# Datapath fault-domain verification: the fault-injection harness units
+# (injector semantics, swap rollback, poisoned-batch quarantine, REST/
+# netctl health) + the chaos suite (shard ejection mid-traffic with
+# oracle verdict parity, hang deadlines, atomic multi-shard swap
+# rollback, all-shards-down policies, agent/store/leader kills).
+# `not slow` mirrors tier-1; RUN_SLOW=1 adds the cross-process soaks.
+verify-faults:
+	JAX_PLATFORMS=cpu $(PY) -m pytest \
+	    tests/test_faults.py tests/test_chaos.py tests/test_shards.py \
+	    -q $(if $(RUN_SLOW),,-m 'not slow') --continue-on-collection-errors \
+	    -p no:cacheprovider -p no:xdist -p no:randomly
 
 # Race-amplified run: CPython has no Go-style race detector, so instead
 # the whole suite runs under dev mode (threading/resource warnings are

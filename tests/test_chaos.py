@@ -23,9 +23,24 @@ restores frame delivery:
   KSR writes resume, and no policy/service state is lost.
 """
 
+import ipaddress
+
+import pytest
+
+import jax.numpy as jnp
+
+from vpp_tpu.datapath import NativeRing, ShardedDataplane, TableSwapError, VxlanOverlay
 from vpp_tpu.kvstore import KVStoreServer, RemoteKVStore
 from vpp_tpu.kvstore.ha import HAEnsemble
+from vpp_tpu.models import ProtocolType
+from vpp_tpu.ops.classify import NO_TABLE, build_rule_tables
+from vpp_tpu.ops.nat import NatMapping, build_nat_tables
+from vpp_tpu.ops.packets import ip_to_u32
+from vpp_tpu.ops.pipeline import RouteConfig
+from vpp_tpu.policy.renderer.api import Action, ContivRule
+from vpp_tpu.testing.aclengine import Verdict, evaluate_table
 from vpp_tpu.testing.cluster import timeout_mult, wait_for
+from vpp_tpu.testing.faults import SITE_DISPATCH_HANG, SITE_DISPATCH_RAISE, SITE_SWAP_FAIL
 from vpp_tpu.testing.framecluster import FrameCluster, FrameNode
 from vpp_tpu.testing.frames import build_frame, frame_tuple, verify_checksums
 
@@ -306,3 +321,294 @@ def test_store_leader_kill_mid_traffic_failover_and_no_lost_state():
                    for fn in cluster.frame_nodes.values()) >= 1
     finally:
         cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# Datapath fault domains: shard supervision, steer, quarantine, atomic swaps
+# (ISSUE 4 tentpole; driven through the fault-injection harness,
+# vpp_tpu/testing/faults.py — no monkeypatching of runner internals).
+# ---------------------------------------------------------------------------
+
+# Egress policy of pod 10.1.1.30: deny TCP :9, allow the rest.  The
+# SAME rule list drives the TPU tables and the mock-engine oracle
+# (testing/aclengine.evaluate_table), so surviving shards' verdicts are
+# checked against ground truth, not against themselves.
+_CHAOS_RULES = [
+    ContivRule(action=Action.DENY, protocol=ProtocolType.TCP, dst_port=9),
+    ContivRule(action=Action.PERMIT),
+]
+_GUARDED_POD = "10.1.1.30"
+_OPEN_POD = "10.1.1.40"
+
+
+def _oracle_allows(dst_ip: str, sport: int, dport: int) -> bool:
+    if dst_ip != _GUARDED_POD:
+        return True  # no tables rendered for that pod -> allow
+    return evaluate_table(
+        _CHAOS_RULES, ipaddress.ip_address("10.1.1.2"),
+        ipaddress.ip_address(dst_ip), ProtocolType.TCP, sport, dport,
+    ) is Verdict.ALLOWED
+
+
+def _chaos_route():
+    return RouteConfig(
+        pod_subnet_base=jnp.asarray(ip_to_u32("10.1.0.0"), dtype=jnp.uint32),
+        pod_subnet_mask=jnp.asarray(0xFFFF0000, dtype=jnp.uint32),
+        this_node_base=jnp.asarray(ip_to_u32("10.1.1.0"), dtype=jnp.uint32),
+        this_node_mask=jnp.asarray(0xFFFFFF00, dtype=jnp.uint32),
+        host_bits=jnp.asarray(8, dtype=jnp.int32),
+    )
+
+
+def _make_chaos_dp(n_shards, **kw):
+    kw.setdefault("batch_size", 8)
+    kw.setdefault("max_vectors", 2)
+    kw.setdefault("eject_errors", 3)
+    kw.setdefault("probation_polls", 2)
+    ios = [tuple(NativeRing() for _ in range(4)) for _ in range(n_shards)]
+    dp = ShardedDataplane(
+        acl=build_rule_tables(
+            [_CHAOS_RULES], {ip_to_u32(_GUARDED_POD): (NO_TABLE, 0)}),
+        nat=build_nat_tables([], snat_enabled=False,
+                             pod_subnet="10.1.0.0/16"),
+        route=_chaos_route(),
+        overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"),
+                             local_node_id=1),
+        shard_ios=ios,
+        **kw,
+    )
+    return dp, ios
+
+
+def _eject_shard(dp, ios, shard, max_polls=24):
+    """Feed sacrificial frames (src ports >= 50000, excluded from every
+    parity check) until the armed fault ejects the shard."""
+    for i in range(max_polls):
+        if dp.health_of[shard].state == "ejected":
+            return
+        ios[shard][0].send(
+            [build_frame("10.1.9.9", _OPEN_POD, 6, 50000 + i, 80)])
+        dp.poll()
+    raise AssertionError(f"shard {shard} never ejected: "
+                         f"{dp.health_of[shard]}")
+
+
+def _delivered_tuples(ios, lo=40000, hi=50000):
+    out = []
+    for io_set in ios:
+        out += [frame_tuple(f) for f in io_set[2].recv_batch(1 << 12)]
+    return sorted(t for t in out if lo <= t[3] < hi)
+
+
+def test_shard_ejection_mid_traffic_survivors_keep_oracle_parity():
+    """ACCEPTANCE: dispatch-raise armed on shard 1 of 4 → the shard is
+    ejected, its queued traffic steers onto the survivors, delivery
+    stays verdict-faithful to the mock-engine oracle, `netctl health`
+    reports the ejection, and the shard rejoins after probation."""
+    dp, ios = _make_chaos_dp(4, reinit_backoff=60.0)  # no rejoin while armed
+    try:
+        dp.faults.arm(SITE_DISPATCH_RAISE, shard=1)
+        _eject_shard(dp, ios, 1)
+        h = dp.health()
+        assert h["shards"][1]["state"] == "ejected"
+        assert h["shards_serving"] == 3 and not h["all_down"]
+        assert h["ejections"] >= 1
+
+        # Mixed allowed/denied traffic over ALL shards — including the
+        # ejected one, whose frames must steer to the survivors.
+        flows = []
+        for i in range(24):
+            dst = _GUARDED_POD if i % 2 else _OPEN_POD
+            dport = 9 if i % 3 == 0 else 80
+            flows.append(("10.1.1.2", dst, 6, 40000 + i, dport))
+        for i, (src, dst, proto, sport, dport) in enumerate(flows):
+            ios[i % 4][0].send([build_frame(src, dst, proto, sport, dport)])
+        dp.drain()
+
+        expected = sorted(
+            (src, dst, proto, sport, dport)
+            for (src, dst, proto, sport, dport) in flows
+            if _oracle_allows(dst, sport, dport)
+        )
+        assert _delivered_tuples(ios) == expected
+        assert dp.health()["steered_frames"] >= 6  # shard 1's quarter
+
+        # The ejection is visible over REST + `netctl health`.
+        import io as _io
+
+        from vpp_tpu.netctl.cli import main as netctl
+        from vpp_tpu.rest.server import AgentRestServer
+
+        rest = AgentRestServer(node_name="n1", datapath=dp)
+        port = rest.start()
+        try:
+            out = _io.StringIO()
+            assert netctl(["health", "--server", f"127.0.0.1:{port}"],
+                          out=out) == 0
+            text = out.getvalue()
+            assert "ejected" in text and "3/4 serving" in text
+        finally:
+            rest.stop()
+
+        # ---- recovery: disarm, expedite probation, rejoin ------------
+        dp.faults.disarm()
+        assert dp.recover(1) == 1
+        probes = []
+        for i in range(30):
+            probe = ("10.1.1.2", _OPEN_POD, 6, 40100 + i, 80)
+            probes.append(probe)
+            ios[1][0].send([build_frame(*probe)])
+            dp.poll()
+            if dp.health_of[1].rejoins >= 1:
+                break
+        assert dp.health_of[1].rejoins >= 1
+        assert dp.health_of[1].state in ("rejoined", "healthy")
+        dp.drain()
+        # Every probe frame (steered or shard-1-served) was delivered.
+        assert _delivered_tuples(ios, 40100, 41000) == sorted(probes)
+        h = dp.health()
+        assert h["shards_serving"] == 4 and h["rejoins"] >= 1
+    finally:
+        dp.close()
+
+
+def test_shard_hang_blows_dispatch_deadline_ejects_and_rejoins():
+    """dispatch-hang: the shard's worker wedges mid-dispatch; the
+    supervisor enforces the dispatch deadline, abandons the thread,
+    ejects the shard — survivors keep serving — and the shard rejoins
+    once the wedge clears (disarm releases it)."""
+    dp, ios = _make_chaos_dp(2, dispatch_deadline=0.3, reinit_backoff=0.05)
+    try:
+        dp.faults.arm(SITE_DISPATCH_HANG, shard=0, seconds=30.0)
+        ios[0][0].send([build_frame("10.1.9.9", _OPEN_POD, 6, 50000, 80)])
+        ios[1][0].send([build_frame("10.1.1.2", _OPEN_POD, 6, 40000, 80)])
+        dp.poll()
+        assert dp.health_of[0].state == "ejected"
+        assert "deadline" in dp.health_of[0].last_error
+        # The survivor delivered its frame within the same poll.
+        assert len(ios[1][2].recv_batch(16)) == 1
+
+        # Traffic queued behind the WEDGED batch is parked, not lost:
+        # the hung admit pins the rx arena, so steering skips the ring
+        # until the wedge clears (the dispatch-raise test covers live
+        # steering of a sanitised shard).
+        parked = ("10.1.1.2", _OPEN_POD, 6, 40001, 80)
+        ios[0][0].send([build_frame(*parked)])
+        dp.drain()
+        assert _delivered_tuples(ios) == []
+        assert len(ios[0][0]) >= 1
+
+        # While the thread is STILL wedged, probation must not touch
+        # the runner: the ejection extends instead.
+        dp.poll()
+        assert dp.health_of[0].state == "ejected"
+
+        # Release the wedge; the abandoned worker finishes (its resumed
+        # poll may consume frames whose batches the rejoin sanitise
+        # then discards — vswitch-crash loss semantics, transports
+        # retransmit), the shard passes probation and rejoins, and
+        # fresh traffic flows through it again.
+        dp.faults.disarm()
+        assert wait_for(lambda: 0 not in dp._stuck or dp._stuck[0].done(),
+                        timeout=5.0)
+        dp.recover(0)
+        probes = []
+        for i in range(30):
+            probe = ("10.1.1.2", _OPEN_POD, 6, 40100 + i, 80)
+            probes.append(probe)
+            ios[0][0].send([build_frame(*probe)])
+            dp.poll()
+            if dp.health_of[0].rejoins >= 1:
+                break
+        assert dp.health_of[0].rejoins >= 1
+        dp.drain()
+        assert _delivered_tuples(ios, 40100, 41000) == sorted(probes)
+    finally:
+        dp.close()
+
+
+def test_swap_fail_on_one_shard_rolls_back_every_shard():
+    """ACCEPTANCE: a mid-swap failure (swap-fail armed on shard 2 of 3)
+    never leaves shards serving different table generations — all roll
+    back to last-good, the error is retriable, and the retry lands the
+    swap on every shard."""
+    dp, ios = _make_chaos_dp(3)
+    try:
+        old_nat = dp.shards[0].nat
+        new_nat = build_nat_tables(
+            [NatMapping("10.96.0.10", 80, 6,
+                        backends=[("10.1.1.40", 8080, 1)])],
+            snat_enabled=False, pod_subnet="10.1.0.0/16",
+        )
+        dp.faults.arm(SITE_SWAP_FAIL, shard=2, count=1)
+        with pytest.raises(TableSwapError, match="shard 2"):
+            dp.update_tables(nat=new_nat)
+        # ALL shards agree on the last-good generation (identity).
+        assert all(r.nat is old_nat for r in dp.shards)
+        assert dp.health()["swap_rollbacks"] == 1
+        assert dp.metrics()["datapath_swap_rollbacks_total"] == 1
+
+        # Old tables really serve: the service VIP is NOT rewritten on
+        # any shard (10.96/12 is off-subnet -> host route, un-DNATed).
+        for s in range(3):
+            ios[s][0].send(
+                [build_frame("10.1.1.2", "10.96.0.10", 6, 40000 + s, 80)])
+        dp.drain()
+        for s in range(3):
+            out = ios[s][3].recv_batch(16)
+            assert len(out) == 1 and frame_tuple(out[0])[1] == "10.96.0.10"
+
+        # The retry (count=1 expired) succeeds everywhere atomically.
+        dp.update_tables(nat=new_nat)
+        assert all(r.nat is not old_nat for r in dp.shards)
+        for s in range(3):
+            ios[s][0].send(
+                [build_frame("10.1.1.2", "10.96.0.10", 6, 41000 + s, 80)])
+        dp.drain()
+        for s in range(3):
+            out = ios[s][2].recv_batch(16)
+            assert len(out) == 1 and frame_tuple(out[0])[1] == "10.1.1.40"
+    finally:
+        dp.close()
+
+
+def test_all_shards_down_fail_closed_drops_and_counts():
+    dp, ios = _make_chaos_dp(2, reinit_backoff=60.0,
+                             on_all_down="fail-closed")
+    try:
+        dp.faults.arm(SITE_DISPATCH_RAISE)  # every shard
+        _eject_shard(dp, ios, 0)
+        _eject_shard(dp, ios, 1)
+        assert dp.health()["all_down"]
+
+        for s in range(2):
+            ios[s][0].send([build_frame("10.1.1.2", _OPEN_POD, 6,
+                                        40000 + 10 * s + i, 80)
+                            for i in range(6)])
+        dp.poll()
+        assert _delivered_tuples(ios) == []           # fail-closed: nothing
+        assert dp.health()["failclosed_drops"] == 12  # ...but counted
+        assert dp.metrics()["datapath_failclosed_drops_total"] == 12
+    finally:
+        dp.close()
+
+
+def test_all_shards_down_static_bypass_forwards_unfiltered():
+    """The opt-in degraded mode: every shard down + on_all_down=bypass
+    forwards ingress over the static host path — unfiltered (even the
+    oracle-denied flow passes: bypass trades policy for reachability)."""
+    dp, ios = _make_chaos_dp(2, reinit_backoff=60.0, on_all_down="bypass")
+    try:
+        dp.faults.arm(SITE_DISPATCH_RAISE)
+        _eject_shard(dp, ios, 0)
+        _eject_shard(dp, ios, 1)
+
+        flows = [("10.1.1.2", _OPEN_POD, 6, 40000, 80),
+                 ("10.1.1.2", _GUARDED_POD, 6, 40001, 9)]  # ACL would deny
+        for s, flow in enumerate(flows):
+            ios[s][0].send([build_frame(*flow)])
+        dp.poll()
+        assert _delivered_tuples(ios) == sorted(flows)
+        assert dp.health()["bypass_forwards"] == 2
+    finally:
+        dp.close()

@@ -1,0 +1,469 @@
+"""Fault-injection harness + datapath fault-domain units.
+
+Covers the building blocks the chaos suite (tests/test_chaos.py)
+composes: the injector's arming/scoping/count/match semantics, the
+runner's last-good table-swap rollback, poisoned-batch quarantine with
+bisection + pcap forensics, frame-source degradation, the scheduler
+applicator's swap-retry path, the REST/netctl health + fault surfaces,
+and the controller's timer/history hygiene fixes.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from vpp_tpu.controller.txn import RecordedTxn
+from vpp_tpu.datapath import (
+    DataplaneRunner,
+    FaultInjectingSource,
+    InMemoryRing,
+    NativeRing,
+    ShardedDataplane,
+    TableSwapError,
+    VxlanOverlay,
+)
+from vpp_tpu.ops.classify import build_rule_tables
+from vpp_tpu.ops.nat import NatMapping, build_nat_tables
+from vpp_tpu.ops.packets import ip_to_u32
+from vpp_tpu.ops.pipeline import RouteConfig
+from vpp_tpu.testing.faults import (
+    SITE_DISPATCH_RAISE,
+    SITE_FRAME_SOURCE_ERROR,
+    SITE_SWAP_FAIL,
+    FaultInjected,
+    FaultInjector,
+)
+from vpp_tpu.testing.frames import build_frame, frame_tuple
+
+
+def make_route():
+    return RouteConfig(
+        pod_subnet_base=jnp.asarray(ip_to_u32("10.1.0.0"), dtype=jnp.uint32),
+        pod_subnet_mask=jnp.asarray(0xFFFF0000, dtype=jnp.uint32),
+        this_node_base=jnp.asarray(ip_to_u32("10.1.1.0"), dtype=jnp.uint32),
+        this_node_mask=jnp.asarray(0xFFFFFF00, dtype=jnp.uint32),
+        host_bits=jnp.asarray(8, dtype=jnp.int32),
+    )
+
+
+def make_runner(engine="native", **kw):
+    rings = [NativeRing() if engine == "native" else InMemoryRing()
+             for _ in range(4)]
+    kw.setdefault("batch_size", 8)
+    kw.setdefault("max_vectors", 2)
+    runner = DataplaneRunner(
+        acl=build_rule_tables([], {}),
+        nat=build_nat_tables(
+            [], nat_loopback="10.1.1.254", snat_ip="192.168.16.1",
+            snat_enabled=True, pod_subnet="10.1.0.0/16",
+        ),
+        route=make_route(),
+        overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"),
+                             local_node_id=1),
+        source=rings[0], tx=rings[1], local=rings[2], host=rings[3],
+        **kw,
+    )
+    return runner, rings
+
+
+# ------------------------------------------------------------- the injector
+
+
+def test_injector_arm_fire_count_and_shard_scoping():
+    inj = FaultInjector()
+    assert not inj.armed
+    inj.fire(SITE_DISPATCH_RAISE)  # disarmed: no-op
+
+    inj.arm(SITE_DISPATCH_RAISE, shard=2, count=2)
+    assert inj.armed
+    inj.fire(SITE_DISPATCH_RAISE, shard=0)  # other shard: no-op
+    with pytest.raises(FaultInjected):
+        inj.fire(SITE_DISPATCH_RAISE, shard=2)
+    with pytest.raises(FaultInjected):
+        inj.fire(SITE_DISPATCH_RAISE, shard=2)
+    # Count exhausted -> auto-disarmed.
+    inj.fire(SITE_DISPATCH_RAISE, shard=2)
+    assert not inj.armed
+
+    # shard=None plans match every shard; disarm() removes them.
+    inj.arm(SITE_SWAP_FAIL)
+    with pytest.raises(FaultInjected):
+        inj.fire(SITE_SWAP_FAIL, shard=7)
+    assert inj.disarm(site=SITE_SWAP_FAIL) == 1
+    inj.fire(SITE_SWAP_FAIL, shard=7)
+
+    with pytest.raises(ValueError, match="unknown fault site"):
+        inj.arm("no-such-site")
+
+
+def test_injector_match_predicate_and_status():
+    inj = FaultInjector()
+    inj.arm(SITE_DISPATCH_RAISE, match={"src_port": 4242})
+    # No batch / non-matching batch: no fire.
+    inj.fire(SITE_DISPATCH_RAISE, batch=None)
+    inj.fire(SITE_DISPATCH_RAISE,
+             batch={"src_port": np.array([1, 2, 3])})
+    with pytest.raises(FaultInjected):
+        inj.fire(SITE_DISPATCH_RAISE,
+                 batch={"src_port": np.array([1, 4242, 3])})
+    st = inj.status()
+    assert st["armed"] and st["plans"][0]["fired"] == 1
+    assert st["plans"][0]["match"] == {"src_port": 4242}
+
+    with pytest.raises(ValueError, match="unmatchable"):
+        inj.arm(SITE_DISPATCH_RAISE, match={"frame_len": 1})
+
+
+def test_injector_hang_released_by_disarm():
+    inj = FaultInjector()
+    inj.arm("dispatch-hang", seconds=30.0)
+    done = threading.Event()
+
+    def wedge():
+        inj.fire("dispatch-hang", shard=0)
+        done.set()
+
+    t = threading.Thread(target=wedge, daemon=True)
+    t.start()
+    assert not done.wait(0.15)  # wedged
+    inj.disarm()
+    assert done.wait(2.0)       # released immediately, not after 30s
+
+
+def test_injector_count_limited_hang_still_released_by_disarm():
+    """A count=1 hang plan leaves the armed list the moment it fires —
+    disarm() must still release the thread wedged in it."""
+    inj = FaultInjector()
+    inj.arm("dispatch-hang", count=1, seconds=30.0)
+    done = threading.Event()
+
+    def wedge():
+        inj.fire("dispatch-hang", shard=0)
+        done.set()
+
+    t = threading.Thread(target=wedge, daemon=True)
+    t.start()
+    assert not done.wait(0.15)
+    assert not inj.armed        # count exhausted: no longer armed...
+    inj.disarm()
+    assert done.wait(2.0)       # ...but the wedged thread still releases
+
+
+def test_steer_targets_require_enqueueing_sources():
+    """Only ring-like sources (send() == enqueue-for-ingest) are legal
+    steer targets; AfPacketIO.send transmits raw on the wire and must
+    never receive steered frames."""
+    from vpp_tpu.datapath import AfPacketIO
+
+    assert InMemoryRing.can_enqueue
+    assert NativeRing.can_enqueue
+    assert not getattr(AfPacketIO, "can_enqueue", False)
+    inj = FaultInjector()
+    assert FaultInjectingSource(InMemoryRing(), inj).can_enqueue
+
+
+# --------------------------------------------------- swap rollback (solo)
+
+
+def test_runner_swap_fail_rolls_back_to_last_good():
+    runner, rings = make_runner()
+    old_acl, old_nat, old_route = runner.acl, runner.nat, runner.route
+    new_nat = build_nat_tables(
+        [NatMapping("10.96.0.10", 80, 6, backends=[("10.1.1.9", 8080, 1)])],
+        nat_loopback="10.1.1.254", snat_ip="192.168.16.1",
+        snat_enabled=True, pod_subnet="10.1.0.0/16",
+    )
+    runner.faults.arm(SITE_SWAP_FAIL, count=1)
+    with pytest.raises(TableSwapError):
+        runner.update_tables(nat=new_nat)
+    # Last-good tables still resident; traffic still serves them.
+    assert runner.nat is old_nat
+    assert runner.acl is old_acl and runner.route is old_route
+    assert runner.counters.swap_rollbacks == 1
+    assert runner.health()["swap_rollbacks"] == 1
+    rings[0].send([build_frame("10.1.1.2", "10.96.0.10", 6, 40000, 80)])
+    runner.drain()
+    # Old tables: no DNAT mapping -> the service VIP is not rewritten.
+    out = rings[3].recv_batch(16)  # off-subnet dst leaves via host/SNAT path
+    assert len(out) == 1
+    assert frame_tuple(out[0])[1] == "10.96.0.10"
+
+    # The fault was count=1: the retry (same call) succeeds.
+    runner.update_tables(nat=new_nat)
+    rings[0].send([build_frame("10.1.1.2", "10.96.0.10", 6, 40001, 80)])
+    runner.drain()
+    out = rings[2].recv_batch(16)
+    assert len(out) == 1 and frame_tuple(out[0])[1] == "10.1.1.9"
+
+
+# ------------------------------------------------- poisoned-batch quarantine
+
+
+@pytest.mark.parametrize("engine", ["native", "python"])
+def test_poisoned_batch_bisected_dropped_and_captured(engine, tmp_path):
+    pcap = str(tmp_path / "quarantine.pcap")
+    runner, rings = make_runner(engine=engine, quarantine_pcap=pcap)
+    # The poison predicate: any batch containing src_port 4242 crashes
+    # dispatch — the data-dependent device-error analog.
+    runner.faults.arm(SITE_DISPATCH_RAISE, match={"src_port": 4242})
+    frames = [build_frame("10.1.1.2", "10.1.1.3", 6, 40000 + i, 80)
+              for i in range(6)]
+    frames.insert(3, build_frame("10.1.1.4", "10.1.1.3", 6, 4242, 80))
+    rings[0].send(frames)
+    runner.drain()
+    # Adjacent flows flowed; the poisoned frame was dropped + counted.
+    out = rings[2].recv_batch(64)
+    assert len(out) == 6
+    assert all(frame_tuple(f)[3] != 4242 for f in out)
+    assert runner.counters.dropped_poisoned == 1
+    assert runner.counters.quarantined_batches == 1
+    assert runner.counters.dispatch_errors >= 2  # original + bisect probes
+    assert runner.counters.dropped_denied == 0   # not mis-counted as policy
+    h = runner.health()
+    assert h["quarantine"]["poisoned_frames"] == 1
+    assert h["quarantine"]["pcap"] == pcap
+
+    # Forensics: the quarantine pcap holds exactly the poisoned frame —
+    # already flushed to disk (it must survive an agent crash).
+    from vpp_tpu.datapath import PcapReader
+
+    captured = PcapReader(pcap).recv_batch(16)
+    assert len(captured) == 1
+    assert frame_tuple(captured[0])[3] == 4242
+
+    # The loop keeps running clean after the quarantine.
+    runner.faults.disarm()
+    rings[0].send([build_frame("10.1.1.2", "10.1.1.3", 6, 41000, 80)])
+    runner.drain()
+    assert len(rings[2].recv_batch(16)) == 1
+
+
+def test_non_data_dependent_error_is_not_quarantined():
+    """An unconditional dispatch fault (every sub-batch fails) must NOT
+    be eaten by the quarantine — it re-raises so shard supervision can
+    eject the fault domain."""
+    runner, rings = make_runner()
+    runner.faults.arm(SITE_DISPATCH_RAISE)
+    rings[0].send([build_frame("10.1.1.2", "10.1.1.3", 6, 40000 + i, 80)
+                   for i in range(4)])
+    with pytest.raises(FaultInjected):
+        runner.poll()
+    assert runner.counters.dropped_poisoned == 0
+    # After the fault clears (and the loop is sanitised), traffic flows.
+    runner.faults.disarm()
+    runner.sanitize_after_fault()
+    rings[0].send([build_frame("10.1.1.2", "10.1.1.3", 6, 41000, 80)])
+    runner.drain()
+    assert len(rings[2].recv_batch(16)) == 1
+
+
+# ------------------------------------------------------- frame-source errors
+
+
+def test_frame_source_error_degrades_not_dies():
+    runner, rings = make_runner()
+    runner.faults.arm(SITE_FRAME_SOURCE_ERROR, count=2)
+    rings[0].send([build_frame("10.1.1.2", "10.1.1.3", 6, 40000, 80)])
+    assert runner.poll() == 0   # source erroring -> idle, not dead
+    assert runner.poll() == 0
+    assert runner.counters.source_errors == 2
+    assert runner.drain() >= 1  # source recovered
+    assert len(rings[2].recv_batch(16)) == 1
+    assert runner.health()["source_errors"] == 2
+
+
+def test_fault_injecting_source_wrapper():
+    """The io-layer hook point: python-engine sources raise at
+    recv_batch exactly like a flapping NIC."""
+    inj = FaultInjector()
+    ring = InMemoryRing()
+    src = FaultInjectingSource(ring, inj, shard=0)
+    ring.send([b"\x00" * 64])
+    assert len(src) == 1
+    inj.arm(SITE_FRAME_SOURCE_ERROR, count=1)
+    with pytest.raises(FaultInjected):
+        src.recv_batch(8)
+    assert len(src.recv_batch(8)) == 1
+
+
+# ------------------------------------------- scheduler swap-retry integration
+
+
+def test_swap_failure_is_retriable_through_the_scheduler():
+    """A mid-swap failure surfaces as a FAILED value + scheduled retry
+    (NOT an agent crash), and the retry re-attempts the SWAP even
+    though nothing recompiled — the _swap_pending path."""
+    from vpp_tpu.scheduler import TxnScheduler
+    from vpp_tpu.scheduler.tpu_applicators import (
+        NAT_SERVICE_PREFIX,
+        TpuNatApplicator,
+    )
+
+    runner, rings = make_runner()
+    retries = []
+    sched = TxnScheduler(schedule_retry=lambda fn, delay: retries.append(fn))
+    app = TpuNatApplicator(
+        on_compiled=lambda t: runner.update_tables(nat=t),
+        installed_fn=lambda: runner.nat,
+    )
+    sched.register_applicator(app)
+
+    old_nat = runner.nat
+    runner.faults.arm(SITE_SWAP_FAIL, count=1)
+    key = f"{NAT_SERVICE_PREFIX}default/web"
+    sched.commit(RecordedTxn(seq_num=1, is_resync=False, values={
+        key: (NatMapping("10.96.0.10", 80, 6,
+                         backends=[("10.1.1.9", 8080, 1)]),),
+    }))
+    # The swap failed and rolled back; the value is FAILED with a retry
+    # queued; the data plane still runs last-good tables.
+    (status,) = [v for v in sched.dump(key)]
+    assert status.state.value == "failed"
+    assert "rolled back" in status.last_error
+    assert runner.nat is old_nat
+    assert retries, "no retry scheduled for the failed swap"
+
+    # The retry re-fires the swap from the cached compile.
+    retries.pop(0)()
+    (status,) = [v for v in sched.dump(key)]
+    assert status.state.value == "applied"
+    assert runner.nat is not old_nat
+    assert runner.nat.num_mappings == 1
+
+
+# ------------------------------------------------------ REST + netctl health
+
+
+def test_rest_health_faults_and_netctl_render():
+    from vpp_tpu.netctl.cli import main as netctl
+    from vpp_tpu.rest.server import AgentRestServer
+
+    ios = [tuple(NativeRing() for _ in range(4)) for _ in range(2)]
+    dp = ShardedDataplane(
+        acl=build_rule_tables([], {}),
+        nat=build_nat_tables([], snat_enabled=False,
+                             pod_subnet="10.1.0.0/16"),
+        route=make_route(),
+        overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"),
+                             local_node_id=1),
+        shard_ios=ios, batch_size=8, max_vectors=2,
+    )
+    rest = AgentRestServer(node_name="n1", datapath=dp)
+    port = rest.start()
+    server = f"127.0.0.1:{port}"
+    try:
+        import io as _io
+        import json
+        import urllib.request
+
+        with urllib.request.urlopen(
+                f"http://{server}/contiv/v1/health") as resp:
+            health = json.loads(resp.read())
+        assert health["shards_total"] == 2
+        assert health["shards_serving"] == 2
+        assert health["policy_all_down"] == "fail-closed"
+        assert [s["state"] for s in health["shards"]] == ["healthy"] * 2
+
+        # Arm a fault over REST, see it in the list, disarm it.
+        req = urllib.request.Request(
+            f"http://{server}/contiv/v1/faults/arm?site=dispatch-raise"
+            f"&shard=1&count=3&match_src_port=4242", method="POST")
+        with urllib.request.urlopen(req) as resp:
+            armed = json.loads(resp.read())
+        assert armed["plans"][0]["site"] == "dispatch-raise"
+        assert armed["plans"][0]["match"] == {"src_port": 4242}
+        assert dp.faults.armed
+
+        out = _io.StringIO()
+        assert netctl(["fault", "--server", server], out=out) == 0
+        assert "dispatch-raise" in out.getvalue()
+
+        req = urllib.request.Request(
+            f"http://{server}/contiv/v1/faults/disarm", method="POST")
+        with urllib.request.urlopen(req) as resp:
+            assert json.loads(resp.read())["disarmed"] == 1
+        assert not dp.faults.armed
+
+        # netctl health renders the supervisor view.
+        out = _io.StringIO()
+        assert netctl(["health", "--server", server], out=out) == 0
+        text = out.getvalue()
+        assert "2/2 serving" in text
+        assert "healthy" in text
+
+        # The inspect view carries the health block too.
+        assert dp.inspect()["health"]["shards_total"] == 2
+    finally:
+        rest.stop()
+        dp.close()
+
+
+def test_netctl_health_solo_runner():
+    """A solo (unsharded) runner serves a flat health view."""
+    import io as _io
+
+    from vpp_tpu.netctl.cli import main as netctl
+    from vpp_tpu.rest.server import AgentRestServer
+
+    runner, _ = make_runner()
+    rest = AgentRestServer(node_name="n1", datapath=runner)
+    port = rest.start()
+    try:
+        out = _io.StringIO()
+        assert netctl(["health", "--server", f"127.0.0.1:{port}"],
+                      out=out) == 0
+        assert "dispatch_errors=0" in out.getvalue()
+    finally:
+        rest.stop()
+
+
+# ----------------------------------------------------- controller satellites
+
+
+def test_controller_timers_cancelled_on_stop():
+    """Periodic-healing / startup / healing timers must not fire after
+    the loop stops (satellite: no timer leaks on shutdown)."""
+    from vpp_tpu.controller.eventloop import Controller
+    from vpp_tpu.testing.cluster import wait_for
+
+    class NullSink:
+        def commit(self, txn):
+            pass
+
+    ctl = Controller([], NullSink(), periodic_healing_interval=0.05,
+                     startup_resync_deadline=30.0, healing_delay=0.05)
+    ctl.start()
+    assert wait_for(lambda: ctl._timers, timeout=2.0)
+    ctl.stop()
+    assert not ctl._timers          # every outstanding timer cancelled
+    # And nothing re-arms afterwards: the guard refuses post-shutdown.
+    time.sleep(0.12)
+    assert not ctl._timers
+
+
+def test_controller_event_history_is_a_bounded_ring():
+    from vpp_tpu.controller.api import DBResync
+    from vpp_tpu.controller.eventloop import Controller
+
+    class NullSink:
+        def commit(self, txn):
+            pass
+
+    ctl = Controller([], NullSink(), history_limit=8)
+    ctl.start()
+    try:
+        ctl.push_event(DBResync(kube_state={}, external_config={}))
+        for _ in range(40):
+            ev = DBResync(kube_state={}, external_config={})
+            ctl.push_event(ev)
+            assert ev.wait(5.0) is None  # processed without error
+        hist = ctl.event_history
+        assert len(hist) == 8                       # ring of last N
+        assert hist[-1].seq_num > 8                 # ...the LAST N
+        assert hist[0].seq_num == hist[-1].seq_num - 7
+    finally:
+        ctl.stop()

@@ -134,10 +134,18 @@ class Controller:
         self._shutdown = False
         self._thread: Optional[threading.Thread] = None
         self._loop_thread_id: Optional[int] = None
-        self._history: List[EventRecord] = []
+        # Ring of the last N event records: a long-lived agent processes
+        # unbounded events, so the history must be a bounded deque (the
+        # old list + slice-trim grew a copy per overflowing event).
+        self._history: "collections.deque[EventRecord]" = collections.deque(
+            maxlen=history_limit)
         self._history_limit = history_limit
         self._healing_scheduled = False
         self._lock = threading.Lock()
+        # Every outstanding threading.Timer, by name — cancelled on
+        # shutdown so no timer callback fires after the loop stopped
+        # (each callback additionally guards on the stopped flag).
+        self._timers: Dict[str, threading.Timer] = {}
 
     # ----------------------------------------------------------------- life
 
@@ -145,13 +153,33 @@ class Controller:
         self._thread = threading.Thread(target=self._event_loop, name="event-loop", daemon=True)
         self._thread.start()
         if self.startup_resync_deadline > 0:
-            timer = threading.Timer(
-                self.startup_resync_deadline, self._startup_resync_check
-            )
-            timer.daemon = True
-            timer.start()
+            self._arm_timer("startup-resync", self.startup_resync_deadline,
+                            self._startup_resync_check)
         if self.periodic_healing_interval > 0:
             self._schedule_periodic_healing()
+
+    # ------------------------------------------------------------- timers
+
+    def _arm_timer(self, name: str, delay: float, fn: Callable[[], None]) -> None:
+        """Start a named daemon timer, replacing (and cancelling) any
+        outstanding timer of the same name; refuses to start after
+        shutdown so stop() leaves no timer behind."""
+        with self._lock:
+            old = self._timers.pop(name, None)
+            if old is not None:
+                old.cancel()
+            if self._shutdown:
+                return
+            timer = threading.Timer(delay, fn)
+            timer.daemon = True
+            self._timers[name] = timer
+        timer.start()
+
+    def _cancel_timers(self) -> None:
+        with self._lock:
+            timers, self._timers = list(self._timers.values()), {}
+        for timer in timers:
+            timer.cancel()
 
     def _startup_resync_check(self) -> None:
         """The startup deadline fired: enqueue a sentinel processed ON THE
@@ -173,18 +201,21 @@ class Controller:
                 self._queue.put(HealingResync(HealingResyncType.PERIODIC))
             self._schedule_periodic_healing()
 
-        timer = threading.Timer(self.periodic_healing_interval, fire)
-        timer.daemon = True
-        timer.start()
+        self._arm_timer("periodic-healing", self.periodic_healing_interval,
+                        fire)
 
     def stop(self, timeout: float = 10.0) -> None:
-        """Push Shutdown and wait for the loop to drain."""
-        if self._thread is None or not self._thread.is_alive():
-            return
-        ev = Shutdown()
-        self.push_event(ev)
-        ev.wait(timeout)
-        self._thread.join(timeout)
+        """Push Shutdown and wait for the loop to drain; cancels every
+        outstanding timer so none fires into a stopped loop."""
+        try:
+            if self._thread is None or not self._thread.is_alive():
+                return
+            ev = Shutdown()
+            self.push_event(ev)
+            ev.wait(timeout)
+            self._thread.join(timeout)
+        finally:
+            self._cancel_timers()
 
     # ------------------------------------------------------------ push/queue
 
@@ -245,6 +276,9 @@ class Controller:
                 break
         for ev in leftovers:
             ev.done(FatalError("event loop is shutting down"))
+        # A fatal-error exit never reaches stop(): cancel here too so a
+        # dead loop leaves no healing/periodic timer ticking behind it.
+        self._cancel_timers()
 
     def _receive_event(self) -> Optional[Event]:
         """Dequeue the next event, honouring follow-up priority and the
@@ -339,9 +373,7 @@ class Controller:
 
         record.duration_ms = (time.time() - record.started) * 1000
         with self._lock:
-            self._history.append(record)
-            if len(self._history) > self._history_limit:
-                self._history = self._history[-self._history_limit:]
+            self._history.append(record)  # bounded deque: ring of last N
 
         # 11. Deliver the result to blocked producers.
         event.done(err)
@@ -470,6 +502,4 @@ class Controller:
             if not self._shutdown:
                 self._queue.put(HealingResync(HealingResyncType.AFTER_ERROR, err))
 
-        timer = threading.Timer(self.healing_delay, fire)
-        timer.daemon = True
-        timer.start()
+        self._arm_timer("healing", self.healing_delay, fire)

@@ -12,6 +12,7 @@ per-packet.
 
 from .io import (
     AfPacketIO,
+    FaultInjectingSource,
     FrameSink,
     FrameSource,
     InMemoryRing,
@@ -19,13 +20,20 @@ from .io import (
     PcapReader,
     PcapWriter,
 )
-from .runner import DataplaneRunner, DeviceSessionState, RunnerCounters, VxlanOverlay
-from .shards import ShardedDataplane
+from .runner import (
+    DataplaneRunner,
+    DeviceSessionState,
+    RunnerCounters,
+    TableSwapError,
+    VxlanOverlay,
+)
+from .shards import ShardedDataplane, ShardHealth
 
 __all__ = [
     "AfPacketIO",
     "DataplaneRunner",
     "DeviceSessionState",
+    "FaultInjectingSource",
     "FrameSink",
     "FrameSource",
     "InMemoryRing",
@@ -33,6 +41,8 @@ __all__ = [
     "PcapReader",
     "PcapWriter",
     "RunnerCounters",
+    "ShardHealth",
     "ShardedDataplane",
+    "TableSwapError",
     "VxlanOverlay",
 ]

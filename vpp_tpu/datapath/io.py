@@ -32,12 +32,48 @@ class FrameSink(Protocol):
         ...
 
 
+class FaultInjectingSource:
+    """Wrap any :class:`FrameSource` with a ``frame-source-error``
+    injection site (vpp_tpu/testing/faults.py): an armed plan makes
+    ``recv_batch`` raise exactly where a flapping NIC / dead socket
+    would, driving the runner's degrade-don't-die source handling
+    through the production code path.  Python-engine sources only —
+    the native engine's rings are consumed in C++, so its site lives
+    in the runner's admit."""
+
+    def __init__(self, source: FrameSource, faults, shard: int = 0):
+        self.source = source
+        self.faults = faults
+        self.shard = shard
+
+    @property
+    def can_enqueue(self) -> bool:
+        return getattr(self.source, "can_enqueue", False)
+
+    def __len__(self) -> int:
+        return len(self.source)  # type: ignore[arg-type]
+
+    def recv_batch(self, max_frames: int) -> List[bytes]:
+        from ..testing.faults import SITE_FRAME_SOURCE_ERROR
+
+        self.faults.fire(SITE_FRAME_SOURCE_ERROR, shard=self.shard)
+        return self.source.recv_batch(max_frames)
+
+    def send(self, frames: Sequence[bytes]) -> None:
+        self.source.send(frames)  # type: ignore[attr-defined]
+
+
 class InMemoryRing:
     """Thread-safe frame ring — both a source and a sink.
 
     The unit-test / benchmark transport, and the rx queue the virtual
     wire of the cluster harness delivers into.
     """
+
+    # send() ENQUEUES for ingest (unlike AfPacketIO.send, which
+    # transmits): the shard supervisor may steer an ejected shard's
+    # frames into this source.
+    can_enqueue = True
 
     def __init__(self, capacity: int = 1 << 16):
         self._dq: "collections.deque[bytes]" = collections.deque(maxlen=capacity)
@@ -132,6 +168,9 @@ class PcapWriter:
             incl = min(len(f), self._snaplen)
             self._fh.write(struct.pack("<IIII", self._ts // 1000000, self._ts % 1000000, incl, len(f)))
             self._fh.write(f[:incl])
+
+    def flush(self) -> None:
+        self._fh.flush()
 
     def close(self) -> None:
         self._fh.close()

@@ -47,8 +47,47 @@ from ..ops.pipeline import (
 )
 from ..ops.slowpath import HostSlowPath
 from ..shim.hostshim import FrameBatch, HostShim, NativeLoop, NativeRing
+from ..testing.faults import (
+    SITE_DISPATCH_HANG,
+    SITE_DISPATCH_RAISE,
+    SITE_FRAME_SOURCE_ERROR,
+    SITE_SWAP_FAIL,
+    FaultInjected,
+    FaultInjector,
+)
 from .io import FrameSink, FrameSource
 from .trace import PacketTracer
+
+
+class TableSwapError(RuntimeError):
+    """A table swap failed and was ROLLED BACK — every shard still
+    serves the previous (last-good) tables.  Retriable: when the swap
+    came from a scheduler applicator's ``on_compiled`` hook, the
+    scheduler absorbs this into FAILED state + backoff retries, and an
+    exhausted retry budget escalates to the controller's healing
+    resync — the data plane never crashes and never splits brain."""
+
+
+_BATCH_FIELDS = ("src_ip", "dst_ip", "protocol", "src_port", "dst_port")
+
+
+@dataclasses.dataclass
+class _HostResult:
+    """A pipeline-result lookalike assembled on the HOST by the
+    poisoned-batch quarantine: verdict arrays are stitched together
+    from the surviving sub-dispatches (numpy, already materialised),
+    with poisoned rows forced to deny.  The harvest paths only ever
+    ``np.asarray`` these fields, so it substitutes transparently."""
+
+    allowed: np.ndarray
+    route: np.ndarray
+    node_id: np.ndarray
+    punt: np.ndarray
+    reply_hit: np.ndarray
+    dnat_hit: np.ndarray
+    snat_hit: np.ndarray
+    batch: PacketBatch
+    poisoned_rows: np.ndarray
 
 
 @dataclasses.dataclass
@@ -131,6 +170,15 @@ class RunnerCounters:
     acl_swaps: int = 0
     nat_swaps: int = 0
     route_swaps: int = 0
+    # Fault-domain observability: dispatch exceptions seen (including
+    # those the quarantine recovered from), frame-source errors
+    # absorbed, batches that went through bisection, frames dropped as
+    # poisoned, and table swaps rolled back to last-good.
+    dispatch_errors: int = 0
+    source_errors: int = 0
+    quarantined_batches: int = 0
+    dropped_poisoned: int = 0
+    swap_rollbacks: int = 0
 
     def as_dict(self) -> Dict[str, int]:
         return {f"datapath_{k}_total": v for k, v in dataclasses.asdict(self).items()}
@@ -207,6 +255,15 @@ class DataplaneRunner:
         slow=None,
         tracer=None,
         host_lock: Optional[threading.Lock] = None,
+        # Fault domain: the (possibly shared) fault injector + this
+        # runner's shard index within it; poisoned-batch quarantine
+        # (bisect a repeatedly-crashing batch, drop + count + pcap the
+        # offending frames, keep the loop running) and the forensics
+        # capture path.
+        faults: Optional[FaultInjector] = None,
+        shard_index: int = 0,
+        quarantine: bool = True,
+        quarantine_pcap: Optional[str] = None,
     ):
         self.acl = acl
         self.mesh = mesh
@@ -260,6 +317,12 @@ class DataplaneRunner:
         # check and the use — so harvest must always take the copying
         # path there.  Solo runners keep the zero-copy fast path.
         self._shared_host = host_lock is not None
+        self.faults = faults if faults is not None else FaultInjector()
+        self.shard_index = shard_index
+        self.quarantine = quarantine
+        self.quarantine_pcap = quarantine_pcap
+        self._quarantine_writer = None
+        self._last_fault_error = ""
         self.counters = RunnerCounters()
         # Optional zero-arg provider of control-plane compile stats (the
         # agent attaches the applicators' stats() here) — surfaced by
@@ -492,21 +555,39 @@ class DataplaneRunner:
         contract is what makes DELTA-BUILT tables safe: the builders'
         scatter produces new arrays without touching the old buffers, so
         a swap here can never mutate tables an in-flight dispatch still
-        references."""
-        if acl is not None or nat is not None or route is not None:
-            # Disarm the host bypass BEFORE the new tables land: a
-            # concurrent poll must never forward under a stale
-            # bypass=eligible flag once deny rules exist.  The refresh
-            # below re-arms it when the new tables are still trivial.
-            self._bypass_tables = False
-        self._adopt_tables(
-            acl,
-            retarget_tables(nat, self._target_backend())
-            if nat is not None else None,
-            route,
-        )
-        if acl is not None or nat is not None or route is not None:
+        references.
+
+        FAULT DOMAIN: the previous tables are kept as LAST-GOOD — any
+        failure mid-swap (retarget, adopt, mesh re-shard, or an armed
+        ``swap-fail`` injection) restores them and raises
+        :class:`TableSwapError`, so the data plane keeps serving a
+        consistent generation and the caller (scheduler applicator)
+        retries instead of crashing the agent."""
+        if acl is None and nat is None and route is None:
+            return
+        last_good = (self.acl, self.nat, self.route)
+        # Disarm the host bypass BEFORE the new tables land: a
+        # concurrent poll must never forward under a stale
+        # bypass=eligible flag once deny rules exist.  The refresh
+        # below re-arms it when the new tables are still trivial.
+        self._bypass_tables = False
+        try:
+            self._adopt_tables(
+                acl,
+                retarget_tables(nat, self._target_backend())
+                if nat is not None else None,
+                route,
+            )
+        except Exception as err:
+            self.acl, self.nat, self.route = last_good
+            self.counters.swap_rollbacks += 1
+            self._last_fault_error = f"table swap failed: {err}"
             self._refresh_bypass()
+            raise TableSwapError(
+                f"table swap failed on shard {self.shard_index}; "
+                f"rolled back to last-good tables: {err}"
+            ) from err
+        self._refresh_bypass()
 
     def _adopt_tables(
         self,
@@ -515,7 +596,12 @@ class DataplaneRunner:
         route: Optional[RouteConfig],
     ) -> None:
         """The swap body minus retarget/bypass derivation — the sharded
-        engine retargets ONCE and adopts on every shard (shards.py)."""
+        engine retargets ONCE and adopts on every shard (shards.py).
+        The ``swap-fail`` site fires BEFORE any reference mutates, so
+        an injected failure never leaves THIS shard partially adopted
+        (multi-shard atomicity is the sharded engine's rollback)."""
+        if acl is not None or nat is not None or route is not None:
+            self.faults.fire(SITE_SWAP_FAIL, shard=self.shard_index)
         if acl is not None:
             self.acl = acl
             self.counters.acl_swaps += 1
@@ -605,6 +691,16 @@ class DataplaneRunner:
         read while the lock is held — another shard may bump the shared
         counter the moment the lock drops, so callers must not re-read
         ``self._ts`` for bookkeeping."""
+        if self.faults.armed:
+            # Injection sites fire BEFORE the state lock: a hang here
+            # models this shard's dispatch thread wedging without
+            # dragging the shared session lock (and so every other
+            # shard) down with it.
+            self.faults.fire(SITE_DISPATCH_HANG, shard=self.shard_index)
+            self.faults.fire(
+                SITE_DISPATCH_RAISE, shard=self.shard_index,
+                batch={f: np.asarray(getattr(batch, f)) for f in _BATCH_FIELDS},
+            )
         with self._state.lock:
             return self._dispatch_locked(batch, k), self._ts
 
@@ -685,9 +781,164 @@ class DataplaneRunner:
                 self._refresh_bypass()
         return result
 
+    # ------------------------------------------------- fault containment
+
+    def _dispatch_protected(self, batch: PacketBatch, k: int):
+        """Dispatch with poisoned-batch quarantine: a batch that
+        crashes dispatch is retried once whole (transient-error path),
+        then BISECTED — sub-batches that still crash narrow to the
+        offending frames, which are dropped + counted + captured for
+        forensics while every other frame's verdict is kept.  A batch
+        whose every frame 'crashes' is not data-dependent (the shard
+        itself is sick) and the original error re-raises so shard
+        supervision can eject the fault domain."""
+        try:
+            return self._dispatch(batch, k)
+        except Exception as err:  # noqa: BLE001 - device errors are data here
+            self.counters.dispatch_errors += 1
+            self._last_fault_error = f"dispatch: {err}"
+            if not self.quarantine:
+                raise
+            return self._quarantine_dispatch(batch, k, err)
+
+    def _quarantine_dispatch(self, batch: PacketBatch, k: int, err: Exception):
+        soa = {f: np.asarray(getattr(batch, f)) for f in _BATCH_FIELDS}
+        total = len(soa["src_ip"])
+        out = {
+            "allowed": np.zeros(total, dtype=bool),
+            "route": np.full(total, ROUTE_LOCAL, dtype=np.int32),
+            "node_id": np.zeros(total, dtype=np.int32),
+            "punt": np.zeros(total, dtype=bool),
+            "reply_hit": np.zeros(total, dtype=bool),
+            "dnat_hit": np.zeros(total, dtype=bool),
+            "snat_hit": np.zeros(total, dtype=bool),
+        }
+        rew = {f: soa[f].copy() for f in _BATCH_FIELDS}
+        poisoned: list = []
+        last_ts = None
+        # Root attempt = the whole-batch retry; halves push depth-first.
+        stack = [np.arange(total)]
+        while stack:
+            idx = stack.pop()
+            sub, sk = self._subbatch(soa, idx)
+            try:
+                res, ts = self._dispatch(sub, sk)
+            except Exception as sub_err:  # noqa: BLE001
+                self.counters.dispatch_errors += 1
+                err = sub_err
+                if len(idx) == 1:
+                    poisoned.append(int(idx[0]))
+                    continue
+                mid = len(idx) // 2
+                stack.append(idx[mid:])
+                stack.append(idx[:mid])
+                continue
+            last_ts = ts
+            m = len(idx)
+            out["allowed"][idx] = np.asarray(res.allowed)[:m]
+            out["route"][idx] = np.asarray(res.route)[:m]
+            out["node_id"][idx] = np.asarray(res.node_id)[:m]
+            for name in ("punt", "reply_hit", "dnat_hit", "snat_hit"):
+                out[name][idx] = np.asarray(getattr(res, name))[:m]
+            for f in _BATCH_FIELDS:
+                rew[f][idx] = np.asarray(getattr(res.batch, f))[:m]
+        if len(poisoned) >= total:
+            # Nothing dispatched at all — a shard-level fault, not a
+            # poisoned batch; surface it to the supervisor.
+            raise err
+        bad = np.array(sorted(poisoned), dtype=np.int64)
+        if len(bad):
+            out["allowed"][bad] = 0
+            self.counters.quarantined_batches += 1
+        result = _HostResult(
+            allowed=out["allowed"], route=out["route"], node_id=out["node_id"],
+            punt=out["punt"], reply_hit=out["reply_hit"],
+            dnat_hit=out["dnat_hit"], snat_hit=out["snat_hit"],
+            batch=PacketBatch(**rew), poisoned_rows=bad,
+        )
+        return result, (last_ts if last_ts is not None else self._ts)
+
+    def _subbatch(self, soa, idx: np.ndarray):
+        """Pack the selected rows into a fresh zero-padded batch sized
+        to the smallest power-of-two vector count (same bucketing as
+        admit, so no new compile shapes)."""
+        m = len(idx)
+        k = 1
+        while k * self.batch_size < m and k < self.max_vectors:
+            k *= 2
+        size = k * self.batch_size
+        arrs = {}
+        for f, a in soa.items():
+            padded = np.zeros(size, dtype=a.dtype)
+            padded[:m] = a[idx]
+            arrs[f] = jnp.asarray(padded)
+        return PacketBatch(**arrs), k
+
+    def _quarantine_rows(self, result, n: int, frame_of) -> int:
+        """Shared harvest tail: count quarantined frames and capture
+        them to the forensics pcap.  ``frame_of(row) -> bytes`` is
+        engine-specific.  Returns how many live rows were poisoned (the
+        caller excludes them from the denied counter)."""
+        bad = getattr(result, "poisoned_rows", None)
+        if bad is None or not len(bad):
+            return 0
+        live = bad[bad < n]
+        if not len(live):
+            return 0
+        self.counters.dropped_poisoned += len(live)
+        if self.quarantine_pcap:
+            from .io import PcapWriter
+
+            if self._quarantine_writer is None:
+                self._quarantine_writer = PcapWriter(self.quarantine_pcap)
+            self._quarantine_writer.send(
+                [frame_of(int(row)) for row in live])
+            # Forensics must survive a crash — the very scenario the
+            # capture exists for; quarantines are rare, flush per batch.
+            self._quarantine_writer.flush()
+        return len(live)
+
+    def sanitize_after_fault(self) -> None:
+        """Reset the loop after a dispatch fault so the NEXT batch
+        starts clean: in-flight batches are discarded (their frames are
+        lost, exactly like a vswitch crash — transports retransmit) and
+        the native loop is rebuilt, releasing arena pins a failed admit
+        left behind.  Called by the shard supervisor on every error and
+        before a probation rejoin."""
+        self._inflight.clear()
+        if self._native is not None:
+            self._rebuild_native()
+
+    def health(self) -> Dict[str, object]:
+        """This runner's fault-domain view (one shard's slice of the
+        sharded engine's health report; the whole report for a solo
+        runner) — surfaced via inspect() → REST /contiv/v1/health →
+        `netctl health`."""
+        return {
+            "dispatch_errors": self.counters.dispatch_errors,
+            "source_errors": self.counters.source_errors,
+            "swap_rollbacks": self.counters.swap_rollbacks,
+            "quarantine": {
+                "enabled": self.quarantine,
+                "batches": self.counters.quarantined_batches,
+                "poisoned_frames": self.counters.dropped_poisoned,
+                "pcap": self.quarantine_pcap or "",
+            },
+            "last_error": self._last_fault_error,
+        }
+
     # ------------------------------------------------------- native engine
 
     def _admit_native(self) -> bool:
+        if self.faults.armed:
+            try:
+                self.faults.fire(SITE_FRAME_SOURCE_ERROR, shard=self.shard_index)
+            except FaultInjected as err:
+                # A source error degrades (count + idle), never kills:
+                # the NIC-flap semantics of the agent's uplink loop.
+                self.counters.source_errors += 1
+                self._last_fault_error = f"source: {err}"
+                return False
         slot = self._slot_next
         c = np.zeros(NativeLoop.ADMIT_COUNTERS, dtype=np.uint64)
         n, k, soa = self._native.admit(slot, c)
@@ -705,7 +956,7 @@ class DataplaneRunner:
             src_port=jnp.asarray(soa["src_port"][:kb]),
             dst_port=jnp.asarray(soa["dst_port"][:kb]),
         )
-        result, batch_ts = self._dispatch(batch, k)
+        result, batch_ts = self._dispatch_protected(batch, k)
         self._inflight.append((slot, n, soa, result, batch_ts))
         return True
 
@@ -743,6 +994,8 @@ class DataplaneRunner:
             orig, rew, allowed, route_tag, node_id,
             punt, reply_hit, dnat_hit, snat_hit, ts,
         )
+        poison_drops = self._quarantine_rows(
+            result, n, lambda row: self._native.slot_frame(slot, row))
         c = np.zeros(NativeLoop.HARVEST_COUNTERS, dtype=np.uint64)
         sent = self._native.harvest(
             slot, allowed, rew["src_ip"], rew["dst_ip"],
@@ -753,9 +1006,10 @@ class DataplaneRunner:
         self.counters.tx_remote += int(c[0])
         self.counters.tx_local += int(c[1])
         self.counters.tx_host += int(c[2])
-        # Denied excludes rows the slow path already counted; rows
-        # permitted but unforwardable are parse failures, not denials.
-        self.counters.dropped_denied += int(c[3]) - slow_drops
+        # Denied excludes rows the slow path already counted and rows
+        # the quarantine dropped as poisoned; rows permitted but
+        # unforwardable are parse failures, not denials.
+        self.counters.dropped_denied += int(c[3]) - slow_drops - poison_drops
         self.counters.dropped_unparseable += int(c[4])
         self.counters.dropped_unroutable += int(c[5])
         if self._bypass_tables:
@@ -768,7 +1022,16 @@ class DataplaneRunner:
     # ------------------------------------------------------- python engine
 
     def _admit_python(self) -> bool:
-        frames = self.source.recv_batch(self.batch_size * self.max_vectors)
+        try:
+            if self.faults.armed:
+                self.faults.fire(SITE_FRAME_SOURCE_ERROR, shard=self.shard_index)
+            frames = self.source.recv_batch(self.batch_size * self.max_vectors)
+        except Exception as err:  # noqa: BLE001 - socket flap / injected
+            # Source errors degrade (count + report idle) instead of
+            # killing the loop — the uplink may recover next poll.
+            self.counters.source_errors += 1
+            self._last_fault_error = f"source: {err}"
+            return False
         if not frames:
             return False
         self.counters.rx_frames += len(frames)
@@ -805,7 +1068,7 @@ class DataplaneRunner:
             src_port=jnp.asarray(fb.batch.src_port),
             dst_port=jnp.asarray(fb.batch.dst_port),
         )
-        result, batch_ts = self._dispatch(batch, k)
+        result, batch_ts = self._dispatch_protected(batch, k)
         self._inflight.append((fb, result, batch_ts))
         return True
 
@@ -838,6 +1101,7 @@ class DataplaneRunner:
             orig, rew, allowed, route_tag, node_id,
             punt, reply_hit, dnat_hit, snat_hit, ts,
         )
+        poison_drops = self._quarantine_rows(result, n, fb.frame)
 
         # -------------------------------------------- native apply + TX
         rew_batch = PacketBatch(
@@ -847,9 +1111,12 @@ class DataplaneRunner:
         fwd = self.shim.apply_masked(fb, allowed, rew_batch)
         allowed_bool = allowed.astype(bool)
         # Pipeline/policy denies exclude rows the slow path already
-        # counted; rows permitted but unforwardable are parse failures
-        # (non-IPv4 frames), not denials.
-        self.counters.dropped_denied += int((~allowed_bool).sum()) - slow_drops
+        # counted and quarantined poisoned rows; rows permitted but
+        # unforwardable are parse failures (non-IPv4 frames), not
+        # denials.
+        self.counters.dropped_denied += (
+            int((~allowed_bool).sum()) - slow_drops - poison_drops
+        )
         self.counters.dropped_unparseable += int((allowed_bool & (fwd == 0)).sum())
 
         is_remote = (route_tag == ROUTE_REMOTE).astype(np.uint8)
@@ -990,6 +1257,7 @@ class DataplaneRunner:
         return {
             "engine": self.engine,
             "dispatch": self.inspect_dispatch(),
+            "health": self.health(),
             "compile": compile_stats,
             "classify": {
                 "rules": getattr(acl, "num_rules", 0) if acl is not None else 0,

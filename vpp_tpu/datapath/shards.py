@@ -8,9 +8,9 @@ same roles differently:
 
 - **Host side (per core)**: N shards, each with its own rx/tx rings and
   its own native C++ admit/harvest loop (runnerloop.cpp).  Shard calls
-  release the GIL, so a thread pool drives all shards' frame work
-  concurrently on multi-core hosts — parse, rewrite, checksums, VXLAN
-  encap all scale with cores, the way VPP workers do.
+  release the GIL, so per-shard worker threads drive all shards' frame
+  work concurrently on multi-core hosts — parse, rewrite, checksums,
+  VXLAN encap all scale with cores, the way VPP workers do.
 - **Device side (shared)**: ONE session table and ONE jit pipeline.
   Dispatches from all shards serialise on the DeviceSessionState lock
   and thread the session state in a single total order.  This deletes
@@ -23,30 +23,104 @@ same roles differently:
   HostSlowPath serves all shards, again because a punted flow's reply
   may land on any shard.
 
+**Fault domains (shard supervision).**  Each shard is a supervised
+fault domain: a per-shard health state machine
+
+    healthy → degraded → ejected → probation → rejoined (→ healthy)
+
+driven by dispatch deadlines (a poll that exceeds
+``dispatch_deadline`` marks the shard hung and its worker thread is
+abandoned) and consecutive-error thresholds (``eject_errors`` failed
+polls eject).  Ejected shards stop receiving traffic — their queued
+source frames are STEERED onto the surviving shards — and re-enter
+via exponential-backoff probation: the runner is sanitised (in-flight
+batches discarded, native loop rebuilt to release arena pins) and
+must complete ``probation_polls`` clean polls to rejoin.  When EVERY
+shard is down the ``on_all_down`` policy decides: ``"fail-closed"``
+drops (and counts) ingress, ``"bypass"`` forwards it unfiltered over
+the static host path — the HyperNAT-style host fallback, explicit
+opt-in because it skips policy enforcement.
+
+**Atomic multi-shard table swap.**  ``update_tables`` keeps the
+last-good tables; if ANY shard's adopt fails, every shard is rolled
+back to them and a retriable :class:`TableSwapError` surfaces — all
+shards always serve the same table generation, never a mix.
+
 Ingest fanout options: PACKET_FANOUT on AF_PACKET sockets (kernel
 multi-queue; vpp_tpu/datapath/io.py), or any per-shard frame source.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import logging
 import threading
-from concurrent.futures import ThreadPoolExecutor
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeout
 from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from ..ops.classify import RuleTables
 from ..ops.nat import NatTables
-from .runner import DataplaneRunner, DeviceSessionState, VxlanOverlay
+from ..testing.faults import FaultInjector
+from .runner import (
+    DataplaneRunner,
+    DeviceSessionState,
+    TableSwapError,
+    VxlanOverlay,
+)
 from .trace import PacketTracer
+
+log = logging.getLogger(__name__)
 
 # A shard's IO endpoints: (source, tx_remote, tx_local, tx_host).
 ShardIO = Tuple[object, object, object, object]
 
+STATE_HEALTHY = "healthy"
+STATE_DEGRADED = "degraded"
+STATE_EJECTED = "ejected"
+STATE_PROBATION = "probation"
+STATE_REJOINED = "rejoined"
+
+# States that still receive traffic (everything but ejected).
+_SERVING_STATES = (STATE_HEALTHY, STATE_DEGRADED, STATE_PROBATION,
+                   STATE_REJOINED)
+
+
+@dataclasses.dataclass
+class ShardHealth:
+    """One shard's supervision record."""
+
+    state: str = STATE_HEALTHY
+    consecutive_errors: int = 0
+    consecutive_ok: int = 0
+    ejections: int = 0
+    rejoins: int = 0
+    eject_streak: int = 0     # ejections since the last full rejoin
+    last_error: str = ""
+    ejected_at: float = 0.0
+    backoff: float = 0.0      # current probation backoff (seconds)
+    dirty: bool = False       # runner needs sanitising before reuse
+
+    def as_dict(self) -> Dict[str, object]:
+        return {
+            "state": self.state,
+            "consecutive_errors": self.consecutive_errors,
+            "ejections": self.ejections,
+            "rejoins": self.rejoins,
+            "backoff_s": round(self.backoff, 3),
+            "last_error": self.last_error,
+        }
+
 
 class ShardedDataplane:
     """N DataplaneRunner shards sharing one device session state, one
-    host slow path, and one tracer; driven concurrently by a thread
-    pool.  API mirrors the single runner (poll/drain/update_tables/
-    metrics) so call sites swap in transparently."""
+    host slow path, one tracer, and one fault injector; each driven by
+    its own supervised worker thread.  API mirrors the single runner
+    (poll/drain/update_tables/metrics/inspect/health) so call sites
+    swap in transparently."""
 
     def __init__(
         self,
@@ -58,18 +132,39 @@ class ShardedDataplane:
         batch_size: int = 256,
         max_vectors: int = 64,
         session_capacity: int = 1 << 16,
-        workers: Optional[int] = None,
+        workers: Optional[int] = None,  # kept for API compat; per-shard now
+        faults: Optional[FaultInjector] = None,
+        # Supervision knobs.  The deadline default is generous: a first
+        # dispatch legitimately pays jit compile time, and a false
+        # ejection costs a probation round trip.
+        dispatch_deadline: float = 30.0,
+        eject_errors: int = 3,
+        probation_polls: int = 3,
+        reinit_backoff: float = 0.25,
+        reinit_backoff_max: float = 8.0,
+        on_all_down: str = "fail-closed",
         **runner_kw,
     ):
         if not shard_ios:
             raise ValueError("need at least one shard")
+        if on_all_down not in ("fail-closed", "bypass"):
+            raise ValueError(
+                f"on_all_down must be 'fail-closed' or 'bypass', "
+                f"not {on_all_down!r}")
         from ..ops.slowpath import HostSlowPath
 
         self.state = DeviceSessionState(session_capacity)
         self.slow = HostSlowPath()
         self.tracer = PacketTracer()
+        self.faults = faults if faults is not None else FaultInjector()
         self._host_lock = threading.Lock()
         self.overlay = overlay
+        self.dispatch_deadline = dispatch_deadline
+        self.eject_errors = eject_errors
+        self.probation_polls = probation_polls
+        self.reinit_backoff = reinit_backoff
+        self.reinit_backoff_max = reinit_backoff_max
+        self.on_all_down = on_all_down
         self.shards: List[DataplaneRunner] = [
             DataplaneRunner(
                 acl=acl, nat=nat, route=route, overlay=overlay,
@@ -77,14 +172,33 @@ class ShardedDataplane:
                 batch_size=batch_size, max_vectors=max_vectors,
                 state=self.state, slow=self.slow, tracer=self.tracer,
                 host_lock=self._host_lock,
+                faults=self.faults, shard_index=i,
                 **runner_kw,
             )
-            for (src, tx, local, host) in shard_ios
+            for i, (src, tx, local, host) in enumerate(shard_ios)
         ]
-        self._pool = ThreadPoolExecutor(
-            max_workers=workers or len(self.shards),
-            thread_name_prefix="dp-shard",
-        )
+        self.health_of: List[ShardHealth] = [
+            ShardHealth() for _ in self.shards
+        ]
+        # One single-thread executor per shard (shards are not
+        # re-entrant): a hung shard's executor can be ABANDONED without
+        # stalling the others, and a fresh one attached at rejoin.
+        self._execs: List[Optional[ThreadPoolExecutor]] = [
+            self._new_exec(i) for i in range(len(self.shards))
+        ]
+        self._stuck: Dict[int, Future] = {}  # abandoned hung futures
+        # Supervisor counters (whole-engine, not per shard).
+        self._ejections = 0
+        self._rejoins = 0
+        self._steered_frames = 0
+        self._failclosed_drops = 0
+        self._bypass_forwards = 0
+        self._swap_rollbacks = 0
+
+    @staticmethod
+    def _new_exec(i: int) -> ThreadPoolExecutor:
+        return ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"dp-shard-{i}")
 
     @property
     def engine(self) -> str:
@@ -102,39 +216,314 @@ class ShardedDataplane:
 
     # --------------------------------------------------------------- loop
 
+    def _serving(self) -> List[int]:
+        return [i for i, h in enumerate(self.health_of)
+                if h.state in _SERVING_STATES]
+
     def poll(self) -> int:
-        """One scheduling turn on every shard, concurrently.  Each shard
-        runs in exactly one pool task at a time (shards are not
-        re-entrant); returns total frames transmitted this turn."""
-        return sum(self._pool.map(lambda r: r.poll(), self.shards))
+        """One supervised scheduling turn: advance the health state
+        machine, steer ejected shards' queued frames onto survivors,
+        then run one poll per serving shard concurrently — each under
+        the dispatch deadline.  Returns total frames transmitted."""
+        self._supervise_tick()
+        serving = self._serving()
+        self._steer(serving)
+        futures: Dict[int, Future] = {
+            i: self._execs[i].submit(self.shards[i].poll) for i in serving
+        }
+        total = 0
+        deadline = time.monotonic() + self.dispatch_deadline
+        for i, fut in futures.items():
+            try:
+                total += fut.result(
+                    timeout=max(0.0, deadline - time.monotonic()))
+            except FutureTimeout:
+                self._on_hang(i, fut)
+            except Exception as err:  # noqa: BLE001 - shard faults are data
+                self._on_error(i, err)
+            else:
+                self._on_ok(i)
+        return total
 
     def drain(self) -> int:
-        """Drain every shard concurrently until all are idle."""
-        return sum(self._pool.map(lambda r: r.drain(), self.shards))
+        """Poll until every serving shard is idle and nothing more can
+        be steered; returns total frames transmitted.  Frames parked in
+        an EJECTED shard's rings (unsteerable, e.g. pinned by a wedged
+        batch) do not block drain — they are either steered on a later
+        poll or discarded by the rejoin sanitise."""
+        total = 0
+        while True:
+            sent = self.poll()
+            total += sent
+            if sent == 0 and self._idle():
+                return total
+
+    def _idle(self) -> bool:
+        for i in self._serving():
+            r = self.shards[i]
+            if r._inflight:
+                return False
+            try:
+                if len(r.source) > 0:  # type: ignore[arg-type]
+                    return False
+            except TypeError:
+                pass
+        return True
+
+    # -------------------------------------------------------- supervision
+
+    def _supervise_tick(self) -> None:
+        """Move ejected shards whose backoff elapsed into probation:
+        sanitise the runner (discard in-flight batches, rebuild the
+        native loop to release arena pins) and attach a fresh worker if
+        the old one was abandoned.  A shard whose hung thread is STILL
+        wedged inside the runner cannot be touched safely — its
+        ejection extends instead."""
+        now = time.monotonic()
+        for i, h in enumerate(self.health_of):
+            if h.state != STATE_EJECTED:
+                continue
+            if now - h.ejected_at < h.backoff:
+                continue
+            stuck = self._stuck.get(i)
+            if stuck is not None and not stuck.done():
+                h.ejected_at = now  # still wedged; extend the ejection
+                continue
+            self._stuck.pop(i, None)
+            if h.dirty:
+                try:
+                    self.shards[i].sanitize_after_fault()
+                except Exception as err:  # noqa: BLE001
+                    h.last_error = f"sanitize: {err}"
+                    h.ejected_at = now
+                    continue
+                h.dirty = False
+            if self._execs[i] is None:
+                self._execs[i] = self._new_exec(i)
+            h.state = STATE_PROBATION
+            h.consecutive_ok = 0
+            h.consecutive_errors = 0
+            log.info("shard %d entering probation (ejection #%d)",
+                     i, h.ejections)
+
+    def _on_ok(self, i: int) -> None:
+        h = self.health_of[i]
+        h.consecutive_errors = 0
+        if h.state == STATE_PROBATION:
+            h.consecutive_ok += 1
+            if h.consecutive_ok >= self.probation_polls:
+                h.state = STATE_REJOINED
+                h.rejoins += 1
+                h.eject_streak = 0
+                self._rejoins += 1
+                log.info("shard %d rejoined after probation", i)
+        elif h.state in (STATE_DEGRADED, STATE_REJOINED):
+            h.state = STATE_HEALTHY
+
+    def _on_error(self, i: int, err: Exception) -> None:
+        h = self.health_of[i]
+        h.last_error = str(err) or repr(err)
+        h.consecutive_ok = 0
+        h.consecutive_errors += 1
+        # Always sanitise after a failed poll: a dispatch exception can
+        # leave an admitted slot pinned in the native arena.
+        try:
+            self.shards[i].sanitize_after_fault()
+        except Exception as serr:  # noqa: BLE001
+            h.last_error = f"{h.last_error}; sanitize: {serr}"
+        if h.state == STATE_PROBATION or \
+                h.consecutive_errors >= self.eject_errors:
+            self._eject(i, dirty=False)
+        elif h.state in (STATE_HEALTHY, STATE_REJOINED):
+            h.state = STATE_DEGRADED
+        log.warning("shard %d poll failed (%d consecutive): %s",
+                    i, h.consecutive_errors, h.last_error)
+
+    def _on_hang(self, i: int, fut: Future) -> None:
+        """The shard's poll blew the dispatch deadline: abandon its
+        worker thread (it may be wedged in a device call forever) and
+        eject.  The runner is marked dirty — it cannot be sanitised
+        until the abandoned thread actually returns."""
+        h = self.health_of[i]
+        h.last_error = (
+            f"dispatch deadline exceeded ({self.dispatch_deadline:.1f}s)")
+        h.consecutive_ok = 0
+        self._stuck[i] = fut
+        ex = self._execs[i]
+        self._execs[i] = None
+        if ex is not None:
+            ex.shutdown(wait=False)
+        self._eject(i, dirty=True)
+        log.error("shard %d hung; worker abandoned and shard ejected", i)
+
+    def recover(self, shard: Optional[int] = None) -> int:
+        """Operator-initiated recovery (netctl health --recover): zero
+        the ejection backoff so the next poll takes the shard(s)
+        straight into probation — the supervisor's safety checks
+        (wedged-thread detection, sanitise, probation polls) still
+        apply.  Returns how many ejected shards were expedited."""
+        expedited = 0
+        for i, h in enumerate(self.health_of):
+            if shard is not None and i != shard:
+                continue
+            if h.state == STATE_EJECTED:
+                h.backoff = 0.0
+                h.ejected_at = 0.0
+                expedited += 1
+        return expedited
+
+    def _eject(self, i: int, dirty: bool) -> None:
+        h = self.health_of[i]
+        h.state = STATE_EJECTED
+        h.dirty = h.dirty or dirty
+        h.ejections += 1
+        h.eject_streak += 1
+        self._ejections += 1
+        h.backoff = min(self.reinit_backoff_max,
+                        self.reinit_backoff * (2 ** (h.eject_streak - 1)))
+        h.ejected_at = time.monotonic()
+
+    # ------------------------------------------------------------ steering
+
+    def _steer(self, serving: List[int]) -> None:
+        """Drain ejected shards' queued source frames and redistribute
+        them round-robin onto the survivors (their device results are
+        identical — sessions are shared — so any shard can serve any
+        flow).  With NO survivors the ``on_all_down`` policy applies:
+        fail-closed drop, or unfiltered static host bypass."""
+        down = [i for i, h in enumerate(self.health_of)
+                if h.state == STATE_EJECTED]
+        if not down:
+            return
+        # Steer ONLY into sources whose send() enqueues for ingest
+        # (ring-likes declare can_enqueue).  AfPacketIO.send would
+        # TRANSMIT the raw frames back onto the wire unprocessed —
+        # with fanout sockets the kernel redistributes on its own once
+        # the ejected socket stops draining.
+        targets = [self.shards[i] for i in serving
+                   if getattr(self.shards[i].source, "can_enqueue", False)]
+        burst = 1 << 12
+        for i in down:
+            r = self.shards[i]
+            if serving and not targets:
+                return  # survivors exist but their sources can't ingest
+            try:
+                frames = r.source.recv_batch(burst)
+            except Exception:  # noqa: BLE001 - ring pinned by a wedged batch
+                continue
+            if not frames:
+                continue
+            if targets:
+                for j, t in enumerate(targets):
+                    chunk = frames[j::len(targets)]
+                    if chunk:
+                        t.source.send(chunk)
+                self._steered_frames += len(frames)
+            elif self.on_all_down == "bypass":
+                self._bypass_forwards += self._bypass_forward(r, frames)
+            else:
+                self._failclosed_drops += len(frames)
+
+    def _bypass_forward(self, r: DataplaneRunner, frames: List[bytes]) -> int:
+        """All-shards-down static host bypass: route frames with pure
+        host arithmetic — NO classify, NO NAT, no device — the explicit
+        degraded mode trading policy enforcement for reachability.
+        Mirrors the tail of the python harvest path."""
+        from ..ops.packets import PacketBatch
+        from ..ops.pipeline import ROUTE_HOST, ROUTE_LOCAL, ROUTE_REMOTE
+
+        fb = r.shim.parse(frames)
+        n = fb.n
+        if n == 0:
+            return 0
+        dst = np.asarray(fb.batch.dst_ip)[:n]
+        base = int(np.asarray(r.route.pod_subnet_base))
+        mask = int(np.asarray(r.route.pod_subnet_mask))
+        tbase = int(np.asarray(r.route.this_node_base))
+        tmask = int(np.asarray(r.route.this_node_mask))
+        hbits = int(np.asarray(r.route.host_bits))
+        local = (dst & tmask) == tbase
+        in_pod = (dst & mask) == base
+        tag = np.where(local, ROUTE_LOCAL,
+                       np.where(in_pod, ROUTE_REMOTE, ROUTE_HOST)).astype(np.int32)
+        node_id = np.where(in_pod & ~local,
+                           (dst - base) >> hbits, 0).astype(np.int32)
+        allowed = np.ones(n, dtype=bool)
+        orig = PacketBatch(
+            src_ip=np.asarray(fb.batch.src_ip)[:n],
+            dst_ip=dst,
+            protocol=np.asarray(fb.batch.protocol)[:n],
+            src_port=np.asarray(fb.batch.src_port)[:n],
+            dst_port=np.asarray(fb.batch.dst_port)[:n],
+        )
+        fwd = r.shim.apply_masked(fb, allowed, orig)  # no rewrite
+        sent = 0
+        is_remote = (tag == ROUTE_REMOTE).astype(np.uint8)
+        out_buf, out_off, out_len, out_rows, _ = r.shim.vxlan_encap(
+            fb, fwd, is_remote, node_id, r.overlay.remote_ips,
+            r.overlay.local_ip, r.overlay.local_node_id, r.overlay.vni,
+        )
+        if len(out_rows):
+            r.tx.send([
+                out_buf[int(out_off[j]):int(out_off[j]) + int(out_len[j])]
+                .tobytes()
+                for j in range(len(out_rows))
+            ])
+            sent += len(out_rows)
+        for rows, sink in (
+            (np.nonzero(fwd.astype(bool) & (tag == ROUTE_LOCAL))[0], r.local),
+            (np.nonzero(fwd.astype(bool) & (tag == ROUTE_HOST))[0], r.host),
+        ):
+            if len(rows):
+                sink.send([fb.frame(int(j)) for j in rows])
+                sent += len(rows)
+        return sent
 
     # ------------------------------------------------------------- tables
 
     def update_tables(self, acl=None, nat=None, route=None) -> None:
-        """One swap for all shards: the backend retarget and the
+        """One ATOMIC swap for all shards: the backend retarget and the
         bypass-eligibility device reads (session/affinity occupancy on
-        the SHARED state) are computed ONCE and handed to every shard,
-        instead of once per shard — at 8+ shards the per-shard device
-        round trips used to dominate the swap latency."""
+        the SHARED state) are computed ONCE and handed to every shard.
+        If ANY shard's adopt fails, every shard is rolled back to the
+        last-good tables — the shards always agree on one table
+        generation — and a retriable :class:`TableSwapError` surfaces
+        to the caller (the scheduler applicator absorbs it into its
+        FAILED/retry/healing machinery)."""
         if not (acl is not None or nat is not None or route is not None):
             return
         from ..ops.nat import retarget_tables
 
         r0 = self.shards[0]
-        if nat is not None:
-            nat = retarget_tables(nat, r0._target_backend())
+        last_good = (r0.acl, r0.nat, r0.route)
         # Disarm every shard's host bypass BEFORE any shard adopts: the
         # adopt + shared occupancy reads below take multiple batches'
         # worth of wall time, and a concurrent poll must not keep
         # forwarding via the bypass once deny rules are being installed.
         for r in self.shards:
             r._bypass_tables = False
-        for r in self.shards:
-            r._adopt_tables(acl, nat, route)
+        idx = -1
+        try:
+            if nat is not None:
+                nat = retarget_tables(nat, r0._target_backend())
+            for idx, r in enumerate(self.shards):
+                r._adopt_tables(acl, nat, route)
+        except Exception as err:
+            # Roll EVERY shard back to last-good (adopted or not — the
+            # restore is reference assignment, idempotent), so no two
+            # shards ever serve different table generations.
+            for r in self.shards:
+                r.acl, r.nat, r.route = last_good
+            self._swap_rollbacks += 1
+            state_clear = (
+                r0._bypass_state_clear() if r0._bypass_static_ok() else False)
+            for r in self.shards:
+                r._refresh_bypass(state_clear=state_clear)
+            raise TableSwapError(
+                f"multi-shard table swap failed on shard {idx}; all "
+                f"{len(self.shards)} shards rolled back to last-good "
+                f"tables: {err}"
+            ) from err
         # Shared-state occupancy reads only when the static half can
         # pass at all (the checks short-circuit before any device read
         # when the tables are non-trivial).
@@ -168,6 +557,16 @@ class ShardedDataplane:
         agg["datapath_slowpath_sessions_active"] = slowpath_sessions
         agg["datapath_inflight"] = sum(len(r._inflight) for r in self.shards)
         agg["datapath_shards"] = len(self.shards)
+        # Supervisor counters: engine-level, not per shard (rollbacks
+        # happen once per failed swap, so the per-runner counter — only
+        # ticked by solo-runner update_tables — is overridden here).
+        agg["datapath_swap_rollbacks_total"] = self._swap_rollbacks
+        agg["datapath_shards_serving"] = len(self._serving())
+        agg["datapath_shard_ejections_total"] = self._ejections
+        agg["datapath_shard_rejoins_total"] = self._rejoins
+        agg["datapath_steered_frames_total"] = self._steered_frames
+        agg["datapath_failclosed_drops_total"] = self._failclosed_drops
+        agg["datapath_bypass_forwards_total"] = self._bypass_forwards
         return agg
 
     def metrics(self) -> Dict[str, int]:
@@ -180,6 +579,38 @@ class ShardedDataplane:
             one.get("datapath_slowpath_sessions_active", 0),
         )
 
+    def health(self) -> Dict[str, object]:
+        """The fault-domain report (REST /contiv/v1/health → `netctl
+        health`): per-shard state machine positions + engine-level
+        ejection/steer/quarantine/rollback counters."""
+        serving = self._serving()
+        shard_views = []
+        for i, (h, r) in enumerate(zip(self.health_of, self.shards)):
+            view = h.as_dict()
+            view["shard"] = i
+            view["quarantined_batches"] = r.counters.quarantined_batches
+            view["poisoned_frames"] = r.counters.dropped_poisoned
+            view["dispatch_errors"] = r.counters.dispatch_errors
+            view["source_errors"] = r.counters.source_errors
+            shard_views.append(view)
+        return {
+            "policy_all_down": self.on_all_down,
+            "shards_total": len(self.shards),
+            "shards_serving": len(serving),
+            "all_down": not serving,
+            "ejections": self._ejections,
+            "rejoins": self._rejoins,
+            "steered_frames": self._steered_frames,
+            "failclosed_drops": self._failclosed_drops,
+            "bypass_forwards": self._bypass_forwards,
+            "swap_rollbacks": self._swap_rollbacks,
+            "quarantined_batches": sum(
+                r.counters.quarantined_batches for r in self.shards),
+            "poisoned_frames": sum(
+                r.counters.dropped_poisoned for r in self.shards),
+            "shards": shard_views,
+        }
+
     def inspect(self) -> Dict[str, object]:
         """Live introspection (netctl inspect): shard 0's FULL view
         carries the shared state (device tables, sessions, slow path —
@@ -190,10 +621,12 @@ class ShardedDataplane:
         rings/inflight aggregate across shards so the summary view
         reflects the whole node."""
         base = self.shards[0].inspect()
+        base["health"] = self.health()
         base["shards"] = [
             {"dispatch": r.inspect_dispatch(), "rings": r.inspect_rings(),
-             "counters": r.counters.as_dict()}
-            for r in self.shards
+             "counters": r.counters.as_dict(),
+             "health": h.as_dict()}
+            for r, h in zip(self.shards, self.health_of)
         ]
         # Aggregate rings: sum frames/dropped per ring name.
         rings: Dict[str, Dict[str, int]] = {}
@@ -215,4 +648,9 @@ class ShardedDataplane:
         return base
 
     def close(self) -> None:
-        self._pool.shutdown(wait=True)
+        # Release any injected hangs first so abandoned threads can
+        # finish instead of leaking for their full timeout.
+        self.faults.disarm()
+        for ex in self._execs:
+            if ex is not None:
+                ex.shutdown(wait=True)

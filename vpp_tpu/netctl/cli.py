@@ -20,6 +20,13 @@ Analog of ``plugins/netctl`` + ``cmd/contiv-netctl`` (cmd/root.go
                  classify/NAT table stats, session + affinity
                  occupancy, ring depths, punt counters; ``--watch N``
                  streams
+- ``health``     datapath fault-domain health: per-shard supervision
+                 state (healthy/degraded/ejected/probation/rejoined),
+                 ejection/rejoin/steer counters, poisoned-batch
+                 quarantine totals, table-swap rollbacks
+- ``fault``      fault-injection harness control: list armed plans,
+                 ``fault arm dispatch-raise --shard 1 --count 4``,
+                 ``fault disarm [--site s]`` (chaos drills / testing)
 
 Run: ``python -m vpp_tpu.netctl <command> [--server host:port]``.
 """
@@ -250,6 +257,96 @@ def cmd_inspect(server: str, out, watch: float = 0.0, raw: bool = False) -> int:
     return 0
 
 
+def cmd_health(server: str, out, raw: bool = False,
+               recover: Optional[int] = None) -> int:
+    """Datapath fault-domain health: the shard supervisor's view of a
+    RUNNING agent — which shards serve, which are ejected and why, how
+    much traffic was steered/quarantined/dropped.  ``--recover [N]``
+    expedites ejected shards into probation."""
+    if recover is not None:
+        q = f"?shard={recover}" if recover >= 0 else ""
+        res = _fetch(server, f"/contiv/v1/health/recover{q}", method="POST")
+        print(f"recovering {res['recovering']} shard(s)", file=out)
+        return 0
+    d = _fetch(server, "/contiv/v1/health")
+    if raw:
+        print(json.dumps(d, indent=2), file=out)
+        return 0
+    if "shards" not in d:
+        # Solo runner: flat health dict, no supervisor.
+        q = d.get("quarantine") or {}
+        print(f"node {d.get('node', '?')}  dispatch_errors="
+              f"{d.get('dispatch_errors', 0)}  source_errors="
+              f"{d.get('source_errors', 0)}  swap_rollbacks="
+              f"{d.get('swap_rollbacks', 0)}  quarantined="
+              f"{q.get('batches', 0)} batches/"
+              f"{q.get('poisoned_frames', 0)} frames", file=out)
+        if d.get("last_error"):
+            print(f"last error: {d['last_error']}", file=out)
+        return 0
+    print(f"node {d.get('node', '?')}  shards {d['shards_serving']}/"
+          f"{d['shards_total']} serving  all-down policy="
+          f"{d['policy_all_down']}"
+          f"{'  ALL DOWN' if d['all_down'] else ''}", file=out)
+    print(f"ejections={d['ejections']}  rejoins={d['rejoins']}  "
+          f"steered={d['steered_frames']}  quarantined="
+          f"{d['quarantined_batches']} batches/"
+          f"{d['poisoned_frames']} frames  swap_rollbacks="
+          f"{d['swap_rollbacks']}  failclosed_drops="
+          f"{d['failclosed_drops']}  bypass_forwards="
+          f"{d['bypass_forwards']}", file=out)
+    rows = [
+        [s["shard"], s["state"], s["consecutive_errors"], s["ejections"],
+         s["rejoins"], s["dispatch_errors"], s["poisoned_frames"],
+         (s["last_error"][:48] if s["last_error"] else "-")]
+        for s in d["shards"]
+    ]
+    print(_table(rows, ["SHARD", "STATE", "ERRS", "EJECT", "REJOIN",
+                        "DISP-ERRS", "POISONED", "LAST-ERROR"]), file=out)
+    return 0
+
+
+def cmd_fault(server: str, out, action: str = "", site: str = "",
+              shard: Optional[int] = None, count: Optional[int] = None,
+              mode: str = "", seconds: float = 30.0) -> int:
+    """Fault-injection harness control (chaos drills): list the armed
+    plans, arm a named site, or disarm."""
+    if action in ("", "list"):
+        st = _fetch(server, "/contiv/v1/faults")
+        print(f"armed={st['armed']}  sites: {', '.join(st['sites'])}",
+              file=out)
+        rows = [[p["id"], p["site"],
+                 p["shard"] if p["shard"] is not None else "any",
+                 p["remaining"] if p["remaining"] is not None else "inf",
+                 p["mode"], p["fired"]]
+                for p in st["plans"]]
+        if rows:
+            print(_table(rows, ["ID", "SITE", "SHARD", "REMAINING", "MODE",
+                                "FIRED"]), file=out)
+        return 0
+    if action == "arm":
+        if not site:
+            print("netctl: fault arm needs a site", file=sys.stderr)
+            return 1
+        q = f"site={site}&seconds={seconds}"
+        if shard is not None:
+            q += f"&shard={shard}"
+        if count is not None:
+            q += f"&count={count}"
+        if mode:
+            q += f"&mode={mode}"
+        res = _fetch(server, f"/contiv/v1/faults/arm?{q}", method="POST")
+        print(f"armed plan #{res['armed_plan']} at {site}", file=out)
+        return 0
+    if action == "disarm":
+        q = f"?site={site}" if site else ""
+        res = _fetch(server, f"/contiv/v1/faults/disarm{q}", method="POST")
+        print(f"disarmed {res['disarmed']} plan(s)", file=out)
+        return 0
+    print(f"netctl: unknown fault action {action!r}", file=sys.stderr)
+    return 1
+
+
 def main(argv: Optional[List[str]] = None, out=None) -> int:
     out = out if out is not None else sys.stdout
     common = argparse.ArgumentParser(add_help=False)
@@ -285,6 +382,26 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
                          help="stream a snapshot every N seconds")
     inspect.add_argument("--raw", action="store_true",
                          help="full JSON instead of the summary view")
+    healthcmd = sub.add_parser("health", parents=[common])
+    healthcmd.add_argument("--raw", action="store_true",
+                           help="full JSON instead of the summary view")
+    healthcmd.add_argument("--recover", type=int, nargs="?", const=-1,
+                           default=None, metavar="SHARD",
+                           help="expedite ejected shards into probation "
+                                "(all, or one shard index)")
+    fault = sub.add_parser("fault", parents=[common])
+    fault.add_argument("action", nargs="?", default="",
+                       choices=["", "list", "arm", "disarm"])
+    fault.add_argument("site", nargs="?", default="",
+                       help="injection site (dispatch-raise, dispatch-hang, "
+                            "swap-fail, frame-source-error)")
+    fault.add_argument("--shard", type=int, default=None,
+                       help="restrict to one shard (default: any)")
+    fault.add_argument("--count", type=int, default=None,
+                       help="fire at most N times (default: until disarmed)")
+    fault.add_argument("--mode", default="", choices=["", "raise", "hang"])
+    fault.add_argument("--seconds", type=float, default=30.0,
+                       help="hang-mode safety timeout")
     args = parser.parse_args(argv)
 
     try:
@@ -300,6 +417,11 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
             return cmd_trace(args.server, out, args.action, args.sample)
         if args.command == "inspect":
             return cmd_inspect(args.server, out, args.watch, args.raw)
+        if args.command == "health":
+            return cmd_health(args.server, out, args.raw, args.recover)
+        if args.command == "fault":
+            return cmd_fault(args.server, out, args.action, args.site,
+                             args.shard, args.count, args.mode, args.seconds)
         return {
             "nodes": cmd_nodes,
             "pods": cmd_pods,

@@ -17,7 +17,13 @@ Analog of the reference's per-node REST surfaces (SURVEY.md §5.5):
   key-class selection (the ``netctl vppdump`` data source, reference
   plugins/netctl/cmdimpl/vppdump.go);
 - ``GET|POST /logging`` — runtime per-component log levels (the
-  cn-infra logmanager analog, cmd/contiv-agent/main.go:71,231).
+  cn-infra logmanager analog, cmd/contiv-agent/main.go:71,231);
+- ``GET /contiv/v1/health`` + ``POST /contiv/v1/health/recover`` —
+  datapath fault-domain health (shard supervision states, quarantine /
+  rollback counters) and operator-expedited shard recovery;
+- ``GET /contiv/v1/faults`` + ``POST /contiv/v1/faults/arm|disarm`` —
+  the fault-injection harness (vpp_tpu/testing/faults.py), the REST
+  arming surface chaos drills use.
 
 Implemented on the stdlib threading HTTP server; components are
 injected and every endpoint degrades to 404 when its component is
@@ -170,10 +176,75 @@ class AgentRestServer:
         """Live datapath introspection (`netctl inspect`, the vppcli
         analog): classify/NAT table stats, session + affinity
         occupancy, ring depths, punt counters, dispatch config."""
+        dp = self._resolve_datapath()
+        return {"node": self.node_name, **dp.inspect()}
+
+    def _resolve_datapath(self):
         dp = self.datapath() if callable(self.datapath) else self.datapath
         if dp is None:
             raise LookupError("no datapath")
-        return {"node": self.node_name, **dp.inspect()}
+        return dp
+
+    def get_health(self) -> dict:
+        """Datapath fault-domain health (`netctl health`): per-shard
+        supervision state, ejection/rejoin/steer counters, poisoned-
+        batch quarantine totals, table-swap rollbacks."""
+        return {"node": self.node_name, **self._resolve_datapath().health()}
+
+    def post_health_recover(self, query: dict) -> dict:
+        """Expedite ejected shards into probation (skip the backoff);
+        optional ``shard=`` restricts to one."""
+        dp = self._resolve_datapath()
+        recover = getattr(dp, "recover", None)
+        if recover is None:
+            raise LookupError("datapath has no shard supervisor")
+        n = recover(int(query["shard"]) if "shard" in query else None)
+        return {"recovering": n, **dp.health()}
+
+    def get_faults(self) -> dict:
+        """The fault-injection harness's armed plans (testing/chaos
+        surface — see vpp_tpu/testing/faults.py)."""
+        return self._resolve_datapath().faults.status()
+
+    def post_fault(self, action: str, query: dict) -> dict:
+        """Arm/disarm a named fault-injection site on the live
+        datapath: ``POST /contiv/v1/faults/arm?site=dispatch-raise&``
+        ``shard=1&count=4`` (optional ``mode=raise|hang``,
+        ``seconds=``, and ``match_src_port=``-style 5-tuple fields for
+        poison predicates); ``POST /contiv/v1/faults/disarm`` clears
+        plans (optionally one ``site=`` / ``id=``)."""
+        faults = self._resolve_datapath().faults
+        if action == "disarm":
+            removed = faults.disarm(
+                site=query.get("site"),
+                plan_id=int(query["id"]) if "id" in query else None,
+            )
+            return {"disarmed": removed, **faults.status()}
+        if action != "arm":
+            raise FileNotFoundError(f"fault action {action!r}")
+        if "site" not in query:
+            raise ValueError("need site= query parameter")
+        from ..ops.packets import ip_to_u32
+
+        match = {}
+        for field_name in ("src_ip", "dst_ip", "protocol",
+                           "src_port", "dst_port"):
+            raw = query.get(f"match_{field_name}")
+            if raw is None:
+                continue
+            match[field_name] = (
+                ip_to_u32(raw) if field_name.endswith("_ip") and "." in raw
+                else int(raw)
+            )
+        plan_id = faults.arm(
+            query["site"],
+            shard=int(query["shard"]) if "shard" in query else None,
+            count=int(query["count"]) if "count" in query else None,
+            mode=query.get("mode"),
+            seconds=float(query.get("seconds", "30")),
+            match=match or None,
+        )
+        return {"armed_plan": plan_id, **faults.status()}
 
     def get_metrics(self) -> str:
         from prometheus_client import generate_latest
@@ -264,6 +335,8 @@ class AgentRestServer:
             ("GET", "/contiv/v1/nodes"): self.get_nodes,
             ("GET", "/contiv/v1/pods"): self.get_pods,
             ("GET", "/contiv/v1/inspect"): self.get_inspect,
+            ("GET", "/contiv/v1/health"): self.get_health,
+            ("GET", "/contiv/v1/faults"): self.get_faults,
         }
         if (method, path) in routes:
             return routes[(method, path)]()
@@ -289,6 +362,10 @@ class AgentRestServer:
             return self.post_trace(
                 path.rsplit("/", 1)[1], int(query.get("sample", "1"))
             )
+        if method == "POST" and path.startswith("/contiv/v1/faults/"):
+            return self.post_fault(path.rsplit("/", 1)[1], query)
+        if method == "POST" and path == "/contiv/v1/health/recover":
+            return self.post_health_recover(query)
         raise FileNotFoundError(path)
 
     def start(self) -> int:

@@ -134,6 +134,15 @@ class _CompilingApplicator(Applicator):
         # data plane is ACTUALLY running (runner.acl / runner.nat).
         self.installed_fn = installed_fn
         self.compile_count = 0  # atomic-swap observability for tests/metrics
+        # True while a compiled artifact has not (yet) been swapped into
+        # the data plane: set before each on_compiled call, cleared on
+        # success.  A swap that fails (runner TableSwapError — the
+        # tables were rolled back to last-good) leaves it set, so the
+        # scheduler's retry re-attempts the SWAP even though the state
+        # is no longer dirty (the retry's _try_apply sees applied ==
+        # desired and issues no CRUD call, so without this flag the
+        # recompiled-but-never-installed tables would be stranded).
+        self._swap_pending = False
 
     update_destroys_on_failure = False  # swaps are atomic in-place updates
 
@@ -165,15 +174,26 @@ class _CompilingApplicator(Applicator):
         with self._lock:
             # Compile when state changed — or on the very first
             # transaction, so empty tables exist from the first resync on
-            # (the data plane must never see None tables).
-            if not self._dirty and self._compiled is not None:
+            # (the data plane must never see None tables).  A pending
+            # swap (an earlier on_compiled failed and rolled back)
+            # re-fires with the cached compile even when nothing is
+            # dirty — that is the scheduler-retry path for swap faults.
+            if not self._dirty and self._compiled is not None \
+                    and not self._swap_pending:
                 return
-            compiled = self._compile(dict(self._state))
-            self._compiled = compiled
-            self._dirty = False
-            self.compile_count += 1
+            if self._dirty or self._compiled is None:
+                self._compiled = self._compile(dict(self._state))
+                self._dirty = False
+                self.compile_count += 1
+            compiled = self._compiled
+            self._swap_pending = self.on_compiled is not None
         if self.on_compiled is not None:
+            # May raise (e.g. a runner TableSwapError): the scheduler's
+            # _end_txns absorbs it into FAILED/retry state, and the
+            # still-set _swap_pending makes the retry re-swap.
             self.on_compiled(compiled)
+        with self._lock:
+            self._swap_pending = False
 
     def _compile(self, state: Dict[str, Any]):
         raise NotImplementedError

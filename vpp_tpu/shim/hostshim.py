@@ -153,6 +153,11 @@ class NativeRing:
     Python ring's.
     """
 
+    # send() ENQUEUES for ingest (unlike AfPacketIO.send, which
+    # transmits raw on the wire): the shard supervisor may steer an
+    # ejected shard's frames into this source.
+    can_enqueue = True
+
     def __init__(self, arena_bytes: int = 8 << 20, max_frames: int = 1 << 16):
         self._lib = _shared_lib()
         self._ptr = self._lib.hs_ring_new(arena_bytes, max_frames)

@@ -1,0 +1,242 @@
+"""Fault-injection harness for the datapath fault-domain layer.
+
+The reference validates its resilience story with Robot chaos suites
+that kill whole agents; the TPU-native data plane has failure modes a
+process kill cannot reach — a JAX dispatch raising on one shard, a
+device call that never returns, a table swap failing halfway through a
+multi-shard fan-out, a frame source erroring under it.  This module
+gives every such mode a NAMED INJECTION SITE, armed programmatically
+(tests) or over REST (`POST /contiv/v1/faults/arm`), so chaos tests
+drive them through the production code paths instead of monkeypatching
+runner internals.
+
+Sites (fired by hook points in ``datapath/runner.py`` /
+``datapath/shards.py`` / ``datapath/io.py``):
+
+- ``dispatch-raise``   — the jit dispatch raises (device error analog);
+  with a ``match`` predicate it only fires when the batch contains a
+  matching frame, which is how poisoned-batch quarantine is driven.
+- ``dispatch-hang``    — the dispatch thread wedges (stuck device call);
+  released by :meth:`FaultInjector.disarm` or the plan's ``seconds``
+  timeout, so tests never leak permanently-stuck threads.
+- ``swap-fail``        — ``update_tables`` / ``_adopt_tables`` raises on
+  the selected shard before any table reference is mutated.
+- ``frame-source-error`` — the frame source errors during admit
+  (flapping NIC / dead socket analog).
+
+The injector is SHARED across all shards of a :class:`ShardedDataplane`
+(plans select shards via ``shard=``; ``None`` matches every shard) and
+costs one attribute read per hook point while disarmed — safe to leave
+compiled into production paths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+from typing import Any, Dict, List, Optional
+
+SITE_DISPATCH_RAISE = "dispatch-raise"
+SITE_DISPATCH_HANG = "dispatch-hang"
+SITE_SWAP_FAIL = "swap-fail"
+SITE_FRAME_SOURCE_ERROR = "frame-source-error"
+
+SITES = (
+    SITE_DISPATCH_RAISE,
+    SITE_DISPATCH_HANG,
+    SITE_SWAP_FAIL,
+    SITE_FRAME_SOURCE_ERROR,
+)
+
+# Fields a poison predicate may match on (the parsed 5-tuple).
+MATCH_FIELDS = ("src_ip", "dst_ip", "protocol", "src_port", "dst_port")
+
+
+class FaultInjected(RuntimeError):
+    """Raised at an armed injection site."""
+
+    def __init__(self, site: str, shard: Optional[int], message: str = ""):
+        super().__init__(
+            message or f"injected fault at {site}"
+            + (f" (shard {shard})" if shard is not None else "")
+        )
+        self.site = site
+        self.shard = shard
+
+
+@dataclasses.dataclass
+class _Plan:
+    plan_id: int
+    site: str
+    shard: Optional[int]          # None = any shard
+    count: Optional[int]          # remaining fires; None = unlimited
+    mode: str                     # "raise" | "hang"
+    message: str
+    match: Optional[Dict[str, int]]  # 5-tuple field -> value (poison predicate)
+    seconds: float                # hang timeout (upper bound)
+    release: threading.Event = dataclasses.field(default_factory=threading.Event)
+    fired: int = 0
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "id": self.plan_id,
+            "site": self.site,
+            "shard": self.shard,
+            "remaining": self.count,
+            "mode": self.mode,
+            "match": dict(self.match) if self.match else None,
+            "seconds": self.seconds,
+            "fired": self.fired,
+        }
+
+
+class FaultInjector:
+    """Registry of armed fault plans, consulted at the named sites."""
+
+    def __init__(self):
+        self._plans: List[_Plan] = []
+        # Plans with a thread currently wedged in their hang: kept here
+        # (even after a count-exhausted plan leaves _plans) so disarm()
+        # can ALWAYS release them.
+        self._wedged: List[_Plan] = []
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        # Volatile fast-path flag: hook points read this WITHOUT the
+        # lock; it is only ever True while plans exist, so a disarmed
+        # injector costs one attribute read per hook.
+        self.armed = False
+
+    # ------------------------------------------------------------- arming
+
+    def arm(
+        self,
+        site: str,
+        shard: Optional[int] = None,
+        count: Optional[int] = None,
+        mode: Optional[str] = None,
+        message: str = "",
+        match: Optional[Dict[str, int]] = None,
+        seconds: float = 30.0,
+    ) -> int:
+        """Arm one plan; returns its id.  ``count=None`` fires until
+        disarmed; ``match`` restricts ``dispatch-raise`` to batches
+        containing a frame whose listed 5-tuple fields all equal the
+        given values (the poisoned-frame predicate)."""
+        if site not in SITES:
+            raise ValueError(f"unknown fault site {site!r} (have {SITES})")
+        if mode is None:
+            mode = "hang" if site == SITE_DISPATCH_HANG else "raise"
+        if mode not in ("raise", "hang"):
+            raise ValueError(f"unknown fault mode {mode!r}")
+        if match is not None:
+            bad = set(match) - set(MATCH_FIELDS)
+            if bad:
+                raise ValueError(f"unmatchable fields {sorted(bad)}")
+            match = {k: int(v) for k, v in match.items()}
+        plan = _Plan(
+            plan_id=next(self._ids), site=site, shard=shard,
+            count=count, mode=mode, message=message, match=match,
+            seconds=float(seconds),
+        )
+        with self._lock:
+            self._plans.append(plan)
+            self.armed = True
+        return plan.plan_id
+
+    def disarm(self, site: Optional[str] = None,
+               plan_id: Optional[int] = None) -> int:
+        """Remove matching plans (all of them by default), releasing any
+        thread currently wedged in a hang.  Returns how many were
+        removed."""
+        with self._lock:
+            keep, gone = [], []
+            for plan in self._plans:
+                if (site is None or plan.site == site) and (
+                    plan_id is None or plan.plan_id == plan_id
+                ):
+                    gone.append(plan)
+                else:
+                    keep.append(plan)
+            self._plans = keep
+            self.armed = bool(keep)
+            # Release matching wedged plans too — a count-exhausted hang
+            # plan is no longer in _plans but its thread is still stuck.
+            for plan in self._wedged:
+                if (site is None or plan.site == site) and (
+                    plan_id is None or plan.plan_id == plan_id
+                ) and plan not in gone:
+                    gone.append(plan)
+        for plan in gone:
+            plan.release.set()
+        return len(gone)
+
+    # -------------------------------------------------------------- firing
+
+    def fire(self, site: str, shard: Optional[int] = None,
+             batch: Optional[Dict[str, Any]] = None) -> None:
+        """Hook point: no-op unless a plan matches ``site``/``shard``
+        (and, for poison plans, the batch contains a matching frame).
+        Raises :class:`FaultInjected` or blocks (hang mode)."""
+        if not self.armed:
+            return
+        with self._lock:
+            plan = None
+            for p in self._plans:
+                if p.site != site:
+                    continue
+                if p.shard is not None and shard is not None and p.shard != shard:
+                    continue
+                if p.match is not None and not self._batch_matches(p.match, batch):
+                    continue
+                plan = p
+                break
+            if plan is None:
+                return
+            plan.fired += 1
+            if plan.count is not None:
+                plan.count -= 1
+                if plan.count <= 0:
+                    self._plans.remove(plan)
+                    self.armed = bool(self._plans)
+        if plan.mode == "hang":
+            # Wedge until disarmed (or the safety timeout) — the analog
+            # of a device call that never returns.  The plan registers
+            # as wedged first so disarm() can un-stick this thread even
+            # after a count-exhausted plan left _plans.
+            with self._lock:
+                self._wedged.append(plan)
+            try:
+                plan.release.wait(plan.seconds)
+            finally:
+                with self._lock:
+                    if plan in self._wedged:
+                        self._wedged.remove(plan)
+            return
+        raise FaultInjected(site, shard, plan.message)
+
+    @staticmethod
+    def _batch_matches(match: Dict[str, int], batch) -> bool:
+        if batch is None:
+            return False
+        import numpy as np
+
+        rows = None
+        for field_name, value in match.items():
+            arr = batch.get(field_name) if isinstance(batch, dict) \
+                else getattr(batch, field_name, None)
+            if arr is None:
+                return False
+            hit = np.asarray(arr) == value
+            rows = hit if rows is None else (rows & hit)
+        return bool(rows is not None and rows.any())
+
+    # -------------------------------------------------------------- status
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "armed": self.armed,
+                "sites": list(SITES),
+                "plans": [p.as_dict() for p in self._plans],
+            }
