@@ -3,9 +3,9 @@
 
 PY ?= python
 
-.PHONY: test test-race verify-ha verify-churn verify-faults lint bench \
-        bench-suite bench-sweep bench-scale bench-latency bench-frames \
-        bench-churn images native
+.PHONY: test test-race verify-ha verify-churn verify-faults \
+        verify-adaptive lint bench bench-suite bench-sweep bench-scale \
+        bench-latency bench-frames bench-churn bench-adaptive images native
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -36,6 +36,24 @@ verify-churn:
 
 bench-churn:
 	$(PY) scripts/bench_churn.py --check
+
+# Adaptive-coalesce verification: the governor unit/property suite
+# (K monotonicity, SLO bound across an offered-load sweep, pow2-bucket
+# pre-warm, mock-engine verdict parity at every chosen K, native k_cap,
+# deeper in-flight window) + a reduced-scale frontier smoke asserting
+# >= 1.5x over fixed K=64 at saturation on a (simulated) floor-bound
+# link while the added-latency budget holds at the reference load.
+# The full frontier (tunnel floor, production scale) is
+# `make bench-adaptive`.
+verify-adaptive:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_governor.py \
+	    -q $(if $(RUN_SLOW),,-m 'not slow') --continue-on-collection-errors \
+	    -p no:cacheprovider -p no:xdist -p no:randomly
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_adaptive.py --smoke --check \
+	    --min-speedup 1.5 --out /tmp/benchadapt_verify.jsonl
+
+bench-adaptive:
+	$(PY) scripts/bench_adaptive.py --check
 
 # Datapath fault-domain verification: the fault-injection harness units
 # (injector semantics, swap rollback, poisoned-batch quarantine, REST/

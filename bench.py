@@ -216,6 +216,55 @@ def _measure_flat(acl, nat, route, pod_ips, mappings, batch_size):
     return _timed_rounds(dispatch, batch_size)
 
 
+def _adaptive_disclosure(acl, nat, route):
+    """Drive the GOVERNED production runner briefly at a saturating
+    queued load and report its chosen-K histogram and in-flight depth,
+    so every BENCH artifact discloses the adaptive configuration next
+    to the pick rule (the headline shape alone no longer identifies
+    the shipping config — the governor picks K per admit)."""
+    from vpp_tpu.datapath import DataplaneRunner, NativeRing, VxlanOverlay
+    from vpp_tpu.ops.packets import ip_to_u32
+    from vpp_tpu.testing.frames import build_frame
+
+    rx, tx, local, host = (
+        NativeRing(arena_bytes=96 << 20, max_frames=1 << 17) for _ in range(4)
+    )
+    runner = DataplaneRunner(
+        acl=acl, nat=nat, route=route,
+        overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"),
+                             local_node_id=1),
+        source=rx, tx=tx, local=local, host=host,
+        # The production defaults: adaptive coalesce to the 256 ceiling
+        # under the 600 µs added-latency SLO, 2-deep in-flight window.
+        prewarm=True,
+    )
+    rng = random.Random(7)
+    wave = [
+        build_frame(f"10.1.1.{rng.randrange(2, 250)}",
+                    f"10.1.1.{rng.randrange(2, 250)}",
+                    6, rng.randrange(1024, 65535), 80)
+        for _ in range(16384)
+    ]
+    max_depth = 0
+    for _ in range(3):
+        rx.send(wave)
+        while len(rx) or runner._inflight:
+            runner.poll()
+            max_depth = max(max_depth, len(runner._inflight))
+    gov = runner.governor.snapshot()
+    return {
+        "coalesce": "adaptive",
+        "ceiling": gov["ceiling"],
+        "slo_us": gov["slo_us"],
+        "max_inflight": runner.max_inflight,
+        "max_inflight_depth_observed": max_depth,
+        "chosen_k_histogram": gov["k_histogram"],
+        "slo_breaches": gov["slo_breaches"],
+        "floor_us": gov["floor_us"],
+        "vec_us": gov["vec_us"],
+    }
+
+
 def main():
     acl, nat, route, _, pod_ips, mappings = build_stress_state()
 
@@ -244,11 +293,12 @@ def main():
         ),
     }
     # Pick rule (VERDICT r4 item 3): the HEADLINE is the PRODUCTION
-    # configuration — flat-safe at the runner's shipping coalesce
-    # (max_vectors=64), the config the agent actually runs (the latency
-    # budget holds K=64; see DataplaneRunner's max_vectors rationale).
-    # The best-of-all-configs number is reported separately as
-    # `capability` — what the chip can do when latency is no object
+    # dispatch SHAPE — flat-safe at 64×256, the SLO-holding operating
+    # point the shipping adaptive governor converges to at the
+    # reference load (the governor's ceiling is 256; what it actually
+    # dispatched is disclosed in the `adaptive` block below).  The
+    # best-of-all-configs number is reported separately as
+    # `capability` — what the chip does when latency is no object
     # (K=256), never the quoted figure.
     results = {name: fn() for name, fn in configs.items()}
     production = "flatsafe-64x256"
@@ -283,6 +333,8 @@ def main():
     p50, _p99 = sample_dispatch_latency(dispatch)
     p50_us = p50 * 1e6
 
+    adaptive = _adaptive_disclosure(acl, nat, route)
+
     print(
         json.dumps(
             {
@@ -295,11 +347,15 @@ def main():
                 "peak_mpps": round(peak, 1),
                 "min_mpps": round(low, 1),
                 "rounds": 5,
-                "pick_rule": "the headline is the SHIPPING configuration "
-                             "(flat-safe, max_vectors=64), median over 5 "
-                             "timed rounds, one process; `capability` is "
-                             "the best configuration's median, reported "
-                             "separately and never quoted as the headline",
+                "pick_rule": "the headline is the shipping dispatch SHAPE "
+                             "(flat-safe, 64x256 — the SLO-holding "
+                             "operating point the adaptive governor "
+                             "converges to at the reference load; see the "
+                             "`adaptive` block for what it dispatched), "
+                             "median over 5 timed rounds, one process; "
+                             "`capability` is the best configuration's "
+                             "median, reported separately and never quoted "
+                             "as the headline",
                 "capability": {
                     "config": best_name,
                     "median": round(cap_median, 1),
@@ -315,6 +371,13 @@ def main():
                 "worst_added_latency_us_at_40mpps_flatsafe64": round(
                     64 * VECTOR_SIZE / 40.0 + p50_us, 1
                 ),
+                # The SHIPPING config is now the adaptive governor (the
+                # 64x256 headline shape is the SLO-holding operating
+                # point it converges to at the reference load): the
+                # chosen-K histogram + in-flight depth of a governed
+                # saturating run disclose what the runner actually
+                # dispatched.
+                "adaptive": adaptive,
             }
         )
     )

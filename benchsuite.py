@@ -319,7 +319,9 @@ def latency(iters):
     same-host-memory figure; across a host<->TPU link the honest
     budget is the measured dispatch latency itself — reported here so
     the headline can be stated as "X Mpps within Y us" and the
-    runner's production max_vectors default is chosen from data."""
+    coalesce governor's SLO default (and ceiling) is chosen from
+    data (the static max_vectors pick this sweep used to anchor is
+    now the governor's per-admit decision)."""
     import jax
 
     from vpp_tpu.ops.pipeline import (

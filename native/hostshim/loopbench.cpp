@@ -44,7 +44,8 @@ HsLoop* hs_loop_new(HsRing* rx, HsRing* tx_remote, HsRing* tx_local,
 void hs_loop_free(HsLoop* lp);
 int32_t hs_loop_admit(HsLoop* lp, int32_t slot_idx, uint32_t* src_ip,
                       uint32_t* dst_ip, int32_t* protocol, int32_t* src_port,
-                      int32_t* dst_port, int32_t* k_out, uint64_t* counters);
+                      int32_t* dst_port, int32_t* k_out, uint64_t* counters,
+                      int32_t k_cap);
 int32_t hs_loop_harvest(HsLoop* lp, int32_t slot_idx, const uint8_t* allowed,
                         const uint32_t* new_src, const uint32_t* new_dst,
                         const int32_t* new_sport, const int32_t* new_dport,
@@ -214,7 +215,7 @@ int main(int argc, char** argv) {
       uint64_t a0 = __rdtsc();
       int32_t n = hs_loop_admit(lp, 0, src_ip.data(), dst_ip.data(),
                                 proto.data(), sport.data(), dport.data(), &k,
-                                admit_c);
+                                admit_c, /*k_cap=*/0);
       uint64_t a1 = __rdtsc();
       if (n <= 0) break;
       for (int32_t i = 0; i < n; ++i) {  // vectorizable verdict/route

@@ -466,14 +466,21 @@ int32_t admit_impl(HsLoop* lp, int32_t slot_idx, uint32_t* src_ip,
                    uint32_t* dst_ip, int32_t* protocol, int32_t* src_port,
                    int32_t* dst_port, int32_t* k_out, uint64_t* counters,
                    const RouteParams* rp, int32_t* route_tag,
-                   int32_t* node_id) {
+                   int32_t* node_id, int32_t k_cap = 0) {
   Slot& slot = lp->slots[slot_idx];
   if (slot.live) {
     *k_out = 1;
     return -1;
   }
   slot.n = 0;
-  uint32_t budget = lp->batch_size * lp->max_vectors;
+  // Per-admit vector cap from the coalesce governor (0 = uncapped):
+  // bounds both the ring read budget and the pow2 bucket below, so an
+  // SLO-capped admit leaves the excess backlog queued for the next
+  // in-flight slot instead of over-filling this one.
+  uint32_t cap = lp->max_vectors;
+  if (k_cap > 0 && static_cast<uint32_t>(k_cap) < cap)
+    cap = static_cast<uint32_t>(k_cap);
+  uint32_t budget = lp->batch_size * cap;
   uint64_t decapped = 0, foreign = 0;
   uint32_t consumed = 0;
   {
@@ -605,7 +612,7 @@ int32_t admit_impl(HsLoop* lp, int32_t slot_idx, uint32_t* src_ip,
   // bucketed to a power of two (bounded jit recompiles).
   int32_t k = 1;
   while (static_cast<uint32_t>(k) * lp->batch_size < static_cast<uint32_t>(n) &&
-         static_cast<uint32_t>(k) < lp->max_vectors)
+         static_cast<uint32_t>(k) < cap)
     k *= 2;
   *k_out = k;
   int32_t padded = k * static_cast<int32_t>(lp->batch_size);
@@ -818,12 +825,15 @@ int32_t harvest_impl(HsLoop* lp, int32_t slot_idx, const uint8_t* allowed,
 
 extern "C" {
 
+// k_cap: per-admit pow2 vector cap from the coalesce governor
+// (0 = uncapped, the historical behavior).
 int32_t hs_loop_admit(HsLoop* lp, int32_t slot_idx, uint32_t* src_ip,
                       uint32_t* dst_ip, int32_t* protocol, int32_t* src_port,
-                      int32_t* dst_port, int32_t* k_out, uint64_t* counters) {
+                      int32_t* dst_port, int32_t* k_out, uint64_t* counters,
+                      int32_t k_cap) {
   return admit_impl<false>(lp, slot_idx, src_ip, dst_ip, protocol, src_port,
                            dst_port, k_out, counters, nullptr, nullptr,
-                           nullptr);
+                           nullptr, k_cap);
 }
 
 // Harvest slot `slot`: apply verdicts + rewrites in place in the rx

@@ -90,7 +90,8 @@ def test_manifest_config_parses_and_matches_dev_copy():
     embedded = "\n".join(line[4:] for line in m.group(1).rstrip().split("\n"))
     assert json.loads(embedded) == json.loads(DEV_CONF.read_text())
     cfg = NetworkConfig.from_dict(json.loads(embedded))
-    assert cfg.batch_size == 256 and cfg.max_vectors == 64
+    assert cfg.batch_size == 256 and cfg.max_vectors == 256
+    assert cfg.coalesce == "adaptive" and cfg.coalesce_prewarm
 
 
 def test_store_and_agent_processes_come_up(store_proc):

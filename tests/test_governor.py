@@ -1,0 +1,425 @@
+"""Load-adaptive vector coalescing governor (ISSUE 5 tentpole).
+
+Covers: the shared pow2 sizing rule, K monotonicity under synthetic
+backlog, the SLO-bound property across an offered-load sweep (pure
+queue simulation against the governor's real decision code), the
+native admit's per-call K cap, pow2-bucket pre-warm (no compile
+inside the timed loop, asserted on the jit cache itself), mock-engine
+verdict parity with the governor enabled at every K it selects, the
+deeper in-flight dispatch window, backlog probes, and the governor's
+observability surfaces (inspect → REST → netctl, dashboard shaping).
+"""
+
+import ipaddress
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from vpp_tpu.conf import IPAMConfig
+from vpp_tpu.datapath import (
+    CoalesceGovernor,
+    DataplaneRunner,
+    InMemoryRing,
+    NativeRing,
+    ShardedDataplane,
+    VxlanOverlay,
+    pow2_vectors,
+)
+from vpp_tpu.datapath.io import FaultInjectingSource, PcapReader, PcapWriter
+from vpp_tpu.ipam import IPAM
+from vpp_tpu.models import ProtocolType
+from vpp_tpu.ops.classify import build_rule_tables
+from vpp_tpu.ops.nat import build_nat_tables
+from vpp_tpu.ops.packets import ip_to_u32
+from vpp_tpu.ops.pipeline import make_route_config
+from vpp_tpu.policy.renderer.api import Action, ContivRule
+from vpp_tpu.testing.aclengine import Verdict, evaluate_table
+from vpp_tpu.testing.faults import FaultInjector
+from vpp_tpu.testing.frames import build_frame, frame_tuple
+
+# Egress policy: deny TCP :9, allow the rest — the SAME rule list
+# drives the TPU tables and the mock-engine oracle, so governed
+# verdicts are checked against ground truth at every K.
+_RULES = [
+    ContivRule(action=Action.DENY, protocol=ProtocolType.TCP, dst_port=9),
+    ContivRule(action=Action.PERMIT),
+]
+_POD = "10.1.1.3"
+
+
+def _oracle_allows(sport: int, dport: int) -> bool:
+    return evaluate_table(
+        _RULES, ipaddress.ip_address("10.1.1.2"), ipaddress.ip_address(_POD),
+        ProtocolType.TCP, sport, dport,
+    ) is Verdict.ALLOWED
+
+
+def _make_runner(ring_cls=NativeRing, **kw):
+    ipam = IPAM(IPAMConfig(), node_id=1)
+    rx, tx, local, host = (ring_cls() for _ in range(4))
+    kw.setdefault("batch_size", 8)
+    kw.setdefault("max_vectors", 8)
+    runner = DataplaneRunner(
+        acl=build_rule_tables([_RULES], {ip_to_u32(_POD): (0, 0)}),
+        nat=build_nat_tables([], snat_enabled=False, pod_subnet="10.1.0.0/16"),
+        route=make_route_config(ipam),
+        overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"),
+                             local_node_id=1),
+        source=rx, tx=tx, local=local, host=host,
+        **kw,
+    )
+    return runner, (rx, tx, local, host)
+
+
+# --------------------------------------------------------------- sizing rule
+
+
+def test_pow2_vectors_shared_rule():
+    assert pow2_vectors(0, 8, 8) == 1
+    assert pow2_vectors(1, 8, 8) == 1
+    assert pow2_vectors(8, 8, 8) == 1
+    assert pow2_vectors(9, 8, 8) == 2
+    assert pow2_vectors(17, 8, 8) == 4
+    assert pow2_vectors(33, 8, 8) == 8
+    assert pow2_vectors(10_000, 8, 8) == 8       # ceiling binds
+    assert pow2_vectors(300, 256, 256) == 2
+
+
+# ------------------------------------------------------------ decision rule
+
+
+def test_choose_k_monotone_in_backlog():
+    gov = CoalesceGovernor(batch_size=256, max_vectors=256)
+    ks = [gov.choose_k(b) for b in
+          [0, 1, 100, 256, 257, 1024, 5000, 16384, 65536, 10**6, 10**8]]
+    assert ks[0] == 1 and ks[1] == 1        # idle link ⇒ smallest vector
+    assert ks == sorted(ks)                 # deeper backlog ⇒ deeper coalesce
+    assert ks[-1] == 256                    # ceiling binds
+    assert all(k & (k - 1) == 0 for k in ks)  # pow2 buckets only
+
+
+def test_slo_cap_bounds_k_when_queue_does_not_demand_more():
+    gov = CoalesceGovernor(batch_size=256, max_vectors=256, slo_us=600.0,
+                           window=1)
+    # Teach the model floor=100µs, vec=10µs with two exact samples.
+    for _ in range(8):
+        gov.observe(1, 110e-6)
+        gov.observe(64, 740e-6)
+    assert gov.floor_us == pytest.approx(100.0, rel=0.05)
+    assert gov.vec_us == pytest.approx(10.0, rel=0.05)
+    # Largest pow2 with 100 + 10K <= 600 is K=32 (K=64 → 740 > 600).
+    assert gov.slo_cap() == 32
+    breaches0 = gov.slo_breaches
+    # Backlog below the cap: backlog rules, no breach.
+    assert gov.choose_k(8 * 256) == 8
+    assert gov.slo_breaches == breaches0
+    # Backlog beyond the cap: clamping would grow the queue — follow
+    # the backlog to the ceiling and account the breach.
+    assert gov.choose_k(256 * 256) == 256
+    assert gov.slo_breaches == breaches0 + 1
+
+
+def test_slo_cap_shrinks_with_inflight_window_depth():
+    """A frame admitted into a W-deep window harvests behind W-1
+    predecessors: deepening the window must SHRINK the per-dispatch
+    cap, not silently multiply the latency budget."""
+    caps = {}
+    for window in (1, 2, 4):
+        gov = CoalesceGovernor(batch_size=256, max_vectors=256,
+                               slo_us=600.0, window=window)
+        for _ in range(8):
+            gov.observe(1, 110e-6)
+            gov.observe(64, 740e-6)
+        caps[window] = gov.slo_cap()
+    # floor=100 vec=10: W=1 → 100+10K<=600 → 32; W=2 → <=300 → 16;
+    # W=4 → <=150 → 4.
+    assert caps == {1: 32, 2: 16, 4: 4}
+
+
+def test_fixed_mode_restores_static_cap():
+    gov = CoalesceGovernor(batch_size=256, max_vectors=64, enabled=False)
+    assert gov.choose_k(0) == 64
+    assert gov.choose_k(10**6) == 64
+
+
+def test_ramp_for_depth_blind_sources():
+    gov = CoalesceGovernor(batch_size=256, max_vectors=64)
+    assert gov.choose_k(-1) == 1            # unknown depth starts small
+    gov.admitted(256, 1)                    # saturated its cap…
+    assert gov.choose_k(-1) == 2            # …ramp doubles
+    gov.admitted(512, 2)
+    assert gov.choose_k(-1) == 4
+    gov.admitted(100, 4)                    # under half full…
+    assert gov.choose_k(-1) == 1            # …ramp decays to what fit
+
+
+def test_slo_property_across_offered_load_sweep():
+    """SLO-bound property: simulate arrivals at each offered load
+    against the governor's real decision code with service
+    t(K) = floor + K·vec (serial dispatches, so window=1).  For every
+    load some in-SLO K can sustain, the steady-state dispatch service
+    stays under the budget; overload drives K to the ceiling
+    (throughput first, breaches accounted)."""
+    V, floor_s, vec_s, slo_us = 256, 150e-6, 5e-6, 600.0
+
+    def t(k):
+        return floor_s + k * vec_s
+
+    sustainable = []  # loads (frames/s) some in-SLO K sustains
+    k = 1
+    while k <= 256:
+        if t(k) * 1e6 <= slo_us:
+            sustainable.append(0.8 * k * V / t(k))
+        k *= 2
+    overload = 2 * 256 * V / t(256)
+
+    for lam in sustainable + [overload]:
+        gov = CoalesceGovernor(batch_size=V, max_vectors=256, slo_us=slo_us,
+                               window=1)
+        backlog, chosen = 0.0, []
+        for _ in range(400):
+            k = gov.choose_k(int(backlog))
+            service = t(k)
+            gov.observe(k, service)
+            backlog = max(0.0, backlog - k * V) + lam * service
+            chosen.append(k)
+        steady = chosen[200:]
+        if lam is not overload:
+            # Added latency (the dispatch service of every steady-state
+            # pick) holds the budget, with no queue blow-up.
+            assert all(t(k) * 1e6 <= slo_us for k in steady), (lam, steady[-5:])
+            assert backlog <= 2 * max(steady) * V, (lam, backlog)
+            assert gov.slo_breaches == 0
+        else:
+            assert max(steady) == 256       # ceiling engaged under overload
+            assert gov.slo_breaches > 0     # and honestly accounted
+
+
+# ----------------------------------------------------------- native k cap
+
+
+def test_native_admit_honors_governor_k_cap():
+    from vpp_tpu.shim.hostshim import NativeLoop
+
+    rx, txr, txl, txh = (NativeRing() for _ in range(4))
+    loop = NativeLoop(rx, txr, txl, txh, batch_size=8, max_vectors=8,
+                      vni=10, n_slots=3)
+    frames = [build_frame("10.1.1.2", _POD, 6, 40000 + i, 80)
+              for i in range(64)]
+    rx.send(frames)
+    c = np.zeros(NativeLoop.ADMIT_COUNTERS, dtype=np.uint64)
+    n, k, _ = loop.admit(0, c, k_cap=2)
+    assert (n, k) == (16, 2)                # capped: 2 vectors × 8
+    assert len(rx) == 48                    # excess stays queued
+    n, k, _ = loop.admit(1, c)              # uncapped pops the rest
+    assert (n, k) == (48, 8)
+    loop.close()
+
+
+def test_backlog_probes():
+    ring = InMemoryRing()
+    ring.send([b"x" * 60] * 5)
+    assert ring.backlog_hint() == 5
+    nring = NativeRing()
+    nring.send([build_frame("10.1.1.2", _POD, 6, 1, 2)] * 3)
+    assert nring.backlog_hint() == 3
+    wrapped = FaultInjectingSource(ring, FaultInjector())
+    assert wrapped.backlog_hint() == 5
+
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".pcap") as fh:
+        w = PcapWriter(fh.name)
+        w.send([b"\x00" * 60] * 4)
+        w.close()
+        rd = PcapReader(fh.name)
+        assert rd.backlog_hint() == 4
+        rd.recv_batch(3)
+        assert rd.backlog_hint() == 1
+        looped = PcapReader(fh.name, loop=True)
+        looped.recv_batch(3)
+        assert looped.backlog_hint() == 4   # replay = saturating source
+
+
+# ------------------------------------------------------------- pre-warm
+
+
+def test_prewarm_compiles_every_bucket_outside_the_timed_loop():
+    """After prewarm_buckets(), dispatching traffic at EVERY pow2 K the
+    governor can select adds no jit cache entries — no compile ever
+    happens inside the serving loop."""
+    from vpp_tpu.ops import pipeline as pl
+
+    runner, (rx, tx, local, host) = _make_runner(prewarm=True)
+    assert runner.prewarm_buckets() == 0    # ledger: already warm
+    sizes = (pl.pipeline_flat_safe_ts0_jit._cache_size(),
+             pl.pipeline_scan_ts0_jit._cache_size(),
+             pl.pipeline_step_jit._cache_size())
+    for k in (1, 2, 4, 8):
+        rx.send([build_frame("10.1.1.2", _POD, 6, 40000 + i, 80)
+                 for i in range(k * 8)])
+        runner.drain()
+    assert (pl.pipeline_flat_safe_ts0_jit._cache_size(),
+            pl.pipeline_scan_ts0_jit._cache_size(),
+            pl.pipeline_step_jit._cache_size()) == sizes
+    hist = runner.governor.k_hist
+    assert set(hist) == {1, 2, 4, 8}        # every bucket actually served
+
+
+def test_prewarm_reruns_on_table_swap_shapes():
+    runner, _ = _make_runner(prewarm=True, max_vectors=2)
+    # Same shapes: the process-global ledger makes the swap free.
+    assert runner.prewarm_buckets() == 0
+    # New table SHAPE (rule count bucket changes) ⇒ new cache keys ⇒
+    # the swap-time prewarm compiles the buckets again.
+    bigger = [_RULES[0]] * 40 + [_RULES[1]]
+    runner.update_tables(
+        acl=build_rule_tables([bigger], {ip_to_u32(_POD): (0, 0)}))
+    assert runner.prewarm_buckets() == 0    # update_tables already warmed
+
+
+# ------------------------------------------- verdict parity at every K
+
+
+@pytest.mark.parametrize("ring_cls", [NativeRing, InMemoryRing])
+def test_governed_verdict_parity_with_mock_engines_at_every_k(ring_cls):
+    """Mixed allowed/denied traffic in waves sized to make the governor
+    select K = 1, 2, 4 and 8: delivery must match the mock-engine
+    oracle exactly at every chosen K, on both engines."""
+    runner, (rx, tx, local, host) = _make_runner(ring_cls)
+    flows, expected = [], []
+    port = 40000
+    for wave_k in (1, 2, 4, 8):
+        wave = []
+        for i in range(wave_k * 8):
+            dport = 9 if i % 3 == 0 else 80
+            wave.append(("10.1.1.2", _POD, 6, port, dport))
+            if _oracle_allows(port, dport):
+                expected.append(("10.1.1.2", _POD, 6, port, dport))
+            port += 1
+        flows.append(wave)
+    for wave in flows:
+        rx.send([build_frame(*f) for f in wave])
+        runner.drain()
+    delivered = sorted(frame_tuple(f) for f in local.recv_batch(1 << 12))
+    assert delivered == sorted(expected)
+    assert set(runner.governor.k_hist) == {1, 2, 4, 8}
+    assert runner.counters.dropped_denied == sum(
+        len(w) for w in flows) - len(expected)
+
+
+# ------------------------------------------------- in-flight window depth
+
+
+def test_deeper_inflight_window_admits_ahead():
+    runner, (rx, *_rest) = _make_runner(
+        InMemoryRing, max_vectors=1, max_inflight=4)
+    rx.send([build_frame("10.1.1.2", _POD, 6, 40000 + i, 80)
+             for i in range(64)])
+    runner.poll()
+    # One poll admits up to the 4-deep window, then harvests the oldest:
+    # three dispatches remain outstanding behind it.
+    assert len(runner._inflight) == 3
+    runner.drain()
+    assert runner.counters.batches == 8
+
+
+def test_inflight_window_resizes_native_loop():
+    runner, (rx, tx, local, host) = _make_runner()
+    assert runner._n_slots == 3
+    runner.max_inflight = 4
+    assert runner._n_slots == 5 and runner.governor.window == 4
+    rx.send([build_frame("10.1.1.2", _POD, 6, 40000 + i, 80)
+             for i in range(8)])
+    assert runner.drain() == 8              # rebuilt loop still serves
+    rx.send([build_frame("10.1.1.2", _POD, 6, 41000, 80)])
+    runner._admit()                         # one batch in flight
+    with pytest.raises(RuntimeError):
+        runner.max_inflight = 2             # resize under traffic refused
+    runner._harvest()
+
+
+# ------------------------------------------------------- python satellite
+
+
+def test_python_admit_single_copy_counter():
+    runner, (rx, *_rest) = _make_runner(InMemoryRing)
+    frames = [build_frame("10.1.1.2", _POD, 6, 40000 + i, 80)
+              for i in range(16)]
+    total = sum(len(f) for f in frames)
+    rx.send(frames)
+    runner.drain()
+    # The packed buffer is built writable in ONE pass now; the counter
+    # records the bytes the old join+copy would have duplicated.
+    assert runner.counters.admit_copy_saved_bytes == total
+    assert runner.metrics()["datapath_admit_copy_saved_bytes_total"] == total
+
+
+# ------------------------------------------------------- observability
+
+
+def test_governor_state_in_inspect_rest_netctl_and_dashboard():
+    import io as _io
+    import json
+
+    from vpp_tpu.netctl.cli import main as netctl_main
+    from vpp_tpu.rest.server import AgentRestServer
+    from vpp_tpu.uibackend.views import shape_dispatch
+
+    runner, (rx, *_rest) = _make_runner()
+    rx.send([build_frame("10.1.1.2", _POD, 6, 40000 + i, 80)
+             for i in range(32)])
+    runner.drain()
+    gov = runner.inspect()["dispatch"]["governor"]
+    assert gov["enabled"] and gov["ceiling"] == 8
+    assert gov["k_histogram"] == {"4": 1}
+    rest = AgentRestServer(node_name="n1", datapath=runner)
+    port = rest.start()
+    try:
+        import urllib.request
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/contiv/v1/inspect") as resp:
+            remote = json.loads(resp.read())
+        assert remote["dispatch"]["governor"]["k_histogram"] == {"4": 1}
+        out = _io.StringIO()
+        assert netctl_main(
+            ["inspect", "--server", f"127.0.0.1:{port}"], out=out) == 0
+        text = out.getvalue()
+        assert "governor: adaptive" in text and "K-hist: 4:1" in text
+    finally:
+        rest.stop()
+    panel = shape_dispatch(runner.inspect())
+    assert panel["governor"]["mode"] == "adaptive"
+    assert panel["governor"]["k_histogram"] == {"4": 1}
+    assert panel["max_vectors"] == 8
+    assert shape_dispatch(None) == {}
+
+
+def test_sharded_inspect_merges_governor_histograms():
+    ios = [tuple(NativeRing() for _ in range(4)) for _ in range(2)]
+    ipam = IPAM(IPAMConfig(), node_id=1)
+    dp = ShardedDataplane(
+        acl=build_rule_tables([_RULES], {ip_to_u32(_POD): (0, 0)}),
+        nat=build_nat_tables([], snat_enabled=False,
+                             pod_subnet="10.1.0.0/16"),
+        route=make_route_config(ipam),
+        overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"),
+                             local_node_id=1),
+        shard_ios=ios, batch_size=8, max_vectors=4,
+    )
+    try:
+        for i, io_set in enumerate(ios):
+            io_set[0].send([build_frame("10.1.1.2", _POD, 6,
+                                        40000 + 100 * i + j, 80)
+                            for j in range(16)])
+        dp.drain()
+        gov = dp.inspect()["dispatch"]["governor"]
+        assert gov["k_histogram"] == {"2": 2}   # one K=2 dispatch per shard
+        assert gov["per_shard_k"] and len(gov["per_shard_backlog"]) == 2
+        metrics = dp.metrics()
+        assert "datapath_governor_slo_breaches_total" in metrics
+    finally:
+        dp.close()

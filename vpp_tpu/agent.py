@@ -212,6 +212,10 @@ class Agent:
             batch_size=self.config.batch_size,
             max_vectors=self.config.max_vectors,
             dispatch=self.config.dispatch,
+            coalesce=self.config.coalesce,
+            coalesce_slo_us=self.config.coalesce_slo_us,
+            prewarm=self.config.coalesce_prewarm,
+            max_inflight=self.config.max_inflight,
         )
         # Hook FIRST, then pull whatever the renderers have already
         # compiled — a table compiled in between fires the hook, so no

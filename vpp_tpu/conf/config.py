@@ -117,9 +117,12 @@ class NetworkConfig:
     # NAT-pipeline vector size: packets per classify->rewrite vector
     # (VPP's vector size).
     batch_size: int = 256
-    # Vectors the datapath runner may coalesce into one device program
-    # (pow2-floored; sessions thread vector-to-vector on device).
-    max_vectors: int = 64
+    # Coalesce CEILING: the most vectors the runner may fuse into one
+    # device program (pow2-floored; sessions thread vector-to-vector
+    # on device).  The per-admit pick under it comes from the coalesce
+    # governor, so the ceiling sits in the capability band (256) —
+    # VPP's adaptive vector size, not a fixed operating point.
+    max_vectors: int = 256
     # Multi-vector dispatch discipline: "auto" picks from the measured
     # per-backend orderings (as of r4: flat-safe on every backend —
     # the commit-first restructure reversed r3's CPU ordering, see
@@ -127,6 +130,20 @@ class NetworkConfig:
     # node, the same trace-time pattern as the NAT lookup-discipline
     # gate (use_hmap).
     dispatch: str = "auto"
+    # Coalesce governor: "adaptive" picks the per-admit pow2 K from
+    # the measured ingress backlog under the added-latency SLO below;
+    # "fixed" restores the static cap (always admit up to the ceiling).
+    coalesce: str = "adaptive"
+    # Added-latency budget (µs) the governor holds when the link is
+    # not saturated: the r5 latency record's production budget (K=64
+    # worst added latency ~559 µs at the 40 Mpps reference load).
+    coalesce_slo_us: float = 600.0
+    # Compile every pow2 K bucket up to the ceiling at start and on
+    # every table swap, so a load spike never stalls on the jit.
+    coalesce_prewarm: bool = True
+    # In-flight dispatch window: outstanding device dispatches the host
+    # may run ahead of the oldest unharvested batch.
+    max_inflight: int = 2
 
     @classmethod
     def from_dict(cls, data: Optional[dict]) -> "NetworkConfig":
@@ -140,8 +157,12 @@ class NetworkConfig:
             interface=InterfaceConfig(other_interfaces=others, **iface_data),
             routing=RoutingConfig(**data.get("routing", {})),
             batch_size=data.get("batch_size", 256),
-            max_vectors=data.get("max_vectors", 64),
+            max_vectors=data.get("max_vectors", 256),
             dispatch=data.get("dispatch", "auto"),
+            coalesce=data.get("coalesce", "adaptive"),
+            coalesce_slo_us=data.get("coalesce_slo_us", 600.0),
+            coalesce_prewarm=data.get("coalesce_prewarm", True),
+            max_inflight=data.get("max_inflight", 2),
         )
 
     def overlay(self, **kw) -> "NetworkConfig":

@@ -10,6 +10,7 @@ With NativeRing endpoints the admit/harvest loop runs in C++
 per-packet.
 """
 
+from .governor import CoalesceGovernor, pow2_vectors
 from .io import (
     AfPacketIO,
     FaultInjectingSource,
@@ -31,6 +32,7 @@ from .shards import ShardedDataplane, ShardHealth
 
 __all__ = [
     "AfPacketIO",
+    "CoalesceGovernor",
     "DataplaneRunner",
     "DeviceSessionState",
     "FaultInjectingSource",
@@ -45,4 +47,5 @@ __all__ = [
     "ShardedDataplane",
     "TableSwapError",
     "VxlanOverlay",
+    "pow2_vectors",
 ]

@@ -1,0 +1,236 @@
+"""Load-adaptive vector coalescing governor.
+
+VPP's core scheduling insight is that the vector size *adapts to
+load*: frames accumulate while the previous vector is in flight, so
+per-dispatch fixed costs amortise exactly when throughput matters and
+vectors stay small (low latency) when the link is quiet (SURVEY §6).
+The runner's admit has always been backlog-shaped — it dispatches the
+power-of-two bucket of whatever the ring holds — but the CAP was a
+static ``max_vectors=64``, the largest coalesce whose *fixed-K* fill
+latency held the budget.  That cap leaves the 400+ Mpps capability
+band (K=256, NATPROFILE_r05: the production dispatch is
+dispatch-floor-bound; device compute is essentially free) on the
+table at exactly the loads where latency is already queue-dominated.
+
+The governor replaces the static pick with a per-admit decision:
+
+- **Backlog term.**  ``K_fill`` = the pow2 vector count covering the
+  measured ingress backlog.  Frames already queued pay no extra fill
+  wait for a deeper coalesce — they are *there* — so deep backlog ⇒
+  large K (amortising the dispatch floor *reduces* their latency),
+  idle link ⇒ K=1.
+- **SLO term.**  An online exponentially-weighted least-squares fit
+  of the dispatch time model ``t(K) = floor + K·vec`` (the dispatch
+  floor and per-vector service time, learned from harvest timings).
+  ``K_slo`` = the largest pow2 whose predicted *added latency* —
+  service time times the in-flight window depth a frame may wait
+  behind — stays under the configured budget.  The governor
+  speculates above the backlog only never; it CAPS at ``K_slo`` when
+  the queue does not already demand more.
+- **Breach accounting.**  When backlog demands more than ``K_slo``
+  allows, clamping would only grow the queue (and with it latency):
+  the governor follows the backlog up to the ceiling and counts an
+  ``slo_breach`` — saturation is reported, not hidden.
+
+The same pow2 bucketing as the fixed cap bounds jit recompiles, and
+:meth:`DataplaneRunner.prewarm_buckets` compiles every bucket up to
+the ceiling at start/table-swap time so a load spike never stalls on
+compilation (see ``_PREWARMED``).
+
+HyperNAT (arXiv:2111.08193) makes the same amortise-the-fixed-
+offload-cost argument for SmartNIC NAT; RVH (arXiv:1909.07159) shows
+classification batching frontiers are load-dependent — the right K is
+a function of offered load, not a constant.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+def pow2_vectors(n_frames: int, batch_size: int, cap: int) -> int:
+    """The power-of-two vector count whose ``k * batch_size`` covers
+    ``n_frames``, capped at ``cap`` (bounded jit recompiles).  The ONE
+    sizing rule shared by the runner's admits, the quarantine's
+    sub-batch packer, and the governor."""
+    k = 1
+    while k * batch_size < n_frames and k < cap:
+        k *= 2
+    return k
+
+
+# Process-global pre-warm ledger: jit caches are per process, so once
+# ONE runner (or shard) has compiled a (discipline, table-shape, K)
+# bucket every other runner hits it — re-executing the warm dispatch
+# per shard would just burn device time.  Keyed by the abstract shapes
+# only; values never enter.
+_PREWARMED: set = set()
+
+
+class CoalesceGovernor:
+    """Per-runner (per-shard) admit-time K picker.
+
+    Not thread-safe by itself: each :class:`DataplaneRunner` owns one
+    instance and calls it only from its own poll thread (the sharded
+    engine gives every shard its own governor, like its own rings).
+    """
+
+    def __init__(
+        self,
+        batch_size: int,
+        max_vectors: int,
+        slo_us: float = 600.0,
+        window: int = 2,
+        alpha: float = 0.05,
+        enabled: bool = True,
+    ):
+        self.batch_size = batch_size
+        self.max_vectors = max_vectors    # the pow2 ceiling
+        self.slo_us = slo_us
+        self.window = max(1, window)      # in-flight depth a frame may wait behind
+        self.alpha = alpha
+        self.enabled = enabled
+        # Exponentially-weighted least squares for t(K) = floor + K*vec
+        # (seconds).  Accumulators decay by (1-alpha) per observation.
+        self._s1 = 0.0
+        self._sk = 0.0
+        self._skk = 0.0
+        self._st = 0.0
+        self._skt = 0.0
+        self.floor_us: Optional[float] = None
+        self.vec_us: Optional[float] = None
+        # Ramp state for depth-blind sources (AF_PACKET reports only
+        # next-frame presence): grow K while admits saturate their cap,
+        # decay when they come back less than half full.
+        self._ramp_k = 1
+        # Observability.
+        self.current_k = 1
+        self.backlog = 0
+        self.decisions = 0
+        self.slo_breaches = 0
+        self.k_hist: Dict[int, int] = {}
+        self.samples = 0
+
+    # ------------------------------------------------------------ model
+
+    def observe(self, k: int, seconds: float) -> None:
+        """Feed one measured (K, per-dispatch wall seconds) sample into
+        the EW least-squares fit."""
+        if seconds <= 0.0 or k <= 0:
+            return
+        d = 1.0 - self.alpha
+        self._s1 = self._s1 * d + 1.0
+        self._sk = self._sk * d + k
+        self._skk = self._skk * d + k * k
+        self._st = self._st * d + seconds
+        self._skt = self._skt * d + k * seconds
+        self.samples += 1
+        det = self._s1 * self._skk - self._sk * self._sk
+        mean_t = self._st / self._s1
+        mean_k = self._sk / self._s1
+        if det > 1e-12 and self._skk / self._s1 > mean_k * mean_k * (1 + 1e-9):
+            slope = (self._s1 * self._skt - self._sk * self._st) / det
+            intercept = mean_t - slope * mean_k
+            # A dispatch has a physical floor >= 0 and vectors cannot
+            # take negative time; clamp the fit to the feasible cone
+            # (tiny-sample noise can put it outside).
+            slope = max(0.0, slope)
+            intercept = max(0.0, min(intercept, mean_t))
+            self.vec_us = slope * 1e6
+            self.floor_us = intercept * 1e6
+        else:
+            # Degenerate: every sample at the same K — attribute the
+            # mean to the floor at that K, leave the slope unknown.
+            if self.vec_us is None:
+                self.floor_us = mean_t * 1e6
+            else:
+                self.floor_us = max(0.0, mean_t * 1e6 - mean_k * self.vec_us)
+
+    def predict_us(self, k: int) -> Optional[float]:
+        """Predicted wall time of one K-vector dispatch (µs), or None
+        before any timing has been observed."""
+        if self.floor_us is None:
+            return None
+        return self.floor_us + k * (self.vec_us or 0.0)
+
+    def slo_cap(self) -> int:
+        """Largest pow2 K (≤ ceiling) whose predicted ADDED latency
+        fits the budget: one dispatch's service time times the
+        in-flight window depth, because a frame admitted into a full
+        window harvests behind window-1 predecessors' dispatches.
+        Deepening ``max_inflight`` therefore SHRINKS the cap — the
+        governor compensates for deeper pipelining instead of silently
+        multiplying the budget.  (Queue wait before admission is the
+        backlog term's business, not this cap's.)  Optimistic
+        (= ceiling) until the model has data."""
+        if self.floor_us is None or self.slo_us <= 0:
+            return self.max_vectors
+        k = 1
+        while k * 2 <= self.max_vectors and \
+                (self.predict_us(k * 2) or 0.0) * self.window <= self.slo_us:
+            k *= 2
+        return k
+
+    # --------------------------------------------------------- decision
+
+    def choose_k(self, backlog: int) -> int:
+        """Pick the pow2 vector cap for the next admit from the
+        measured ingress backlog depth (``backlog < 0`` = source cannot
+        report depth; the saturation ramp stands in)."""
+        if not self.enabled:
+            self.current_k = self.max_vectors
+            return self.max_vectors
+        self.decisions += 1
+        if backlog is None or backlog < 0:
+            k_fill = self._ramp_k
+            self.backlog = -1
+        else:
+            self.backlog = int(backlog)
+            k_fill = pow2_vectors(max(1, self.backlog), self.batch_size,
+                                  self.max_vectors)
+        cap = self.slo_cap()
+        if k_fill <= cap:
+            k = k_fill
+        else:
+            # Queueing already dominates: clamping K below the backlog
+            # would grow the queue and with it every frame's latency —
+            # follow the backlog to the ceiling and account the breach.
+            k = min(k_fill, self.max_vectors)
+            pred = self.predict_us(k)
+            if pred is not None and pred * self.window > self.slo_us:
+                self.slo_breaches += 1
+        self.current_k = k
+        return k
+
+    def admitted(self, n_frames: int, k_cap: int) -> None:
+        """Post-admit feedback: records the chosen bucket and drives
+        the depth-blind ramp (saturated cap ⇒ double, under-half ⇒
+        halve)."""
+        k_used = pow2_vectors(max(1, n_frames), self.batch_size, k_cap)
+        if n_frames > 0:
+            self.k_hist[k_used] = self.k_hist.get(k_used, 0) + 1
+        if n_frames >= k_cap * self.batch_size:
+            self._ramp_k = min(self.max_vectors, max(self._ramp_k, k_cap) * 2)
+        elif n_frames * 2 < k_cap * self.batch_size:
+            self._ramp_k = max(1, k_used)
+
+    # ---------------------------------------------------- observability
+
+    def snapshot(self) -> Dict[str, object]:
+        return {
+            "enabled": self.enabled,
+            "slo_us": self.slo_us,
+            "ceiling": self.max_vectors,
+            "window": self.window,
+            "current_k": self.current_k,
+            "backlog": self.backlog,
+            "floor_us": round(self.floor_us, 1)
+            if self.floor_us is not None else None,
+            "vec_us": round(self.vec_us, 3)
+            if self.vec_us is not None else None,
+            "slo_cap": self.slo_cap(),
+            "decisions": self.decisions,
+            "slo_breaches": self.slo_breaches,
+            "samples": self.samples,
+            "k_histogram": {str(k): v for k, v in sorted(self.k_hist.items())},
+        }

@@ -53,6 +53,15 @@ class FaultInjectingSource:
     def __len__(self) -> int:
         return len(self.source)  # type: ignore[arg-type]
 
+    def backlog_hint(self) -> int:
+        hint = getattr(self.source, "backlog_hint", None)
+        if hint is not None:
+            return int(hint())
+        try:
+            return len(self.source)  # type: ignore[arg-type]
+        except TypeError:
+            return -1
+
     def recv_batch(self, max_frames: int) -> List[bytes]:
         from ..testing.faults import SITE_FRAME_SOURCE_ERROR
 
@@ -81,6 +90,10 @@ class InMemoryRing:
         self.dropped = 0
 
     def __len__(self) -> int:
+        return len(self._dq)
+
+    def backlog_hint(self) -> int:
+        """Queued frame count (the coalesce governor's depth probe)."""
         return len(self._dq)
 
     def send(self, frames: Sequence[bytes]) -> None:
@@ -151,6 +164,13 @@ class PcapReader:
         out = self._frames[self._pos:self._pos + max_frames]
         self._pos += len(out)
         return out
+
+    def backlog_hint(self) -> int:
+        """Frames left in the replay (a looping reader always reports
+        full depth — replay IS a saturating source)."""
+        if self.loop:
+            return len(self._frames)
+        return max(0, len(self._frames) - self._pos)
 
 
 class PcapWriter:
@@ -238,6 +258,21 @@ class AfPacketIO:
             if frame:
                 out.append(frame)
         return out
+
+    def backlog_hint(self) -> int:
+        """AF_PACKET cannot report queue DEPTH — SIOCINQ on a packet
+        socket returns only the next frame's size.  Report 0 (idle) vs
+        -1 (frames pending, depth unknown): the governor's saturation
+        ramp takes over for depth-blind sources."""
+        import fcntl
+
+        try:
+            buf = struct.pack("i", 0)
+            pending = struct.unpack(
+                "i", fcntl.ioctl(self._sock.fileno(), 0x541B, buf))[0]
+        except OSError:
+            return -1
+        return 0 if pending == 0 else -1
 
     def send(self, frames: Sequence[bytes]) -> None:
         for f in frames:

@@ -22,6 +22,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import threading
+import time
 from typing import Callable, Deque, Dict, Optional, Tuple
 
 import numpy as np
@@ -55,6 +56,7 @@ from ..testing.faults import (
     FaultInjected,
     FaultInjector,
 )
+from .governor import _PREWARMED, CoalesceGovernor, pow2_vectors
 from .io import FrameSink, FrameSource
 from .trace import PacketTracer
 
@@ -179,6 +181,10 @@ class RunnerCounters:
     quarantined_batches: int = 0
     dropped_poisoned: int = 0
     swap_rollbacks: int = 0
+    # Bytes the python admit did NOT copy a second time since the
+    # packed buffer became single-pass writable (bytearray join): the
+    # old np.frombuffer(join).copy() duplicated every batch.
+    admit_copy_saved_bytes: int = 0
 
     def as_dict(self) -> Dict[str, int]:
         return {f"datapath_{k}_total": v for k, v in dataclasses.asdict(self).items()}
@@ -199,19 +205,36 @@ class DataplaneRunner:
         local: Optional[FrameSink] = None,
         host: Optional[FrameSink] = None,
         batch_size: int = 256,
-        # Production coalesce default, chosen from BENCHLAT_r03 +
-        # BENCHSWEEP_r03: K=64 (16384 pkts/dispatch) is the smallest
-        # power-of-two coalesce whose production dispatch clears the
-        # 40 Mpps baseline (flat-safe ~62, scan ~48-72 sustained), and
-        # its latency cost stays sub-millisecond — p50 dispatch latency
-        # is ~266 us (tunnel-round-trip dominated, nearly independent
-        # of size), so worst-case added latency at 40 Mpps offered load
-        # is fill (410 us) + dispatch (266 us) ~= 0.7 ms.  K=16 fills
-        # faster (102 us) but sustains a fraction of that; K=256
-        # sustains 200+ Mpps but its 1.6 ms fill at 40 Mpps (65 ms at
-        # 1 Mpps!) blows any latency budget at low load.
-        max_vectors: int = 64,
+        # max_vectors is the coalesce CEILING, not the pick: the
+        # governor (datapath/governor.py) chooses the per-admit pow2 K
+        # from the measured backlog depth under the added-latency SLO,
+        # so the ceiling can sit in the capability band (K=256 sustains
+        # 425-480 Mpps on the tunnel, NATPROFILE_r05/BENCHLAT_r05)
+        # without the fixed-K latency pathology that forced the old
+        # static 64 (K=256's 1.6 ms fill at 40 Mpps offered — 65 ms at
+        # 1 Mpps! — blew every budget at low load).  An idle link still
+        # dispatches K=1; only a deep queue earns a deep coalesce.
+        max_vectors: int = 256,
+        # In-flight dispatch window: how many outstanding device
+        # dispatches host admit/parse may run ahead of the oldest
+        # unharvested batch (VPP's in-flight vector discipline,
+        # generalised from the historical fixed 2).  Deeper windows
+        # overlap more host work with device time on floor-bound links;
+        # the governor folds the depth into its SLO math (a frame may
+        # wait behind window-1 predecessors' service).
         max_inflight: int = 2,
+        # Coalesce governor: "adaptive" (default) picks K per admit
+        # from backlog + EWMA dispatch-time estimates under
+        # coalesce_slo_us of added latency; "fixed" restores the
+        # static-cap behavior (always admit up to the ceiling).
+        coalesce: str = "adaptive",
+        coalesce_slo_us: float = 600.0,
+        # Pre-warm: compile EVERY pow2 K bucket up to the ceiling at
+        # construction/table-swap time so a load spike never stalls on
+        # jit compilation.  Off by default (a swap-time compile burst
+        # is wrong for short-lived test runners); production agents
+        # enable it via NetworkConfig.coalesce_prewarm.
+        prewarm: bool = False,
         session_capacity: int = 1 << 16,
         # Sweeps (idle-session GC + ClientIP-affinity expiry) run every
         # sweep_interval dispatched vectors.  Affinity timeouts are
@@ -281,11 +304,12 @@ class DataplaneRunner:
         self._native = None  # set after endpoint inspection below
         self.batch_size = batch_size
         # When >1, coalesce up to max_vectors queued batch_size-packet
-        # vectors into ONE device dispatch via pipeline_scan: sessions
-        # thread between vectors on device, dispatch cost amortises
-        # K-fold.  K is bucketed to powers of two to bound recompiles,
-        # so the effective cap is the power-of-two floor of max_vectors
-        # (enforced by the property setter).
+        # vectors into ONE device dispatch: sessions thread between
+        # vectors on device, dispatch cost amortises K-fold.  K is
+        # bucketed to powers of two to bound recompiles, so the
+        # effective ceiling is the power-of-two floor of max_vectors
+        # (enforced by the property setter); the governor picks the
+        # per-admit K under it.
         self.max_vectors = max_vectors
         if dispatch not in ("auto", "scan", "flat-safe"):
             raise ValueError(f"unknown dispatch discipline: {dispatch!r}")
@@ -294,7 +318,26 @@ class DataplaneRunner:
             # commit-first restructure (it used to lose on CPU).
             dispatch = "flat-safe"
         self.dispatch = dispatch
-        self.max_inflight = max(1, max_inflight)
+        self.max_inflight = max_inflight
+        if coalesce not in ("adaptive", "fixed"):
+            raise ValueError(f"unknown coalesce mode: {coalesce!r}")
+        self.governor = CoalesceGovernor(
+            batch_size=self._batch_size,
+            max_vectors=self._max_vectors,
+            slo_us=coalesce_slo_us,
+            window=self._max_inflight,
+            enabled=(coalesce == "adaptive"),
+        )
+        self.prewarm = prewarm
+        # Governor timing taps: wall-clock of the previous harvest
+        # completion (inter-completion intervals approximate per-
+        # dispatch service time in the pipelined steady state), and
+        # the pow2 buckets already timed once — a bucket's FIRST
+        # dispatch may include a multi-second jit compile, which would
+        # poison the EWLS fit (floor_us off by ~6 orders) and spray
+        # false slo_breaches until the decay washes it out.
+        self._last_harvest_t: Optional[float] = None
+        self._timed_k: set = set()
         self.sweep_interval = sweep_interval
         self.sweep_max_age = sweep_max_age
         self.shim = shim or HostShim()
@@ -332,8 +375,10 @@ class DataplaneRunner:
         # Sampled per-packet verdict traces (vpptrace analog), enabled on
         # demand via REST/netctl.
         self.tracer = tracer if tracer is not None else PacketTracer()
-        # In-flight queue: python engine (FrameBatch, result, ts);
-        # native engine (slot, n, orig-SoA dict, result, ts).
+        # In-flight queue: python engine (FrameBatch, result, ts, k,
+        # t_admit, depth); native engine (slot, n, orig-SoA dict,
+        # result, ts, k, t_admit, depth) — the (k, t_admit, depth)
+        # tail feeds the governor's timing fit at harvest.
         self._inflight: Deque[Tuple] = collections.deque()
         # Engine selection (VERDICT r2 item 1): when every endpoint is a
         # NativeRing, admit/harvest run in C++ (runnerloop.cpp) and
@@ -350,7 +395,6 @@ class DataplaneRunner:
         self.engine = engine or ("native" if native_ok else "python")
         self._native: Optional[NativeLoop] = None
         self._slot_next = 0
-        self._n_slots = self.max_inflight + 1
         if self.engine == "native":
             self._native = NativeLoop(
                 self.source, self.tx, self.local, self.host,
@@ -360,6 +404,8 @@ class DataplaneRunner:
         self._bypass_tables = False
         self._bypass_route = None
         self._refresh_bypass()
+        if self.prewarm:
+            self.prewarm_buckets()
 
     # ------------------------------------------------------ host bypass
 
@@ -481,9 +527,12 @@ class DataplaneRunner:
 
     # ----------------------------------------------------- sizing knobs
 
-    # batch_size / max_vectors are settable post-construction (tests
-    # shrink them); the native loop bakes both into its slot layout, so
-    # the setters rebuild it.  Only legal with no batches in flight.
+    # batch_size / max_vectors / max_inflight are settable post-
+    # construction (tests shrink them; operators deepen the window);
+    # the native loop bakes the sizes into its slot layout, so the
+    # setters rebuild it.  Only legal with no batches in flight.  The
+    # governor tracks every change (its ceiling/vector math must match
+    # the loop's).
 
     @property
     def batch_size(self) -> int:
@@ -493,6 +542,8 @@ class DataplaneRunner:
     def batch_size(self, value: int) -> None:
         self._check_resizable()
         self._batch_size = value
+        if getattr(self, "governor", None) is not None:
+            self.governor.batch_size = value
         self._rebuild_native()
 
     @property
@@ -506,6 +557,23 @@ class DataplaneRunner:
         while k * 2 <= max(1, value):
             k *= 2
         self._max_vectors = k
+        if getattr(self, "governor", None) is not None:
+            self.governor.max_vectors = k
+        self._rebuild_native()
+
+    @property
+    def max_inflight(self) -> int:
+        return self._max_inflight
+
+    @max_inflight.setter
+    def max_inflight(self, value: int) -> None:
+        self._check_resizable()
+        self._max_inflight = max(1, value)
+        # One spare slot beyond the window: a harvest's SoA views must
+        # stay stable while the next admit fills a fresh slot.
+        self._n_slots = self._max_inflight + 1
+        if getattr(self, "governor", None) is not None:
+            self.governor.window = self._max_inflight
         self._rebuild_native()
 
     def _check_resizable(self) -> None:
@@ -588,6 +656,12 @@ class DataplaneRunner:
                 f"rolled back to last-good tables: {err}"
             ) from err
         self._refresh_bypass()
+        if self.prewarm:
+            # New table shapes mean new jit cache keys: re-warm every
+            # pow2 bucket NOW so the next load spike never stalls on a
+            # compile (the process-global ledger makes same-shape swaps
+            # free).
+            self.prewarm_buckets()
 
     def _adopt_tables(
         self,
@@ -602,6 +676,10 @@ class DataplaneRunner:
         (multi-shard atomicity is the sharded engine's rollback)."""
         if acl is not None or nat is not None or route is not None:
             self.faults.fire(SITE_SWAP_FAIL, shard=self.shard_index)
+            # New tables may mean new jit cache keys: every bucket's
+            # next dispatch may compile again, so its timing sample
+            # must be re-screened (see _observe_harvest).
+            self._timed_k.clear()
         if acl is not None:
             self.acl = acl
             self.counters.acl_swaps += 1
@@ -626,7 +704,109 @@ class DataplaneRunner:
                 partition_sessions=self.partition_sessions,
             )
 
+    # ----------------------------------------------------- bucket pre-warm
+
+    def _bucket_signature(self, k: int) -> Tuple:
+        """Process-global jit-cache identity of one dispatch bucket:
+        the discipline plus the abstract (shape, dtype) of every table/
+        session leaf.  Values never enter — cache keys are avals."""
+        leaves = jax.tree_util.tree_leaves(
+            (self.acl, self.nat, self.route, self.sessions))
+        return (
+            self.dispatch, k, self._batch_size,
+            tuple(
+                (tuple(getattr(leaf, "shape", ())),
+                 str(getattr(leaf, "dtype", type(leaf).__name__)))
+                for leaf in leaves
+            ),
+        )
+
+    def _prewarm_one(self, k: int) -> None:
+        """Compile (and run once, against a throwaway session table)
+        the jit program the dispatch path would select at vector count
+        ``k`` — the runner's own state is untouched."""
+        size = k * self._batch_size
+        z32 = jnp.zeros(size, dtype=jnp.uint32)
+        zi = jnp.zeros(size, dtype=jnp.int32)
+        batch = PacketBatch(src_ip=z32, dst_ip=z32, protocol=zi,
+                            src_port=zi, dst_port=zi)
+        # Fresh scratch per bucket: the jit entry points DONATE the
+        # sessions argument.
+        scratch = empty_sessions(self.sessions.capacity)
+        if k == 1 and self.dispatch != "flat-safe":
+            result = pipeline_step_jit(
+                self.acl, self.nat, self.route, scratch, batch, jnp.int32(1))
+        else:
+            vectors = jax.tree_util.tree_map(
+                lambda a: a.reshape((k, self._batch_size) + a.shape[1:]),
+                batch)
+            step = (
+                pipeline_flat_safe_ts0_jit if self.dispatch == "flat-safe"
+                else pipeline_scan_ts0_jit
+            )
+            result = step(
+                self.acl, self.nat, self.route, scratch, vectors,
+                jnp.int32(0))
+        result.allowed.block_until_ready()
+
+    def prewarm_buckets(self) -> int:
+        """Compile every pow2 dispatch bucket up to the ceiling against
+        the CURRENT tables, so a load spike never stalls on jit
+        compilation mid-traffic.  Returns the number of buckets
+        actually compiled — 0 when everything was already warm (the
+        ledger is process-global: N shards and repeated same-shape
+        swaps pay once).  Mesh runners skip (GSPMD placement changes
+        the cache key; their dispatch shapes are pre-placed at swap)."""
+        if (self.acl is None or self.nat is None or self.route is None
+                or self.mesh is not None):
+            return 0
+        compiled = 0
+        k = 1
+        while k <= self._max_vectors:
+            sig = self._bucket_signature(k)
+            if sig not in _PREWARMED:
+                self._prewarm_one(k)
+                _PREWARMED.add(sig)
+                compiled += 1
+            k *= 2
+        return compiled
+
     # --------------------------------------------------------------- loop
+
+    def _backlog_depth(self) -> int:
+        """Ingress backlog in frames, or -1 when the source cannot
+        report depth (the governor's saturation ramp stands in)."""
+        hint = getattr(self.source, "backlog_hint", None)
+        if hint is not None:
+            try:
+                return int(hint())
+            except Exception:  # noqa: BLE001 - a flapping probe = unknown
+                return -1
+        try:
+            return len(self.source)  # type: ignore[arg-type]
+        except TypeError:
+            return -1
+
+    def _observe_harvest(self, k: int, t_admit: float, depth: int) -> None:
+        """Feed one per-dispatch wall-time sample to the governor.
+        Unpipelined batches (admitted with nothing in flight) time the
+        full admit→harvest round trip; pipelined ones use the inter-
+        completion interval, which is exactly the per-dispatch wall in
+        the saturated steady state.  A bucket's first-ever sample is
+        discarded unless the bucket was pre-warmed — it may include
+        jit compile time, which is not service time."""
+        now = time.perf_counter()
+        prev = self._last_harvest_t
+        self._last_harvest_t = now
+        if k not in self._timed_k:
+            self._timed_k.add(k)
+            if self.mesh is not None or \
+                    self._bucket_signature(k) not in _PREWARMED:
+                return
+        if depth == 0:
+            self.governor.observe(k, now - t_admit)
+        elif prev is not None and prev >= t_admit:
+            self.governor.observe(k, now - prev)
 
     def poll(self) -> int:
         """One scheduling turn: admit new batches up to the in-flight
@@ -863,9 +1043,7 @@ class DataplaneRunner:
         to the smallest power-of-two vector count (same bucketing as
         admit, so no new compile shapes)."""
         m = len(idx)
-        k = 1
-        while k * self.batch_size < m and k < self.max_vectors:
-            k *= 2
+        k = pow2_vectors(m, self.batch_size, self.max_vectors)
         size = k * self.batch_size
         arrs = {}
         for f, a in soa.items():
@@ -906,6 +1084,9 @@ class DataplaneRunner:
         left behind.  Called by the shard supervisor on every error and
         before a probation rejoin."""
         self._inflight.clear()
+        # Timing continuity is broken: the next inter-completion
+        # interval would span the fault, poisoning the governor's fit.
+        self._last_harvest_t = None
         if self._native is not None:
             self._rebuild_native()
 
@@ -940,13 +1121,18 @@ class DataplaneRunner:
                 self._last_fault_error = f"source: {err}"
                 return False
         slot = self._slot_next
+        # Governor: pick this admit's pow2 vector cap from the ring's
+        # measured depth; the native admit bounds its read budget by it
+        # (excess backlog stays queued for the next in-flight slot).
+        k_cap = self.governor.choose_k(self._backlog_depth())
         c = np.zeros(NativeLoop.ADMIT_COUNTERS, dtype=np.uint64)
-        n, k, soa = self._native.admit(slot, c)
+        n, k, soa = self._native.admit(slot, c, k_cap)
         self.counters.rx_frames += int(c[0])
         self.counters.rx_decapped += int(c[1])
         self.counters.dropped_foreign_vni += int(c[2])
         if n == 0:
             return bool(c[0])  # consumed (all foreign-VNI drops) vs idle
+        self.governor.admitted(n, k_cap)
         self._slot_next = (slot + 1) % self._n_slots
         kb = k * self.batch_size
         batch = PacketBatch(
@@ -956,12 +1142,15 @@ class DataplaneRunner:
             src_port=jnp.asarray(soa["src_port"][:kb]),
             dst_port=jnp.asarray(soa["dst_port"][:kb]),
         )
+        t_admit = time.perf_counter()
+        depth = len(self._inflight)
         result, batch_ts = self._dispatch_protected(batch, k)
-        self._inflight.append((slot, n, soa, result, batch_ts))
+        self._inflight.append((slot, n, soa, result, batch_ts,
+                               k, t_admit, depth))
         return True
 
     def _harvest_native(self) -> int:
-        slot, n, soa, result, ts = self._inflight.popleft()
+        slot, n, soa, result, ts, k, t_admit, depth = self._inflight.popleft()
         # Materialise (blocks on THIS batch only; newer ones stay queued).
         punt = np.asarray(result.punt)[:n]
         reply_hit = np.asarray(result.reply_hit)[:n]
@@ -1017,15 +1206,17 @@ class DataplaneRunner:
             # have created sessions/punts the swap-time eligibility
             # check could not see — re-derive before the next bypass.
             self._bypass_recheck = True
+        self._observe_harvest(k, t_admit, depth)
         return sent
 
     # ------------------------------------------------------- python engine
 
     def _admit_python(self) -> bool:
+        k_cap = self.governor.choose_k(self._backlog_depth())
         try:
             if self.faults.armed:
                 self.faults.fire(SITE_FRAME_SOURCE_ERROR, shard=self.shard_index)
-            frames = self.source.recv_batch(self.batch_size * self.max_vectors)
+            frames = self.source.recv_batch(self.batch_size * k_cap)
         except Exception as err:  # noqa: BLE001 - socket flap / injected
             # Source errors degrade (count + report idle) instead of
             # killing the loop — the uplink may recover next poll.
@@ -1036,10 +1227,15 @@ class DataplaneRunner:
             return False
         self.counters.rx_frames += len(frames)
         # Pack once; every later stage works on views into this buffer.
+        # bytearray.join builds the packed bytes in ONE pass and is
+        # writable (the harvest rewrites headers in place), where the
+        # old bytes-join + .copy() duplicated every batch — the counter
+        # records the second copy that no longer happens.
         lens = np.array([len(f) for f in frames], dtype=np.uint32)
         offsets = np.zeros(len(frames), dtype=np.uint64)
         np.cumsum(lens[:-1], dtype=np.uint64, out=offsets[1:])
-        buf = np.frombuffer(b"".join(frames), dtype=np.uint8).copy()
+        buf = np.frombuffer(bytearray(b"").join(frames), dtype=np.uint8)
+        self.counters.admit_copy_saved_bytes += buf.size
         # Overlay ingress: de-encapsulate VXLAN frames (offset math in
         # native code, zero copies).  Only our VNI belongs to this
         # overlay segment — foreign VNIs are dropped, preserving the
@@ -1054,12 +1250,14 @@ class DataplaneRunner:
             in_off, in_len = in_off[keep], in_len[keep]
             if not len(in_off):
                 return True  # batch consumed entirely by foreign-VNI drops
-        # Vector count for this dispatch: enough 256-pkt vectors to hold
-        # the kept frames, bucketed to a power of two (bounded compiles).
-        n_kept = len(in_off)
-        k = 1
-        while k * self.batch_size < n_kept and k < self.max_vectors:
-            k *= 2
+        # Governor feedback AFTER the VNI filter, like the native admit:
+        # the histogram/ramp must record what is DISPATCHED, not what a
+        # drop-heavy overlay read pulled off the socket.
+        self.governor.admitted(len(in_off), k_cap)
+        # Vector count for this dispatch: enough batch_size-pkt vectors
+        # to hold the kept frames, bucketed to a power of two under the
+        # governor's cap (bounded compiles; one sizing rule everywhere).
+        k = pow2_vectors(len(in_off), self.batch_size, k_cap)
         fb = self.shim.parse_view(buf, in_off, in_len, pad_to=k * self.batch_size)
         batch = PacketBatch(
             src_ip=jnp.asarray(fb.batch.src_ip),
@@ -1068,12 +1266,14 @@ class DataplaneRunner:
             src_port=jnp.asarray(fb.batch.src_port),
             dst_port=jnp.asarray(fb.batch.dst_port),
         )
+        t_admit = time.perf_counter()
+        depth = len(self._inflight)
         result, batch_ts = self._dispatch_protected(batch, k)
-        self._inflight.append((fb, result, batch_ts))
+        self._inflight.append((fb, result, batch_ts, k, t_admit, depth))
         return True
 
     def _harvest_python(self) -> int:
-        fb, result, ts = self._inflight.popleft()
+        fb, result, ts, k, t_admit, depth = self._inflight.popleft()
         n = fb.n
         # Materialise (blocks on THIS batch only; newer ones stay queued).
         allowed = np.asarray(result.allowed)[:n].copy()
@@ -1150,6 +1350,7 @@ class DataplaneRunner:
             sent += len(frames)
         if self._bypass_tables:
             self._bypass_recheck = True  # see _harvest_native
+        self._observe_harvest(k, t_admit, depth)
         return sent
 
     # ------------------------------------------------------ shared harvest
@@ -1230,6 +1431,10 @@ class DataplaneRunner:
         out["datapath_affinity_active"] = affinity_occupancy(self.sessions)
         out["datapath_slowpath_sessions_active"] = len(self.slow)
         out["datapath_inflight"] = len(self._inflight)
+        out["datapath_governor_k"] = self.governor.current_k
+        out["datapath_governor_backlog"] = self.governor.backlog
+        out["datapath_governor_slo_breaches_total"] = \
+            self.governor.slo_breaches
         return out
 
     def inspect(self) -> Dict[str, object]:
@@ -1304,6 +1509,8 @@ class DataplaneRunner:
             "device_batches": self.counters.batches,
             "ts": self._ts,
             "mesh": str(self.mesh.shape) if self.mesh is not None else "",
+            "governor": self.governor.snapshot(),
+            "prewarm": self.prewarm,
         }
 
     def inspect_rings(self) -> Dict[str, Dict[str, int]]:

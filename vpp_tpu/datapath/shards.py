@@ -130,7 +130,10 @@ class ShardedDataplane:
         overlay: VxlanOverlay,
         shard_ios: Sequence[ShardIO],
         batch_size: int = 256,
-        max_vectors: int = 64,
+        # Coalesce ceiling (the governor picks the per-admit K under
+        # it, per shard — each shard has its own rings, so each gets
+        # its own backlog-driven governor; see runner.py).
+        max_vectors: int = 256,
         session_capacity: int = 1 << 16,
         workers: Optional[int] = None,  # kept for API compat; per-shard now
         faults: Optional[FaultInjector] = None,
@@ -530,6 +533,12 @@ class ShardedDataplane:
         state_clear = r0._bypass_state_clear() if r0._bypass_static_ok() else False
         for r in self.shards:
             r._refresh_bypass(state_clear=state_clear)
+        if r0.prewarm:
+            # ONE prewarm per swap: every shard dispatches through the
+            # same process-wide jit cache, and the bucket ledger makes
+            # the other shards' (and same-shape future swaps') calls
+            # free anyway.
+            r0.prewarm_buckets()
 
     # ------------------------------------------------------------ metrics
 
@@ -557,6 +566,15 @@ class ShardedDataplane:
         agg["datapath_slowpath_sessions_active"] = slowpath_sessions
         agg["datapath_inflight"] = sum(len(r._inflight) for r in self.shards)
         agg["datapath_shards"] = len(self.shards)
+        # Governor gauges: K/backlog are per-shard states — report the
+        # deepest (the shard the node's latency story hinges on);
+        # breach counts sum.
+        agg["datapath_governor_k"] = max(
+            r.governor.current_k for r in self.shards)
+        agg["datapath_governor_backlog"] = max(
+            r.governor.backlog for r in self.shards)
+        agg["datapath_governor_slo_breaches_total"] = sum(
+            r.governor.slo_breaches for r in self.shards)
         # Supervisor counters: engine-level, not per shard (rollbacks
         # happen once per failed swap, so the per-runner counter — only
         # ticked by solo-runner update_tables — is overridden here).
@@ -638,6 +656,21 @@ class ShardedDataplane:
         base["rings"] = rings
         base["dispatch"]["inflight"] = sum(
             len(r._inflight) for r in self.shards)
+        # Whole-node governor view: per-shard K histograms merged,
+        # breach/decision counts summed, current K and backlog reported
+        # per shard (each shard's rings have their own depth).
+        gov = base["dispatch"]["governor"]
+        hist: Dict[str, int] = {}
+        for r in self.shards:
+            for key, value in r.governor.k_hist.items():
+                hist[str(key)] = hist.get(str(key), 0) + value
+        gov["k_histogram"] = {k: hist[k] for k in sorted(hist, key=int)}
+        gov["decisions"] = sum(r.governor.decisions for r in self.shards)
+        gov["slo_breaches"] = sum(
+            r.governor.slo_breaches for r in self.shards)
+        gov["samples"] = sum(r.governor.samples for r in self.shards)
+        gov["per_shard_k"] = [r.governor.current_k for r in self.shards]
+        gov["per_shard_backlog"] = [r.governor.backlog for r in self.shards]
         # Aggregated counters WITHOUT re-reading device occupancy:
         # shard 0's inspect() above already transferred the gauges.
         sessions = base["sessions"]

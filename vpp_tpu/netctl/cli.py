@@ -212,6 +212,25 @@ def cmd_inspect(server: str, out, watch: float = 0.0, raw: bool = False) -> int:
               f"{'on' if dp['bypass_eligible'] else 'off'}"
               f"{'  shards=' + str(n_shards) if n_shards else ''}"
               f"{'  mesh=' + dp['mesh'] if dp['mesh'] else ''}", file=out)
+        gov = dp.get("governor") or {}
+        if gov:
+            hist = gov.get("k_histogram") or {}
+            hist_s = " ".join(f"{k}:{v}" for k, v in hist.items()) or "-"
+            floor = gov.get("floor_us")
+            vec = gov.get("vec_us")
+            if floor is None:
+                model = "model=warming"
+            else:
+                # vec stays unknown while every sample sits at one K
+                # (quiet link): the fit is degenerate, not absent.
+                model = (f"floor={floor}us "
+                         f"vec={'?' if vec is None else vec}us")
+            print(f"governor: {'adaptive' if gov.get('enabled') else 'fixed'}"
+                  f"  K={gov.get('current_k')}/{gov.get('ceiling')}"
+                  f"  backlog={gov.get('backlog')}"
+                  f"  slo={gov.get('slo_us')}us cap={gov.get('slo_cap')}"
+                  f" breaches={gov.get('slo_breaches')}"
+                  f"  {model}  K-hist: {hist_s}", file=out)
         print(f"classify: {cl['rules']} rules / {cl['tables']} tables / "
               f"{cl['pods']} pods    nat: {nt['mappings']} mappings "
               f"ring={nt['bucket_size']} "

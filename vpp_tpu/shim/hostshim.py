@@ -105,7 +105,7 @@ def _load() -> ctypes.CDLL:
     lib.hs_loop_admit.restype = ctypes.c_int32
     lib.hs_loop_admit.argtypes = [
         ctypes.c_void_p, ctypes.c_int32,
-        _u32p, _u32p, _i32p, _i32p, _i32p, _i32p, _u64p,
+        _u32p, _u32p, _i32p, _i32p, _i32p, _i32p, _u64p, ctypes.c_int32,
     ]
     lib.hs_loop_harvest.restype = ctypes.c_int32
     lib.hs_loop_harvest.argtypes = [
@@ -171,6 +171,11 @@ class NativeRing:
 
     def __len__(self) -> int:
         return int(self._lib.hs_ring_count(self._ptr))
+
+    def backlog_hint(self) -> int:
+        """Queued frame count — the coalesce governor's ingress depth
+        probe (one C call, no lock contention beyond the ring mutex)."""
+        return len(self)
 
     @property
     def dropped(self) -> int:
@@ -282,8 +287,12 @@ class NativeLoop:
             for _ in range(n_slots)
         ]
 
-    def admit(self, slot: int, counters: np.ndarray):
-        """Returns (n_kept, k, soa_dict); counters (uint64[3]) += deltas."""
+    def admit(self, slot: int, counters: np.ndarray, k_cap: int = 0):
+        """Returns (n_kept, k, soa_dict); counters (uint64[3]) += deltas.
+        ``k_cap`` (pow2, 0 = uncapped) is the coalesce governor's
+        per-admit vector cap: the ring read budget and the pow2 bucket
+        are both bounded by it, leaving excess backlog queued for the
+        next in-flight slot."""
         soa = self._soa[slot]
         k = ctypes.c_int32(0)
         n = int(self._lib.hs_loop_admit(
@@ -295,6 +304,7 @@ class NativeLoop:
             soa["dst_port"].ctypes.data_as(_i32p),
             ctypes.byref(k),
             counters.ctypes.data_as(_u64p),
+            ctypes.c_int32(k_cap),
         ))
         if n < 0:
             raise RuntimeError(f"slot {slot} is still in flight (unharvested)")

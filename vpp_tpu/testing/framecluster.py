@@ -77,6 +77,12 @@ class FrameNode:
             route=make_route_config(sim.ipam),
             batch_size=sim.config.batch_size,
             max_vectors=sim.config.max_vectors,
+            coalesce=sim.config.coalesce,
+            coalesce_slo_us=sim.config.coalesce_slo_us,
+            max_inflight=sim.config.max_inflight,
+            # NOT coalesce_prewarm: a per-test compile burst of every
+            # pow2 bucket up to the ceiling would swamp suite runtime;
+            # prewarm is covered by its own tests.
             overlay=VxlanOverlay(local_ip=self.node_ip, local_node_id=self.node_id),
             source=self.rx,
             tx=self.tx,

@@ -216,8 +216,15 @@ class UIBackend:
                 # error, never as a healthy-looking empty dashboard.
                 return (502, "text/plain",
                         f"agent {node!r}: {errors['dump']}".encode())
+            # Dispatch/governor panel: optional — an agent without a
+            # live datapath 404s here, which must not error the page
+            # (the panel just hides).
+            inspect = agent_json("inspect", "contiv/v1/inspect")
+            if inspect is None:
+                errors.pop("inspect", None)
             shaped = shape_views(dump or [], ipam or {}, trace or {},
-                                 trace_ip=trace_ip or None)
+                                 trace_ip=trace_ip or None,
+                                 inspect=inspect)
             # Partial failures reach the page per panel (the JS renders
             # them into the affected tables instead of empty rows).
             shaped["errors"] = errors

@@ -184,8 +184,43 @@ def shape_trace(entries: List[dict],
     return rows
 
 
+def shape_dispatch(inspect: Optional[dict]) -> Dict[str, Any]:
+    """The dashboard's dispatch panel: the adaptive-coalesce state an
+    operator watches during a load event — current K vs ceiling,
+    ingress backlog, the learned dispatch-time model, the chosen-K
+    histogram and SLO breaches.  Empty for agents without a live
+    datapath (the page hides the panel)."""
+    if not inspect:
+        return {}
+    dp = inspect.get("dispatch") or {}
+    gov = dp.get("governor") or {}
+    return {
+        "engine": inspect.get("engine", ""),
+        "discipline": dp.get("discipline", ""),
+        "batch_size": dp.get("batch_size", 0),
+        "max_vectors": dp.get("max_vectors", 0),
+        "inflight": dp.get("inflight", 0),
+        "max_inflight": dp.get("max_inflight", 0),
+        "bypass": bool(dp.get("bypass_eligible")),
+        "device_batches": dp.get("device_batches", 0),
+        "governor": {
+            "mode": "adaptive" if gov.get("enabled") else "fixed",
+            "current_k": gov.get("current_k", 0),
+            "ceiling": gov.get("ceiling", 0),
+            "backlog": gov.get("backlog", 0),
+            "slo_us": gov.get("slo_us", 0),
+            "slo_cap": gov.get("slo_cap", 0),
+            "slo_breaches": gov.get("slo_breaches", 0),
+            "floor_us": gov.get("floor_us"),
+            "vec_us": gov.get("vec_us"),
+            "k_histogram": gov.get("k_histogram") or {},
+        },
+    }
+
+
 def shape_views(dump: List[dict], ipam: dict, trace: dict,
-                trace_ip: Optional[str] = None) -> Dict[str, Any]:
+                trace_ip: Optional[str] = None,
+                inspect: Optional[dict] = None) -> Dict[str, Any]:
     """The full ``/api/views/<node>`` payload."""
     pod_ips = (ipam or {}).get("allocatedPodIPs") or {}
     out = shape_config_views(dump or [], pod_ips)
@@ -197,4 +232,5 @@ def shape_views(dump: List[dict], ipam: dict, trace: dict,
         "filter_ip": trace_ip or "",
         "rows": shape_trace((trace or {}).get("entries") or [], trace_ip),
     }
+    out["dispatch"] = shape_dispatch(inspect)
     return out
