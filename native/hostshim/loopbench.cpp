@@ -13,11 +13,13 @@
 // before/after the SIMD work on the per-frame path.
 
 #include <algorithm>
+#include <atomic>
 #include <cinttypes>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <thread>
 #include <vector>
 
 #include <x86intrin.h>
@@ -130,8 +132,17 @@ int main(int argc, char** argv) {
   // modes isolate one harvest path each for the phase profile.
   // "fused" runs the mixed mix through hs_loop_hostpath (the runner's
   // host-bypass batch) instead of split admit/route/harvest calls.
+  // "threaded" replays the ShardedDataplane shape — N producer
+  // threads pushing into the rx ring while the main thread
+  // admits/harvests concurrently — the workload `make native-sanitize`
+  // runs under TSan to race-check the HsRing mutex discipline.
   const char* mode = argc > 3 ? argv[3] : "mixed";
   const bool fused = mode[0] == 'f';
+  const bool threaded = mode[0] == 't';
+  // Clamp: atoi("garbage") and an explicit 0 both mean "no pushers",
+  // which would divide by zero in the slice math below.
+  const int n_pushers =
+      threaded ? std::max(1, argc > 4 ? atoi(argv[4]) : 4) : 0;
   const uint32_t batch = 256, vectors = 64;
 
   HsRing* rx = hs_ring_new(64u << 20, 1u << 17);
@@ -197,10 +208,33 @@ int main(int argc, char** argv) {
   std::vector<double> r_admit, r_route, r_harv, mpps;
   double best_mpps = 0, sum_mpps = 0;
   for (int r = 0; r < rounds + 1; ++r) {  // round 0 = warm-up
-    hs_ring_push(rx, buf.data(), offs.data(), lens.data(), n_frames);
+    std::atomic<int> live_pushers{0};
+    std::vector<std::thread> pushers;
+    if (threaded) {
+      // ShardedDataplane shape: producers feed the rx ring while the
+      // consumer admits concurrently — every push/admit contends on
+      // the HsRing mutex, which is exactly what TSan must watch.
+      live_pushers = n_pushers;
+      const int32_t per = n_frames / n_pushers;
+      for (int t = 0; t < n_pushers; ++t) {
+        const int32_t start = t * per;
+        const int32_t end = (t == n_pushers - 1) ? n_frames : start + per;
+        pushers.emplace_back([&, start, end]() {
+          const int32_t burst = 512;
+          for (int32_t i = start; i < end; i += burst) {
+            int32_t n = std::min(burst, end - i);
+            hs_ring_push(rx, buf.data(), offs.data() + i, lens.data() + i, n);
+          }
+          live_pushers.fetch_sub(1);
+        });
+      }
+    } else {
+      hs_ring_push(rx, buf.data(), offs.data(), lens.data(), n_frames);
+    }
     uint64_t cyc_admit = 0, cyc_route = 0, cyc_harvest = 0;
     uint64_t t0 = __rdtsc();
     int32_t done = 0;
+    bool final_pass = false;  // one re-admit after the last pusher exits
     while (true) {
       int32_t k = 0;
       if (fused) {
@@ -217,7 +251,20 @@ int main(int argc, char** argv) {
                                 proto.data(), sport.data(), dport.data(), &k,
                                 admit_c, /*k_cap=*/0);
       uint64_t a1 = __rdtsc();
-      if (n <= 0) break;
+      if (n <= 0) {
+        if (live_pushers.load() > 0) {
+          std::this_thread::yield();  // producers still filling the ring
+          continue;
+        }
+        if (threaded && !final_pass) {
+          // The last pusher's final push can land after our empty
+          // admit but before its counter decrement — admit once more
+          // now that live_pushers==0 guarantees every push completed.
+          final_pass = true;
+          continue;
+        }
+        break;
+      }
       for (int32_t i = 0; i < n; ++i) {  // vectorizable verdict/route
         uint32_t d = dst_ip[i];
         int32_t tag = (d & kNodeMask) == kNodeBase   ? kRouteLocal
@@ -237,6 +284,7 @@ int main(int argc, char** argv) {
       done += n;
     }
     uint64_t t1 = __rdtsc();
+    for (auto& th : pushers) th.join();
     drain();
     if (r == 0 || done == 0) continue;
     r_admit.push_back(static_cast<double>(cyc_admit) / done);

@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Invariant static-analysis gate — `make lint` / `make verify-static`.
+
+Runs the project-native checker battery (vpp_tpu/analysis/) over the
+given paths and exits non-zero on any unwaived finding:
+
+    python scripts/check_static.py vpp_tpu/              # the full gate
+    python scripts/check_static.py --rule hot-path-sync vpp_tpu/datapath
+    python scripts/check_static.py --list-rules
+    python scripts/check_static.py --show-waived vpp_tpu/
+
+Findings are waivable at the site with a written reason:
+
+    np.asarray(x)  # static: allow(hot-path-sync) — swap-time, once per table
+
+A waiver with no reason is itself a failure (no silent waivers).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from vpp_tpu.analysis import CHECKERS, Project, run_checks  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to check (default: vpp_tpu/)")
+    ap.add_argument("--root", default=None,
+                    help="package root anchoring module names "
+                         "(default: repo root)")
+    ap.add_argument("--rule", action="append", dest="rules",
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--show-waived", action="store_true",
+                    help="also print findings silenced by waivers")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(CHECKERS):
+            print(f"{rule:18s} {CHECKERS[rule]().description}")
+        return 0
+
+    paths = args.paths or ["vpp_tpu"]
+    root = args.root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    if args.rules:
+        unknown = set(args.rules) - set(CHECKERS)
+        if unknown:
+            ap.error(f"unknown rule(s) {sorted(unknown)}; "
+                     f"have {sorted(CHECKERS)}")
+
+    project = Project.load(paths, root=root)
+    unwaived, waived = run_checks(project, rules=args.rules)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [vars(f) for f in unwaived],
+            "waived": [vars(f) for f in waived],
+        }, indent=1))
+    else:
+        for f in unwaived:
+            print(f.format())
+        if args.show_waived:
+            for f in waived:
+                print(f.format())
+        print(
+            f"check_static: {len(project.files)} files, "
+            f"{len(unwaived)} finding(s), {len(waived)} waived"
+        )
+    return 1 if unwaived else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,3 +45,41 @@ if os.environ.get("VPP_TPU_RACE_STRESS"):
     import sys
 
     sys.setswitchinterval(1e-5)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Thread-leak gate (ISSUE 7, `make test-race`): no non-daemon
+    thread may survive suite teardown.  Supervisor executors, governor
+    timers, HA tick loops and watch streams all have stop() paths that
+    JOIN — a survivor here means some test (or some component) leaked
+    one, which is exactly the state where the next test's timing
+    assumptions silently rot.  A short grace absorbs pool workers that
+    are mid-exit (shutdown(wait=False) drains asynchronously)."""
+    if not os.environ.get("VPP_TPU_RACE_STRESS"):
+        return
+    import threading
+    import time
+
+    def leaked():
+        return [
+            t for t in threading.enumerate()
+            if t is not threading.main_thread()
+            and t.is_alive() and not t.daemon
+        ]
+
+    deadline = time.monotonic() + 3.0
+    while leaked() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    survivors = leaked()
+    if survivors:
+        tr = session.config.pluginmanager.getplugin("terminalreporter")
+        lines = [f"  {t.name} (ident={t.ident})" for t in survivors]
+        msg = (
+            "non-daemon threads survived suite teardown "
+            "(stop() paths must join):\n" + "\n".join(lines)
+        )
+        if tr is not None:
+            tr.write_line("ERROR: " + msg, red=True)
+        else:  # pragma: no cover - no terminal reporter configured
+            print("ERROR: " + msg)
+        session.exitstatus = 3

@@ -75,6 +75,7 @@ def store_proc():
     yield status["store"]
     proc.send_signal(signal.SIGTERM)
     proc.wait(timeout=10)
+    proc.stdout.close()  # leaked pipe trips the test-race gate
 
 
 def test_manifest_config_parses_and_matches_dev_copy():
@@ -160,6 +161,7 @@ def test_store_and_agent_processes_come_up(store_proc):
     finally:
         agent.send_signal(signal.SIGTERM)
         agent.wait(timeout=15)
+        agent.stdout.close()  # leaked pipe trips the test-race gate
 
 
 def test_k8s_api_listwatch_streams_events():
@@ -214,6 +216,7 @@ def test_k8s_api_listwatch_streams_events():
         lw.close()
     finally:
         httpd.shutdown()
+        httpd.server_close()  # shutdown() alone leaks the listen socket
 
 
 def test_second_agent_gets_distinct_node_id(store_proc):
@@ -233,6 +236,7 @@ def test_second_agent_gets_distinct_node_id(store_proc):
             a.send_signal(signal.SIGTERM)
         for a in agents:
             a.wait(timeout=15)
+            a.stdout.close()  # leaked pipe trips the test-race gate
 
 
 # ---------------------------------------------------------------------------

@@ -467,3 +467,68 @@ def test_controller_event_history_is_a_bounded_ring():
         assert hist[0].seq_num == hist[-1].seq_num - 7
     finally:
         ctl.stop()
+
+
+# -------------------------------------- ISSUE 7 checker-fix regressions
+
+
+def test_fire_reads_batch_fields_only_under_a_match_plan():
+    """The dispatch hook passes the DEVICE batch through fire() as-is;
+    the injector must not touch its fields unless a poison-match plan
+    is armed — an eager read here is a per-dispatch host↔device sync
+    (the hot-path-sync checker's runner.py finding, fixed in ISSUE 7)."""
+
+    class ExplodingBatch:
+        def __getattr__(self, name):
+            raise AssertionError(f"batch field {name!r} materialised "
+                                 "without a match plan")
+
+    inj = FaultInjector()
+    inj.arm(SITE_DISPATCH_RAISE)          # raise-mode, NO match predicate
+    with pytest.raises(FaultInjected):
+        inj.fire(SITE_DISPATCH_RAISE, shard=0, batch=ExplodingBatch())
+
+    # With a match plan the fields ARE read (the poison predicate).
+    inj2 = FaultInjector()
+    inj2.arm(SITE_DISPATCH_RAISE, match={"src_port": 4242})
+    touched = []
+
+    class RecordingBatch:
+        def __getattr__(self, name):
+            touched.append(name)
+            return np.array([4242])
+
+    with pytest.raises(FaultInjected):
+        inj2.fire(SITE_DISPATCH_RAISE, shard=0, batch=RecordingBatch())
+    assert touched  # predicate evaluated lazily, on demand
+
+
+def test_route_of_caches_host_scalars_and_invalidates_on_swap():
+    """_route_of reads the route scalars off the device ONCE per table
+    generation (was: five device→host round trips per restored packet —
+    found by the hot-path-sync checker)."""
+    runner, _ = make_runner(engine="python")
+    assert runner._route_cache is None
+    from vpp_tpu.ops.pipeline import ROUTE_HOST, ROUTE_LOCAL, ROUTE_REMOTE
+
+    assert runner._route_of(ip_to_u32("10.1.1.7"))[0] == ROUTE_LOCAL
+    cached = runner._route_cache
+    assert cached is not None
+    tag, node = runner._route_of(ip_to_u32("10.1.3.9"))
+    assert (tag, node) == (ROUTE_REMOTE, 3)
+    assert runner._route_of(ip_to_u32("93.184.216.34"))[0] == ROUTE_HOST
+    assert runner._route_cache is cached      # no re-read between calls
+    runner.update_tables(route=make_route())  # swap invalidates
+    assert runner._route_cache is None
+
+
+def test_runner_close_releases_quarantine_writer(tmp_path):
+    pcap = str(tmp_path / "q.pcap")
+    runner, rings = make_runner(engine="python", quarantine_pcap=pcap)
+    runner.faults.arm(SITE_DISPATCH_RAISE, match={"src_port": 4242})
+    rings[0].send([build_frame("10.1.1.4", "10.1.1.3", 6, 4242, 80)])
+    runner.drain()
+    assert runner._quarantine_writer is not None
+    runner.close()
+    assert runner._quarantine_writer is None
+    runner.close()  # idempotent

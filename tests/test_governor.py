@@ -395,6 +395,14 @@ def test_governor_state_in_inspect_rest_netctl_and_dashboard():
     assert panel["governor"]["mode"] == "adaptive"
     assert panel["governor"]["k_histogram"] == {"4": 1}
     assert panel["max_vectors"] == 8
+    # ISSUE 7 schema reconciliation: the panel surfaces the window,
+    # decision/sample counts and pre-warm state the inspect schema
+    # already carried (per-shard K stays empty on a solo runner).
+    assert panel["governor"]["window"] == runner.max_inflight
+    assert panel["governor"]["decisions"] >= 1
+    assert panel["governor"]["samples"] == gov["samples"]
+    assert panel["governor"]["per_shard_k"] == []
+    assert panel["prewarm"] is False
     assert shape_dispatch(None) == {}
 
 

@@ -332,7 +332,8 @@ def test_three_process_ensemble_survives_leader_sigkill(tmp_path):
         old_port = int(old_leader.rsplit(":", 1)[1])
         procs[old_port].kill()  # SIGKILL
         procs[old_port].wait(timeout=10)
-        t_kill = time.time()
+        procs[old_port].stdout.close()  # the rejoin below replaces this
+        t_kill = time.time()            # Popen; its pipe must not leak
 
         # Transparent failover: the idempotent writes keep landing with
         # NO caller-visible exception while the election runs.
@@ -384,3 +385,8 @@ def test_three_process_ensemble_survives_leader_sigkill(tmp_path):
         for proc in procs.values():
             proc.kill()
             proc.wait(timeout=10)
+            # Close the captured stdout pipe: Popen does not close it
+            # on kill/wait, and the leaked BufferedReader trips the
+            # test-race ResourceWarning gate (ISSUE 7).
+            if proc.stdout is not None:
+                proc.stdout.close()

@@ -267,4 +267,33 @@ def test_two_process_cluster_converges_after_outage(tmp_path):
             child.wait(timeout=10)
         except subprocess.TimeoutExpired:
             child.kill()
+        if child.stdout is not None:
+            child.stdout.close()  # leaked pipe trips the test-race gate
         c.stop()
+
+
+def test_rpc_survives_concurrent_channel_eviction():
+    """ISSUE 7 race regression: the watch thread's outage eviction can
+    CLOSE the cached channel between another thread's cache read and
+    its invoke — grpc raises `ValueError: Cannot invoke RPC on closed
+    channel!`, which used to escape _rpc and fail the caller (a
+    pre-existing `make test-race` flake).  A closed channel never sent
+    the request, so _rpc must redial fresh and retry."""
+    store = KVStore()
+    pod = Pod(name="p-evict", namespace="default", ip_address="10.1.9.2")
+    store.put(key_for(pod), pod)
+    server = KVStoreServer(store)
+    server.start()
+    try:
+        client = RemoteKVStore(server.address, timeout=2.0)
+        try:
+            assert client.get(key_for(pod)) is not None
+            # Simulate the concurrent eviction at the worst moment: the
+            # cached channel is closed under the caller's feet.
+            client._target(client._active).channel.close()
+            got = client.get(key_for(pod))     # must redial, not raise
+            assert got is not None and got.ip_address == "10.1.9.2"
+        finally:
+            client.close()
+    finally:
+        server.stop()

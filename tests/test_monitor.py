@@ -192,7 +192,8 @@ def test_linux_stn_steals_and_reverts_real_interface(netns):
     save_stolen(state, stolen)
     reloaded = load_stolen(state)
     assert reloaded.addresses == stolen.addresses
-    assert json.load(open(state))["name"] == "up0"
+    with open(state) as fh:
+        assert json.load(fh)["name"] == "up0"
 
     daemon.release_interface("up0")
     assert net.get_interface("up0").addresses == ("10.0.0.1/24",)
@@ -214,6 +215,7 @@ def test_stn_cli_oneshot_takeover(netns):
     rc = stn_main(["--takeover", "--interface", "up0", "--netns", ns,
                    "--state", state, "--oneshot"])
     assert rc == 0
-    data = json.load(open(state))
+    with open(state) as fh:
+        data = json.load(fh)
     assert data["name"] == "up0"
     assert data["addresses"] == ["10.0.0.1/24"]

@@ -1,0 +1,244 @@
+"""Native-engine sanitize subset — every C++ entry point, no device.
+
+`make native-sanitize` runs THIS file (plus test_hostshim's parse/
+apply oracle tests) against the ASan+UBSan hostshim flavor
+(`VPP_TPU_HOSTSHIM_LIB=native/build/libhostshim.asan.so` with libasan
+preloaded), so every hostshim.cpp / runnerloop.cpp surface — parse,
+apply, VXLAN encap/decap, ring push/pop, loop admit/harvest, the fused
+host path, slot frame access — executes under the sanitizers from the
+real ctypes marshalling layer, with the real view lifetimes.
+
+Deliberately NO jax dispatch anywhere in this file: jaxlib's MLIR
+bindings throw C++ exceptions through a statically linked __cxa_throw
+that the preloaded GCC ASan runtime cannot intercept (an environment
+incompatibility that aborts on ANY XLA lowering — not a hostshim bug),
+so the sanitized interpreter must never trigger a jit compile.  The
+C++-only ring/loop concurrency gets its TSan pass from loopbench's
+`threaded` mode instead.
+
+The file also runs in tier-1 (it is fast and device-free) as plain
+regression coverage of the native marshalling layer.
+"""
+
+import numpy as np
+import pytest
+
+from vpp_tpu.ops.packets import ip_to_u32
+from vpp_tpu.shim import HostShim
+from vpp_tpu.shim.hostshim import FrameBatch, NativeLoop, NativeRing
+from vpp_tpu.testing.frames import build_frame, frame_tuple, verify_checksums
+
+POD_BASE = ip_to_u32("10.1.0.0")
+POD_MASK = 0xFFFF0000
+NODE_BASE = ip_to_u32("10.1.1.0")
+NODE_MASK = 0xFFFFFF00
+HOST_BITS = 8
+ROUTE_LOCAL, ROUTE_REMOTE, ROUTE_HOST = 1, 2, 3
+
+
+@pytest.fixture(scope="module")
+def shim():
+    return HostShim()
+
+
+def _mixed_frames(n=96):
+    """The loopbench traffic mix: local pod-to-pod, cross-node remote,
+    egress host — plus a VLAN frame and a runt for the parse edges."""
+    frames = []
+    for i in range(n):
+        if i % 10 < 6:
+            dst = f"10.1.1.{2 + (i % 200)}"
+        elif i % 10 < 9:
+            dst = f"10.1.{2 + (i % 40)}.{2 + (i % 200)}"
+        else:
+            dst = "93.184.216.34"
+        frames.append(build_frame(
+            src_ip=f"10.1.1.{2 + ((i * 7) % 200)}", dst_ip=dst,
+            protocol=[6, 17][i % 2], src_port=40000 + i, dst_port=80,
+            vlan=100 if i % 13 == 0 else None,
+        ))
+    frames.append(b"\x02\x00")              # runt
+    frames.append(b"\xff" * 12 + b"\x08\x06" + b"\x00" * 28)  # ARP
+    return frames
+
+
+def _route_arrays(dst_ip: np.ndarray):
+    local = (dst_ip & NODE_MASK) == NODE_BASE
+    in_pod = (dst_ip & POD_MASK) == POD_BASE
+    tag = np.where(local, ROUTE_LOCAL,
+                   np.where(in_pod, ROUTE_REMOTE, ROUTE_HOST)).astype(np.int32)
+    node_id = np.where(in_pod & ~local,
+                       (dst_ip - POD_BASE) >> HOST_BITS, 0).astype(np.int32)
+    return tag, node_id
+
+
+class TestNativeRing:
+    def test_push_pop_roundtrip_and_backlog(self):
+        ring = NativeRing(arena_bytes=1 << 20, max_frames=512)
+        frames = _mixed_frames(32)
+        ring.send(frames)
+        assert len(ring) == len(frames)
+        assert ring.backlog_hint() == len(frames)
+        got = ring.recv_batch(1 << 10)
+        assert got == frames
+        assert len(ring) == 0
+        ring.close()
+
+    def test_overflow_counts_drops(self):
+        ring = NativeRing(arena_bytes=1 << 16, max_frames=8)
+        frames = [build_frame("10.1.1.2", "10.1.1.3")] * 32
+        ring.send(frames)
+        assert len(ring) <= 8
+        assert ring.dropped >= 24
+        ring.recv_batch(64)
+        ring.close()
+
+    def test_view_path(self):
+        """send_views/recv_views — the zero-copy lane AF_PACKET uses."""
+        ring = NativeRing(arena_bytes=1 << 20, max_frames=64)
+        frames = _mixed_frames(8)
+        lens = np.array([len(f) for f in frames], dtype=np.uint32)
+        offsets = np.zeros(len(frames), dtype=np.uint64)
+        np.cumsum(lens[:-1], dtype=np.uint64, out=offsets[1:])
+        buf = np.frombuffer(b"".join(frames), dtype=np.uint8)
+        ring.send_views(buf, offsets, lens)
+        out = ring.recv_views(64)
+        assert out is not None
+        out_buf, out_off, out_len = out
+        assert len(out_len) == len(frames)
+        for i, f in enumerate(frames):
+            start = int(out_off[i])
+            assert out_buf[start:start + int(out_len[i])].tobytes() == f
+        ring.close()
+
+
+class TestParseApplyVxlan:
+    def test_parse_apply_snat_rewrite(self, shim):
+        frames = _mixed_frames(64)
+        fb = shim.parse(frames)
+        n = fb.n
+        assert n == len(frames)
+        b = fb.batch
+        rewritten_fields = {
+            "src_ip": np.asarray(b.src_ip).copy(),
+            "dst_ip": np.asarray(b.dst_ip).copy(),
+            "protocol": np.asarray(b.protocol).copy(),
+            "src_port": np.asarray(b.src_port).copy(),
+            "dst_port": np.asarray(b.dst_port).copy(),
+        }
+        rewritten_fields["src_ip"][:n] = ip_to_u32("192.168.16.1")
+        rewritten_fields["src_port"][:n] = 61000
+        from vpp_tpu.ops.packets import PacketBatch
+
+        allowed = np.ones(n, dtype=np.uint8)
+        allowed[::7] = 0
+        out = shim.apply(fb, allowed, PacketBatch(**rewritten_fields))
+        parsed_rows = [i for i in range(n)
+                       if allowed[i] and (fb.flags[i] & 1)]
+        assert len(out) == len(parsed_rows)
+        for frame in out:
+            src, _, _, sport, _ = frame_tuple(frame)
+            assert (src, sport) == ("192.168.16.1", 61000)
+            assert verify_checksums(frame)
+
+    def test_vxlan_encap_decap_roundtrip(self, shim):
+        frames = [build_frame("10.1.1.2", f"10.1.{2 + i}.9", src_port=1000 + i)
+                  for i in range(16)]
+        fb = shim.parse(frames)
+        n = fb.n
+        dst = np.asarray(fb.batch.dst_ip)[:n]
+        tag, node_id = _route_arrays(dst)
+        remote_ips = np.zeros(64, dtype=np.uint32)
+        for node in range(2, 64):
+            remote_ips[node] = ip_to_u32(f"192.168.16.{node}")
+        fwd = np.ones(n, dtype=np.uint8)
+        is_remote = (tag == ROUTE_REMOTE).astype(np.uint8)
+        out_buf, out_off, out_len, out_rows, unroutable = shim.vxlan_encap(
+            fb, fwd, is_remote, node_id, remote_ips,
+            ip_to_u32("192.168.16.1"), 1, 10,
+        )
+        assert unroutable == 0 and len(out_rows) == int(is_remote.sum())
+        encapped = [
+            out_buf[int(out_off[j]):int(out_off[j]) + int(out_len[j])].tobytes()
+            for j in range(len(out_rows))
+        ]
+        # Decap view sees the VNI and the inner frame of every capsule.
+        lens = np.array([len(f) for f in encapped], dtype=np.uint32)
+        offsets = np.zeros(len(encapped), dtype=np.uint64)
+        np.cumsum(lens[:-1], dtype=np.uint64, out=offsets[1:])
+        buf = np.frombuffer(b"".join(encapped), dtype=np.uint8)
+        in_off, in_len, vnis = shim.vxlan_decap_view(buf, offsets, lens)
+        assert (vnis == 10).all()
+        inner = shim.parse_view(buf, in_off, in_len)
+        assert inner.n == len(encapped)
+        got = set(map(int, np.asarray(inner.batch.dst_ip)[:inner.n]))
+        want = set(map(int, dst[is_remote.astype(bool)]))
+        assert got == want
+
+
+class TestNativeLoop:
+    def _loop(self):
+        rx = NativeRing(arena_bytes=4 << 20, max_frames=1 << 12)
+        txr = NativeRing(arena_bytes=4 << 20, max_frames=1 << 12)
+        txl = NativeRing(arena_bytes=4 << 20, max_frames=1 << 12)
+        txh = NativeRing(arena_bytes=4 << 20, max_frames=1 << 12)
+        loop = NativeLoop(rx, txr, txl, txh, batch_size=64, max_vectors=4,
+                          vni=10, n_slots=3)
+        return loop, rx, txr, txl, txh
+
+    def test_admit_harvest_full_cycle(self):
+        loop, rx, txr, txl, txh = self._loop()
+        frames = _mixed_frames(96)
+        rx.send(frames)
+        remote_ips = np.zeros(64, dtype=np.uint32)
+        for node in range(2, 64):
+            remote_ips[node] = ip_to_u32(f"192.168.16.{node}")
+        sent_total = 0
+        while True:
+            ac = np.zeros(NativeLoop.ADMIT_COUNTERS, dtype=np.uint64)
+            n, k, soa = loop.admit(0, ac, 2)
+            if n == 0:
+                break
+            # Forensics path: slot frames must match what went in.
+            assert isinstance(loop.slot_frame(0, 0), bytes)
+            tag, node_id = _route_arrays(soa["dst_ip"][:n])
+            allowed = np.ones(n, dtype=np.uint8)
+            hc = np.zeros(NativeLoop.HARVEST_COUNTERS, dtype=np.uint64)
+            sent_total += loop.harvest(
+                0, allowed, soa["src_ip"][:n], soa["dst_ip"][:n],
+                soa["src_port"][:n], soa["dst_port"][:n], tag, node_id,
+                remote_ips, ip_to_u32("192.168.16.1"), 1, hc,
+            )
+        # Every parseable frame forwarded somewhere; the runt/ARP dropped.
+        assert sent_total == len(frames) - 2
+        assert len(txr) + len(txl) + len(txh) == sent_total
+        for ring in (txr, txl, txh):
+            for frame in ring.recv_batch(1 << 12):
+                assert verify_checksums(frame)
+        loop.close()
+        for r in (rx, txr, txl, txh):
+            r.close()
+
+    def test_fused_hostpath(self):
+        loop, rx, txr, txl, txh = self._loop()
+        frames = _mixed_frames(64)
+        rx.send(frames)
+        remote_ips = np.zeros(64, dtype=np.uint32)
+        for node in range(2, 64):
+            remote_ips[node] = ip_to_u32(f"192.168.16.{node}")
+        ac = np.zeros(NativeLoop.ADMIT_COUNTERS, dtype=np.uint64)
+        hc = np.zeros(NativeLoop.HARVEST_COUNTERS, dtype=np.uint64)
+        consumed = 0
+        while True:
+            n, sent = loop.hostpath(
+                0, POD_BASE, POD_MASK, NODE_BASE, NODE_MASK, HOST_BITS,
+                remote_ips, ip_to_u32("192.168.16.1"), 1, ac, hc,
+            )
+            if n == 0 and int(ac[0]) == consumed:
+                break
+            consumed = int(ac[0])
+        assert int(ac[0]) == len(frames)
+        assert len(txr) + len(txl) + len(txh) == len(frames) - 2
+        loop.close()
+        for r in (rx, txr, txl, txh):
+            r.close()

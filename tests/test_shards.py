@@ -230,15 +230,23 @@ def test_afpacket_fanout_spreads_frames():
     ingest path of the sharded engine)."""
     from vpp_tpu.datapath.io import AfPacketIO
 
+    opened = []
     try:
         tx = AfPacketIO("lo")
+        opened.append(tx)
         # Round-robin mode guarantees both sockets receive (hash mode
         # would too on 16 distinct flows, but is kernel-hash dependent).
         rx_a = AfPacketIO("lo", blocking_ms=300, fanout_group=77,
                           fanout_mode="lb")
+        opened.append(rx_a)
         rx_b = AfPacketIO("lo", blocking_ms=300, fanout_group=77,
                           fanout_mode="lb")
+        opened.append(rx_b)
     except (PermissionError, OSError) as e:
+        # Close whatever DID construct (fanout can fail on the second
+        # socket with the first already bound) — a skip must not leak.
+        for io_obj in opened:
+            io_obj.close()
         pytest.skip(f"AF_PACKET unavailable: {e}")
     try:
         sent = [

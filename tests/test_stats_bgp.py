@@ -62,11 +62,19 @@ class TestStatsCollector:
 
         class R:
             allowed = np.array([1, 1, 0, 1], dtype=bool)
+            punt = np.array([0, 1, 0, 0], dtype=bool)
 
         stats = counters_from_result(R())
         assert stats.in_packets == 4
         assert stats.out_packets == 3
         assert stats.drop_packets == 1
+        # ISSUE 7 regression: puntPackets was exported but never set.
+        assert stats.punt_packets == 1
+
+        class NoPunt:
+            allowed = np.array([1], dtype=bool)
+
+        assert counters_from_result(NoPunt()).punt_packets == 0
 
 
 class FakeRouteSource:

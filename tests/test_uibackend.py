@@ -64,8 +64,15 @@ def get(backend, path, auth=None):
         req.add_header(
             "Authorization", "Basic " + base64.b64encode(auth.encode()).decode()
         )
-    with urllib.request.urlopen(req, timeout=5) as resp:
-        return resp.status, resp.read()
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as exc:
+        # The error object carries an open response socket; close it
+        # here (code/headers stay readable) so `pytest.raises` call
+        # sites cannot leak it — the test-race ResourceWarning gate.
+        exc.close()
+        raise
 
 
 def test_contiv_route_proxies_to_agent(backend):
@@ -167,6 +174,7 @@ def test_netctl_malformed_body_400(backend):
         with pytest.raises(urllib.error.HTTPError) as exc:
             urllib.request.urlopen(req, timeout=5)
         assert exc.value.code == 400
+        exc.value.close()  # see get(): the error holds a live socket
 
 
 def test_k8s_route_unconfigured_502(backend):
@@ -495,8 +503,12 @@ def test_netctl_route_resolves_node_to_server(backend):
             f"http://127.0.0.1:{backend.port}/api/netctl",
             data=json.dumps(payload).encode(), method="POST",
         )
-        with urllib.request.urlopen(req, timeout=5) as resp:
-            return json.loads(resp.read())
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as exc:
+            exc.close()  # see get(): pytest.raises sites must not leak
+            raise
 
     out = post({"args": ["nodes"], "node": "node1"})
     assert out["output"].startswith("ran: nodes --server 127.0.0.1:")

@@ -1,0 +1,42 @@
+"""Project-native static analysis — the invariant battery.
+
+NOTES_r05 proved the datapath is dispatch-floor-bound: one accidental
+host↔device sync in the admit/dispatch/harvest path silently erases
+the governor's win, and nothing in `make lint` would catch it.  This
+package encodes the repo's REAL invariants as ``ast``-based checkers:
+
+- ``hot-path-sync``     — no host-sync constructs reachable from the
+  dispatch/admit/harvest/steering hot paths (vpp_tpu/analysis/hotpath.py);
+- ``jit-discipline``    — jax.jit callables in ops/ and datapath/ are
+  module-level, and dispatch-shaped jits are pre-warm-registered
+  (vpp_tpu/analysis/jit_discipline.py);
+- ``lock-discipline``   — cross-thread attributes carry ``# guarded-by:``
+  / ``# lock-free:`` / ``# owner:`` annotations and guarded writes stay
+  inside their lock (vpp_tpu/analysis/locks.py);
+- ``obs-parity``        — every counter is live and exported, inspect()
+  matches the dashboard's expectations, every REST route has a netctl
+  or test consumer (vpp_tpu/analysis/obs_parity.py).
+
+Findings can be waived INLINE with a reason (core.py waiver syntax):
+
+    something_suspect()  # static: allow(hot-path-sync) — why it's fine
+
+The CLI gate is ``scripts/check_static.py`` (wired into ``make lint``
+and ``make verify-static``); the checkers self-test on fixture
+snippets in ``tests/test_static_analysis.py``.
+"""
+
+from .core import (  # noqa: F401
+    CHECKERS,
+    Checker,
+    Finding,
+    Project,
+    register,
+    run_checks,
+)
+
+# Importing the checker modules registers them.
+from . import hotpath  # noqa: F401,E402
+from . import jit_discipline  # noqa: F401,E402
+from . import locks  # noqa: F401,E402
+from . import obs_parity  # noqa: F401,E402

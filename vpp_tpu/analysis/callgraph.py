@@ -1,0 +1,277 @@
+"""Call-graph builder — name/import-resolved, with method dispatch.
+
+Gives the hot-path checker its reachability set: which functions can
+run under ``runner._dispatch`` / admit / harvest / shard steering.
+
+Resolution is deliberately CONSERVATIVE (an over-approximation — a
+missed edge would silently exempt code from the hot-path invariant,
+while a spurious edge costs at worst one explicit waiver):
+
+- ``name(...)``       → the caller's module first, then the caller's
+  ``from X import name`` bindings, then any project def of that name;
+- ``alias.attr(...)`` where ``alias`` is an imported module → that
+  module's ``attr`` exactly;
+- ``self.m(...)``     → methods named ``m`` on the caller's class, its
+  project bases and its project subclasses (method dispatch);
+- ``obj.m(...)``      → every project def named ``m`` — except names in
+  ``COMMON_METHODS`` (dict/list/deque/lock/executor vocabulary), which
+  would wire the whole repo into every hot path.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import Project, SourceFile
+
+# Attribute-call names too generic to resolve project-wide: stdlib
+# container/concurrency vocabulary.  `self.<name>` calls still resolve
+# class-locally, so a project method with one of these names keeps its
+# same-class edges.
+COMMON_METHODS = frozenset({
+    "get", "put", "set", "add", "pop", "popleft", "append", "appendleft",
+    "remove", "clear", "update", "copy", "keys", "values", "items",
+    "join", "split", "strip", "startswith", "endswith", "format",
+    "encode", "decode", "read", "write", "flush", "close", "open",
+    "acquire", "release", "wait", "notify", "submit", "map", "shutdown",
+    "result", "done", "cancel", "start", "stop", "sort", "sum", "any",
+    "all", "index", "count", "extend", "setdefault", "is_set", "send",
+    "__init__", "delete", "create", "commit", "poll", "apply", "status",
+    "replace", "snapshot", "resync", "dump", "list",
+})
+
+# Callables handed to these become edges too: a thread/executor target
+# IS called, just on another thread.
+_DEFERRED_CALLERS = frozenset({"submit", "map", "Thread", "Timer",
+                               "start_new_thread"})
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    qualname: str                 # module.Class.name | module.name
+    module: str
+    cls: Optional[str]            # enclosing class simple name
+    name: str
+    path: str
+    node: ast.AST                 # FunctionDef / AsyncFunctionDef
+    lineno: int
+
+
+class _ImportMap:
+    """alias → dotted target for one module."""
+
+    def __init__(self, sf: SourceFile):
+        self.modules: Dict[str, str] = {}   # alias -> module dotted path
+        self.names: Dict[str, str] = {}     # alias -> module.attr
+        pkg_parts = sf.module.split(".")
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.modules[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg_parts[: len(pkg_parts) - node.level]
+                    mod = ".".join(base + ([node.module] if node.module else []))
+                else:
+                    mod = node.module or ""
+                for a in node.names:
+                    self.names[a.asname or a.name] = f"{mod}.{a.name}"
+
+
+class CallGraph:
+    def __init__(self, project: Project):
+        self.project = project
+        self.funcs: Dict[str, FuncInfo] = {}
+        self.by_name: Dict[str, List[FuncInfo]] = {}
+        self.class_bases: Dict[str, List[str]] = {}   # module.Class -> base names
+        self.imports: Dict[str, _ImportMap] = {}
+        self._edges: Dict[str, Set[str]] = {}
+        self._index()
+
+    # ------------------------------------------------------------ indexing
+
+    def _index(self) -> None:
+        for sf in self.project.files.values():
+            self.imports[sf.module] = _ImportMap(sf)
+            for node in sf.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    bases = [self._base_name(b) for b in node.bases]
+                    self.class_bases[f"{sf.module}.{node.name}"] = \
+                        [b for b in bases if b]
+                    for item in node.body:
+                        if isinstance(item, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                            self._add(sf, item, cls=node.name)
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._add(sf, node, cls=None)
+
+    @staticmethod
+    def _base_name(node: ast.AST) -> str:
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        return ""
+
+    def _add(self, sf: SourceFile, node, cls: Optional[str]) -> None:
+        qual = f"{sf.module}.{cls}.{node.name}" if cls else \
+            f"{sf.module}.{node.name}"
+        info = FuncInfo(qualname=qual, module=sf.module, cls=cls,
+                        name=node.name, path=sf.path, node=node,
+                        lineno=node.lineno)
+        self.funcs[qual] = info
+        self.by_name.setdefault(node.name, []).append(info)
+
+    # ---------------------------------------------------------- resolution
+
+    def _related_classes(self, module: str, cls: str) -> Set[Tuple[str, str]]:
+        """(module, class) pairs dispatch on ``self`` may land in: the
+        class itself, project bases, and project subclasses."""
+        out = {(module, cls)}
+        # bases (one level is enough for this repo's hierarchies)
+        for qual, bases in self.class_bases.items():
+            mod, _, name = qual.rpartition(".")
+            if name == cls and mod == module:
+                for b in bases:
+                    for q2 in self.class_bases:
+                        m2, _, n2 = q2.rpartition(".")
+                        if n2 == b:
+                            out.add((m2, n2))
+            # subclasses of cls anywhere in the project
+            if cls in bases:
+                out.add((mod, name))
+        return out
+
+    def callees(self, info: FuncInfo) -> List[FuncInfo]:
+        cached = self._edges.get(info.qualname)
+        if cached is not None:
+            return [self.funcs[q] for q in cached if q in self.funcs]
+        imap = self.imports.get(info.module)
+        out: Set[str] = set()
+
+        def resolve_ref(ref: ast.AST) -> None:
+            """A callable REFERENCE (thread target, submit arg)."""
+            if isinstance(ref, ast.Attribute):
+                out.update(f.qualname for f in self._resolve_attr(
+                    ref, info, imap, allow_common=True))
+            elif isinstance(ref, ast.Name):
+                out.update(f.qualname for f in self._resolve_name(
+                    ref.id, info, imap))
+
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Name):
+                out.update(f.qualname for f in self._resolve_name(
+                    func.id, info, imap))
+                name = func.id
+            elif isinstance(func, ast.Attribute):
+                out.update(f.qualname for f in self._resolve_attr(
+                    func, info, imap))
+                name = func.attr
+            else:
+                continue
+            if name in _DEFERRED_CALLERS:
+                # submit(fn, ...) / map(fn, it) / Thread(target=fn)
+                if node.args:
+                    resolve_ref(node.args[0])
+                if name == "Timer" and len(node.args) >= 2:
+                    resolve_ref(node.args[1])
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        resolve_ref(kw.value)
+        self._edges[info.qualname] = out
+        return [self.funcs[q] for q in out if q in self.funcs]
+
+    def _resolve_name(self, name: str, caller: FuncInfo,
+                      imap: Optional[_ImportMap]) -> List[FuncInfo]:
+        local = self.funcs.get(f"{caller.module}.{name}")
+        if local is not None:
+            return [local]
+        if imap and name in imap.names:
+            target = self.funcs.get(imap.names[name])
+            if target is not None:
+                return [target]
+            # from X import Y where Y is a class: constructor edge
+            mod, _, attr = imap.names[name].rpartition(".")
+            init = self.funcs.get(f"{mod}.{attr}.__init__")
+            if init is not None:
+                return [init]
+            return []
+        # Class constructor in the same module.
+        init = self.funcs.get(f"{caller.module}.{name}.__init__")
+        if init is not None:
+            return [init]
+        return []
+
+    def _resolve_attr(self, func: ast.Attribute, caller: FuncInfo,
+                      imap: Optional[_ImportMap],
+                      allow_common: bool = False) -> List[FuncInfo]:
+        attr = func.attr
+        value = func.value
+        # super().m(...) → project base classes of the caller's class
+        if isinstance(value, ast.Call) and \
+                isinstance(value.func, ast.Name) and \
+                value.func.id == "super" and caller.cls is not None:
+            hits = []
+            for base in self.class_bases.get(
+                    f"{caller.module}.{caller.cls}", ()):
+                for q, info in self.funcs.items():
+                    if info.cls == base and info.name == attr:
+                        hits.append(info)
+            return hits
+        # module alias: np.asarray, mod.func — exact or external (empty)
+        if isinstance(value, ast.Name):
+            if imap and value.id in imap.modules:
+                target = self.funcs.get(f"{imap.modules[value.id]}.{attr}")
+                return [target] if target else []
+            if value.id == "self" and caller.cls is not None:
+                hits = []
+                for mod, cls in self._related_classes(caller.module,
+                                                      caller.cls):
+                    t = self.funcs.get(f"{mod}.{cls}.{attr}")
+                    if t is not None:
+                        hits.append(t)
+                if hits:
+                    return hits
+                # fall through: self.<injected-component>.… not a method
+        if attr in COMMON_METHODS and not allow_common:
+            return []
+        if allow_common and attr == "__init__":
+            return []
+        return list(self.by_name.get(attr, ()))
+
+    # -------------------------------------------------------- reachability
+
+    def reachable(
+        self,
+        roots: Iterable[str],
+        prune: Sequence[str] = (),
+    ) -> Dict[str, List[str]]:
+        """BFS from root qualnames; returns {qualname: chain-from-root}.
+        ``prune`` entries (qualname suffixes) are still REPORTED as
+        reached but their bodies are not traversed — the sanctioned-
+        sync-point semantics (their own code is exempt, their callees
+        are only checked if reached some other way)."""
+        chains: Dict[str, List[str]] = {}
+        queue: List[str] = []
+        for r in roots:
+            matches = [q for q in self.funcs if q == r or q.endswith("." + r)]
+            for q in matches:
+                if q not in chains:
+                    chains[q] = [q]
+                    queue.append(q)
+        def pruned(q: str) -> bool:
+            return any(q == p or q.endswith("." + p) for p in prune)
+        while queue:
+            q = queue.pop(0)
+            if pruned(q):
+                continue
+            for callee in self.callees(self.funcs[q]):
+                if callee.qualname not in chains:
+                    chains[callee.qualname] = chains[q] + [callee.qualname]
+                    queue.append(callee.qualname)
+        return chains

@@ -1,0 +1,218 @@
+"""Static-analysis core: source index, waivers, registry, runner.
+
+Design constraints that shaped this module:
+
+- **stdlib only** (``ast`` + ``tokenize``): the checkers run in CI and
+  in the container image, which bakes no linting toolchain.
+- **project-native**: generic linters cannot know that ``np.asarray``
+  in ``_harvest_native`` is the sanctioned materialisation point while
+  the same call in ``_dispatch_locked`` erases the 2.83× governor win.
+  Checkers here are parameterised with the repo's own roots/allowlists.
+- **waivable with a written reason**: every rule can be silenced at a
+  single site with ``# static: allow(<rule>) — <reason>``; a waiver
+  without a reason is itself a finding (no silent waivers — the ISSUE 7
+  policy, enforced here rather than by review).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Type
+
+# Waiver syntax:   # static: allow(<rule>) — <reason>
+# The dash may be an em/en dash or one or more ASCII hyphens; the
+# reason is REQUIRED (an empty reason is reported as a finding).
+# A waiver trailing a line covers that line; a waiver alone on a line
+# covers the NEXT source line (for statements too long to share one).
+_WAIVER_RE = re.compile(
+    r"#\s*static:\s*allow\(\s*([\w*-]+)\s*\)\s*(?:[—–-]+\s*(?P<reason>.*\S))?\s*$"
+)
+
+
+@dataclasses.dataclass
+class Waiver:
+    rule: str
+    line: int          # the source line the waiver covers
+    reason: str
+    decl_line: int     # where the waiver comment itself sits
+    used: bool = False
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at one site."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    waived: bool = False
+    waiver_reason: str = ""
+
+    def format(self) -> str:
+        tag = " (waived: %s)" % self.waiver_reason if self.waived else ""
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}{tag}"
+
+
+class SourceFile:
+    """One parsed python source file + its waiver table."""
+
+    def __init__(self, path: str, text: str, module: str):
+        self.path = path
+        self.text = text
+        self.module = module          # dotted module name, e.g. vpp_tpu.ops.nat
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        self.waivers: List[Waiver] = []
+        self._parse_waivers()
+
+    def _parse_waivers(self) -> None:
+        for i, raw in enumerate(self.lines, start=1):
+            if "static:" not in raw:
+                continue
+            m = _WAIVER_RE.search(raw)
+            if m is None:
+                continue
+            covers = i if raw[: m.start()].strip() else i + 1
+            self.waivers.append(Waiver(
+                rule=m.group(1),
+                line=covers,
+                reason=(m.group("reason") or "").strip(),
+                decl_line=i,
+            ))
+
+    def waiver_for(self, rule: str, line: int) -> Optional[Waiver]:
+        for w in self.waivers:
+            if w.line == line and w.rule in (rule, "*"):
+                return w
+        return None
+
+    def src(self, node: ast.AST) -> str:
+        return ast.get_source_segment(self.text, node) or ""
+
+
+class Project:
+    """The file index every checker works over."""
+
+    def __init__(self, files: Dict[str, SourceFile]):
+        self.files = files
+
+    @classmethod
+    def load(cls, paths: Sequence[str], root: Optional[str] = None) -> "Project":
+        """Index every ``*.py`` under ``paths``.  ``root`` anchors the
+        dotted module names (defaults to the common parent so that
+        ``vpp_tpu/ops/nat.py`` → ``vpp_tpu.ops.nat``)."""
+        files: Dict[str, SourceFile] = {}
+        for p in paths:
+            p = os.path.abspath(p)
+            base = os.path.abspath(root) if root else os.path.dirname(p)
+            if os.path.isfile(p):
+                cls._add(files, p, base)
+                continue
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        cls._add(files, os.path.join(dirpath, fn), base)
+        return cls(files)
+
+    @classmethod
+    def from_sources(cls, sources: Dict[str, str]) -> "Project":
+        """Build from in-memory {relpath: source} — the fixture path the
+        self-tests use."""
+        files = {}
+        for relpath, text in sources.items():
+            module = relpath[:-3].replace("/", ".").replace("\\", ".")
+            files[relpath] = SourceFile(relpath, text, module)
+        return cls(files)
+
+    @staticmethod
+    def _add(files: Dict[str, SourceFile], path: str, base: str) -> None:
+        rel = os.path.relpath(path, base)
+        module = rel[:-3].replace(os.sep, ".")
+        if module.endswith(".__init__"):
+            module = module[: -len(".__init__")]
+        with open(path) as fh:
+            text = fh.read()
+        files[rel] = SourceFile(path=rel, text=text, module=module)
+
+    def by_module(self, module: str) -> Optional[SourceFile]:
+        for f in self.files.values():
+            if f.module == module:
+                return f
+        return None
+
+
+class Checker:
+    """Base checker: subclass, set ``rule``, implement ``check``."""
+
+    rule: str = ""
+    description: str = ""
+
+    def check(self, project: Project) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+CHECKERS: Dict[str, Type[Checker]] = {}
+
+
+def register(cls: Type[Checker]) -> Type[Checker]:
+    if not cls.rule:
+        raise ValueError(f"{cls.__name__} has no rule name")
+    if cls.rule in CHECKERS:
+        raise ValueError(f"duplicate checker rule {cls.rule!r}")
+    CHECKERS[cls.rule] = cls
+    return cls
+
+
+def run_checks(
+    project: Project,
+    rules: Optional[Iterable[str]] = None,
+    checkers: Optional[Sequence[Checker]] = None,
+) -> Tuple[List[Finding], List[Finding]]:
+    """Run the selected checkers; returns ``(unwaived, waived)``.
+
+    Waivers are applied here (one implementation for every rule), and
+    waiver hygiene is enforced: a waiver with no reason string is an
+    unwaivable ``waiver-syntax`` finding.
+    """
+    if checkers is None:
+        selected = rules if rules is not None else sorted(CHECKERS)
+        checkers = [CHECKERS[r]() for r in selected]
+    unwaived: List[Finding] = []
+    waived: List[Finding] = []
+    for checker in checkers:
+        for finding in checker.check(project):
+            sf = project.files.get(finding.path)
+            w = sf.waiver_for(checker.rule, finding.line) if sf else None
+            if w is not None and w.reason:
+                w.used = True
+                finding.waived = True
+                finding.waiver_reason = w.reason
+                waived.append(finding)
+            else:
+                unwaived.append(finding)
+    # Waiver hygiene: reasons are mandatory, waivers must attach to a rule.
+    for sf in project.files.values():
+        for w in sf.waivers:
+            if not w.reason:
+                unwaived.append(Finding(
+                    rule="waiver-syntax", path=sf.path, line=w.decl_line,
+                    message=(
+                        f"waiver for {w.rule!r} has no reason — write "
+                        "'# static: allow(%s) — <why this site is safe>'"
+                        % w.rule
+                    ),
+                ))
+            elif w.rule != "*" and w.rule not in CHECKERS:
+                unwaived.append(Finding(
+                    rule="waiver-syntax", path=sf.path, line=w.decl_line,
+                    message=f"waiver names unknown rule {w.rule!r} "
+                            f"(have: {', '.join(sorted(CHECKERS))})",
+                ))
+    unwaived.sort(key=lambda f: (f.path, f.line, f.rule))
+    waived.sort(key=lambda f: (f.path, f.line, f.rule))
+    return unwaived, waived

@@ -1,0 +1,196 @@
+"""hot-path-sync — no host↔device syncs on the dispatch-floor path.
+
+NOTES_r05: the production dispatch is dispatch-floor-bound — device
+compute is essentially free and each host↔device round trip is what
+costs.  One accidental ``.item()`` / ``np.asarray`` / implicit
+``bool()`` on a device value inside admit/dispatch/steering erases the
+governor's 2.83× win and nothing functional breaks, so only a machine
+check catches it.  This checker walks every function reachable (call
+graph, method dispatch included) from the datapath roots and flags:
+
+- ``.item()`` and ``.block_until_ready()`` calls;
+- ``np.asarray(...)`` / ``jax.device_get(...)`` — device→host reads;
+- ``time.time()`` — wall clock on the hot path (drifts under NTP; the
+  timing fit must use ``perf_counter``/``monotonic``);
+- ``int()/float()/bool()`` over expressions that mention a device
+  value (``jnp.``-rooted expressions, pipeline ``result`` fields, the
+  device ``sessions`` table).
+
+Sanctioned sync points (the harvest materialisation, swap-time bypass
+derivation, the all-shards-down host path, occupancy gauges) are
+listed in ``SANCTIONED``: their own bodies are exempt and traversal
+stops there.  Anything else syncs only with an inline waiver.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence
+
+from .callgraph import CallGraph
+from .core import Checker, Finding, Project, register
+
+# Where the hot paths start (qualname suffixes; resolved against the
+# project, so fixture modules can declare their own roots).
+DEFAULT_ROOTS = (
+    "DataplaneRunner._dispatch",
+    "DataplaneRunner._admit",
+    "DataplaneRunner._harvest",
+    "ShardedDataplane._steer",
+    "ShardedDataplane.poll",
+)
+
+# Sanctioned sync points: these functions' own bodies may sync (each
+# one is a DESIGNED host block); traversal is pruned at them.
+DEFAULT_SANCTIONED = (
+    # The harvest is the one sanctioned materialisation point: the host
+    # blocks on the OLDEST in-flight batch only, by design.
+    "DataplaneRunner._harvest_native",
+    "DataplaneRunner._harvest_python",
+    # Host-stitched quarantine recovery: already on the failure path.
+    "DataplaneRunner._quarantine_dispatch",
+    # Swap-time bypass eligibility pays its occupancy reads once per
+    # table swap, not per batch.
+    "DataplaneRunner._refresh_bypass",
+    "DataplaneRunner._bypass_static_ok",
+    "DataplaneRunner._bypass_state_clear",
+    "DataplaneRunner._bypass_once",
+    # The all-shards-down degraded mode is an explicit host path.
+    "ShardedDataplane._bypass_forward",
+    # Occupancy gauges are host-side reads by contract (/metrics).
+    "session_occupancy",
+    "affinity_occupancy",
+)
+
+# Modules BELOW the device boundary: pure host-side marshalling whose
+# numpy work never touches a device value (np.asarray on a host buffer
+# is a view, not a sync).  Reached functions there are exempt.
+DEFAULT_HOST_MODULES = (
+    "vpp_tpu.shim.hostshim",
+)
+
+# Names whose appearance inside an int()/float()/bool() argument marks
+# the cast as a device-value materialisation.
+DEVICE_VALUE_NAMES = frozenset({"result", "res", "sessions"})
+
+_CASTS = ("int", "float", "bool")
+
+
+def _mentions_device_value(node: ast.AST, jnp_aliases: frozenset) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in DEVICE_VALUE_NAMES:
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr in DEVICE_VALUE_NAMES:
+            return True
+        if isinstance(sub, ast.Name) and sub.id in jnp_aliases:
+            return True
+    return False
+
+
+@register
+class HotPathSyncChecker(Checker):
+    rule = "hot-path-sync"
+    description = (
+        "no host-sync constructs (.item/np.asarray/device casts/"
+        "block_until_ready/time.time) reachable from the datapath "
+        "dispatch, admit, harvest, or steering roots"
+    )
+
+    def __init__(self, roots: Sequence[str] = DEFAULT_ROOTS,
+                 sanctioned: Sequence[str] = DEFAULT_SANCTIONED,
+                 host_modules: Sequence[str] = DEFAULT_HOST_MODULES):
+        self.roots = roots
+        self.sanctioned = sanctioned
+        self.host_modules = host_modules
+
+    def check(self, project: Project) -> List[Finding]:
+        graph = CallGraph(project)
+        # Sanctioned functions are BODY-exempt but still traversed
+        # through: a helper they call is on the hot path unless it is
+        # itself sanctioned.
+        chains = graph.reachable(self.roots, prune=())
+        findings: List[Finding] = []
+        for qual, chain in sorted(chains.items()):
+            if any(qual == p or qual.endswith("." + p)
+                   for p in self.sanctioned):
+                continue
+            if graph.funcs[qual].module in self.host_modules:
+                continue
+            info = graph.funcs[qual]
+            sf = project.files[info.path]
+            findings.extend(self._check_func(sf, info, chain))
+        return findings
+
+    # ------------------------------------------------------------ per-func
+
+    def _check_func(self, sf, info, chain) -> List[Finding]:
+        imap = {}
+        np_aliases = set()
+        jax_aliases = set()
+        time_aliases = set()
+        jnp_aliases = set()
+        # Alias maps come from the whole module (imports may be at the
+        # top or function-local, e.g. `import time as _time`).
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    alias = a.asname or a.name.split(".")[0]
+                    if a.name == "numpy":
+                        np_aliases.add(alias)
+                    elif a.name == "jax":
+                        jax_aliases.add(alias)
+                    elif a.name == "time":
+                        time_aliases.add(alias)
+                    elif a.name == "jax.numpy":
+                        jnp_aliases.add(alias)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "jax" and not node.level:
+                    for a in node.names:
+                        if a.name == "numpy":
+                            jnp_aliases.add(a.asname or "numpy")
+                        if a.name == "device_get":
+                            imap[a.asname or "device_get"] = "jax.device_get"
+                if node.module == "time" and not node.level:
+                    for a in node.names:
+                        if a.name == "time":
+                            imap[a.asname or "time"] = "time.time"
+        jnp_frozen = frozenset(jnp_aliases)
+        hop = " → ".join(q.rsplit(".", 1)[-1] for q in chain)
+        out: List[Finding] = []
+
+        def flag(node: ast.AST, what: str) -> None:
+            out.append(Finding(
+                rule=self.rule, path=sf.path, line=node.lineno,
+                message=f"{what} on the hot path (via {hop})",
+            ))
+
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                base = func.value
+                base_name = base.id if isinstance(base, ast.Name) else None
+                if func.attr == "item" and not node.args:
+                    flag(node, "`.item()` (device→host scalar sync)")
+                elif func.attr == "block_until_ready":
+                    flag(node, "`.block_until_ready()` (explicit device barrier)")
+                elif func.attr == "asarray" and base_name in np_aliases:
+                    flag(node, "`np.asarray(...)` (device→host materialisation)")
+                elif func.attr == "device_get" and base_name in jax_aliases:
+                    flag(node, "`jax.device_get(...)` (device→host transfer)")
+                elif func.attr == "time" and base_name in time_aliases:
+                    flag(node, "`time.time()` (wall clock; use "
+                               "perf_counter/monotonic)")
+            elif isinstance(func, ast.Name):
+                target = imap.get(func.id)
+                if target == "jax.device_get":
+                    flag(node, "`device_get(...)` (device→host transfer)")
+                elif target == "time.time":
+                    flag(node, "`time()` (wall clock; use "
+                               "perf_counter/monotonic)")
+                elif func.id in _CASTS and node.args and \
+                        _mentions_device_value(node.args[0], jnp_frozen):
+                    flag(node, f"`{func.id}(...)` over a device value "
+                               "(implicit host sync)")
+        return out

@@ -1,0 +1,157 @@
+"""jit-discipline — jax.jit construction and pre-warm registration.
+
+Two invariants from the PR 4 governor work:
+
+1. **No jit construction in hot code.**  ``jax.jit(...)`` inside a
+   function body in ``ops/`` or ``datapath/`` builds a NEW jit wrapper
+   (and its own cache entry) per call — a load spike then stalls on a
+   fresh trace+compile exactly when latency matters.  Jit callables
+   must be module-level (``pipeline_step_jit = jax.jit(...)``) or
+   decorator-applied; anything else needs a waiver explaining its
+   caching story.
+
+2. **Dispatch-shaped jits register with the pre-warm ledger.**  Every
+   ``pipeline_*_jit`` entry point the runner's dispatch references
+   must also be referenced by ``DataplaneRunner._prewarm_one`` — the
+   pow2-bucket pre-warm compiles every shape a load spike can select,
+   and a dispatch path that can pick a jit the warmer never compiled
+   reintroduces the mid-traffic compile stall the ledger exists to
+   kill.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence, Set
+
+from .core import Checker, Finding, Project, register
+
+DEFAULT_SCOPES = ("vpp_tpu.ops.", "vpp_tpu.datapath.")
+DEFAULT_DISPATCH_FUNC = "DataplaneRunner._dispatch_locked"
+DEFAULT_PREWARM_FUNC = "DataplaneRunner._prewarm_one"
+
+
+def _jit_aliases(tree: ast.AST) -> tuple:
+    """(jax module aliases, bare names bound to jax.jit)."""
+    jax_aliases: Set[str] = set()
+    jit_names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax":
+                    jax_aliases.add(a.asname or "jax")
+        elif isinstance(node, ast.ImportFrom) and node.module == "jax" \
+                and not node.level:
+            for a in node.names:
+                if a.name == "jit":
+                    jit_names.add(a.asname or "jit")
+    return jax_aliases, jit_names
+
+
+def _is_jit_call(node: ast.Call, jax_aliases, jit_names) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "jit" and \
+            isinstance(f.value, ast.Name) and f.value.id in jax_aliases:
+        return True
+    return isinstance(f, ast.Name) and f.id in jit_names
+
+
+@register
+class JitDisciplineChecker(Checker):
+    rule = "jit-discipline"
+    description = (
+        "jax.jit callables in ops/ and datapath/ are module-level (no "
+        "construction in functions), and dispatch-referenced "
+        "pipeline_*_jit entry points are pre-warm-registered"
+    )
+
+    def __init__(self, scopes: Sequence[str] = DEFAULT_SCOPES,
+                 dispatch_func: str = DEFAULT_DISPATCH_FUNC,
+                 prewarm_func: str = DEFAULT_PREWARM_FUNC):
+        self.scopes = scopes
+        self.dispatch_func = dispatch_func
+        self.prewarm_func = prewarm_func
+
+    def _in_scope(self, module: str) -> bool:
+        return any(module.startswith(s) or module == s.rstrip(".")
+                   for s in self.scopes)
+
+    def check(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        module_jits: Set[str] = set()   # module-level *_jit names
+        for sf in project.files.values():
+            if not self._in_scope(sf.module):
+                continue
+            jax_aliases, jit_names = _jit_aliases(sf.tree)
+            if not jax_aliases and not jit_names:
+                continue
+            # Module-level jit assignments are the SANCTIONED form.
+            for node in sf.tree.body:
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call) and \
+                        _is_jit_call(node.value, jax_aliases, jit_names):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            module_jits.add(t.name if hasattr(t, "name")
+                                            else t.id)
+            # jit construction inside ANY function body is flagged.
+            for func in ast.walk(sf.tree):
+                if not isinstance(func, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                for node in ast.walk(func):
+                    if isinstance(node, ast.Call) and \
+                            _is_jit_call(node, jax_aliases, jit_names):
+                        findings.append(Finding(
+                            rule=self.rule, path=sf.path, line=node.lineno,
+                            message=(
+                                f"jax.jit constructed inside "
+                                f"{func.name}() — builds a new wrapper "
+                                "(and trace) per call; hoist to module "
+                                "level or cache it"
+                            ),
+                        ))
+        findings.extend(self._check_prewarm_registration(project))
+        return findings
+
+    # ------------------------------------------------- pre-warm registration
+
+    def _find_func(self, project: Project, suffix: str):
+        cls_name, _, fn_name = suffix.rpartition(".")
+        for sf in project.files.values():
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.ClassDef) and node.name == cls_name:
+                    for item in node.body:
+                        if isinstance(item, ast.FunctionDef) and \
+                                item.name == fn_name:
+                            return sf, item
+                elif not cls_name and isinstance(node, ast.FunctionDef) \
+                        and node.name == fn_name:
+                    return sf, node
+        return None, None
+
+    @staticmethod
+    def _names_in(node: ast.AST) -> Set[str]:
+        return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+    def _check_prewarm_registration(self, project: Project) -> List[Finding]:
+        disp_sf, disp = self._find_func(project, self.dispatch_func)
+        warm_sf, warm = self._find_func(project, self.prewarm_func)
+        if disp is None or warm is None:
+            return []   # fixture projects without a runner: nothing to do
+        dispatch_jits = {n for n in self._names_in(disp)
+                         if n.startswith("pipeline_") and n.endswith("_jit")}
+        warm_jits = self._names_in(warm)
+        out = []
+        for name in sorted(dispatch_jits - warm_jits):
+            out.append(Finding(
+                rule=self.rule, path=disp_sf.path, line=disp.lineno,
+                message=(
+                    f"dispatch-shaped jit `{name}` is used by "
+                    f"{self.dispatch_func.split('.')[-1]}() but not "
+                    f"registered with the pre-warm ledger "
+                    f"({self.prewarm_func.split('.')[-1]}) — a load "
+                    "spike selecting it stalls on a mid-traffic compile"
+                ),
+            ))
+        return out

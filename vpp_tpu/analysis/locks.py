@@ -1,0 +1,366 @@
+"""lock-discipline — annotated cross-thread state, enforced writes.
+
+The PR 3/4 threading work (shard worker threads + supervisor, HA tick
+loop + replication pool, REST handler threads) mutates shared state
+from multiple thread entry points.  CPython has no race detector, so
+the discipline is made machine-checkable via annotations:
+
+- ``self.attr = ...  # guarded-by: <lock>`` — declared at the
+  attribute's construction site: every OTHER write to ``attr`` in the
+  scoped files must sit inside ``with <lock>:`` or inside a function
+  annotated ``# holds: <lock>`` (for ``*_locked`` helpers and
+  acquire/release patterns).
+- ``# lock-free: <reason>`` — a deliberate single-word/atomic-ref
+  publication (e.g. the table swap's reference assignment); reason
+  required.
+- ``# owner: <reason>`` — single-writer state owned by one thread
+  (e.g. per-shard governor state touched only by that shard's worker);
+  reason required.
+
+Any attribute written from more than one thread entry point WITHOUT
+one of the three annotations is flagged.  Thread entry points are
+inferred per file: ``threading.Thread(target=X)`` / ``Timer(..., X)``
+targets, executor ``submit``/``map`` callables, and everything
+transitively reachable from them through the project call graph.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import CallGraph
+from .core import Checker, Finding, Project, register
+
+DEFAULT_SCOPES = (
+    "vpp_tpu.datapath.runner",
+    "vpp_tpu.datapath.shards",
+    "vpp_tpu.datapath.governor",
+    "vpp_tpu.kvstore.ha",
+)
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*(\S+)")
+_LOCKFREE_RE = re.compile(r"#\s*lock-free:(.*)$")
+_OWNER_RE = re.compile(r"#\s*owner:(.*)$")
+_HOLDS_RE = re.compile(r"#\s*holds:\s*(\S+)")
+_ATTR_ON_LINE_RE = re.compile(r"(?:self|sessions)\.(\w+)|^\s*(\w+)\s*[:=]")
+
+_INIT_FUNCS = ("__init__", "__post_init__", "__new__")
+
+
+def _lock_token(lockexpr: str) -> str:
+    """The comparison token of a lock expression: its last dotted
+    component (``self._state.lock`` → ``lock``)."""
+    return lockexpr.rstrip(":").split(".")[-1]
+
+
+class _WriteSite:
+    def __init__(self, path: str, line: int, attr: str,
+                 func_stack: Tuple[str, ...], with_locks: Tuple[str, ...]):
+        self.path = path
+        self.line = line
+        self.attr = attr
+        self.func_stack = func_stack        # outermost → innermost names
+        self.with_locks = with_locks        # lock tokens of enclosing withs
+
+    @property
+    def func(self) -> str:
+        return self.func_stack[-1] if self.func_stack else "<module>"
+
+
+class _FileScan(ast.NodeVisitor):
+    """Collect attribute write sites with their enclosing function and
+    ``with`` context."""
+
+    def __init__(self, sf):
+        self.sf = sf
+        self.writes: List[_WriteSite] = []
+        self._funcs: List[str] = []
+        self._withs: List[str] = []
+
+    # --- context tracking
+
+    def visit_FunctionDef(self, node):
+        self._funcs.append(node.name)
+        self.generic_visit(node)
+        self._funcs.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_With(self, node):
+        tokens = []
+        for item in node.items:
+            src = self.sf.src(item.context_expr)
+            # `with lock:` / `with self._state.lock:` / `with a, b:`
+            tokens.append(_lock_token(src.split("(")[0].strip()))
+        self._withs.extend(tokens)
+        self.generic_visit(node)
+        del self._withs[len(self._withs) - len(tokens):]
+
+    # --- write collection
+
+    def _record(self, target: ast.AST, line: int) -> None:
+        if isinstance(target, ast.Attribute):
+            self._add(target.attr, line)
+        elif isinstance(target, ast.Subscript) and \
+                isinstance(target.value, ast.Attribute):
+            self._add(target.value.attr, line)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._record(elt, line)
+
+    def _add(self, attr: str, line: int) -> None:
+        self.writes.append(_WriteSite(
+            self.sf.path, line, attr,
+            tuple(self._funcs), tuple(self._withs)))
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._record(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._record(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._record(node.target, node.lineno)
+        self.generic_visit(node)
+
+
+@register
+class LockDisciplineChecker(Checker):
+    rule = "lock-discipline"
+    description = (
+        "cross-thread attributes are annotated (guarded-by / lock-free "
+        "/ owner) and guarded writes happen inside their lock"
+    )
+
+    def __init__(self, scopes: Sequence[str] = DEFAULT_SCOPES):
+        self.scopes = scopes
+
+    def _scoped(self, project: Project):
+        return [sf for sf in project.files.values()
+                if sf.module in self.scopes
+                or any(sf.module.startswith(s + ".") for s in self.scopes)]
+
+    # ------------------------------------------------------------------ run
+
+    def check(self, project: Project) -> List[Finding]:
+        scoped = self._scoped(project)
+        if not scoped:
+            return []
+        findings: List[Finding] = []
+        guarded: Dict[str, str] = {}        # attr -> lock token
+        annotated: Set[str] = set()         # attrs with ANY annotation
+        holds: Dict[Tuple[str, str], str] = {}   # (path, func) -> lock token
+
+        for sf in scoped:
+            for i, line in enumerate(sf.lines, start=1):
+                g = _GUARDED_RE.search(line)
+                lf = _LOCKFREE_RE.search(line)
+                ow = _OWNER_RE.search(line)
+                # `class Foo:  # owner: …` annotates every field of the
+                # class at once (counter dataclasses are single-owner
+                # as a unit, not per field).
+                cls_m = re.match(r"\s*class\s+(\w+)", line) \
+                    if (g or lf or ow) else None
+                if cls_m is not None:
+                    for field in self._class_fields(sf, cls_m.group(1)):
+                        annotated.add(field)
+                        if g:
+                            guarded[field] = _lock_token(g.group(1))
+                attr = self._attr_on_line(sf, i)
+                if g:
+                    if attr is None:
+                        findings.append(Finding(
+                            rule=self.rule, path=sf.path, line=i,
+                            message="guarded-by annotation on a line with "
+                                    "no attribute assignment",
+                        ))
+                    else:
+                        guarded[attr] = _lock_token(g.group(1))
+                        annotated.add(attr)
+                for m, kind in ((lf, "lock-free"), (ow, "owner")):
+                    if m is None:
+                        continue
+                    if not m.group(1).strip():
+                        findings.append(Finding(
+                            rule=self.rule, path=sf.path, line=i,
+                            message=f"{kind} annotation without a reason — "
+                                    f"write '# {kind}: <why this is safe>'",
+                        ))
+                    if attr is not None:
+                        annotated.add(attr)
+                h = _HOLDS_RE.search(line)
+                if h:
+                    fn = self._def_at_or_below(sf, i)
+                    if fn is not None:
+                        holds[(sf.path, fn)] = _lock_token(h.group(1))
+
+        scans = {}
+        for sf in scoped:
+            scan = _FileScan(sf)
+            scan.visit(sf.tree)
+            scans[sf.path] = scan
+
+        findings.extend(self._check_guarded_writes(scans, guarded, holds))
+        findings.extend(self._check_unannotated(
+            project, scoped, scans, annotated))
+        return findings
+
+    # ----------------------------------------------------------- helpers
+
+    @staticmethod
+    def _class_fields(sf, cls_name: str) -> Set[str]:
+        """Field names of one class: annotated class-level fields plus
+        ``self.X = …`` targets in its ``__init__``."""
+        fields: Set[str] = set()
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.ClassDef) and node.name == cls_name):
+                continue
+            for item in node.body:
+                if isinstance(item, ast.AnnAssign) and \
+                        isinstance(item.target, ast.Name):
+                    fields.add(item.target.id)
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.FunctionDef) and \
+                        sub.name in _INIT_FUNCS:
+                    for a in ast.walk(sub):
+                        if isinstance(a, ast.Attribute) and \
+                                isinstance(a.ctx, ast.Store):
+                            fields.add(a.attr)
+        return fields
+
+    @staticmethod
+    def _attr_on_line(sf, lineno: int) -> Optional[str]:
+        line = sf.lines[lineno - 1]
+        code = line.split("#", 1)[0]
+        m = _ATTR_ON_LINE_RE.search(code)
+        if m:
+            return m.group(1) or m.group(2)
+        return None
+
+    @staticmethod
+    def _def_at_or_below(sf, lineno: int) -> Optional[str]:
+        """The function a `# holds:` comment annotates: a def on the
+        same line, the line below (comment above the def), or a couple
+        of lines up (comment trailing a multi-line signature)."""
+        for i in (lineno, lineno + 1, lineno - 1, lineno - 2):
+            if 0 < i <= len(sf.lines):
+                m = re.match(r"\s*(?:async\s+)?def\s+(\w+)", sf.lines[i - 1])
+                if m:
+                    return m.group(1)
+        return None
+
+    # ------------------------------------------------- guarded-write check
+
+    def _check_guarded_writes(self, scans, guarded, holds) -> List[Finding]:
+        out: List[Finding] = []
+        for scan in scans.values():
+            for w in scan.writes:
+                token = guarded.get(w.attr)
+                if token is None or w.func in _INIT_FUNCS:
+                    continue
+                if token in w.with_locks:
+                    continue
+                if any(holds.get((w.path, fn)) == token
+                       for fn in w.func_stack):
+                    continue
+                out.append(Finding(
+                    rule=self.rule, path=w.path, line=w.line,
+                    message=(
+                        f"write to guarded attribute `{w.attr}` outside "
+                        f"`with {token}` (declare `# holds: {token}` on "
+                        f"{w.func}() if every caller takes the lock)"
+                    ),
+                ))
+        return out
+
+    # --------------------------------------------- cross-thread inference
+
+    def _thread_entries(self, sf) -> Set[str]:
+        """Function names handed to Thread/Timer/submit/map in one file."""
+        entries: Set[str] = set()
+
+        def callable_name(node: ast.AST) -> Optional[str]:
+            if isinstance(node, ast.Attribute):
+                return node.attr
+            if isinstance(node, ast.Name):
+                return node.id
+            return None
+
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = node.func.attr if isinstance(node.func, ast.Attribute) \
+                else (node.func.id if isinstance(node.func, ast.Name) else "")
+            if fname in ("Thread", "Timer"):
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        n = callable_name(kw.value)
+                        if n:
+                            entries.add(n)
+                if fname == "Timer" and len(node.args) >= 2:
+                    n = callable_name(node.args[1])
+                    if n:
+                        entries.add(n)
+            elif fname in ("submit", "map") and node.args:
+                n = callable_name(node.args[0])
+                if n:
+                    entries.add(n)
+        return entries
+
+    def _check_unannotated(self, project, scoped, scans,
+                           annotated) -> List[Finding]:
+        graph = CallGraph(project)
+        entry_names: Set[str] = set()
+        for sf in scoped:
+            entry_names.update(self._thread_entries(sf))
+        scoped_paths = {sf.path for sf in scoped}
+        # Per-entry reachability: a function reachable from TWO entry
+        # points runs on two threads even if it is the only writer.
+        entry_of: Dict[str, Set[str]] = {}
+        for entry in sorted(entry_names):
+            for q in graph.reachable([entry]):
+                if graph.funcs[q].path in scoped_paths:
+                    entry_of.setdefault(graph.funcs[q].name, set()).add(entry)
+        threaded_names = set(entry_of)
+
+        by_attr: Dict[str, List[_WriteSite]] = {}
+        for scan in scans.values():
+            for w in scan.writes:
+                if w.func in _INIT_FUNCS or not w.func_stack:
+                    continue
+                by_attr.setdefault(w.attr, []).append(w)
+
+        out: List[Finding] = []
+        for attr, sites in sorted(by_attr.items()):
+            if attr in annotated:
+                continue
+            writers = {(w.path, w.func) for w in sites}
+            threaded_writers = {(p, f) for (p, f) in writers
+                                if f in threaded_names}
+            multi_entry = {
+                f for _, f in threaded_writers if len(entry_of[f]) > 1}
+            if not threaded_writers or (
+                    len(writers) < 2 and not multi_entry):
+                continue
+            first = min(sites, key=lambda w: (w.path, w.line))
+            funcs = ", ".join(sorted({f for _, f in writers}))
+            detail = (
+                f"from multiple thread entry points ({funcs})"
+                if len(writers) > 1 else
+                f"by {funcs}(), which runs on multiple threads "
+                f"({', '.join(sorted(entry_of[first.func]))})"
+            )
+            out.append(Finding(
+                rule=self.rule, path=first.path, line=first.line,
+                message=(
+                    f"attribute `{attr}` is written {detail} with no "
+                    "guarded-by / lock-free / owner annotation"
+                ),
+            ))
+        return out

@@ -1,0 +1,293 @@
+"""obs-parity — counters, inspect schema, and REST routes stay live.
+
+Dead observability rots silently: a counter that is exported but never
+incremented reads as "always zero, nothing wrong"; one incremented but
+never exported is invisible at 3am; a dashboard key the agent stopped
+producing renders as a blank panel.  Three sub-checks:
+
+1. **Counter liveness** — every field of a ``*Counters`` dataclass
+   must be incremented/assigned somewhere outside its class body (the
+   export side is structural: ``as_dict`` walks all fields), and every
+   counter dataclass must have an ``as_dict`` exporter.
+2. **Schema parity** — every key the dashboard's
+   ``views.shape_dispatch`` consumes (``dp.get("...")`` /
+   ``gov.get("...")`` / ``inspect.get("...")``) must be produced as a
+   literal key by ``DataplaneRunner.inspect_dispatch`` /
+   ``CoalesceGovernor.snapshot`` / ``DataplaneRunner.inspect``; and
+   every literal gauge key the solo ``metrics()`` emits must also be
+   emitted by the sharded ``_aggregate_counters`` (the two views must
+   never drift).
+3. **Route liveness** — every REST path literal routed in
+   ``rest/server.py`` must be referenced by netctl, the UI proxy, or a
+   test (``reference_dirs``, default ``tests/``, is scanned as raw
+   text so the CLI finds test consumers without indexing them).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Checker, Finding, Project, register
+
+DEFAULT_SCHEMA_PAIRS = (
+    # (consumer func qualname suffix, producer func qualname suffixes)
+    ("shape_dispatch", ("DataplaneRunner.inspect_dispatch",
+                        "CoalesceGovernor.snapshot",
+                        "ShardedDataplane.inspect",
+                        "DataplaneRunner.inspect")),
+)
+DEFAULT_METRICS_PAIR = ("DataplaneRunner.metrics",
+                        "ShardedDataplane._aggregate_counters")
+DEFAULT_REST_MODULE = "vpp_tpu.rest.server"
+DEFAULT_REFERENCE_DIRS = ("tests",)
+
+
+def _find_funcs(project: Project, suffix: str):
+    """Every (sf, FunctionDef) whose qualname ends with ``suffix``."""
+    cls_name, _, fn_name = suffix.rpartition(".")
+    for sf in project.files.values():
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef) and (
+                    not cls_name or node.name == cls_name):
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef) and \
+                            item.name == fn_name:
+                        yield sf, item
+            elif not cls_name and isinstance(node, ast.FunctionDef) and \
+                    node.name == fn_name:
+                yield sf, node
+
+
+def _literal_keys_produced(func: ast.AST) -> Set[str]:
+    """String keys a function produces: dict-literal keys and
+    ``x["key"] = ...`` subscript stores."""
+    keys: Set[str] = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys.add(k.value)
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.slice, ast.Constant) and \
+                        isinstance(t.slice.value, str):
+                    keys.add(t.slice.value)
+    return keys
+
+
+def _literal_keys_consumed(func: ast.AST) -> List[Tuple[str, int]]:
+    """(key, line) for every ``.get("key")`` call and ``x["key"]``
+    subscript READ in a consumer function."""
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "get" and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            out.append((node.args[0].value, node.lineno))
+    return out
+
+
+@register
+class ObservabilityParityChecker(Checker):
+    rule = "obs-parity"
+    description = (
+        "counters are incremented AND exported, the inspect schema "
+        "covers the dashboard's reads, and every REST route has a "
+        "netctl / proxy / test consumer"
+    )
+
+    def __init__(
+        self,
+        schema_pairs=DEFAULT_SCHEMA_PAIRS,
+        metrics_pair=DEFAULT_METRICS_PAIR,
+        rest_module: str = DEFAULT_REST_MODULE,
+        reference_dirs: Sequence[str] = DEFAULT_REFERENCE_DIRS,
+    ):
+        self.schema_pairs = schema_pairs
+        self.metrics_pair = metrics_pair
+        self.rest_module = rest_module
+        self.reference_dirs = reference_dirs
+
+    def check(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        findings.extend(self._check_counters(project))
+        findings.extend(self._check_schema(project))
+        findings.extend(self._check_metrics_parity(project))
+        findings.extend(self._check_routes(project))
+        return findings
+
+    # -------------------------------------------------- counter liveness
+
+    def _check_counters(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        # field -> (path, line) of declaration, per counters class
+        decls: Dict[str, List[Tuple[str, str, int]]] = {}
+        for sf in project.files.values():
+            for node in ast.walk(sf.tree):
+                if not (isinstance(node, ast.ClassDef)
+                        and node.name.endswith("Counters")):
+                    continue
+                has_exporter = any(
+                    isinstance(i, ast.FunctionDef) and i.name == "as_dict"
+                    for i in node.body)
+                if not has_exporter:
+                    findings.append(Finding(
+                        rule=self.rule, path=sf.path, line=node.lineno,
+                        message=f"counter class {node.name} has no "
+                                "as_dict exporter — its counts never "
+                                "reach /metrics or inspect()",
+                    ))
+                for item in node.body:
+                    if isinstance(item, ast.AnnAssign) and \
+                            isinstance(item.target, ast.Name):
+                        decls.setdefault(item.target.id, []).append(
+                            (node.name, sf.path, item.lineno))
+        if not decls:
+            return findings
+        # Any write `<something>.<field> op=` outside the class bodies.
+        written: Set[str] = set()
+        for sf in project.files.values():
+            for node in ast.walk(sf.tree):
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        if isinstance(t, ast.Attribute):
+                            written.add(t.attr)
+        for field, sites in sorted(decls.items()):
+            if field in written:
+                continue
+            for cls, path, line in sites:
+                findings.append(Finding(
+                    rule=self.rule, path=path, line=line,
+                    message=(
+                        f"dead counter: {cls}.{field} is exported but "
+                        "never incremented anywhere — delete it or wire "
+                        "the increment"
+                    ),
+                ))
+        return findings
+
+    # ---------------------------------------------------- schema parity
+
+    def _check_schema(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for consumer_name, producer_names in self.schema_pairs:
+            consumers = list(_find_funcs(project, consumer_name))
+            if not consumers:
+                continue
+            produced: Set[str] = set()
+            found_producer = False
+            for pname in producer_names:
+                for _, func in _find_funcs(project, pname):
+                    found_producer = True
+                    produced |= _literal_keys_produced(func)
+            if not found_producer:
+                continue
+            for sf, func in consumers:
+                for key, line in _literal_keys_consumed(func):
+                    if key not in produced:
+                        findings.append(Finding(
+                            rule=self.rule, path=sf.path, line=line,
+                            message=(
+                                f"{consumer_name}() reads key {key!r} "
+                                f"that no producer "
+                                f"({', '.join(producer_names)}) emits — "
+                                "the panel renders blank"
+                            ),
+                        ))
+        return findings
+
+    def _check_metrics_parity(self, project: Project) -> List[Finding]:
+        solo_name, sharded_name = self.metrics_pair
+        solo = next(iter(_find_funcs(project, solo_name)), None)
+        sharded = next(iter(_find_funcs(project, sharded_name)), None)
+        if solo is None or sharded is None:
+            return []
+        solo_sf, solo_fn = solo
+        solo_keys = {k for k in _literal_keys_produced(solo_fn)
+                     if k.startswith("datapath_")}
+        sharded_keys = _literal_keys_produced(sharded[1])
+        out = []
+        for key in sorted(solo_keys - sharded_keys):
+            out.append(Finding(
+                rule=self.rule, path=solo_sf.path, line=solo_fn.lineno,
+                message=(
+                    f"metrics drift: solo {solo_name.split('.')[-1]}() "
+                    f"emits {key!r} but the sharded "
+                    f"{sharded_name.split('.')[-1]}() does not — the "
+                    "gauge vanishes when a node goes multi-shard"
+                ),
+            ))
+        return out
+
+    # ---------------------------------------------------- route liveness
+
+    def _check_routes(self, project: Project) -> List[Finding]:
+        rest_sf = project.by_module(self.rest_module)
+        if rest_sf is None:
+            return []
+        route_fn = None
+        for node in ast.walk(rest_sf.tree):
+            if isinstance(node, ast.FunctionDef) and node.name == "_route":
+                route_fn = node
+                break
+        if route_fn is None:
+            return []
+        routes: List[Tuple[str, int]] = []
+        for node in ast.walk(route_fn):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and \
+                    node.value.startswith("/"):
+                routes.append((node.value, node.lineno))
+        corpus = self._reference_corpus(project, exclude=rest_sf.path)
+        out = []
+        for path, line in sorted(set(routes)):
+            needle = path.rstrip("/")
+            if not needle:
+                continue
+            if any(needle in text for text in corpus):
+                continue
+            # Consumers may build subpaths dynamically
+            # (`f".../cni/{action}"`): the parent prefix counts ONLY in
+            # a dynamic-construction shape — immediately followed by an
+            # interpolation or a closing quote (string concatenation).
+            # A plain sibling-route literal must NOT suppress.
+            parent = needle.rsplit("/", 1)[0] + "/"
+            markers = (parent + "{", parent + '"', parent + "'")
+            if len(parent) > 1 and any(
+                    m in text for m in markers for text in corpus):
+                continue
+            out.append(Finding(
+                rule=self.rule, path=rest_sf.path, line=line,
+                message=(
+                    f"REST route {path!r} has no netctl, proxy, or test "
+                    "reference — dead surface (or untested one)"
+                ),
+            ))
+        return out
+
+    def _reference_corpus(self, project: Project,
+                          exclude: str) -> List[str]:
+        corpus = [sf.text for sf in project.files.values()
+                  if sf.path != exclude]
+        for d in self.reference_dirs:
+            if not os.path.isdir(d):
+                continue
+            for dirpath, dirnames, filenames in os.walk(d):
+                dirnames[:] = [x for x in dirnames if x != "__pycache__"]
+                for fn in filenames:
+                    if fn.endswith(".py"):
+                        try:
+                            with open(os.path.join(dirpath, fn)) as fh:
+                                corpus.append(fh.read())
+                        except OSError:
+                            continue
+        return corpus

@@ -195,6 +195,15 @@ class PcapWriter:
     def close(self) -> None:
         self._fh.close()
 
+    def __del__(self):  # pragma: no cover - GC safety net
+        # The capture must never leak an open file handle: quarantine
+        # writers live on runners whose owners may drop them without a
+        # close (the test-race ResourceWarning gate enforces this).
+        try:
+            self._fh.close()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
+
 
 # ---------------------------------------------------------------------------
 # AF_PACKET raw socket (real interfaces / veth pairs)
@@ -236,17 +245,26 @@ class AfPacketIO:
         self._sock = socket.socket(
             socket.AF_PACKET, socket.SOCK_RAW, socket.htons(self.ETH_P_ALL)
         )
-        self._sock.bind((ifname, 0))
-        if fanout_group is not None:
-            mode = self.FANOUT_MODES[fanout_mode]
-            self._sock.setsockopt(
-                self.SOL_PACKET, self.PACKET_FANOUT,
-                (fanout_group & 0xFFFF) | (mode << 16),
-            )
-        if blocking_ms:
-            self._sock.settimeout(blocking_ms / 1000.0)
-        else:
-            self._sock.setblocking(False)
+        try:
+            self._sock.bind((ifname, 0))
+            if fanout_group is not None:
+                mode = self.FANOUT_MODES[fanout_mode]
+                self._sock.setsockopt(
+                    self.SOL_PACKET, self.PACKET_FANOUT,
+                    (fanout_group & 0xFFFF) | (mode << 16),
+                )
+            if blocking_ms:
+                self._sock.settimeout(blocking_ms / 1000.0)
+            else:
+                self._sock.setblocking(False)
+        except BaseException:
+            # A half-constructed IO must not leak its raw socket: bind
+            # or PACKET_FANOUT can fail AFTER the fd exists (fanout is
+            # EOPNOTSUPP on some interfaces/kernels) and the caller
+            # never gets an object to close (found by the test-race
+            # ResourceWarning gate).
+            self._sock.close()
+            raise
 
     def recv_batch(self, max_frames: int) -> List[bytes]:
         out: List[bytes] = []

@@ -132,8 +132,8 @@ class DeviceSessionState:
     threads dispatch-to-dispatch in a single total order."""
 
     def __init__(self, capacity: int = 1 << 16):
-        self.sessions: NatSessions = empty_sessions(capacity)
-        self.ts = 0
+        self.sessions: NatSessions = empty_sessions(capacity)  # guarded-by: lock
+        self.ts = 0             # guarded-by: lock
         self.lock = threading.RLock()
         # (ts, wall-time) of the last sweep — the affinity expiry
         # converts per-mapping SECONDS to timestamp units at the rate
@@ -146,11 +146,11 @@ class DeviceSessionState:
         # occupying slots forever — sweep_sessions deliberately skips
         # affinity rows, so nothing else would ever free them.  Cleared
         # when a sweep of a no-affinity table finds zero pins left.
-        self.aff_pinned = False
+        self.aff_pinned = False  # guarded-by: lock
 
 
 @dataclasses.dataclass
-class RunnerCounters:
+class RunnerCounters:  # owner: shard worker — admit/dispatch/harvest/bypass all run inside this runner's poll(); swap ticks touch a quiesced or solo runner
     rx_frames: int = 0
     rx_decapped: int = 0
     tx_local: int = 0
@@ -288,14 +288,21 @@ class DataplaneRunner:
         quarantine: bool = True,
         quarantine_pcap: Optional[str] = None,
     ):
-        self.acl = acl
+        # Table references are LOCK-FREE atomic swaps by design: a swap
+        # publishes whole new objects, in-flight batches keep the
+        # references they captured, and readers never see a mix.
+        self.acl = acl          # lock-free: atomic ref swap; in-flight batches keep their tables
         self.mesh = mesh
         # The lookup-discipline gate (use_hmap) is derived from the
         # backend the dispatch TARGETS, not the builder's process —
         # tables built CPU-side and shipped to TPU workers (or vice
         # versa) would otherwise keep the wrong crossover pick.
-        self.nat = retarget_tables(nat, self._target_backend())
-        self.route = route
+        self.nat = retarget_tables(nat, self._target_backend())  # lock-free: atomic ref swap (see acl)
+        self.route = route      # lock-free: atomic ref swap (see acl)
+        # Host-side mirror of the route scalars (filled lazily by
+        # _route_of, invalidated per swap) — keeps the slow-path
+        # restore from paying device reads per packet.
+        self._route_cache: Optional[Tuple] = None  # lock-free: derived cache; worst case one re-read
         self.overlay = overlay
         self.source = source
         self.tx = tx
@@ -336,7 +343,7 @@ class DataplaneRunner:
         # dispatch may include a multi-second jit compile, which would
         # poison the EWLS fit (floor_us off by ~6 orders) and spray
         # false slo_breaches until the decay washes it out.
-        self._last_harvest_t: Optional[float] = None
+        self._last_harvest_t: Optional[float] = None  # owner: shard worker — sanitize touches a quiesced runner only
         self._timed_k: set = set()
         self.sweep_interval = sweep_interval
         self.sweep_max_age = sweep_max_age
@@ -364,8 +371,8 @@ class DataplaneRunner:
         self.shard_index = shard_index
         self.quarantine = quarantine
         self.quarantine_pcap = quarantine_pcap
-        self._quarantine_writer = None
-        self._last_fault_error = ""
+        self._quarantine_writer = None  # owner: shard worker — close() touches a quiesced runner only
+        self._last_fault_error = ""  # lock-free: diagnostic string; last-writer-wins is acceptable
         self.counters = RunnerCounters()
         # Optional zero-arg provider of control-plane compile stats (the
         # agent attaches the applicators' stats() here) — surfaced by
@@ -393,16 +400,16 @@ class DataplaneRunner:
         if engine == "native" and not native_ok:
             raise ValueError("native engine requires NativeRing endpoints")
         self.engine = engine or ("native" if native_ok else "python")
-        self._native: Optional[NativeLoop] = None
-        self._slot_next = 0
+        self._native: Optional[NativeLoop] = None  # owner: shard worker — rebuild/close touch a quiesced runner only
+        self._slot_next = 0  # owner: shard worker — resize/sanitize rebuilds touch a runner with nothing in flight
         if self.engine == "native":
             self._native = NativeLoop(
                 self.source, self.tx, self.local, self.host,
                 batch_size=self.batch_size, max_vectors=self.max_vectors,
                 vni=self.overlay.vni, n_slots=self._n_slots,
             )
-        self._bypass_tables = False
-        self._bypass_route = None
+        self._bypass_tables = False  # lock-free: single-word disarm flag; swaps clear it BEFORE adopting, pollers re-derive
+        self._bypass_route = None    # lock-free: written before _bypass_tables arms; read only when armed
         self._refresh_bypass()
         if self.prewarm:
             self.prewarm_buckets()
@@ -465,7 +472,7 @@ class DataplaneRunner:
                 int(np.asarray(self.route.host_bits)),
             )
         self._bypass_tables = eligible
-        self._bypass_recheck = False
+        self._bypass_recheck = False  # lock-free: bool hint; a lost write costs one extra re-derive
 
     def _bypass_ready(self) -> bool:
         # In-flight dispatched batches must harvest first (arena pins
@@ -514,7 +521,7 @@ class DataplaneRunner:
         return self._state.sessions
 
     @sessions.setter
-    def sessions(self, value: NatSessions) -> None:
+    def sessions(self, value: NatSessions) -> None:  # holds: lock
         self._state.sessions = value
 
     @property
@@ -522,7 +529,7 @@ class DataplaneRunner:
         return self._state.ts
 
     @_ts.setter
-    def _ts(self, value: int) -> None:
+    def _ts(self, value: int) -> None:  # holds: lock
         self._state.ts = value
 
     # ----------------------------------------------------- sizing knobs
@@ -606,6 +613,7 @@ class DataplaneRunner:
         """(Re-)place tables + sessions onto the mesh."""
         from ..parallel.mesh import shard_dataplane
 
+        # static: allow(lock-discipline) — mesh runners are driven single-threaded; placement runs at init/swap with no worker live
         self.acl, self.nat, self.route, self.sessions = shard_dataplane(
             self.mesh, self.acl, self.nat, self.route, self.sessions,
             partition_sessions=self.partition_sessions,
@@ -648,6 +656,11 @@ class DataplaneRunner:
             )
         except Exception as err:
             self.acl, self.nat, self.route = last_good
+            # A worker thread may have refilled the route-scalar cache
+            # from the half-adopted generation between _adopt_tables'
+            # clear and this rollback — drop it so _route_of re-reads
+            # the restored route.
+            self._route_cache = None
             self.counters.swap_rollbacks += 1
             self._last_fault_error = f"table swap failed: {err}"
             self._refresh_bypass()
@@ -690,10 +703,19 @@ class DataplaneRunner:
                 # Pins may be created from now on; the sweep keeps
                 # running (and draining orphans) even after a later
                 # swap to a no-affinity table — see DeviceSessionState.
-                self._state.aff_pinned = True
+                # Under the state lock: the dispatch-path sweep CLEARS
+                # this flag when the last orphan pin drains, and an
+                # unguarded True here could lose against that clear
+                # (lock-discipline checker finding; the flag is
+                # guarded-by the state lock like the rest of the
+                # shared session state).
+                with self._state.lock:
+                    self._state.aff_pinned = True
         if route is not None:
             self.route = route
             self.counters.route_swaps += 1
+            # Host-side route-scalar cache follows the table generation.
+            self._route_cache = None
         if self.mesh is not None and (
             acl is not None or nat is not None or route is not None
         ):
@@ -875,16 +897,19 @@ class DataplaneRunner:
             # Injection sites fire BEFORE the state lock: a hang here
             # models this shard's dispatch thread wedging without
             # dragging the shared session lock (and so every other
-            # shard) down with it.
+            # shard) down with it.  The batch rides through AS-IS (no
+            # materialisation): the injector only reads its fields when
+            # a poison-match plan is armed, so unmatched arm modes
+            # (hang, swap-fail drills) never pay a device→host sync on
+            # the dispatch path.
             self.faults.fire(SITE_DISPATCH_HANG, shard=self.shard_index)
             self.faults.fire(
-                SITE_DISPATCH_RAISE, shard=self.shard_index,
-                batch={f: np.asarray(getattr(batch, f)) for f in _BATCH_FIELDS},
+                SITE_DISPATCH_RAISE, shard=self.shard_index, batch=batch,
             )
         with self._state.lock:
             return self._dispatch_locked(batch, k), self._ts
 
-    def _dispatch_locked(self, batch: PacketBatch, k: int):
+    def _dispatch_locked(self, batch: PacketBatch, k: int):  # holds: lock
         prev_ts = self._ts
         self._ts += k
         if k == 1 and self.dispatch != "flat-safe":
@@ -1089,6 +1114,19 @@ class DataplaneRunner:
         self._last_harvest_t = None
         if self._native is not None:
             self._rebuild_native()
+
+    def close(self) -> None:
+        """Release host-side resources: the forensics pcap handle and
+        the native loop's frame arena.  Idempotent; the runner must not
+        be polled afterwards.  (PcapWriter also closes on GC, but an
+        explicit close is what keeps `make test-race`'s ResourceWarning
+        gate quiet deterministically.)"""
+        if self._quarantine_writer is not None:
+            self._quarantine_writer.close()
+            self._quarantine_writer = None
+        if self._native is not None:
+            self._native.close()
+            self._native = None
 
     def health(self) -> Dict[str, object]:
         """This runner's fault-domain view (one shard's slice of the
@@ -1410,12 +1448,24 @@ class DataplaneRunner:
 
     def _route_of(self, dst_ip: int) -> Tuple[int, int]:
         """Host-side mirror of the pipeline's node-ID route arithmetic
-        (for slow-path-restored packets only)."""
-        base = int(np.asarray(self.route.pod_subnet_base))
-        mask = int(np.asarray(self.route.pod_subnet_mask))
-        tbase = int(np.asarray(self.route.this_node_base))
-        tmask = int(np.asarray(self.route.this_node_mask))
-        hbits = int(np.asarray(self.route.host_bits))
+        (for slow-path-restored packets only).  The route scalars are
+        cached host-side per table generation: reading them off the
+        device per restored packet cost FIVE device→host round trips on
+        the harvest path (found by the hot-path-sync checker),
+        multiplied by the restore count under punt-heavy load."""
+        cached = self._route_cache
+        if cached is None:
+            # One-time (per swap) device read — the same five scalars
+            # _refresh_bypass reads at swap time.
+            cached = self._route_cache = tuple(
+                int(np.asarray(v))  # static: allow(hot-path-sync) — once per swap, not per packet
+                for v in (
+                    self.route.pod_subnet_base, self.route.pod_subnet_mask,
+                    self.route.this_node_base, self.route.this_node_mask,
+                    self.route.host_bits,
+                )
+            )
+        base, mask, tbase, tmask, hbits = cached
         if (dst_ip & tmask) == tbase:
             return ROUTE_LOCAL, 0
         if (dst_ip & mask) == base:

@@ -90,7 +90,7 @@ _SERVING_STATES = (STATE_HEALTHY, STATE_DEGRADED, STATE_PROBATION,
 
 
 @dataclasses.dataclass
-class ShardHealth:
+class ShardHealth:  # owner: supervisor — every health transition runs on the poll() caller thread; workers never touch it
     """One shard's supervision record."""
 
     state: str = STATE_HEALTHY
@@ -186,7 +186,7 @@ class ShardedDataplane:
         # One single-thread executor per shard (shards are not
         # re-entrant): a hung shard's executor can be ABANDONED without
         # stalling the others, and a fresh one attached at rejoin.
-        self._execs: List[Optional[ThreadPoolExecutor]] = [
+        self._execs: List[Optional[ThreadPoolExecutor]] = [  # owner: supervisor — executors swap on the poll() caller thread only
             self._new_exec(i) for i in range(len(self.shards))
         ]
         self._stuck: Dict[int, Future] = {}  # abandoned hung futures
@@ -514,9 +514,12 @@ class ShardedDataplane:
         except Exception as err:
             # Roll EVERY shard back to last-good (adopted or not — the
             # restore is reference assignment, idempotent), so no two
-            # shards ever serve different table generations.
+            # shards ever serve different table generations.  Each
+            # shard's route-scalar cache drops too: a worker may have
+            # refilled it from the half-adopted generation.
             for r in self.shards:
                 r.acl, r.nat, r.route = last_good
+                r._route_cache = None
             self._swap_rollbacks += 1
             state_clear = (
                 r0._bypass_state_clear() if r0._bypass_static_ok() else False)
@@ -687,3 +690,13 @@ class ShardedDataplane:
         for ex in self._execs:
             if ex is not None:
                 ex.shutdown(wait=True)
+        # Release per-shard host resources (pcap handles, native
+        # arenas) — but never under a thread that may still be wedged
+        # INSIDE the runner: freeing the native arena under it would be
+        # a use-after-free in C++.  Those shards' resources fall to the
+        # GC safety nets instead.
+        for i, r in enumerate(self.shards):
+            stuck = self._stuck.get(i)
+            if stuck is not None and not stuck.done():
+                continue
+            r.close()

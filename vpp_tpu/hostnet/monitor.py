@@ -94,9 +94,18 @@ class _IpMonitor:
                 proc.wait(timeout=5)
             except subprocess.TimeoutExpired:  # pragma: no cover
                 proc.kill()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        thread, self._thread = self._thread, None
+        pump_exited = True
+        if thread is not None:
+            thread.join(timeout=5)
+            pump_exited = not thread.is_alive()
+        if proc is not None and proc.stdout is not None and pump_exited:
+            # Close ONLY once the pump thread actually exited — closing
+            # under a still-blocked reader raises inside it.  A wedged
+            # pump (handler stuck >5s) keeps its pipe and falls to GC
+            # instead; a leaked pipe on the clean path would trip the
+            # test-race ResourceWarning gate.
+            proc.stdout.close()
 
 
 def _parse_route_line(line: str) -> Optional[RouteEvent]:

@@ -105,9 +105,9 @@ class _FollowerState:
     leader acknowledge a write no follower holds."""
 
     def __init__(self, next_index: int):
-        self.next = next_index        # optimistic log-slice cursor
-        self.match = 0                # highest index confirmed by an RPC ack
-        self.acked_at = 0.0           # monotonic time of the last ack
+        self.next = next_index        # guarded-by: lock — optimistic log-slice cursor
+        self.match = 0                # guarded-by: lock — highest index confirmed by an RPC ack
+        self.acked_at = 0.0           # guarded-by: lock — monotonic time of the last ack
         self.lock = threading.Lock()  # serializes pushes to this follower
 
 
@@ -145,28 +145,34 @@ class HAReplica:
         self.replica_id = 0
         self._el: Optional[ElectionState] = None
         self._state_lock = threading.RLock()
-        self._log: List[LogEntry] = []
+        self._log: List[LogEntry] = []     # guarded-by: _state_lock
         self._log_capacity = log_capacity
-        self._base_index = 0   # the log starts after (base_index, base_term)
-        self._base_term = 0
-        self._last_index = 0
-        self._last_term = 0
+        # The log starts after (base_index, base_term).
+        self._base_index = 0   # guarded-by: _state_lock
+        self._base_term = 0    # guarded-by: _state_lock
+        self._last_index = 0   # guarded-by: _state_lock
+        self._last_term = 0    # guarded-by: _state_lock
         # Election-rank cursor: the tail of entries KNOWN replicated —
         # quorum-acked own writes, or entries received from a leader.
         # A deposed leader's unacknowledged suffix is excluded, so it
         # cannot outrank a follower holding a quorum-acked entry it
         # lacks (the committed-write-survival invariant).
-        self._rank_index = 0
-        self._rank_term = 0
+        self._rank_index = 0   # guarded-by: _state_lock
+        self._rank_term = 0    # guarded-by: _state_lock
         # A replica that has never reconciled with a leader in this
         # process must take a snapshot install before following the log:
         # its store may hold state (sqlite preseed) the log cursor knows
         # nothing about, and a matching (0, 0) cursor would silently
         # merge diverged stores.
-        self._virgin = True
+        self._virgin = True    # guarded-by: _state_lock
         self._followers: Dict[str, _FollowerState] = {}
-        self._peer_targets: Dict[str, _Target] = {}
-        self._last_quorum_at = 0.0
+        # Peer channel cache: dialed/evicted from the tick loop, pool
+        # pushes, AND client commit threads concurrently — its own lock
+        # (NOT _state_lock: _peer_call blocks on the network and must
+        # never hold the state lock across an RPC).
+        self._peer_targets: Dict[str, _Target] = {}  # guarded-by: _peers_lock
+        self._peers_lock = threading.Lock()
+        self._last_quorum_at = 0.0  # guarded-by: _state_lock
         self._stop_event = threading.Event()
         self._tick_thread: Optional[threading.Thread] = None
         self._pool: Optional[_futures.ThreadPoolExecutor] = None
@@ -219,12 +225,15 @@ class HAReplica:
             self._tick_thread.join(timeout=2.0)
         if self._pool is not None:
             self._pool.shutdown(wait=False)
-        # Snapshot the dict: pool workers shut down with wait=False can
-        # still be inside _peer_call mutating it (a straggler's channel
-        # then leaks until process exit, which kill() is anyway).
-        for target in list(self._peer_targets.values()):
+        # Snapshot under the peers lock, then close outside it: pool
+        # workers shut down with wait=False can still be inside
+        # _peer_call dialing (a straggler's channel then leaks until
+        # process exit, which kill() is anyway).
+        with self._peers_lock:
+            targets = list(self._peer_targets.values())
+            self._peer_targets.clear()
+        for target in targets:
             target.channel.close()
-        self._peer_targets.clear()
 
     # ------------------------------------------------------------- queries
 
@@ -331,7 +340,7 @@ class HAReplica:
             return s.compare_and_delete(args["key"], args["expected"])
         raise ValueError(f"unknown replicated op {op!r}")
 
-    def _append(self, entry: LogEntry) -> None:
+    def _append(self, entry: LogEntry) -> None:  # holds: _state_lock
         self._log.append(entry)
         self._last_index = entry.index
         self._last_term = entry.term
@@ -344,12 +353,29 @@ class HAReplica:
 
     def _peer_call(self, addr: str, method: str, request: dict,
                    timeout: Optional[float] = None) -> Optional[dict]:
-        target = self._peer_targets.get(addr)
-        if target is None:
-            target = self._peer_targets[addr] = _Target(addr)
+        # Get-or-dial under the peers lock: _peer_call runs on the tick
+        # loop, pool pushes and client commit threads at once, and the
+        # unguarded check-then-dial raced — two threads could both dial
+        # the same peer and one _Target's channel leaked open (found by
+        # the lock-discipline checker).  The RPC itself runs unlocked.
+        with self._peers_lock:
+            target = self._peer_targets.get(addr)
+            if target is None:
+                target = self._peer_targets[addr] = _Target(addr)
         try:
             return target.calls[method](
                 request, timeout=timeout or self._replicate_timeout)
+        except ValueError as e:
+            # A concurrent eviction (or kill()) closed the cached
+            # channel between the lock release and the invoke — grpc
+            # raises ValueError, not RpcError.  The request was never
+            # sent; report push failure, the next tick redials fresh.
+            if "closed channel" not in str(e):
+                raise
+            with self._peers_lock:
+                if self._peer_targets.get(addr) is target:
+                    self._peer_targets.pop(addr, None)
+            return None
         except grpc.RpcError as e:
             code = _code_of(e)
             if code in OUTAGE_CODES and not channel_ready(target.channel):
@@ -359,8 +385,11 @@ class HAReplica:
                 # reconnect backoff, and the tick loop would keep
                 # riding the same doomed channel forever.  A deadline
                 # on a READY channel is just a slow peer — redialing
-                # a healthy transport buys nothing.
-                self._peer_targets.pop(addr, None)
+                # a healthy transport buys nothing.  Evict only OUR
+                # target: a concurrent caller may already have redialed.
+                with self._peers_lock:
+                    if self._peer_targets.get(addr) is target:
+                        self._peer_targets.pop(addr, None)
                 try:
                     target.channel.close()
                 except Exception:  # noqa: BLE001 - eviction is best-effort
@@ -409,12 +438,13 @@ class HAReplica:
             if resp["term"] > term:
                 with self._state_lock:
                     if self._el is not None and resp["term"] > self._el.term:
+                        # static: allow(lock-discipline) — _el.term writes serialize on _state_lock (held here)
                         self._el.term = resp["term"]
                         self._el.step_down()
                 return False
             if resp.get("ok"):
-                fs.next = fs.match = resp["last_index"]
-                fs.acked_at = time.monotonic()
+                fs.next = fs.match = resp["last_index"]  # static: allow(lock-discipline) — fs.lock held via the bounded acquire above
+                fs.acked_at = time.monotonic()  # static: allow(lock-discipline) — fs.lock held via the bounded acquire above
                 return True
             if resp.get("needs_snapshot"):
                 # The mismatch reply carries the follower's actual tail.
@@ -428,7 +458,7 @@ class HAReplica:
                 with self._state_lock:
                     in_log = self._base_index <= tail <= self._last_index
                 if tail != cursor and in_log:
-                    fs.next = tail
+                    fs.next = tail  # static: allow(lock-discipline) — fs.lock held via the bounded acquire above
                     return False  # re-push from the new cursor next round
                 return self._install_snapshot(addr, fs, term)
             # Rejected outright (e.g. the follower stays sticky to its
@@ -439,7 +469,8 @@ class HAReplica:
             fs.lock.release()
 
     def _install_snapshot(self, addr: str, fs: _FollowerState,
-                          term: int) -> bool:
+                          term: int) -> bool:  # holds: lock
+
         with self._state_lock:
             snap, rev = self.store.snapshot_with_revision([""])
             payload = {

@@ -593,6 +593,28 @@ class RemoteKVStore:
             except Exception:  # noqa: BLE001 - eviction is best-effort
                 pass
 
+    def _call_once(self, address: str, method: str, request: dict) -> dict:
+        """One attempt on the (cached) channel.  A concurrent outage
+        eviction — the watch thread runs _evict_target too — can CLOSE
+        the channel between the cache read and the invoke; grpc then
+        raises ValueError, not RpcError.  A closed channel provably
+        never sent the request, so ONE redial-and-retry is safe for any
+        op, idempotent or not (found as a pre-existing `make test-race`
+        flake while hardening the race battery in ISSUE 7)."""
+        target = self._target(address)
+        try:
+            return target.calls[method](request, timeout=self.timeout)
+        except ValueError as e:
+            if "closed channel" not in str(e):
+                raise
+            # Drop the stale entry ourselves — the racing eviction may
+            # have closed the channel before (or without) popping it.
+            with self._target_lock:
+                if self._targets.get(address) is target:
+                    self._targets.pop(address, None)
+            return self._target(address).calls[method](
+                request, timeout=self.timeout)
+
     def _rpc(self, method: str, request: dict) -> dict:
         if not self._failover:
             # Historical single-server semantics: one attempt, errors
@@ -601,8 +623,7 @@ class RemoteKVStore:
             # still evicts the channel so the NEXT attempt redials.
             address = self._active
             try:
-                return self._target(address).calls[method](
-                    request, timeout=self.timeout)
+                return self._call_once(address, method, request)
             except grpc.RpcError as e:
                 if _code_of(e) in OUTAGE_CODES:
                     self._evict_target(address)
@@ -613,8 +634,7 @@ class RemoteKVStore:
         while True:
             address = self._active
             try:
-                return self._target(address).calls[method](
-                    request, timeout=self.timeout)
+                return self._call_once(address, method, request)
             except grpc.RpcError as e:
                 hint = not_leader_hint(e)
                 code = _code_of(e)

@@ -30,6 +30,17 @@ _LIB = os.path.join(_NATIVE_DIR, "build", "libhostshim.so")
 
 
 def _build_library() -> str:
+    # Explicit flavor override: `make native-sanitize` points this at
+    # the ASan+UBSan build (libhostshim.asan.so) so the native-engine
+    # test subset runs sanitizer-hardened without touching the
+    # production artifact.
+    override = os.environ.get("VPP_TPU_HOSTSHIM_LIB")
+    if override:
+        if not os.path.exists(override):
+            raise FileNotFoundError(
+                f"VPP_TPU_HOSTSHIM_LIB={override} does not exist "
+                "(build it with: make -C native/hostshim SANITIZE=asan)")
+        return override
     src_dir = os.path.abspath(_SRC_DIR)
     lib = os.path.abspath(_LIB)
     sources = [os.path.join(src_dir, s) for s in _SOURCES]

@@ -216,10 +216,16 @@ def counters_from_result(result, fb=None) -> InterfaceStats:
         lens = np.asarray(fb.lens)
         in_bytes = int(lens.sum())
         out_bytes = int(lens[: len(allowed)][allowed[: len(lens)] > 0].sum())
+    # puntPackets was exported-but-never-set (a dead gauge the ISSUE 7
+    # obs-parity sweep flushed out): pipeline results carry the punt
+    # verdict column — surface it like the reference's punt counter.
+    punt = getattr(result, "punt", None)
+    punts = int(np.asarray(punt).sum()) if punt is not None else 0
     return InterfaceStats(
         in_packets=n,
         out_packets=forwarded,
         in_bytes=in_bytes,
         out_bytes=out_bytes,
         drop_packets=n - forwarded,
+        punt_packets=punts,
     )

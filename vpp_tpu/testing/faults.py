@@ -227,7 +227,11 @@ class FaultInjector:
                 else getattr(batch, field_name, None)
             if arr is None:
                 return False
-            hit = np.asarray(arr) == value
+            # The ONLY place the injector touches batch contents: runs
+            # when a poison-match plan is armed (a chaos drill), never
+            # on undisturbed production dispatches.
+            hit = np.asarray(arr) == value  # static: allow(hot-path-sync) — fires only under an armed poison-match plan
+
             rows = hit if rows is None else (rows & hit)
         return bool(rows is not None and rows.any())
 

@@ -203,17 +203,25 @@ def shape_dispatch(inspect: Optional[dict]) -> Dict[str, Any]:
         "max_inflight": dp.get("max_inflight", 0),
         "bypass": bool(dp.get("bypass_eligible")),
         "device_batches": dp.get("device_batches", 0),
+        "prewarm": bool(dp.get("prewarm")),
         "governor": {
             "mode": "adaptive" if gov.get("enabled") else "fixed",
             "current_k": gov.get("current_k", 0),
             "ceiling": gov.get("ceiling", 0),
             "backlog": gov.get("backlog", 0),
+            "window": gov.get("window", 0),
             "slo_us": gov.get("slo_us", 0),
             "slo_cap": gov.get("slo_cap", 0),
             "slo_breaches": gov.get("slo_breaches", 0),
+            "decisions": gov.get("decisions", 0),
+            "samples": gov.get("samples", 0),
             "floor_us": gov.get("floor_us"),
             "vec_us": gov.get("vec_us"),
             "k_histogram": gov.get("k_histogram") or {},
+            # Sharded engines report per-shard K/backlog (each shard
+            # has its own rings); solo runners omit them.
+            "per_shard_k": gov.get("per_shard_k") or [],
+            "per_shard_backlog": gov.get("per_shard_backlog") or [],
         },
     }
 
