@@ -4,9 +4,9 @@
 PY ?= python
 
 .PHONY: test test-race verify-ha verify-churn verify-faults \
-        verify-adaptive verify-static lint bench bench-suite bench-sweep \
-        bench-scale bench-latency bench-frames bench-churn bench-adaptive \
-        images native native-sanitize
+        verify-adaptive verify-static verify-telemetry lint bench \
+        bench-suite bench-sweep bench-scale bench-latency bench-frames \
+        bench-churn bench-adaptive images native native-sanitize
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -55,6 +55,21 @@ verify-adaptive:
 
 bench-adaptive:
 	$(PY) scripts/bench_adaptive.py --check
+
+# Telemetry verification (ISSUE 8): the histogram/span/flight suites
+# (single-writer vs reader-merge property, bucket boundaries, the full
+# controller-driven span lifecycle with mock engines, ejection flight
+# dumps, REST/netctl/metrics surfaces) + the static gate — in
+# particular hot-path-sync must stay clean with the recorder on the
+# dispatch path.  These tests also run in plain `make test`/tier-1
+# (tests/test_telemetry.py); `make lint` byte-compiles + checks
+# vpp_tpu/telemetry/ with the rest of the tree.
+verify-telemetry:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_telemetry.py \
+	    -q $(if $(RUN_SLOW),,-m 'not slow') --continue-on-collection-errors \
+	    -p no:cacheprovider -p no:xdist -p no:randomly
+	$(PY) scripts/check_static.py vpp_tpu/ --rule hot-path-sync \
+	    --rule obs-parity
 
 # Datapath fault-domain verification: the fault-injection harness units
 # (injector semantics, swap rollback, poisoned-batch quarantine, REST/

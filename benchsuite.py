@@ -352,10 +352,10 @@ def latency(iters):
                 sessions = r.sessions
                 return r.allowed
 
-            p50_s, p99_s = bench.sample_dispatch_latency(
+            p50_s, p99_s, p999_s = bench.sample_dispatch_latency(
                 dispatch, samples=n_lat_samples
             )
-            p50, p99 = p50_s * 1e6, p99_s * 1e6
+            p50, p99, p999 = p50_s * 1e6, p99_s * 1e6, p999_s * 1e6
             print(
                 json.dumps(
                     {
@@ -365,6 +365,7 @@ def latency(iters):
                         "discipline": disc,
                         "p50_us": round(p50, 1),
                         "p99_us": round(p99, 1),
+                        "p999_us": round(p999, 1),
                         "single_dispatch_mpps": round(n / p50, 2),
                         # Coalesce-fill delay: the time the FIRST packet
                         # of a dispatch waits for the batch to fill.

@@ -189,12 +189,19 @@ def run_saturate(runner, rings, pool, duration_s: float, rounds: int):
 
 def run_offered(runner, rings, pool, rate_mpps: float, duration_s: float):
     """Paced injection at rate_mpps; added latency = arrival→delivery
-    per frame (FIFO local delivery makes the pairing exact)."""
+    per frame (FIFO local delivery makes the pairing exact).
+    Percentiles come from the telemetry Log2Histogram (ISSUE 8) — the
+    same bucketing/interpolation the runner's own latency pillar and
+    `netctl inspect` use — so BENCHADAPT lines and live telemetry quote
+    one methodology (and gain p99/p99.9)."""
+    from vpp_tpu.telemetry import Log2Histogram
+
     reset(runner, rings)
     rx = rings[0]
     rate_fps = rate_mpps * 1e6
     arrivals: collections.deque = collections.deque()
-    lats = []
+    lat_hist = Log2Histogram()
+    lat_max = 0.0
     injected = delivered = 0
     credit, idx = 0.0, 0
     hist0 = dict(runner.governor.k_hist)
@@ -213,7 +220,10 @@ def run_offered(runner, rings, pool, rate_mpps: float, duration_s: float):
         sent = runner.poll()
         t_done = time.perf_counter()
         for _ in range(min(sent, len(arrivals))):
-            lats.append(t_done - arrivals.popleft())
+            lat = t_done - arrivals.popleft()
+            lat_hist.record_s(lat)
+            if lat > lat_max:
+                lat_max = lat
         delivered += sent
         drain_sinks(rings)
     wall = time.perf_counter() - t0
@@ -232,14 +242,20 @@ def run_offered(runner, rings, pool, rate_mpps: float, duration_s: float):
         "k_histogram": {str(k): v for k, v in sorted(hist.items())},
         "slo_breaches": runner.governor.slo_breaches - breaches0,
     }
-    if lats:
-        lats.sort()
+    if lat_hist.count:
         out["added_latency_us"] = {
-            "p50": round(lats[len(lats) // 2] * 1e6, 1),
-            "p95": round(lats[int(0.95 * (len(lats) - 1))] * 1e6, 1),
-            "max": round(lats[-1] * 1e6, 1),
-            "samples": len(lats),
+            "p50": round(lat_hist.percentile_us(0.50), 1),
+            "p95": round(lat_hist.percentile_us(0.95), 1),
+            "p99": round(lat_hist.percentile_us(0.99), 1),
+            "p999": round(lat_hist.percentile_us(0.999), 1),
+            "max": round(lat_max * 1e6, 1),
+            "samples": lat_hist.count,
         }
+        # The runner's OWN telemetry view (admit-wait / round-trip /
+        # harvest / frame-e2e pillars) rides along so the artifact
+        # correlates external pacing with internal latency.  Cumulative
+        # across this runner's whole sweep — labelled as such.
+        out["runner_latency_us_cumulative"] = runner.inspect_latency()
     return out
 
 

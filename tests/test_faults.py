@@ -235,6 +235,16 @@ def test_poisoned_batch_bisected_dropped_and_captured(engine, tmp_path):
     assert len(captured) == 1
     assert frame_tuple(captured[0])[3] == 4242
 
+    # ISSUE 8: the flight recorder snapshots ALONGSIDE the pcap — the
+    # last dispatches' K/backlog/generation context for the post-mortem,
+    # flushed with the same crash-durability contract.
+    import json as _json
+
+    flight_path = tmp_path / "quarantine.pcap.flight.jsonl"
+    assert flight_path.exists()
+    snap = _json.loads(flight_path.read_text().splitlines()[-1])
+    assert snap["reason"] == "quarantine" and snap["shard"] == 0
+
     # The loop keeps running clean after the quarantine.
     runner.faults.disarm()
     rings[0].send([build_frame("10.1.1.2", "10.1.1.3", 6, 41000, 80)])

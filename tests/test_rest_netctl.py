@@ -276,5 +276,19 @@ def test_inspect_live_datapath_shows_session_after_flow():
         assert netctl_main(
             ["inspect", "--server", server, "--raw"], out=out) == 0
         assert json.loads(out.getvalue())["sessions"]["active"] == 1
+
+        # ISSUE 8 latency pillar: inspect carries the histograms after
+        # a dispatch, the summary renders them, and the flight recorder
+        # serves the same dispatch through its own endpoint.
+        assert after["latency"]["dispatch_rt"]["count"] >= 1
+        assert after["latency"]["frame_e2e"]["p999"] >= \
+            after["latency"]["frame_e2e"]["p50"] > 0
+        assert "latency: " in text and "p99.9=" in text
+        flight = _get(server, "/contiv/v1/flight")
+        assert flight["shards"][0]["records"][-1]["frames"] == 1
+        assert flight["shards"][0]["records"][-1]["k"] == 1
+        out = _io.StringIO()
+        assert netctl_main(["flight", "--server", server], out=out) == 0
+        assert "GEN" in out.getvalue()
     finally:
         rest.stop()

@@ -476,6 +476,97 @@ class ShardedDataplane:
     assert "datapath_special_gauge" in unwaived[0].message
 
 
+def test_obs_must_flag_latency_panel_key_nobody_produces():
+    """ISSUE 8 surface: the dashboard latency panel consumes histogram
+    snapshot keys — a renamed/dropped percentile must flag."""
+    views = """
+def shape_latency(inspect):
+    lat = inspect.get("latency") or {}
+    h = lat.get("dispatch_rt") or {}
+    return {"p": h.get("p95", 0)}
+"""
+    producer = """
+class Log2Histogram:
+    def snapshot(self):
+        return {"count": 0, "p50": 0, "p99": 0, "p999": 0}
+
+class DataplaneRunner:
+    def inspect(self):
+        return {"latency": {}}
+"""
+    project = Project.from_sources({
+        "vpp_tpu/uibackend/views.py": views,
+        "vpp_tpu/telemetry/hist.py": producer,
+    })
+    unwaived, _ = _run(project, _obs_checker(
+        schema_pairs=(("shape_latency",
+                       ("DataplaneRunner.inspect",
+                        "Log2Histogram.snapshot")),)))
+    msgs = [f.message for f in unwaived]
+    assert any("p95" in m for m in msgs)
+    assert not any("'p50'" in m for m in msgs)
+
+
+def test_obs_must_pass_latency_exporter_alignment():
+    """Must-pass: exporter + panel reading exactly the snapshot schema."""
+    views = """
+def shape_latency(inspect):
+    lat = inspect.get("latency") or {}
+    h = lat.get("dispatch_rt") or {}
+    return {"n": h.get("count", 0), "p": h.get("p999", 0)}
+"""
+    producer = """
+class Log2Histogram:
+    def snapshot(self):
+        return {"count": 0, "p50": 0, "p90": 0, "p99": 0, "p999": 0}
+
+class _DatapathCollector:
+    def collect(self):
+        snap = self._hist().snapshot()
+        yield snap.get("p50")
+        yield snap.get("p999")
+
+class DataplaneRunner:
+    def inspect(self):
+        return {"latency": {"dispatch_rt": {}}}
+"""
+    project = Project.from_sources({
+        "vpp_tpu/uibackend/views.py": views,
+        "vpp_tpu/telemetry/hist.py": producer,
+    })
+    unwaived, _ = _run(project, _obs_checker(
+        schema_pairs=(
+            ("shape_latency", ("DataplaneRunner.inspect",
+                               "Log2Histogram.snapshot")),
+            ("_DatapathCollector.collect", ("Log2Histogram.snapshot",)),
+        )))
+    assert unwaived == [], [f.format() for f in unwaived]
+
+
+def test_obs_must_flag_exporter_key_snapshot_stopped_producing():
+    """Must-flag: the metrics exporter reads a key the histogram
+    snapshot no longer emits — the Prometheus gauge would silently
+    flatline at the fallback."""
+    producer = """
+class Log2Histogram:
+    def snapshot(self):
+        return {"count": 0, "p50": 0}
+
+class _DatapathCollector:
+    def collect(self):
+        snap = self._hist().snapshot()
+        yield snap.get("p999")
+"""
+    project = Project.from_sources({
+        "vpp_tpu/telemetry/hist.py": producer,
+    })
+    unwaived, _ = _run(project, _obs_checker(
+        schema_pairs=(
+            ("_DatapathCollector.collect", ("Log2Histogram.snapshot",)),
+        )))
+    assert len(unwaived) == 1 and "p999" in unwaived[0].message
+
+
 def test_obs_must_pass_clean_fixture():
     src = """
 from dataclasses import dataclass

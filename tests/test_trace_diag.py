@@ -116,7 +116,12 @@ def test_trace_rest_netctl_and_bug_report(traced_cluster, tmp_path):
         assert "enabled=True" in text
         assert f"{client_ip}:42000" in text and backend_ip in text
         svc_line = next(ln for ln in text.splitlines() if "10.96.0.10" in ln)
-        assert svc_line.rstrip().endswith("D")  # DNAT flag on the traced row
+        fields = svc_line.split()
+        # DNAT flag on the traced row; the ISSUE 8 GEN/K correlation
+        # stamps follow it as the last two columns.
+        assert fields[-3] == "D"
+        assert fields[-2].isdigit() and fields[-1].isdigit()
+        assert int(fields[-1]) >= 1  # the batch's governor-chosen K
 
         with urllib.request.urlopen(
             f"http://{server}/contiv/v1/trace", timeout=5

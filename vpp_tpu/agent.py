@@ -174,6 +174,9 @@ class Agent:
             tracer=self.runner.tracer if self.runner else None,
             datapath=lambda: self.runner,
             store=self.store,
+            # Propagation spans (ISSUE 8): the controller mints one per
+            # event; REST serves the ring at /contiv/v1/spans.
+            spans=self.controller.spans,
             host="0.0.0.0" if rest_port else "127.0.0.1",
             port=rest_port,
         )

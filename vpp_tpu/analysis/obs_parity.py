@@ -37,6 +37,16 @@ DEFAULT_SCHEMA_PAIRS = (
                         "CoalesceGovernor.snapshot",
                         "ShardedDataplane.inspect",
                         "DataplaneRunner.inspect")),
+    # ISSUE 8 telemetry surfaces: the dashboard latency panel and the
+    # Prometheus exporters read the SAME snapshot schemas the inspect()
+    # pillar produces — a histogram field renamed on one side goes
+    # blank on the other, which is exactly what this catches.
+    ("shape_latency", ("DataplaneRunner.inspect",
+                       "ShardedDataplane.inspect",
+                       "Log2Histogram.snapshot",
+                       "FlightRecorder.status")),
+    ("_DatapathCollector.collect", ("Log2Histogram.snapshot",)),
+    ("_SpanCollector.collect", ("SpanTracker.status",)),
 )
 DEFAULT_METRICS_PAIR = ("DataplaneRunner.metrics",
                         "ShardedDataplane._aggregate_counters")

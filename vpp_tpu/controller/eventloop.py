@@ -42,6 +42,7 @@ from .api import (
     UpdateEvent,
     UpdateTxnType,
 )
+from ..telemetry import SpanTracker, current_span_id, record_stage
 from .txn import Txn, TxnSink, RecordedTxn
 
 log = logging.getLogger(__name__)
@@ -78,6 +79,9 @@ class EventRecord:
     txn_error: Optional[str] = None
     started: float = 0.0
     duration_ms: float = 0.0
+    # Propagation-span correlation (ISSUE 8): the span minted for this
+    # event, findable in /contiv/v1/spans by the same id.
+    span_id: int = 0
 
     @property
     def error(self) -> Optional[str]:
@@ -104,11 +108,19 @@ class Controller:
         history_limit: int = 1000,
         periodic_healing_interval: float = 0.0,
         startup_resync_deadline: float = 0.0,
+        spans: Optional[SpanTracker] = None,
     ):
         self.handlers = list(handlers)
         self.sink = sink
         self.healing_delay = healing_delay
         self.on_fatal = on_fatal
+        # Propagation spans (ISSUE 8): one span per processed event,
+        # stages stamped through handlers → applicator compile → device
+        # swap → shard adoption (all on this loop's thread), dumped via
+        # REST /contiv/v1/spans + `netctl spans`.  Always present —
+        # spans cost two perf_counter calls per stage on the control
+        # plane, nowhere near a hot path.
+        self.spans = spans if spans is not None else SpanTracker()
         # Optional periodic healing resync (plugin_controller.go
         # periodicHealing :411-425; disabled by default, as in the
         # reference's config).
@@ -334,6 +346,21 @@ class Controller:
             is_followup=getattr(event, "_from_followup", False),
             started=time.time(),
         )
+        # Propagation span: minted HERE — the moment the K8s/external
+        # event reaches the control plane — and finished after commit,
+        # so its total is the full event→device propagation latency.
+        # Downstream stages (applicator compile, device swap, per-shard
+        # adoption) stamp into it through the telemetry thread-local;
+        # no context threads through handler signatures.
+        span = self.spans.start(event.name, str(event))
+        record.span_id = span.span_id
+        try:
+            self._process_event_spanned(event, record)
+        finally:
+            self.spans.finish(span)
+
+    def _process_event_spanned(self, event: Event,
+                               record: EventRecord) -> None:
 
         # 1-2. Update the cached Kubernetes state.
         if isinstance(event, DBResync):
@@ -389,6 +416,7 @@ class Controller:
     def _process_resync(self, event: Event, record: EventRecord) -> Optional[Exception]:
         self._resync_count += 1
         txn = Txn(is_resync=True)
+        txn.span_id = current_span_id()
         self.current_txn = txn
         first_err: Optional[Exception] = None
         for handler in self.handlers:
@@ -396,6 +424,7 @@ class Controller:
                 continue
             hrec = HandlerRecord(handler=handler.name)
             record.handlers.append(hrec)
+            t0 = time.perf_counter()
             try:
                 handler.resync(event, self.kube_state, self._resync_count, txn)
             except FatalError:
@@ -407,6 +436,11 @@ class Controller:
                     first_err = e
                 # Resync is best-effort across handlers (reference continues
                 # and reports, scheduling healing afterwards).
+            finally:
+                # Span stage: processor + renderer work runs inside the
+                # handler, so this is the "event processing" leg.
+                record_stage(f"handler:{handler.name}",
+                             time.perf_counter() - t0)
         self.current_txn = None
         commit_err = self._commit(txn, record)
         return first_err or commit_err
@@ -420,6 +454,7 @@ class Controller:
 
         ordered = self.handlers if direction is UpdateDirection.FORWARD else list(reversed(self.handlers))
         txn = Txn(is_resync=False)
+        txn.span_id = current_span_id()
         self.current_txn = txn
         executed: List[EventHandler] = []
         err: Optional[Exception] = None
@@ -429,6 +464,7 @@ class Controller:
                 continue
             hrec = HandlerRecord(handler=handler.name)
             record.handlers.append(hrec)
+            t0 = time.perf_counter()
             try:
                 hrec.change = handler.update(event, txn) or ""
                 executed.append(handler)
@@ -446,6 +482,9 @@ class Controller:
                     err = e
                 if txn_type is UpdateTxnType.REVERT_ON_FAILURE:
                     break
+            finally:
+                record_stage(f"handler:{handler.name}",
+                             time.perf_counter() - t0)
 
         self.current_txn = None
         if err is not None and txn_type is UpdateTxnType.REVERT_ON_FAILURE and not aborted:
@@ -468,9 +507,12 @@ class Controller:
     def _commit(self, txn: Txn, record: EventRecord, downstream: bool = False) -> Optional[Exception]:
         if txn.empty and not txn.is_resync:
             return None
+        if not txn.span_id:  # downstream-repair txns are built inline
+            txn.span_id = current_span_id()
         self._txn_seq += 1
         if record.txn is None:  # healing runs commit + downstream repair
             record.txn = txn.record(self._txn_seq)
+        t0 = time.perf_counter()
         try:
             if downstream:
                 # Verify-first southbound repair when the sink supports
@@ -489,6 +531,11 @@ class Controller:
         except Exception as e:  # noqa: BLE001
             record.txn_error = str(e)
             return e
+        finally:
+            # Span stage bracketing the whole southbound commit (the
+            # compile/swap/adopt stages stamped inside it nest here).
+            record_stage("commit", time.perf_counter() - t0,
+                         downstream=downstream)
         return None
 
     def _schedule_healing(self, err: Exception) -> None:

@@ -25,13 +25,17 @@ class RecordedTxn:
 
     ``is_resync`` distinguishes full-resync commits (desired state is
     *replaced* by ``values``) from incremental commits (``values`` are
-    merged, None meaning delete).
+    merged, None meaning delete).  ``span_id`` (ISSUE 8) is the
+    propagation span minted for the originating event — the join key
+    between the event history, the scheduler txn log, and the span
+    ring dumped at ``/contiv/v1/spans``.
     """
 
     seq_num: int = 0
     is_resync: bool = False
     # key -> value; value None = delete (only in non-resync txns)
     values: Dict[str, Any] = field(default_factory=dict)
+    span_id: int = 0
 
     def describe(self) -> str:
         ops = []
@@ -49,6 +53,9 @@ class Txn:
     def __init__(self, is_resync: bool):
         self.is_resync = is_resync
         self._values: Dict[str, Any] = {}
+        # The propagation span of the event this txn belongs to,
+        # stamped by the controller when it opens the txn (0 = none).
+        self.span_id = 0
 
     def put(self, key: str, value: Any) -> None:
         """Add or modify a value. ``value`` cannot be None."""
@@ -78,4 +85,5 @@ class Txn:
         return not self._values
 
     def record(self, seq_num: int) -> RecordedTxn:
-        return RecordedTxn(seq_num=seq_num, is_resync=self.is_resync, values=dict(self._values))
+        return RecordedTxn(seq_num=seq_num, is_resync=self.is_resync,
+                           values=dict(self._values), span_id=self.span_id)

@@ -48,6 +48,7 @@ from ..ops.pipeline import (
 )
 from ..ops.slowpath import HostSlowPath
 from ..shim.hostshim import FrameBatch, HostShim, NativeLoop, NativeRing
+from ..telemetry import FlightRecorder, LatencyRecorder, record_stage
 from ..testing.faults import (
     SITE_DISPATCH_HANG,
     SITE_DISPATCH_RAISE,
@@ -382,6 +383,19 @@ class DataplaneRunner:
         # Sampled per-packet verdict traces (vpptrace analog), enabled on
         # demand via REST/netctl.
         self.tracer = tracer if tracer is not None else PacketTracer()
+        # Telemetry (ISSUE 8): latency histograms fed from the SAME
+        # perf_counter timestamps the governor's timing fit takes — the
+        # dispatch path gains zero new clock calls or device syncs —
+        # plus the per-shard flight recorder of recent dispatches
+        # (snapshotted next to the forensic pcap on ejection/
+        # quarantine).  Both are single-writer (this runner's worker);
+        # readers merge/copy on read.
+        self.telemetry = LatencyRecorder()
+        self.flight = FlightRecorder()
+        # Monotonic table generation: bumped once per adopted swap so
+        # flight-recorder rows and packet traces pin the exact tables a
+        # batch dispatched under (correlates with propagation spans).
+        self._table_gen = 0  # owner: control plane — only _adopt_tables bumps it (swaps serialise on the scheduler lock); workers read a plain int
         # In-flight queue: python engine (FrameBatch, result, ts, k,
         # t_admit, depth); native engine (slot, n, orig-SoA dict,
         # result, ts, k, t_admit, depth) — the (k, t_admit, depth)
@@ -687,12 +701,14 @@ class DataplaneRunner:
         The ``swap-fail`` site fires BEFORE any reference mutates, so
         an injected failure never leaves THIS shard partially adopted
         (multi-shard atomicity is the sharded engine's rollback)."""
-        if acl is not None or nat is not None or route is not None:
-            self.faults.fire(SITE_SWAP_FAIL, shard=self.shard_index)
-            # New tables may mean new jit cache keys: every bucket's
-            # next dispatch may compile again, so its timing sample
-            # must be re-screened (see _observe_harvest).
-            self._timed_k.clear()
+        if acl is None and nat is None and route is None:
+            return
+        t0 = time.perf_counter()
+        self.faults.fire(SITE_SWAP_FAIL, shard=self.shard_index)
+        # New tables may mean new jit cache keys: every bucket's
+        # next dispatch may compile again, so its timing sample
+        # must be re-screened (see _observe_harvest).
+        self._timed_k.clear()
         if acl is not None:
             self.acl = acl
             self.counters.acl_swaps += 1
@@ -725,6 +741,13 @@ class DataplaneRunner:
                 self.mesh, self.acl, self.nat, self.route, self.sessions,
                 partition_sessions=self.partition_sessions,
             )
+        # One generation per adopted swap (whatever mix of tables it
+        # carried): flight-recorder rows and packet traces stamp it.
+        self._table_gen += 1
+        # Propagation span: this shard's adoption duration (no-op when
+        # no controller span is active, e.g. standalone benches).
+        record_stage(f"adopt:shard{self.shard_index}",
+                     time.perf_counter() - t0)
 
     # ----------------------------------------------------- bucket pre-warm
 
@@ -809,17 +832,39 @@ class DataplaneRunner:
         except TypeError:
             return -1
 
-    def _observe_harvest(self, k: int, t_admit: float, depth: int) -> None:
-        """Feed one per-dispatch wall-time sample to the governor.
-        Unpipelined batches (admitted with nothing in flight) time the
-        full admit→harvest round trip; pipelined ones use the inter-
+    def _observe_harvest(self, k: int, t_admit: float, depth: int,
+                         t_harvest: Optional[float] = None, ts: int = 0,
+                         frames: int = 0, sent: int = 0,
+                         denied: int = 0) -> None:
+        """Feed one per-dispatch wall-time sample to the governor, the
+        latency histograms, and the flight recorder.  Unpipelined
+        batches (admitted with nothing in flight) time the full
+        admit→harvest round trip; pipelined ones use the inter-
         completion interval, which is exactly the per-dispatch wall in
-        the saturated steady state.  A bucket's first-ever sample is
-        discarded unless the bucket was pre-warmed — it may include
-        jit compile time, which is not service time."""
+        the saturated steady state.  A bucket's first-ever governor
+        sample is discarded unless the bucket was pre-warmed — it may
+        include jit compile time, which is not service time (the
+        histograms keep it: a compile stall IS latency the frames
+        experienced).
+
+        ``t_harvest`` is the perf_counter the harvest took before
+        materialising (the one clock call telemetry added, on the
+        sanctioned harvest path — the dispatch path still takes
+        exactly the timestamps the governor always took); the
+        remaining arguments are host ints the harvest already
+        computed, so this tap stays free of device syncs."""
         now = time.perf_counter()
         prev = self._last_harvest_t
         self._last_harvest_t = now
+        self.telemetry.record_harvest(
+            t_admit, t_harvest if t_harvest is not None else t_admit,
+            now, frames,
+        )
+        self.flight.note_dispatch(
+            ts=ts, k=k, frames=frames, sent=sent, denied=denied,
+            backlog=self.governor.backlog, inflight=depth,
+            table_gen=self._table_gen, rt_us=(now - t_admit) * 1e6,
+        )
         if k not in self._timed_k:
             self._timed_k.add(k)
             if self.mesh is not None or \
@@ -1099,6 +1144,10 @@ class DataplaneRunner:
             # Forensics must survive a crash — the very scenario the
             # capture exists for; quarantines are rare, flush per batch.
             self._quarantine_writer.flush()
+            # The flight recorder rides along: the last N dispatches'
+            # K/backlog/generation context lands NEXT TO the frames
+            # that poisoned the batch (same crash-durability rules).
+            self.snapshot_flight("quarantine")
         return len(live)
 
     def sanitize_after_fault(self) -> None:
@@ -1146,6 +1195,44 @@ class DataplaneRunner:
             "last_error": self._last_fault_error,
         }
 
+    # ---------------------------------------------------------- telemetry
+
+    def snapshot_flight(self, reason: str) -> Optional[str]:
+        """Dump this runner's flight-recorder ring next to the forensic
+        pcap (``<quarantine_pcap>.flight.jsonl``); returns the path, or
+        None when no pcap destination is configured (nowhere to put
+        forensics).  Called on poisoned-batch quarantine and — via the
+        shard supervisor — on every ejection."""
+        if not self.quarantine_pcap:
+            return None
+        path = self.quarantine_pcap + ".flight.jsonl"
+        self.flight.snapshot_to(path, reason=reason, shard=self.shard_index)
+        return path
+
+    def latency_histograms(self):
+        """{name: Log2Histogram} for the metrics exporter (host-only;
+        the sharded engine merges across shards instead)."""
+        return self.telemetry.histograms()
+
+    def inspect_latency(self) -> Dict[str, object]:
+        """The latency pillar of inspect(): per-histogram count/sum and
+        p50/p90/p99/p99.9 — derived on read, no device access."""
+        return {
+            name: hist.snapshot()
+            for name, hist in self.telemetry.histograms().items()
+        }
+
+    def dump_flight(self, limit: int = 0) -> Dict[str, object]:
+        """On-demand flight-recorder dump (REST /contiv/v1/flight →
+        `netctl flight`)."""
+        return {
+            "shards": [{
+                "shard": self.shard_index,
+                **self.flight.status(),
+                "records": self.flight.dump(limit),
+            }],
+        }
+
     # ------------------------------------------------------- native engine
 
     def _admit_native(self) -> bool:
@@ -1188,6 +1275,12 @@ class DataplaneRunner:
         return True
 
     def _harvest_native(self) -> int:
+        # Harvest-start mark: together with _observe_harvest's existing
+        # end-of-harvest perf_counter this bounds the "harvest stitch"
+        # histogram (device block + host stitch) and the in-flight wait
+        # — one clock call per BATCH on the sanctioned harvest path;
+        # the dispatch path keeps its original timestamps untouched.
+        t_h0 = time.perf_counter()
         slot, n, soa, result, ts, k, t_admit, depth = self._inflight.popleft()
         # Materialise (blocks on THIS batch only; newer ones stay queued).
         punt = np.asarray(result.punt)[:n]
@@ -1219,7 +1312,7 @@ class DataplaneRunner:
         orig = {key: arr[:n] for key, arr in soa.items()}
         slow_drops = self._slowpath_and_trace(
             orig, rew, allowed, route_tag, node_id,
-            punt, reply_hit, dnat_hit, snat_hit, ts,
+            punt, reply_hit, dnat_hit, snat_hit, ts, k,
         )
         poison_drops = self._quarantine_rows(
             result, n, lambda row: self._native.slot_frame(slot, row))
@@ -1236,7 +1329,8 @@ class DataplaneRunner:
         # Denied excludes rows the slow path already counted and rows
         # the quarantine dropped as poisoned; rows permitted but
         # unforwardable are parse failures, not denials.
-        self.counters.dropped_denied += int(c[3]) - slow_drops - poison_drops
+        denied = int(c[3])
+        self.counters.dropped_denied += denied - slow_drops - poison_drops
         self.counters.dropped_unparseable += int(c[4])
         self.counters.dropped_unroutable += int(c[5])
         if self._bypass_tables:
@@ -1244,7 +1338,8 @@ class DataplaneRunner:
             # have created sessions/punts the swap-time eligibility
             # check could not see — re-derive before the next bypass.
             self._bypass_recheck = True
-        self._observe_harvest(k, t_admit, depth)
+        self._observe_harvest(k, t_admit, depth, t_harvest=t_h0, ts=int(ts),
+                              frames=n, sent=sent, denied=denied)
         return sent
 
     # ------------------------------------------------------- python engine
@@ -1311,6 +1406,7 @@ class DataplaneRunner:
         return True
 
     def _harvest_python(self) -> int:
+        t_h0 = time.perf_counter()  # harvest-start mark; see _harvest_native
         fb, result, ts, k, t_admit, depth = self._inflight.popleft()
         n = fb.n
         # Materialise (blocks on THIS batch only; newer ones stay queued).
@@ -1337,7 +1433,7 @@ class DataplaneRunner:
         }
         slow_drops = self._slowpath_and_trace(
             orig, rew, allowed, route_tag, node_id,
-            punt, reply_hit, dnat_hit, snat_hit, ts,
+            punt, reply_hit, dnat_hit, snat_hit, ts, k,
         )
         poison_drops = self._quarantine_rows(result, n, fb.frame)
 
@@ -1352,9 +1448,8 @@ class DataplaneRunner:
         # counted and quarantined poisoned rows; rows permitted but
         # unforwardable are parse failures (non-IPv4 frames), not
         # denials.
-        self.counters.dropped_denied += (
-            int((~allowed_bool).sum()) - slow_drops - poison_drops
-        )
+        denied = int((~allowed_bool).sum())
+        self.counters.dropped_denied += denied - slow_drops - poison_drops
         self.counters.dropped_unparseable += int((allowed_bool & (fwd == 0)).sum())
 
         is_remote = (route_tag == ROUTE_REMOTE).astype(np.uint8)
@@ -1388,14 +1483,15 @@ class DataplaneRunner:
             sent += len(frames)
         if self._bypass_tables:
             self._bypass_recheck = True  # see _harvest_native
-        self._observe_harvest(k, t_admit, depth)
+        self._observe_harvest(k, t_admit, depth, t_harvest=t_h0, ts=int(ts),
+                              frames=n, sent=sent, denied=denied)
         return sent
 
     # ------------------------------------------------------ shared harvest
 
     def _slowpath_and_trace(
         self, orig, rew, allowed, route_tag, node_id,
-        punt, reply_hit, dnat_hit, snat_hit, ts,
+        punt, reply_hit, dnat_hit, snat_hit, ts, k=0,
     ) -> int:
         """Host slow path (punt servicing, port fixups, reply restores)
         + sampled packet trace — shared by both engines.  Mutates
@@ -1403,16 +1499,19 @@ class DataplaneRunner:
         returns the number of slow-path drops.  Guarded by the (shared)
         host lock: in the sharded engine the slow path's session dict is
         one structure for all shards, because a punted flow's reply may
-        land on a different shard than its forward packet did."""
+        land on a different shard than its forward packet did.  ``k``
+        is the governor-chosen vector count of this batch — stamped
+        (with the table generation) into the packet trace so traces
+        correlate with flight-recorder rows and propagation spans."""
         with self._host_lock:
             return self._slowpath_and_trace_locked(
                 orig, rew, allowed, route_tag, node_id,
-                punt, reply_hit, dnat_hit, snat_hit, ts,
+                punt, reply_hit, dnat_hit, snat_hit, ts, k,
             )
 
     def _slowpath_and_trace_locked(
         self, orig, rew, allowed, route_tag, node_id,
-        punt, reply_hit, dnat_hit, snat_hit, ts,
+        punt, reply_hit, dnat_hit, snat_hit, ts, k=0,
     ) -> int:
         slow_drops = 0
         if punt.any():
@@ -1443,6 +1542,7 @@ class DataplaneRunner:
         self.tracer.record_batch(
             ts, orig, rew, allowed, route_tag, node_id,
             dnat_hit, snat_hit, reply_hit, punt,
+            table_gen=self._table_gen, k=k,
         )
         return slow_drops
 
@@ -1541,6 +1641,8 @@ class DataplaneRunner:
             "rings": self.inspect_rings(),
             "counters": self.counters.as_dict(),
             "trace": self.tracer.status(),
+            "latency": self.inspect_latency(),
+            "flight": self.flight.status(),
         }
 
     # Host-only inspect slices (NO device reads) — the sharded engine
@@ -1558,6 +1660,7 @@ class DataplaneRunner:
             "bypass_batches": self.counters.bypass_batches,
             "device_batches": self.counters.batches,
             "ts": self._ts,
+            "table_gen": self._table_gen,
             "mesh": str(self.mesh.shape) if self.mesh is not None else "",
             "governor": self.governor.snapshot(),
             "prewarm": self.prewarm,

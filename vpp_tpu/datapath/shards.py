@@ -385,6 +385,16 @@ class ShardedDataplane:
         h.backoff = min(self.reinit_backoff_max,
                         self.reinit_backoff * (2 ** (h.eject_streak - 1)))
         h.ejected_at = time.monotonic()
+        # Post-mortem forensics (ISSUE 8): snapshot the shard's flight
+        # recorder — its last N dispatches' K/backlog/generation/
+        # verdict context — next to the quarantine pcap BEFORE the
+        # runner is sanitised or its worker abandoned.  Reading the
+        # ring is safe even for a hung shard: the recorder is a host
+        # deque and the wedged thread is parked in a device call.
+        try:
+            self.shards[i].snapshot_flight(f"ejection: {h.last_error}")
+        except OSError as err:  # forensics must never block supervision
+            log.warning("shard %d flight snapshot failed: %s", i, err)
 
     # ------------------------------------------------------------ steering
 
@@ -520,6 +530,18 @@ class ShardedDataplane:
             for r in self.shards:
                 r.acl, r.nat, r.route = last_good
                 r._route_cache = None
+            # Re-align table generations: shards that adopted before
+            # the failure bumped theirs, the failing one did not — left
+            # alone they would diverge forever and the generation would
+            # stop being a cross-shard correlation key for flight/trace
+            # rows.  One PAST the highest: batches already harvested
+            # under the transient new tables stamped max, so the
+            # restored last-good state needs its OWN generation — a
+            # post-mortem joining rows on table_gen must never mix
+            # rolled-back-table verdicts with last-good ones.
+            gen = max(r._table_gen for r in self.shards) + 1
+            for r in self.shards:
+                r._table_gen = gen
             self._swap_rollbacks += 1
             state_clear = (
                 r0._bypass_state_clear() if r0._bypass_static_ok() else False)
@@ -600,6 +622,31 @@ class ShardedDataplane:
             one.get("datapath_slowpath_sessions_active", 0),
         )
 
+    # ---------------------------------------------------------- telemetry
+
+    def latency_histograms(self):
+        """Whole-node latency histograms: every shard's single-writer
+        recorders merged on read (same names as the solo runner, so the
+        metrics exporter and dashboard see one schema)."""
+        from ..telemetry import LatencyRecorder
+
+        return LatencyRecorder.merged(r.telemetry for r in self.shards)
+
+    def inspect_latency(self) -> Dict[str, object]:
+        return {name: hist.snapshot()
+                for name, hist in self.latency_histograms().items()}
+
+    def dump_flight(self, limit: int = 0) -> Dict[str, object]:
+        """All shards' flight rings, each labelled with its shard index
+        (post-mortems usually chase ONE shard's history)."""
+        return {
+            "shards": [{
+                "shard": i,
+                **r.flight.status(),
+                "records": r.flight.dump(limit),
+            } for i, r in enumerate(self.shards)],
+        }
+
     def health(self) -> Dict[str, object]:
         """The fault-domain report (REST /contiv/v1/health → `netctl
         health`): per-shard state machine positions + engine-level
@@ -674,6 +721,16 @@ class ShardedDataplane:
         gov["samples"] = sum(r.governor.samples for r in self.shards)
         gov["per_shard_k"] = [r.governor.current_k for r in self.shards]
         gov["per_shard_backlog"] = [r.governor.backlog for r in self.shards]
+        # Whole-node latency view: merged across every shard's
+        # single-writer recorders (shard 0's solo view would miss the
+        # other shards' samples); flight status aggregates similarly.
+        base["latency"] = self.inspect_latency()
+        base["flight"] = {
+            "recorded": sum(len(r.flight) for r in self.shards),
+            "capacity": sum(r.flight.capacity for r in self.shards),
+            "dispatches_total": sum(
+                r.flight.status()["dispatches_total"] for r in self.shards),
+        }
         # Aggregated counters WITHOUT re-reading device occupancy:
         # shard 0's inspect() above already transferred the gauges.
         sessions = base["sessions"]

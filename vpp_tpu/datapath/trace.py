@@ -31,7 +31,12 @@ _ROUTE_NAMES = {
 
 @dataclass(frozen=True)
 class TraceEntry:
-    """One traced packet (the vppctl `show trace` record analog)."""
+    """One traced packet (the vppctl `show trace` record analog).
+
+    ``table_gen`` and ``k`` (ISSUE 8) stamp the dispatch batch's table
+    generation and governor-chosen vector count, so a trace row
+    correlates directly with flight-recorder rows (same generation
+    field) and with the propagation span that installed those tables."""
 
     seq: int
     batch_ts: int
@@ -51,6 +56,8 @@ class TraceEntry:
     snat: bool
     reply: bool
     punt: bool
+    table_gen: int
+    k: int
 
     def as_dict(self) -> Dict:
         return asdict(self)
@@ -93,12 +100,13 @@ class PacketTracer:
 
     def record_batch(
         self, batch_ts, orig, rew, allowed, route_tag, node_id,
-        dnat, snat, reply, punt,
+        dnat, snat, reply, punt, table_gen: int = 0, k: int = 0,
     ) -> None:
         """Record the sampled rows of one harvested batch; ``orig``/``rew``
-        are the harvest's field->ndarray dicts.  The hot path stores raw
-        int tuples; all string formatting is deferred to dump(), and the
-        lock is held only for the ring appends."""
+        are the harvest's field->ndarray dicts.  ``table_gen``/``k``
+        are batch-constant correlation stamps (ISSUE 8).  The hot path
+        stores raw int tuples; all string formatting is deferred to
+        dump(), and the lock is held only for the ring appends."""
         if not self.enabled:
             return
         n = len(allowed)
@@ -121,6 +129,7 @@ class PacketTracer:
                 int(rew["src_port"][i]), int(rew["dst_port"][i]),
                 bool(allowed[i]), int(route_tag[i]), int(node_id[i]),
                 bool(dnat[i]), bool(snat[i]), bool(reply[i]), bool(punt[i]),
+                int(table_gen), int(k),
             )
             for j, i in enumerate(rows)
         ]
@@ -137,6 +146,10 @@ class PacketTracer:
             rw_src_port=r[9], rw_dst_port=r[10],
             allowed=r[11], route=_ROUTE_NAMES.get(r[12], "?"),
             node_id=r[13], dnat=r[14], snat=r[15], reply=r[16], punt=r[17],
+            # Entries recorded before the ISSUE 8 stamps existed (an
+            # enable spanning an agent upgrade) degrade to gen 0 / K 0.
+            table_gen=r[18] if len(r) > 18 else 0,
+            k=r[19] if len(r) > 19 else 0,
         )
 
     def dump(self) -> List[Dict]:

@@ -27,6 +27,12 @@ Analog of ``plugins/netctl`` + ``cmd/contiv-netctl`` (cmd/root.go
 - ``fault``      fault-injection harness control: list armed plans,
                  ``fault arm dispatch-raise --shard 1 --count 4``,
                  ``fault disarm [--site s]`` (chaos drills / testing)
+- ``spans``      recent config-propagation spans: per-stage timings of
+                 event → compile → device swap → shard adoption, plus
+                 the end-to-end propagation latency histogram
+- ``flight``     the datapath flight recorder: the last N dispatch
+                 records per shard (K, backlog, in-flight depth, table
+                 generation, verdicts, round-trip µs) for post-mortems
 
 Run: ``python -m vpp_tpu.netctl <command> [--server host:port]``.
 """
@@ -183,9 +189,67 @@ def cmd_trace(server: str, out, action: str = "", sample: int = 1) -> int:
             "allow" if e["allowed"] else "deny",
             e["route"] + (f"#{e['node_id']}" if e["route"] == "remote" else ""),
             flags,
+            # Correlation stamps (ISSUE 8): the table generation the
+            # batch dispatched under + the governor-chosen K — join
+            # keys into `netctl flight` rows and propagation spans.
+            str(e.get("table_gen", 0)),
+            str(e.get("k", 0)),
         ])
     print(_table(rows, ["SEQ", "SRC", "DST", "PROTO", "RW-SRC", "RW-DST",
-                        "VERDICT", "ROUTE", "FLAGS"]), file=out)
+                        "VERDICT", "ROUTE", "FLAGS", "GEN", "K"]), file=out)
+    return 0
+
+
+def cmd_spans(server: str, out, raw: bool = False, limit: int = 20) -> int:
+    """Config-propagation spans: how long from the K8s event until the
+    rule was live on the device, stage by stage."""
+    d = _fetch(server, f"/contiv/v1/spans?limit={limit}")
+    if raw:
+        print(json.dumps(d, indent=2), file=out)
+        return 0
+    st = d["status"]
+    p = st.get("propagation_us") or {}
+    print(f"node {d.get('node', '?')}  spans={st['spans_started']} "
+          f"propagated={st['spans_propagated']}  recorded="
+          f"{st['recorded']}/{st['capacity']}", file=out)
+    print(f"propagation: n={p.get('count', 0)}  p50={p.get('p50', 0)}us "
+          f"p90={p.get('p90', 0)}us  p99={p.get('p99', 0)}us  "
+          f"p99.9={p.get('p999', 0)}us", file=out)
+    rows = []
+    for s in d["spans"]:
+        stages = " ".join(
+            f"{g['stage']}={g['us']:.0f}us"
+            + (f"({g['mode']})" if g.get("mode") else "")
+            for g in s["stages"]
+        )
+        rows.append([s["span_id"], s["event"],
+                     f"{s['total_us']:.0f}",
+                     "yes" if s["propagated"] else "-",
+                     stages[:120]])
+    print(_table(rows, ["SPAN", "EVENT", "TOTAL-US", "DEVICE", "STAGES"]),
+          file=out)
+    return 0
+
+
+def cmd_flight(server: str, out, raw: bool = False, limit: int = 20) -> int:
+    """Flight-recorder dump: the per-shard ring of recent dispatches."""
+    d = _fetch(server, f"/contiv/v1/flight?limit={limit}")
+    if raw:
+        print(json.dumps(d, indent=2), file=out)
+        return 0
+    for shard in d["shards"]:
+        print(f"node {d.get('node', '?')}  shard {shard['shard']}  "
+              f"dispatches={shard['dispatches_total']}  recorded="
+              f"{shard['recorded']}/{shard['capacity']}", file=out)
+        rows = [
+            [r["seq"], r["ts"], r["k"], r["frames"], r["sent"], r["denied"],
+             r["backlog"], r["inflight"], r["table_gen"], r["rt_us"]]
+            for r in shard["records"]
+        ]
+        if rows:
+            print(_table(rows, ["SEQ", "TS", "K", "FRAMES", "SENT", "DENIED",
+                                "BACKLOG", "INFLIGHT", "GEN", "RT-US"]),
+                  file=out)
     return 0
 
 
@@ -240,6 +304,16 @@ def cmd_inspect(server: str, out, watch: float = 0.0, raw: bool = False) -> int:
         print(f"sessions: {se['active']}/{se['capacity']} active, "
               f"{se['affinity_pins']} affinity pins   slowpath: "
               f"{sp['sessions']} sessions", file=out)
+        lat = d.get("latency") or {}
+        if lat:
+            parts = []
+            for name in ("admit_wait", "dispatch_rt", "harvest", "frame_e2e"):
+                h = lat.get(name) or {}
+                if h.get("count"):
+                    parts.append(f"{name} p50={h['p50']}us p99={h['p99']}us "
+                                 f"p99.9={h['p999']}us")
+            if parts:
+                print("latency: " + "   ".join(parts), file=out)
         comp = d.get("compile") or {}
         if comp:
             parts = [f"swaps acl={comp.get('acl_swaps', 0)} "
@@ -421,6 +495,16 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
     fault.add_argument("--mode", default="", choices=["", "raise", "hang"])
     fault.add_argument("--seconds", type=float, default=30.0,
                        help="hang-mode safety timeout")
+    spanscmd = sub.add_parser("spans", parents=[common])
+    spanscmd.add_argument("--raw", action="store_true",
+                          help="full JSON instead of the summary view")
+    spanscmd.add_argument("--limit", type=int, default=20,
+                          help="show the most recent N spans")
+    flightcmd = sub.add_parser("flight", parents=[common])
+    flightcmd.add_argument("--raw", action="store_true",
+                           help="full JSON instead of the summary view")
+    flightcmd.add_argument("--limit", type=int, default=20,
+                           help="show the most recent N records per shard")
     args = parser.parse_args(argv)
 
     try:
@@ -441,6 +525,10 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
         if args.command == "fault":
             return cmd_fault(args.server, out, args.action, args.site,
                              args.shard, args.count, args.mode, args.seconds)
+        if args.command == "spans":
+            return cmd_spans(args.server, out, args.raw, args.limit)
+        if args.command == "flight":
+            return cmd_flight(args.server, out, args.raw, args.limit)
         return {
             "nodes": cmd_nodes,
             "pods": cmd_pods,

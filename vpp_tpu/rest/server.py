@@ -21,6 +21,12 @@ Analog of the reference's per-node REST surfaces (SURVEY.md §5.5):
 - ``GET /contiv/v1/health`` + ``POST /contiv/v1/health/recover`` —
   datapath fault-domain health (shard supervision states, quarantine /
   rollback counters) and operator-expedited shard recovery;
+- ``GET /contiv/v1/spans`` — recent config-propagation spans (event →
+  compile → swap → shard adoption stage timings) + the propagation
+  latency histogram (ISSUE 8);
+- ``GET /contiv/v1/flight`` — the per-shard flight recorder: the last
+  N dispatch records (K, backlog, in-flight depth, table generation,
+  verdict counts, round-trip µs) for live post-mortems;
 - ``GET /contiv/v1/faults`` + ``POST /contiv/v1/faults/arm|disarm`` —
   the fault-injection harness (vpp_tpu/testing/faults.py), the REST
   arming surface chaos drills use.
@@ -76,6 +82,7 @@ class AgentRestServer:
         tracer=None,
         datapath=None,
         store=None,
+        spans=None,
         host: str = "127.0.0.1",
         port: int = 0,
     ):
@@ -95,6 +102,9 @@ class AgentRestServer:
         # This agent's cluster-store handle (KVStore or RemoteKVStore):
         # the data source for the arbitrary-keyspace dump.
         self.store = store
+        # Propagation spans: an explicit SpanTracker, or (default) the
+        # controller's own — every Controller carries one.
+        self.spans = spans
         self.host = host
         self.port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -205,6 +215,26 @@ class AgentRestServer:
         """The fault-injection harness's armed plans (testing/chaos
         surface — see vpp_tpu/testing/faults.py)."""
         return self._resolve_datapath().faults.status()
+
+    def get_spans(self, query: dict) -> dict:
+        """Recent config-propagation spans + the end-to-end propagation
+        histogram (`netctl spans`); ``limit=`` bounds the dump."""
+        tracker = self.spans or getattr(self.controller, "spans", None)
+        if tracker is None:
+            raise LookupError("no span tracker")
+        limit = int(query.get("limit", "0"))
+        return {
+            "node": self.node_name,
+            "status": tracker.status(),
+            "spans": tracker.dump(limit),
+        }
+
+    def get_flight(self, query: dict) -> dict:
+        """Flight-recorder dump (`netctl flight`): per shard, the last
+        N dispatch records; ``limit=`` bounds records per shard."""
+        dp = self._resolve_datapath()
+        limit = int(query.get("limit", "0"))
+        return {"node": self.node_name, **dp.dump_flight(limit)}
 
     def post_fault(self, action: str, query: dict) -> dict:
         """Arm/disarm a named fault-injection site on the live
@@ -358,6 +388,10 @@ class AgentRestServer:
             return self.get_metrics()
         if method == "GET" and path == "/contiv/v1/trace":
             return self.get_trace()
+        if method == "GET" and path == "/contiv/v1/spans":
+            return self.get_spans(query)
+        if method == "GET" and path == "/contiv/v1/flight":
+            return self.get_flight(query)
         if method == "POST" and path.startswith("/contiv/v1/trace/"):
             return self.post_trace(
                 path.rsplit("/", 1)[1], int(query.get("sample", "1"))

@@ -29,10 +29,12 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..ops.classify import RuleTables
 from ..ops.nat import NatMapping, NatTables
+from ..telemetry import record_stage
 from .scheduler import Applicator
 
 ACL_POD_PREFIX = "tpu/acl/pod/"
@@ -121,6 +123,10 @@ class _CompilingApplicator(Applicator):
     """Shared begin/end-txn bracket: subclasses mutate ``_state`` in
     create/update/delete and compile once per transaction."""
 
+    # Short stage label for propagation spans ("compile:acl" etc.);
+    # subclasses override.
+    telemetry_name = "tables"
+
     def __init__(self, on_compiled: Optional[Callable[[Any], None]] = None,
                  installed_fn: Optional[Callable[[], Any]] = None):
         self._state: Dict[str, Any] = {}
@@ -182,7 +188,26 @@ class _CompilingApplicator(Applicator):
                     and not self._swap_pending:
                 return
             if self._dirty or self._compiled is None:
+                # Propagation span: the compile stage, labelled with
+                # whether the PERSISTENT builder took the O(changed)
+                # delta path or fell back to a full rebuild (PR 2's
+                # compile stats, read before/after so one stage = one
+                # compile's mode, not the lifetime totals).
+                builder = getattr(self, "_builder", None)
+                full0 = builder.stats.full_builds if builder else 0
+                delta0 = builder.stats.delta_builds if builder else 0
+                t0 = time.perf_counter()
                 self._compiled = self._compile(dict(self._state))
+                dt = time.perf_counter() - t0
+                if builder is not None and \
+                        builder.stats.delta_builds > delta0:
+                    mode = "delta"
+                elif builder is not None and \
+                        builder.stats.full_builds > full0:
+                    mode = "full"
+                else:
+                    mode = "direct"  # test subclasses compiling inline
+                record_stage(f"compile:{self.telemetry_name}", dt, mode=mode)
                 self._dirty = False
                 self.compile_count += 1
             compiled = self._compiled
@@ -190,8 +215,15 @@ class _CompilingApplicator(Applicator):
         if self.on_compiled is not None:
             # May raise (e.g. a runner TableSwapError): the scheduler's
             # _end_txns absorbs it into FAILED/retry state, and the
-            # still-set _swap_pending makes the retry re-swap.
-            self.on_compiled(compiled)
+            # still-set _swap_pending makes the retry re-swap.  The
+            # swap stage brackets the runner's update_tables, whose
+            # per-shard adoption stages nest inside it.
+            t0 = time.perf_counter()
+            try:
+                self.on_compiled(compiled)
+            finally:
+                record_stage(f"swap:{self.telemetry_name}",
+                             time.perf_counter() - t0)
         with self._lock:
             self._swap_pending = False
 
@@ -245,6 +277,7 @@ class TpuAclApplicator(_CompilingApplicator):
     (ops/classify_delta)."""
 
     prefix = ACL_POD_PREFIX
+    telemetry_name = "acl"
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
@@ -281,6 +314,7 @@ class TpuNatApplicator(_CompilingApplicator):
     backend rings / hash-index slots in place (ops/nat_delta)."""
 
     prefix = NAT_PREFIX
+    telemetry_name = "nat"
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)

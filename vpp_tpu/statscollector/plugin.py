@@ -93,18 +93,92 @@ class _DatapathCollector:
     """Custom Prometheus collector: one consistent runner.metrics()
     snapshot per scrape (occupancy involves a device reduction — doing
     it once per scrape, not once per gauge, keeps scrapes off the hot
-    path and the exported counters mutually consistent)."""
+    path and the exported counters mutually consistent).
+
+    Monotonic ``*_total`` counters export as COUNTER families (ISSUE 8
+    satellite): Prometheus ``rate()``/``increase()`` handle counter
+    resets (agent restarts) only for the counter type — exported as
+    gauges, every restart looked like a traffic cliff.  Gauges (active
+    sessions, ring depths, governor K) stay gauges.
+
+    Latency histograms (ISSUE 8 tentpole) export as HISTOGRAM families
+    in cumulative-le form so ``histogram_quantile()`` works natively;
+    the derived p50/p90/p99/p99.9 export alongside as gauges for
+    dashboards without PromQL (reading the SAME ``snapshot()`` keys the
+    REST/netctl/dashboard surfaces read — the obs-parity checker holds
+    exporter and inspect() schema together)."""
 
     def __init__(self, runner):
         self.runner = runner
 
     def collect(self):
-        from prometheus_client.core import GaugeMetricFamily
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+            HistogramMetricFamily,
+        )
 
         snapshot = self.runner.metrics()
         for name, value in snapshot.items():
-            yield GaugeMetricFamily(name, f"datapath counter {name}",
-                                    value=float(value))
+            if name.endswith("_total"):
+                yield CounterMetricFamily(
+                    name, f"datapath counter {name}", value=float(value))
+            else:
+                yield GaugeMetricFamily(
+                    name, f"datapath gauge {name}", value=float(value))
+        hist_fn = getattr(self.runner, "latency_histograms", None)
+        if hist_fn is None:
+            return
+        for name, hist in hist_fn().items():
+            buckets, sum_us = hist.cumulative()
+            yield HistogramMetricFamily(
+                f"datapath_latency_{name}_us",
+                f"datapath {name} latency distribution (µs, log2 buckets)",
+                buckets=buckets, sum_value=sum_us,
+            )
+            snap = hist.snapshot()
+            for q_name, q_value in (
+                ("p50", snap.get("p50")),
+                ("p90", snap.get("p90")),
+                ("p99", snap.get("p99")),
+                ("p999", snap.get("p999")),
+            ):
+                yield GaugeMetricFamily(
+                    f"datapath_latency_{name}_{q_name}_us",
+                    f"datapath {name} latency {q_name} (µs, derived on read)",
+                    value=float(q_value or 0.0),
+                )
+
+
+class _SpanCollector:
+    """Control-plane propagation telemetry: the config-propagation
+    latency histogram plus span counters, from the controller's
+    SpanTracker (ISSUE 8)."""
+
+    def __init__(self, tracker):
+        self.tracker = tracker
+
+    def collect(self):
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            HistogramMetricFamily,
+        )
+
+        status = self.tracker.status()
+        yield CounterMetricFamily(
+            "controlplane_spans_total",
+            "propagation spans started (one per controller event)",
+            value=float(status.get("spans_started") or 0))
+        yield CounterMetricFamily(
+            "controlplane_spans_propagated_total",
+            "spans whose config reached compile/swap/adoption",
+            value=float(status.get("spans_propagated") or 0))
+        buckets, sum_us = self.tracker.propagation.cumulative()
+        yield HistogramMetricFamily(
+            "controlplane_config_propagation_us",
+            "K8s event → device-table adoption latency (µs, log2 buckets)",
+            buckets=buckets, sum_value=sum_us,
+        )
 
 
 class StatsCollector(EventHandler):
@@ -125,6 +199,7 @@ class StatsCollector(EventHandler):
             for metric, help_text in METRICS
         }
         self._datapath_collector: Optional[_DatapathCollector] = None
+        self._span_collector: Optional[_SpanCollector] = None
 
     # ------------------------------------------------------------- datapath
 
@@ -140,6 +215,16 @@ class StatsCollector(EventHandler):
             self.registry.register(self._datapath_collector)
         else:
             self._datapath_collector.runner = runner
+
+    def register_spans(self, tracker) -> None:
+        """Export the controller's propagation-span telemetry
+        (config-propagation histogram + span counters); re-registering
+        swaps the tracker like register_datapath swaps the runner."""
+        if self._span_collector is None:
+            self._span_collector = _SpanCollector(tracker)
+            self.registry.register(self._span_collector)
+        else:
+            self._span_collector.tracker = tracker
 
     # ----------------------------------------------------------- data plane
 

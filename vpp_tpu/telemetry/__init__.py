@@ -1,0 +1,30 @@
+"""End-to-end telemetry (ISSUE 8): datapath latency histograms,
+control-plane propagation spans, and the per-shard flight recorder.
+
+Three pillars, one design rule — the hot path pays arithmetic only:
+
+- :mod:`.hist` — lock-free single-writer log2 latency histograms fed
+  from the perf_counter timestamps the coalesce governor already takes
+  (zero new clock calls or host↔device syncs on the dispatch path);
+  merged on read, percentiles derived on read.
+- :mod:`.spans` — a span minted per controller event, stages stamped
+  through the whole propagation chain (handlers → compile → swap →
+  per-shard adoption) via a thread-local, totals folded into the
+  config-propagation histogram.
+- :mod:`.flight` — a bounded per-shard ring of dispatch records,
+  snapshotted next to the forensic pcap on ejection/quarantine.
+"""
+
+from .flight import FlightRecorder
+from .hist import LATENCY_HISTOGRAMS, LatencyRecorder, Log2Histogram
+from .spans import SpanTracker, current_span_id, record_stage
+
+__all__ = [
+    "FlightRecorder",
+    "LATENCY_HISTOGRAMS",
+    "LatencyRecorder",
+    "Log2Histogram",
+    "SpanTracker",
+    "current_span_id",
+    "record_stage",
+]

@@ -1,0 +1,115 @@
+"""Flight recorder — the last N dispatches, readable after the crash.
+
+Before ISSUE 8, a shard ejection left exactly one artifact: the
+forensic pcap of the poisoned frames.  *What the shard was doing* in
+the seconds before — how deep its coalesce ran, how far the backlog
+had grown, which table generation it served, what the verdict mix
+looked like — was gone with the abandoned worker thread.  The flight
+recorder is a per-shard bounded ring of per-dispatch records, appended
+at harvest (single writer, no locks, raw ints only — the same
+discipline as the packet tracer) and
+
+- **snapshotted automatically** next to the forensic pcap on shard
+  ejection and poisoned-batch quarantine (JSONL, one snapshot object
+  per line, appended + flushed so it survives the crash it documents),
+- **dumpable on demand** via REST ``/contiv/v1/flight`` and
+  ``netctl flight`` for live post-mortems.
+
+Record fields: monotonic sequence, the batch's session timestamp, the
+governor-chosen K, frame/sent/denied counts, the measured ingress
+backlog, the in-flight depth at admit, the table generation the batch
+dispatched under (correlates with spans + ``netctl trace``), and the
+admit→harvest round trip in µs.
+"""
+
+from __future__ import annotations
+
+import collections
+import datetime
+import json
+import threading
+from typing import Deque, Dict, List, Optional
+
+DEFAULT_CAPACITY = 256
+
+FIELDS = ("seq", "ts", "k", "frames", "sent", "denied", "backlog",
+          "inflight", "table_gen", "rt_us")
+
+# Snapshot appends serialize process-wide: the sharded engine hands
+# every shard the same quarantine_pcap, so N shards' snapshots target
+# ONE .flight.jsonl — a quarantine (shard executor thread) racing an
+# ejection (supervisor thread) would otherwise interleave buffered
+# writes mid-line and corrupt the very post-mortem a fault storm needs.
+_SNAPSHOT_LOCK = threading.Lock()
+
+
+class FlightRecorder:
+    """Bounded per-shard dispatch ring; lock-free single-writer append
+    (the shard's worker), read-side copy for dumps (REST thread) — a
+    deque append racing a list() copy is safe under the GIL, and a
+    dump that misses the newest record is one poll stale, not wrong."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._ring: Deque[tuple] = collections.deque(maxlen=capacity)
+        self._seq = 0  # lock-free: single-writer int; dumps read it monotonic
+        # Sequence high-water mark of the last snapshot: snapshots are
+        # INCREMENTAL (only records newer than the previous snapshot),
+        # so a poison storm that quarantines every batch appends a few
+        # new rows per snapshot instead of re-dumping the whole ring —
+        # the full history is the concatenation of the JSONL lines.
+        self._snap_seq = 0
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen or 0
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def note_dispatch(self, ts: int, k: int, frames: int, sent: int,
+                      denied: int, backlog: int, inflight: int,
+                      table_gen: int, rt_us: float) -> None:
+        """Append one harvested dispatch.  Plain ints/floats only —
+        callers must pass host values (hot-path-sync clean)."""
+        self._seq += 1
+        self._ring.append((self._seq, ts, k, frames, sent, denied,
+                           backlog, inflight, table_gen, round(rt_us, 1)))
+
+    # --------------------------------------------------------------- read
+
+    def dump(self, limit: int = 0) -> List[Dict]:
+        rows = list(self._ring)
+        if limit > 0:
+            rows = rows[-limit:]
+        return [dict(zip(FIELDS, row)) for row in rows]
+
+    def status(self) -> Dict:
+        return {
+            "recorded": len(self._ring),
+            "capacity": self.capacity,
+            "dispatches_total": self._seq,
+        }
+
+    def snapshot_to(self, path: str, reason: str, shard: int = 0) -> None:
+        """Append one snapshot object (JSONL) and flush — the forensic
+        write next to the quarantine pcap.  Appending (not truncating)
+        preserves earlier ejections' context in the same post-mortem
+        file; flushing makes it crash-durable like the pcap.  Only
+        records NEWER than the previous snapshot are written (see
+        ``_snap_seq``); a snapshot with nothing new still writes its
+        header line so every ejection/quarantine leaves a timestamped
+        mark.  Wall time via datetime (time.time() is banned from
+        anything the harvest path can reach)."""
+        rows = [r for r in self.dump() if r["seq"] > self._snap_seq]
+        self._snap_seq = self._seq
+        record = {
+            "reason": reason,
+            "shard": shard,
+            "at": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+            "records": rows,
+        }
+        line = json.dumps(record) + "\n"
+        with _SNAPSHOT_LOCK:
+            with open(path, "a") as fh:
+                fh.write(line)
+                fh.flush()

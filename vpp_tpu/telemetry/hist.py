@@ -1,0 +1,222 @@
+"""Log2-bucketed latency histograms — the `show runtime` clocks analog.
+
+VPP's per-node runtime stats expose clocks/vectors per graph node; the
+reproduction's datapath exposed only point-in-time gauges until ISSUE 8.
+These recorders turn the perf_counter timestamps the runner ALREADY
+takes for the coalesce governor into latency *distributions* —
+p50/p90/p99/p99.9 derived on read — without adding a single
+host↔device sync or clock call to the dispatch path.
+
+Design constraints (they shape everything here):
+
+- **Single-writer record path, no locks.**  Each shard's worker thread
+  owns its recorder; ``record_us`` is a couple of integer adds into a
+  fixed-size list.  Readers (REST, /metrics scrapes, the sharded
+  inspect) MERGE on read: they copy the counts under the GIL and sum
+  across shards.  A reader racing the writer may observe a snapshot
+  that is one sample stale or whose ``count`` is one ahead of the
+  bucket sum — bounded, self-healing skew, the price of a lock-free
+  hot path (VPP's per-worker counters make the same trade).
+- **Fixed size, zero allocation.**  ``N_BUCKETS`` pow2 buckets over
+  microseconds: bucket *i* holds samples in ``(2^(i-1), 2^i] µs``
+  (bucket 0 = ≤1 µs, the last bucket is the +Inf catch-all).  40
+  buckets cover 1 µs to ~76 hours — every latency this datapath can
+  produce — in 40 ints.
+- **Percentiles on read.**  Log2 buckets bound any quantile to within
+  2× — exactly the resolution operators act on (is p99 600 µs or
+  1.2 ms?) — and the read-side linear interpolation inside the bucket
+  reports a smooth estimate rather than a stairstep.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# Bucket upper bounds in µs: 1<<0 .. 1<<(N_BUCKETS-2), then +Inf.
+N_BUCKETS = 40
+
+PERCENTILES = (("p50", 0.50), ("p90", 0.90), ("p99", 0.99), ("p999", 0.999))
+
+
+class Log2Histogram:
+    """Fixed-size log2-bucketed recorder (µs domain).
+
+    Writer side: :meth:`record_us` / :meth:`record_s` — lock-free,
+    single writer by contract.  Reader side: :meth:`snapshot` /
+    :meth:`merged` — copy + derive, never blocks the writer.
+    """
+
+    __slots__ = ("counts", "count", "sum_us")
+
+    def __init__(self):
+        # counts is only ever mutated in place (never rebound) so a
+        # concurrent reader's reference stays valid.
+        self.counts: List[int] = [0] * N_BUCKETS  # lock-free: single-writer ints; readers copy under the GIL
+        self.count = 0       # lock-free: see counts
+        self.sum_us = 0.0    # lock-free: see counts
+
+    # ------------------------------------------------------------ writer
+
+    def record_us(self, us: float, weight: int = 1) -> None:
+        """Record one sample of ``us`` microseconds (``weight`` lets a
+        batch-granular sample stand for its frames).  Pure int/float
+        arithmetic — safe on the harvest path."""
+        if us < 0.0:
+            us = 0.0
+        idx = int(us).bit_length()
+        if idx >= N_BUCKETS:
+            idx = N_BUCKETS - 1
+        self.counts[idx] += weight
+        self.count += weight
+        self.sum_us += us * weight
+
+    def record_s(self, seconds: float, weight: int = 1) -> None:
+        self.record_us(seconds * 1e6, weight)
+
+    # ------------------------------------------------------------ reader
+
+    @staticmethod
+    def bound_us(idx: int) -> float:
+        """Upper bound of bucket ``idx`` in µs (+Inf for the last)."""
+        if idx >= N_BUCKETS - 1:
+            return float("inf")
+        return float(1 << idx)
+
+    def merged(self, others: Iterable["Log2Histogram"]) -> "Log2Histogram":
+        """A fresh histogram holding this one plus ``others`` (the
+        sharded engine's read-side merge)."""
+        out = Log2Histogram()
+        for h in (self, *others):
+            counts = list(h.counts)  # one GIL-atomic-ish copy per shard
+            for i, c in enumerate(counts):
+                out.counts[i] += c
+            out.count += sum(counts)  # consistent with the copied buckets
+            out.sum_us += h.sum_us
+        return out
+
+    def percentile_us(self, q: float,
+                      counts: Optional[List[int]] = None) -> float:
+        """The q-quantile (0 < q <= 1) in µs, linearly interpolated
+        inside the winning log2 bucket; 0.0 when empty."""
+        counts = list(self.counts) if counts is None else counts
+        total = sum(counts)
+        if total <= 0:
+            return 0.0
+        target = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            lo = 0.0 if i == 0 else float(1 << (i - 1))
+            hi = self.bound_us(i)
+            if cum + c >= target:
+                if hi == float("inf"):
+                    return lo  # the catch-all has no upper edge
+                frac = (target - cum) / c
+                return lo + (hi - lo) * frac
+            cum += c
+        return self.bound_us(N_BUCKETS - 1)
+
+    def snapshot(self) -> Dict[str, object]:
+        """One consistent read: count, sum and the standard quantiles.
+        Keys here are the schema contract the dashboard's
+        ``shape_latency`` and the metrics exporter consume — the
+        obs-parity checker holds them together."""
+        counts = list(self.counts)
+        total = sum(counts)
+        # Literal keys on purpose: the obs-parity checker pins the
+        # dashboard's shape_latency and the metrics exporter to exactly
+        # this schema (a loop over PERCENTILES would be invisible to it).
+        return {
+            "count": total,
+            "sum_us": round(self.sum_us, 1),
+            "p50": round(self.percentile_us(0.50, counts), 1),
+            "p90": round(self.percentile_us(0.90, counts), 1),
+            "p99": round(self.percentile_us(0.99, counts), 1),
+            "p999": round(self.percentile_us(0.999, counts), 1),
+        }
+
+    def cumulative(self) -> Tuple[List[Tuple[str, float]], float]:
+        """Prometheus exposition shape: ([(le, cumulative_count)...]
+        ending at +Inf, sum) — the HistogramMetricFamily contract so
+        PromQL ``histogram_quantile`` works out of the box."""
+        counts = list(self.counts)
+        sum_us = self.sum_us
+        cum = 0.0
+        buckets: List[Tuple[str, float]] = []
+        for i, c in enumerate(counts):
+            cum += c
+            le = "+Inf" if i == N_BUCKETS - 1 else str(float(1 << i))
+            buckets.append((le, cum))
+        return buckets, sum_us
+
+
+# The four datapath latency pillars (ISSUE 8).  Names are the schema:
+# inspect()["latency"][<name>], datapath_latency_<name>_us in /metrics.
+LATENCY_HISTOGRAMS = (
+    # dispatch submission → harvest begin: the wait behind the
+    # in-flight window (≈0 when unpipelined).
+    "admit_wait",
+    # dispatch submission → harvest complete: the batch's full
+    # admit→harvest round trip.
+    "dispatch_rt",
+    # harvest begin → harvest complete: the sanctioned host block —
+    # device materialisation + slow path + rewrite + TX stitch.
+    "harvest",
+    # the per-FRAME view of the round trip: the batch sample weighted
+    # by its frame count, so deep-coalesce batches count per frame
+    # (sampled at batch granularity — per-frame clocks would cost a
+    # clock call per packet).
+    "frame_e2e",
+)
+
+
+class LatencyRecorder:
+    """The per-runner (per-shard, single-writer) recorder set.
+
+    ``record_harvest`` is the ONE tap: it receives the timestamps the
+    harvest already holds (``t_admit`` from the governor's timing fit,
+    the harvest-start/-end perf_counter pair) and fans them into the
+    four histograms.  ``enabled=False`` turns the tap into a no-op —
+    the A/B switch the bench overhead check flips."""
+
+    __slots__ = ("enabled", "admit_wait", "dispatch_rt", "harvest",
+                 "frame_e2e")
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled  # lock-free: bool flip; a racing batch lands in whichever mode it saw
+        self.admit_wait = Log2Histogram()
+        self.dispatch_rt = Log2Histogram()
+        self.harvest = Log2Histogram()
+        self.frame_e2e = Log2Histogram()
+
+    def record_harvest(self, t_admit: float, t_harvest: float,
+                       t_done: float, frames: int) -> None:
+        """Fan one harvested batch's timestamps into the histograms.
+        Arithmetic only — no clocks, no syncs (hot-path-sync clean)."""
+        if not self.enabled:
+            return
+        wait_us = (t_harvest - t_admit) * 1e6
+        if wait_us < 0.0:
+            wait_us = 0.0
+        rt_us = (t_done - t_admit) * 1e6
+        self.admit_wait.record_us(wait_us)
+        self.dispatch_rt.record_us(rt_us)
+        self.harvest.record_us((t_done - t_harvest) * 1e6)
+        if frames > 0:
+            self.frame_e2e.record_us(rt_us, weight=frames)
+
+    def histograms(self) -> Dict[str, Log2Histogram]:
+        return {name: getattr(self, name) for name in LATENCY_HISTOGRAMS}
+
+    @staticmethod
+    def merged(recorders: Iterable["LatencyRecorder"]) -> Dict[str, Log2Histogram]:
+        """Read-side merge across shards: {name: merged histogram}."""
+        recs = list(recorders)
+        if not recs:
+            return {name: Log2Histogram() for name in LATENCY_HISTOGRAMS}
+        head, tail = recs[0], recs[1:]
+        return {
+            name: getattr(head, name).merged(getattr(r, name) for r in tail)
+            for name in LATENCY_HISTOGRAMS
+        }

@@ -1,0 +1,173 @@
+"""Propagation spans — "how long from the K8s event to the device?".
+
+The reproduction could always answer *what* was configured (scheduler
+dump, event history) but never *how long propagation took*: a policy
+event flows controller → processor → renderer → applicator compile →
+device swap → per-shard adoption, and before ISSUE 8 none of those
+stages left a duration anywhere.  A :class:`SpanTracker` span is minted
+when the controller dequeues an event; every downstream stage stamps a
+(name, duration) pair into it through a thread-local — the whole chain
+runs on the controller's event-loop thread (commit included), so no
+context needs to be threaded through the processor/renderer/applicator
+signatures.  The span id also rides the transaction
+(``Txn.span_id`` → ``RecordedTxn``) so the event history, the
+scheduler txn log and the span ring correlate.
+
+Completed spans land in a bounded ring (REST ``/contiv/v1/spans`` /
+``netctl spans``) and every span that reached a compile-or-deeper stage
+records its total into the **config-propagation histogram** — the
+control plane's answer to the datapath's latency pillars, exported as
+``controlplane_config_propagation_us``.
+
+Stage vocabulary (flat list, stamped in execution order):
+
+    handler:<name>    one event handler's processing (processor +
+                      renderer work happens inside)
+    compile:acl|nat   applicator table compile, mode=full|delta|cached
+    swap:acl|nat      the on_compiled device swap (runner update_tables)
+    adopt:shard<i>    one shard's table adoption inside the swap
+    commit            the whole scheduler commit (brackets the above)
+
+Threading: spans are control-plane only.  ``start``/``finish`` run on
+the event-loop thread; ``dump``/``status`` on REST threads — the ring
+is guarded by a lock (this is not a hot path).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Tuple
+
+from .hist import Log2Histogram
+
+DEFAULT_CAPACITY = 256
+MAX_STAGES = 128  # a 100-shard adopt fan-out must not grow unbounded
+
+# The one thread-local connecting the controller to the stages below
+# it.  Multiple agents in one process are fine: each controller has its
+# own loop thread, so each thread sees only its own span.
+_current = threading.local()
+
+# Stages that prove config actually moved toward the device — only
+# spans reaching one of these advance the propagation histogram
+# (handler-only spans are control-plane bookkeeping, not propagation).
+_PROPAGATION_PREFIXES = ("compile:", "swap:", "adopt:")
+
+
+@dataclass
+class Span:
+    """One event's propagation record."""
+
+    span_id: int
+    name: str
+    detail: str = ""
+    started: float = 0.0         # wall clock, for display only
+    _t0: float = 0.0             # perf_counter base
+    stages: List[Tuple[str, float, Dict]] = field(default_factory=list)
+    total_us: float = 0.0
+
+    def stamp(self, stage: str, dur_s: float, **extra) -> None:
+        if len(self.stages) < MAX_STAGES:
+            self.stages.append((stage, dur_s * 1e6, extra))
+
+    @property
+    def propagated(self) -> bool:
+        return any(s.startswith(_PROPAGATION_PREFIXES)
+                   for s, _, _ in self.stages)
+
+    def as_dict(self) -> Dict:
+        return {
+            "span_id": self.span_id,
+            "event": self.name,
+            "detail": self.detail,
+            "started": round(self.started, 3),
+            "total_us": round(self.total_us, 1),
+            "propagated": self.propagated,
+            "stages": [
+                {"stage": s, "us": round(us, 1), **extra}
+                for s, us, extra in self.stages
+            ],
+        }
+
+
+def record_stage(stage: str, dur_s: float, **extra) -> None:
+    """Stamp a stage into the CURRENT thread's active span (no-op when
+    none is active — e.g. a scheduler retry timer firing outside an
+    event, or a standalone runner in a bench)."""
+    span = getattr(_current, "span", None)
+    if span is not None:
+        span.stamp(stage, dur_s, **extra)
+
+
+def current_span_id() -> int:
+    """The active span's id, 0 when none (what Txn picks up)."""
+    span = getattr(_current, "span", None)
+    return span.span_id if span is not None else 0
+
+
+class SpanTracker:
+    """Bounded ring of completed propagation spans + the end-to-end
+    config-propagation histogram.  One per controller."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._lock = threading.Lock()
+        self._ring: Deque[Span] = collections.deque(maxlen=capacity)
+        self._seq = 0
+        self.started_total = 0
+        self.propagated_total = 0
+        self.propagation = Log2Histogram()  # written under _lock (finish)
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self, name: str, detail: str = "") -> Span:
+        """Mint a span and make it the thread's current one."""
+        with self._lock:
+            self._seq += 1
+            self.started_total += 1
+            span_id = self._seq
+        span = Span(
+            span_id=span_id, name=name, detail=detail,
+            started=time.time(), _t0=time.perf_counter(),
+        )
+        _current.span = span
+        return span
+
+    def finish(self, span: Span) -> None:
+        """Close the span: compute the total, ring-append when any
+        stage stamped (no-op events leave no record), advance the
+        propagation histogram when config reached compile-or-deeper."""
+        if getattr(_current, "span", None) is span:
+            _current.span = None
+        span.total_us = (time.perf_counter() - span._t0) * 1e6
+        if not span.stages:
+            return
+        with self._lock:
+            self._ring.append(span)
+            if span.propagated:
+                self.propagated_total += 1
+                self.propagation.record_us(span.total_us)
+
+    # -------------------------------------------------------------- read
+
+    def dump(self, limit: int = 0) -> List[Dict]:
+        with self._lock:
+            spans = list(self._ring)
+        if limit > 0:
+            spans = spans[-limit:]
+        return [s.as_dict() for s in spans]
+
+    def status(self) -> Dict:
+        with self._lock:
+            recorded = len(self._ring)
+            capacity = self._ring.maxlen or 0
+            snap = self.propagation.snapshot()
+        return {
+            "spans_started": self.started_total,
+            "spans_propagated": self.propagated_total,
+            "recorded": recorded,
+            "capacity": capacity,
+            "propagation_us": snap,
+        }

@@ -226,6 +226,37 @@ def shape_dispatch(inspect: Optional[dict]) -> Dict[str, Any]:
     }
 
 
+def shape_latency(inspect: Optional[dict]) -> Dict[str, Any]:
+    """The dashboard's latency panel (ISSUE 8): the four datapath
+    histograms' counts and p50/p90/p99/p99.9 — the `show runtime`
+    clocks analog an operator reads during a latency event.  Every key
+    consumed here is produced by ``DataplaneRunner.inspect`` /
+    ``inspect_latency`` / ``Log2Histogram.snapshot`` — the obs-parity
+    checker enforces the schema so this panel can never silently go
+    blank.  Empty for agents without a live datapath."""
+    if not inspect:
+        return {}
+    lat = inspect.get("latency") or {}
+    out: Dict[str, Any] = {}
+    for name in ("admit_wait", "dispatch_rt", "harvest", "frame_e2e"):
+        h = lat.get(name) or {}
+        out[name] = {
+            "count": h.get("count", 0),
+            "sum_us": h.get("sum_us", 0.0),
+            "p50": h.get("p50", 0.0),
+            "p90": h.get("p90", 0.0),
+            "p99": h.get("p99", 0.0),
+            "p999": h.get("p999", 0.0),
+        }
+    flight = inspect.get("flight") or {}
+    out["flight"] = {
+        "recorded": flight.get("recorded", 0),
+        "capacity": flight.get("capacity", 0),
+        "dispatches_total": flight.get("dispatches_total", 0),
+    }
+    return out
+
+
 def shape_views(dump: List[dict], ipam: dict, trace: dict,
                 trace_ip: Optional[str] = None,
                 inspect: Optional[dict] = None) -> Dict[str, Any]:
@@ -241,4 +272,5 @@ def shape_views(dump: List[dict], ipam: dict, trace: dict,
         "rows": shape_trace((trace or {}).get("entries") or [], trace_ip),
     }
     out["dispatch"] = shape_dispatch(inspect)
+    out["latency"] = shape_latency(inspect)
     return out
