@@ -3,8 +3,9 @@
 
 PY ?= python
 
-.PHONY: test test-race verify-ha verify-churn verify-faults \
-        verify-adaptive verify-static verify-telemetry lint bench \
+.PHONY: test test-race verify verify-ha verify-churn verify-faults \
+        verify-adaptive verify-static verify-telemetry verify-soak soak \
+        lint bench \
         bench-suite bench-sweep bench-scale bench-latency bench-frames \
         bench-churn bench-adaptive images native native-sanitize
 
@@ -115,6 +116,34 @@ verify-static:
 	    -q $(if $(RUN_SLOW),,-m 'not slow') --continue-on-collection-errors \
 	    -p no:cacheprovider -p no:xdist -p no:randomly
 	$(PY) scripts/check_static.py vpp_tpu/
+
+# Cluster-soak verification (ISSUE 9): the fake-kubelet harness units
+# (real conflist parsed, real shim binary exec'd over gRPC AND the
+# stdlib-HTTP fallback, manifest/chart cross-validation), controller
+# resilience observability, churn-script determinism, and the tier-1
+# soak-smoke — ~8 procnode agents over a 3-replica HA store of OS
+# processes, every fault class (leader SIGKILL, store-outage window,
+# shard eject/hang/swap-fail, agent SIGKILL-restart) fired at least
+# once with mock-engine verdict parity as the oracle.  RUN_SLOW=1 adds
+# the mid-size scripted run.
+verify-soak:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_soak.py \
+	    -q $(if $(RUN_SLOW),,-m 'not slow') --continue-on-collection-errors \
+	    -p no:cacheprovider -p no:xdist -p no:randomly
+
+# The full mega-cluster chaos soak (the ISSUE 9 acceptance run): ≥50
+# agents, ≥1000 pod ADD/DEL through the real exec'd CNI shim, ≥2 leader
+# kills, ≥2 store-outage windows, ≥4 shard faults, ≥2 agent restarts —
+# self-checking (nonzero exit on any parity mismatch / unconverged
+# node), recorded to SOAK_r08.jsonl.
+soak:
+	JAX_PLATFORMS=cpu $(PY) scripts/soak_cluster.py --check
+
+# The aggregate verification gate: static battery + every subsystem's
+# verify target, soak-smoke included.
+verify: lint verify-static verify-ha verify-churn verify-adaptive \
+        verify-telemetry verify-faults verify-soak
+	@echo verify OK
 
 bench:
 	$(PY) bench.py

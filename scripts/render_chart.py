@@ -107,7 +107,10 @@ def store(values: dict) -> list:
     ns = values["namespace"]
     st = values["store"]
     replicas = st.get("replicas", 1)
-    args = ["--host", "0.0.0.0", "--port", str(st["port"])]
+    args = ["--host", "0.0.0.0", "--port", str(st["port"]),
+            # One watch stream per agent pod: size for the node count
+            # (ISSUE 9 — the server default of 64 caps the cluster).
+            "--max-watchers", str(st.get("maxWatchers", 1024))]
     env = []
     if replicas > 1:
         # HA ensemble (kvstore/ha.py): every member gets the full

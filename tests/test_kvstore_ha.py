@@ -23,7 +23,7 @@ from vpp_tpu.kvstore.election import (
     pick_leader,
 )
 from vpp_tpu.kvstore.ha import ELECTION_KEY, HAEnsemble
-from vpp_tpu.testing.cluster import timeout_mult, wait_for
+from vpp_tpu.testing.cluster import free_ports, timeout_mult, wait_for
 
 
 def _peer(rid, role="follower", term=1, last_index=0, last_term=0,
@@ -253,19 +253,6 @@ def test_killed_replica_rejoins_and_catches_up(ensemble):
 # ------------------------------------------- OS-process SIGKILL acceptance
 
 
-def _free_ports(n):
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
-
-
 def _spawn_replica(port, members, lease):
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
@@ -300,7 +287,7 @@ def test_three_process_ensemble_survives_leader_sigkill(tmp_path):
     resumes at its last revision, and after the corpse rejoins all
     three replicas report identical revision and snapshot contents."""
     lease = 0.6 * timeout_mult()
-    ports = _free_ports(3)
+    ports = free_ports(3)
     members = ",".join(f"127.0.0.1:{p}" for p in ports)
     procs = {p: _spawn_replica(p, members, lease) for p in ports}
     client = RemoteKVStore(members, timeout=1.0,

@@ -198,6 +198,128 @@ def test_dbwatcher_mirror_fallback_and_reconnect_resync(tmp_path):
         server2.stop()
 
 
+def test_corrupted_mirror_falls_back_to_remote_and_recreates(tmp_path):
+    """ISSUE 9 satellite: a truncated/garbage mirror file must degrade
+    to a full remote resync (and a re-created mirror), never crash."""
+    from vpp_tpu.controller.dbwatcher import DBWatcher
+    from vpp_tpu.kvstore.mirror import LocalMirror
+
+    pod = Pod(name="p1", namespace="default", ip_address="10.1.1.2")
+    store = KVStore()
+    store.put(key_for(pod), pod)
+    server = KVStoreServer(store)
+    server.start()
+    mirror_path = tmp_path / "mirror.db"
+    mirror_path.write_bytes(b"this is not a sqlite file \x00\x01" * 64)
+    client = RemoteKVStore(server.address, timeout=1.0)
+    loop = _FakeLoop()
+    try:
+        # Construction over the garbage file re-creates it in place...
+        watcher = DBWatcher(loop, client, mirror_path=str(mirror_path))
+        watcher.start()
+        # ...and the startup resync comes from the REMOTE store.
+        assert len(loop.events) == 1
+        assert key_for(pod) in loop.events[0].kube_state["pod"]
+        assert watcher.resynced_from_mirror == 0
+        assert watcher._mirror.recreated == 1
+        # The fresh mirror is populated and serves the outage fallback.
+        server.stop()
+        ev = watcher.resync()
+        assert ev is not None and key_for(pod) in ev.kube_state["pod"]
+        assert watcher.resynced_from_mirror == 1
+        watcher.stop()
+    finally:
+        client.close()
+        server.stop()
+
+    # Corruption AFTER population (undecodable row): load() reports
+    # no-mirror and quarantines, instead of raising into the agent.
+    good = LocalMirror(str(tmp_path / "m2.db"))
+    good.save_snapshot({"/a/1": {"v": 1}}, revision=7)
+    assert good.load() is not None
+    good._conn.execute("UPDATE mirror SET value = X'DEADBEEF'")
+    good._conn.commit()
+    assert good.load() is None          # failed decode = no mirror
+    assert good.recreated == 1
+    good.save_snapshot({"/a/2": {"v": 2}}, revision=9)  # usable again
+    assert good.load() == ({"/a/2": {"v": 2}}, 9)
+    good.close()
+
+
+def test_watch_reconnect_backoff_schedule_caps_and_jitters():
+    """ISSUE 9 satellite: the watch re-establishment schedule is capped
+    exponential with multiplicative jitter, so a fleet of agents whose
+    streams died together does not thundering-herd the recovering
+    leader."""
+    from vpp_tpu.kvstore.remote import reconnect_backoff
+
+    # Deterministic midpoint rng: pure exponential-with-cap shape.
+    mid = lambda: 0.5  # noqa: E731
+    bases = [reconnect_backoff(a, initial=0.05, cap=2.0, jitter=0.5,
+                               rng=mid) for a in range(1, 10)]
+    assert bases == sorted(bases)              # monotone ramp
+    assert bases[0] == pytest.approx(0.05)
+    assert bases[-1] == pytest.approx(2.0)     # capped
+    assert all(b <= 2.0 for b in bases)
+    # Jitter bounds: delay in [base*(1-j), base*(1+j)) for rng in [0,1).
+    lo = reconnect_backoff(7, initial=0.05, cap=2.0, jitter=0.5,
+                           rng=lambda: 0.0)   # base 0.05*2^6=3.2 -> cap 2.0
+    hi = reconnect_backoff(7, initial=0.05, cap=2.0, jitter=0.5,
+                           rng=lambda: 0.999999)
+    assert lo == pytest.approx(2.0 * 0.5)
+    assert hi < 2.0 * 1.5 and hi == pytest.approx(3.0, rel=1e-3)
+    # Two agents with independent rngs diverge (the de-sync property).
+    import random
+
+    a = reconnect_backoff(4, rng=random.Random(1).random)
+    b = reconnect_backoff(4, rng=random.Random(2).random)
+    assert a != b
+    # Degenerate knobs stay sane.
+    assert reconnect_backoff(0, jitter=0.0) == pytest.approx(0.05)
+    # The client carries the knobs for its watchers.
+    client = RemoteKVStore("127.0.0.1:1", watch_backoff_initial=0.1,
+                           watch_backoff_max=1.0, watch_backoff_jitter=0.2)
+    try:
+        assert client.watch_backoff_initial == 0.1
+        assert client.watch_backoff_max == 1.0
+        assert client.watch_backoff_jitter == 0.2
+    finally:
+        client.close()
+
+
+def test_ha_probe_rpcs_evict_hung_channels():
+    """ISSUE 9 regression (found by the soak's election wait): a
+    channel dialed before the replica's port was bound hangs past any
+    reconnect backoff; ha_status/local_dump bypass _rpc so they must
+    evict on outage codes themselves, or every later probe of the
+    (now healthy) replica rides the doomed channel forever."""
+    import grpc
+
+    from vpp_tpu.testing.cluster import free_ports
+
+    port = free_ports(1)[0]
+    address = f"127.0.0.1:{port}"
+    client = RemoteKVStore(address, timeout=1.0)
+    try:
+        with pytest.raises(grpc.RpcError):
+            client.ha_status(address)        # dialed before bind: fails
+        assert address not in client._targets  # ...and was evicted
+        store = KVStore()
+        server = KVStoreServer(store, port=port)
+        server.start()
+        try:
+            # A fresh channel reaches the server immediately (standalone
+            # serves UNIMPLEMENTED — any non-outage status proves the
+            # transport connected instead of riding the old attempt).
+            with pytest.raises(grpc.RpcError) as err:
+                client.ha_status(address)
+            assert err.value.code() == grpc.StatusCode.UNIMPLEMENTED
+        finally:
+            server.stop()
+    finally:
+        client.close()
+
+
 # --------------------------------------------------- two-OS-process cluster
 
 

@@ -1,0 +1,385 @@
+"""Cluster-scale chaos soak (ISSUE 9): fake-kubelet harness units,
+controller-resilience observability, churn-script determinism, and the
+tier-1 ``soak-smoke`` — ~8 procnode agents over a 3-replica HA store of
+OS processes, every fault class fired at least once, mock-engine
+verdict parity as the oracle.  The full mega-cluster run is
+``make soak`` (scripts/soak_cluster.py --check)."""
+
+import io
+import json
+import pathlib
+import time
+
+import pytest
+
+from vpp_tpu.testing.kubelet import (
+    CNIError,
+    FakeKubelet,
+    PLUGIN_TYPE,
+    pod_ip,
+    validate_manifests,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------------------
+# Fake-kubelet harness: the REAL conflist, the REAL shim binary, exec'd.
+# ---------------------------------------------------------------------------
+
+
+def test_kubelet_parses_real_conflist_and_execs_version():
+    kubelet = FakeKubelet(grpc_server="127.0.0.1:1")
+    assert kubelet.conflist_path == REPO / "deploy/cni/10-vpp-tpu.conflist"
+    assert kubelet.plugin["type"] == PLUGIN_TYPE
+    netconf = kubelet.netconf()
+    assert netconf["name"] == kubelet.conflist["name"]
+    assert netconf["grpcServer"] == "127.0.0.1:1"   # per-agent override
+    # VERSION through the exec protocol (a real subprocess).
+    version = kubelet.version()
+    assert version["cniVersion"] == kubelet.conflist["cniVersion"]
+
+
+def test_kubelet_refuses_conflist_without_our_plugin(tmp_path):
+    bogus = tmp_path / "10-other.conflist"
+    bogus.write_text(json.dumps({
+        "name": "x", "cniVersion": "0.3.1",
+        "plugins": [{"type": "bridge"}],
+    }))
+    with pytest.raises(ValueError, match=PLUGIN_TYPE):
+        FakeKubelet(conflist_path=str(bogus))
+
+
+@pytest.fixture()
+def exec_agent():
+    """A minimal live agent with BOTH CNI transports up: the gRPC
+    RemoteCNI server and the REST /cni/* fallback."""
+    from vpp_tpu.cni.rpc import CNIServer
+    from vpp_tpu.conf import NetworkConfig
+    from vpp_tpu.controller.api import DBResync
+    from vpp_tpu.controller.eventloop import Controller
+    from vpp_tpu.controller.txn import TxnSink
+    from vpp_tpu.ipv4net import IPv4Net
+    from vpp_tpu.kvstore import KVStore
+    from vpp_tpu.nodesync import NodeSync
+    from vpp_tpu.podmanager import PodManager
+    from vpp_tpu.rest.server import AgentRestServer
+    from vpp_tpu.testing.cluster import wait_for
+
+    class Sink(TxnSink):
+        def commit(self, txn):
+            pass
+
+    store = KVStore()
+    nodesync = NodeSync(store, node_name="kubelet-node")
+    podmanager = PodManager()
+    ipv4net = IPv4Net(NetworkConfig(), nodesync, podmanager=podmanager)
+    ctl = Controller(handlers=[podmanager, ipv4net], sink=Sink())
+    podmanager.event_loop = ctl
+    ctl.start()
+    ctl.push_event(DBResync())
+    assert wait_for(lambda: ipv4net.ipam is not None)
+    cni = CNIServer(podmanager, port=0)
+    cni_port = cni.start()
+    rest = AgentRestServer(node_name="kubelet-node", controller=ctl,
+                           podmanager=podmanager, port=0)
+    rest_port = rest.start()
+    yield podmanager, f"127.0.0.1:{cni_port}", f"127.0.0.1:{rest_port}"
+    rest.stop()
+    cni.stop()
+    ctl.stop()
+
+
+def test_kubelet_add_del_exec_real_shim_grpc(exec_agent):
+    from vpp_tpu.models import PodID
+
+    podmanager, grpc_target, http_target = exec_agent
+    kubelet = FakeKubelet(grpc_server=grpc_target, http_server=http_target)
+    result = kubelet.add("exec-pod")
+    assert result["cniVersion"] == "0.3.1"
+    assert pod_ip(result).startswith("10.1.1.")
+    assert PodID("exec-pod", "default") in podmanager.local_pods
+    kubelet.delete("exec-pod")
+    assert PodID("exec-pod", "default") not in podmanager.local_pods
+    assert [i["command"] for i in kubelet.invocations] == ["ADD", "DEL"]
+    assert all(i["rc"] == 0 for i in kubelet.invocations)
+
+
+def test_kubelet_http_fallback_exec_same_binary(exec_agent):
+    """transport=http pins VPP_TPU_CNI_TRANSPORT in the shim's exec env
+    — the SAME binary a grpc-less host python would run, over the REST
+    /cni/* route."""
+    from vpp_tpu.models import PodID
+
+    podmanager, grpc_target, http_target = exec_agent
+    kubelet = FakeKubelet(grpc_server="127.0.0.1:1",  # must NOT be dialed
+                          http_server=http_target, transport="http")
+    result = kubelet.add("http-pod")
+    assert pod_ip(result).startswith("10.1.1.")
+    assert PodID("http-pod", "default") in podmanager.local_pods
+    kubelet.delete("http-pod")
+    assert PodID("http-pod", "default") not in podmanager.local_pods
+
+
+def test_kubelet_agent_down_raises_cni_error():
+    kubelet = FakeKubelet(grpc_server="127.0.0.1:1")
+    with pytest.raises(CNIError) as err:
+        kubelet.add("unreachable")
+    assert err.value.code == 11
+    assert err.value.returncode == 1
+
+
+def test_manifests_validate_against_harness_and_catch_drift():
+    kubelet = FakeKubelet()
+    results = validate_manifests(kubelet)
+    assert {r["source"] for r in results} == {"deploy/k8s", "deploy/chart"}
+    assert all(r["cni_port"] == "9111" and r["rest_port"] == "9999"
+               for r in results)
+    # Drift detector: a conflist whose gRPC port disagrees with the
+    # DaemonSet's --cni-port must FAIL validation.
+    bad = FakeKubelet()
+    bad.plugin = dict(bad.plugin, grpcServer="127.0.0.1:1234")
+    with pytest.raises(AssertionError, match="cni-port"):
+        validate_manifests(bad)
+
+
+# ---------------------------------------------------------------------------
+# Controller resilience counters (ISSUE 9 satellite): the "no silent
+# healing loop" observability the soak oracle reads.
+# ---------------------------------------------------------------------------
+
+
+def test_controller_status_counts_healing_lifecycle():
+    from vpp_tpu.controller.api import DBResync, EventHandler, KubeStateChange
+    from vpp_tpu.controller.eventloop import Controller
+    from vpp_tpu.controller.txn import TxnSink
+    from vpp_tpu.testing.cluster import wait_for
+
+    class Sink(TxnSink):
+        def commit(self, txn):
+            pass
+
+    class Flaky(EventHandler):
+        name = "flaky"
+        fail = True
+
+        def handles_event(self, event):
+            return True
+
+        def resync(self, event, kube_state, resync_count, txn):
+            pass
+
+        def update(self, event, txn):
+            if self.fail:
+                self.fail = False
+                raise RuntimeError("induced")
+            return ""
+
+    flaky = Flaky()
+    ctl = Controller(handlers=[flaky], sink=Sink(), healing_delay=0.05)
+    ctl.start()
+    try:
+        ctl.push_event(DBResync())
+        assert wait_for(lambda: ctl.status()["resync_count"] == 1)
+        assert ctl.status()["last_resync_age_s"] is not None
+        ctl.push_event(KubeStateChange(resource="pod", key="/k",
+                                       prev_value=None, new_value=None))
+        # The failed event schedules healing; the healing resync (on
+        # the now-healthy handler) completes and the ledger settles.
+        assert wait_for(lambda: ctl.status()["healing_completed"] == 1)
+        status = ctl.status()
+        assert status["event_errors"] == 1
+        assert status["healing_scheduled"] == 1
+        assert status["healing_failed"] == 0
+        assert status["healing_pending"] is False
+        assert status["resync_count"] == 2  # startup + healing
+    finally:
+        ctl.stop()
+
+
+def test_health_surfaces_controller_without_datapath():
+    """REST /contiv/v1/health and `netctl health` must serve the
+    controller section on a control-plane-only agent (no datapath) —
+    the shape every non-datapath soak agent reports."""
+    import urllib.request
+
+    from vpp_tpu.controller.eventloop import Controller
+    from vpp_tpu.controller.txn import TxnSink
+    from vpp_tpu.netctl.cli import main as netctl
+    from vpp_tpu.rest.server import AgentRestServer
+
+    class Sink(TxnSink):
+        def commit(self, txn):
+            pass
+
+    ctl = Controller(handlers=[], sink=Sink())
+    ctl.start()
+    rest = AgentRestServer(node_name="cp-only", controller=ctl, port=0)
+    port = rest.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/contiv/v1/health", timeout=5) as r:
+            health = json.load(r)
+        assert health["node"] == "cp-only"
+        assert "healing_scheduled" in health["controller"]
+        assert "shards" not in health
+        out = io.StringIO()
+        assert netctl(["health", "--server", f"127.0.0.1:{port}"],
+                      out=out) == 0
+        text = out.getvalue()
+        assert "controller:" in text and "healing=" in text
+    finally:
+        rest.stop()
+        ctl.stop()
+
+
+def test_controller_collector_exports_prometheus_families():
+    from prometheus_client import generate_latest
+
+    from vpp_tpu.controller.eventloop import Controller
+    from vpp_tpu.controller.txn import TxnSink
+    from vpp_tpu.statscollector import StatsCollector
+
+    class Sink(TxnSink):
+        def commit(self, txn):
+            pass
+
+    ctl = Controller(handlers=[], sink=Sink())
+    stats = StatsCollector()
+    stats.register_controller(ctl)
+    text = generate_latest(stats.registry).decode()
+    assert "controlplane_resyncs_total" in text
+    assert "controlplane_healing_scheduled_total" in text
+    assert "controlplane_event_errors_total" in text
+    assert "controlplane_last_resync_age_seconds" in text
+    # Re-registering swaps the controller, never double-registers.
+    stats.register_controller(ctl)
+    assert generate_latest(stats.registry).decode().count(
+        "# HELP controlplane_resyncs_total") == 1
+
+
+# ---------------------------------------------------------------------------
+# Churn scripts: deterministic, recorded, replayable.
+# ---------------------------------------------------------------------------
+
+
+def test_churn_script_deterministic_and_replayable(tmp_path):
+    from vpp_tpu.testing.soak import (
+        SoakConfig,
+        generate_churn,
+        load_churn,
+        save_churn,
+    )
+
+    cfg = SoakConfig(agents=4, pods=6, churn_ops=20, seed=77)
+    ops1 = generate_churn(cfg)
+    ops2 = generate_churn(cfg)
+    assert ops1 == ops2                       # same seed, same script
+    assert ops1 != generate_churn(
+        SoakConfig(agents=4, pods=6, churn_ops=20, seed=78))
+    adds = [op for op in ops1 if op["op"] == "pod-add"]
+    dels = [op for op in ops1 if op["op"] == "pod-del"]
+    assert len(adds) >= cfg.pods and dels     # real churn, not just adds
+    # Every DEL follows its own ADD (per-pod ordering holds by script).
+    seen = set()
+    for op in ops1:
+        if op["op"] == "pod-add":
+            seen.add(op["pod"])
+        elif op["op"] == "pod-del":
+            assert op["pod"] in seen
+    path = tmp_path / "churn.jsonl"
+    save_churn(ops1, str(path))
+    assert load_churn(str(path)) == ops1      # byte-faithful replay
+
+
+def test_parity_probe_helpers_agree_with_oracle_engine():
+    """probe_flows/oracle_verdicts against a SimCluster ground truth:
+    the probe oracle must match the full MockACLEngine connection
+    verdicts for the same flows."""
+    from vpp_tpu.testing.cluster import SimCluster, wait_for
+    from vpp_tpu.testing.procnode import oracle_verdicts, probe_flows
+
+    cluster = SimCluster()
+    try:
+        node = cluster.add_node("node-1")
+        cluster.deploy_pod("node-1", "web-1", labels={"app": "web"})
+        cluster.deploy_pod("node-1", "web-2", labels={"app": "web"})
+        cluster.deploy_pod("node-1", "db-1", labels={"app": "db"})
+        cluster.apply_policy({
+            "metadata": {"name": "deny-web", "namespace": "default"},
+            "spec": {"podSelector": {"matchLabels": {"app": "web"}},
+                     "policyTypes": ["Ingress"],
+                     "ingress": [{"from": [{"podSelector": {
+                         "matchLabels": {"app": "web"}}}]}]},
+        })
+        assert wait_for(
+            lambda: node.policy_renderer.tables is not None
+            and int(node.policy_renderer.tables.rule_valid.sum()) > 0)
+        flows = probe_flows(node, round_no=3)
+        assert flows and len({f[0] for f in flows}) > 1
+        verdicts = oracle_verdicts(node, flows)
+        # Ground truth: the pipeline itself (the established parity).
+        res = node.send(flows)
+        import numpy as np
+
+        assert [bool(v) for v in np.asarray(res.allowed)] == verdicts
+        assert True in verdicts and False in verdicts  # both classes hit
+    finally:
+        cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# The tier-1 soak-smoke: ~8 nodes, every fault class, parity oracle on.
+# ---------------------------------------------------------------------------
+
+
+def test_soak_smoke_all_fault_classes_with_parity(tmp_path):
+    from vpp_tpu.testing.soak import SoakConfig, run_soak
+
+    out = tmp_path / "soak_smoke.jsonl"
+    cfg = SoakConfig.smoke(str(tmp_path / "work"), out_path=str(out))
+    report = run_soak(cfg)
+    assert report["ok"], report
+    # Every fault class fired at least once.
+    assert report["leader_kills"] >= 1
+    assert report["store_outages"] >= 1
+    assert report["agent_restarts"] >= 1
+    assert report["shard_faults"] >= 3     # eject + swap-fail + hang
+    # Pod churn went through the REAL exec'd shim.
+    assert report["cni_adds"] >= cfg.pods
+    assert report["cni_dels"] >= 1
+    assert report["cni_errors"] == 0
+    # The oracle: parity clean, everyone converged, healing settled,
+    # and the mirror fallback actually carried an outage resync.
+    assert report["parity_rounds"] >= 2
+    assert report["parity_checked"] > 0
+    assert report["parity_mismatches"] == 0
+    assert report["unconverged"] == 0
+    assert report["healing_failed"] == 0
+    assert report["mirror_resyncs"] >= 1
+    # The run is recorded: replayable churn script + events + summary.
+    events = [json.loads(line) for line in out.read_text().splitlines()]
+    kinds = {e["event"] for e in events}
+    assert {"start", "churn-script", "fault", "fault-done", "parity",
+            "converged", "summary"} <= kinds
+    assert (tmp_path / "work" / "churn_script.jsonl").exists()
+
+
+@pytest.mark.slow
+def test_soak_midsize_via_script(tmp_path):
+    """The scripts/soak_cluster.py entrypoint end to end (self-checking
+    --check mode) at a mid scale; the full acceptance run is
+    `make soak`."""
+    import subprocess
+    import sys
+
+    out = tmp_path / "soak_mid.jsonl"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "soak_cluster.py"),
+         "--smoke", "--check", "--agents", "16", "--pods", "24",
+         "--ops", "60", "--workdir", str(tmp_path / "work"),
+         "--out", str(out)],
+        cwd=str(REPO), capture_output=True, text=True, timeout=1800,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:] + proc.stdout[-2000:]
+    assert out.exists()

@@ -47,6 +47,16 @@ DEFAULT_SCHEMA_PAIRS = (
                        "FlightRecorder.status")),
     ("_DatapathCollector.collect", ("Log2Histogram.snapshot",)),
     ("_SpanCollector.collect", ("SpanTracker.status",)),
+    # ISSUE 9 controller-resilience surfaces: the Prometheus collector
+    # and the `netctl health` renderer both read Controller.status()'s
+    # literal schema (plus, for netctl, the REST health merge and the
+    # datapath health sections) — a renamed counter goes dark on every
+    # surface at once, which is exactly what this pins.
+    ("_ControllerCollector.collect", ("Controller.status",)),
+    ("cmd_health", ("Controller.status",
+                    "AgentRestServer.get_health",
+                    "DataplaneRunner.health",
+                    "ShardedDataplane.health")),
 )
 DEFAULT_METRICS_PAIR = ("DataplaneRunner.metrics",
                         "ShardedDataplane._aggregate_counters")

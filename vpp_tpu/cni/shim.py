@@ -144,8 +144,13 @@ def main(env=None, stdin=None, stdout=None, exec_ipam_plugin=None) -> int:
         except Exception:
             pass
 
+    # Transport selection: gRPC when importable, unless the environment
+    # pins the stdlib HTTP fallback (VPP_TPU_CNI_TRANSPORT=http) — the
+    # kubelet harness uses the knob to exercise the REST path with the
+    # SAME exec'd binary a grpc-less host python would run.
+    use_grpc = _HAVE_GRPC and env.get("VPP_TPU_CNI_TRANSPORT", "") != "http"
     try:
-        if _HAVE_GRPC:
+        if use_grpc:
             if command == "ADD":
                 reply = remote_cni_add(target, request)
             else:

@@ -140,6 +140,16 @@ class Controller:
         self._resync_count = 0
         self._event_seq = 0
         self._txn_seq = 0
+        # Resilience counters (ISSUE 9 satellite): the healing loop must
+        # be OBSERVABLE — a controller stuck scheduling healing resyncs
+        # that never complete is a silent failure mode the cluster soak
+        # asserts against.  All written on the loop thread (plus the
+        # healing timer's scheduled count), read lock-free by status().
+        self._healing_scheduled_total = 0
+        self._healing_completed_total = 0
+        self._healing_failed_total = 0
+        self._event_errors_total = 0
+        self._last_resync_ts = 0.0
         # The transaction of the event being processed right now, while
         # handlers run (scheduler-routed renderers emit KVs into it).
         self.current_txn: Optional[Txn] = None
@@ -258,6 +268,26 @@ class Controller:
     @property
     def resync_count(self) -> int:
         return self._resync_count
+
+    def status(self) -> Dict[str, Any]:
+        """Control-plane resilience snapshot: resync/healing/error
+        counters + last-resync age.  Served by REST ``/contiv/v1/
+        health``/``/contiv/v1/inspect``, printed by ``netctl health``,
+        exported by the Prometheus ``_ControllerCollector`` — the soak's
+        "no silent healing loop" oracle reads it (scheduled healings
+        must complete, never accumulate)."""
+        last = self._last_resync_ts
+        return {
+            "resync_count": self._resync_count,
+            "events_processed": self._event_seq,
+            "event_errors": self._event_errors_total,
+            "healing_scheduled": self._healing_scheduled_total,
+            "healing_completed": self._healing_completed_total,
+            "healing_failed": self._healing_failed_total,
+            "healing_pending": self._healing_scheduled,
+            "last_resync_age_s": (
+                round(time.time() - last, 3) if last else None),
+        }
 
     # ------------------------------------------------------------------ loop
 
@@ -407,14 +437,19 @@ class Controller:
 
         # 12-13. Healing / fatal handling.
         if err is not None:
+            self._event_errors_total += 1
             if isinstance(event, HealingResync):
+                self._healing_failed_total += 1
                 raise FatalError(f"healing resync failed: {err}") from err
             if isinstance(err, FatalError):
                 raise err
             self._schedule_healing(err)
+        elif isinstance(event, HealingResync):
+            self._healing_completed_total += 1
 
     def _process_resync(self, event: Event, record: EventRecord) -> Optional[Exception]:
         self._resync_count += 1
+        self._last_resync_ts = time.time()
         txn = Txn(is_resync=True)
         txn.span_id = current_span_id()
         self.current_txn = txn
@@ -543,6 +578,7 @@ class Controller:
         if self._healing_scheduled or self._shutdown:
             return
         self._healing_scheduled = True
+        self._healing_scheduled_total += 1
 
         def fire():
             self._healing_scheduled = False

@@ -454,11 +454,17 @@ class DataplaneRunner:
         backend picks if the service reappears).  The sharded engine
         computes this ONCE per table swap (the session state is shared)
         and hands it to every shard's _refresh_bypass."""
-        return (
-            len(self.slow) == 0
-            and session_occupancy(self.sessions) == 0
-            and affinity_occupancy(self.sessions) == 0
-        )
+        with self._state.lock:
+            # The dispatch jits DONATE the session buffers; reading
+            # occupancy outside the state lock races the donation on a
+            # live engine ("Array has been deleted" — the ISSUE 9 soak
+            # hit this on swap-under-traffic).  The lock serialises
+            # against the dispatch that would invalidate the handle.
+            return (
+                len(self.slow) == 0
+                and session_occupancy(self.sessions) == 0
+                and affinity_occupancy(self.sessions) == 0
+            )
 
     def _refresh_bypass(self, state_clear: Optional[bool] = None) -> None:
         """Precompute host-bypass eligibility — VPP's feature-less
@@ -1577,8 +1583,14 @@ class DataplaneRunner:
     def metrics(self) -> Dict[str, int]:
         out = self.counters.as_dict()
         out.update(self.slow.counters.as_dict())
-        out["datapath_sessions_active"] = session_occupancy(self.sessions)
-        out["datapath_affinity_active"] = affinity_occupancy(self.sessions)
+        with self._state.lock:
+            # Occupancy reads must hold the state lock: a concurrent
+            # dispatch donates the session buffers it sums over (REST
+            # scrape vs datapath thread — found by the ISSUE 9 soak).
+            out["datapath_sessions_active"] = \
+                session_occupancy(self.sessions)
+            out["datapath_affinity_active"] = \
+                affinity_occupancy(self.sessions)
         out["datapath_slowpath_sessions_active"] = len(self.slow)
         out["datapath_inflight"] = len(self._inflight)
         out["datapath_governor_k"] = self.governor.current_k
@@ -1602,6 +1614,9 @@ class DataplaneRunner:
         not a hot path."""
         acl = self.acl
         nat = self.nat
+        with self._state.lock:  # vs concurrent dispatch donation (see metrics)
+            sessions_active = session_occupancy(self.sessions)
+            affinity_pins = affinity_occupancy(self.sessions)
         compile_stats: Dict[str, object] = {
             "acl_swaps": self.counters.acl_swaps,
             "nat_swaps": self.counters.nat_swaps,
@@ -1629,8 +1644,8 @@ class DataplaneRunner:
             },
             "sessions": {
                 "capacity": self.sessions.capacity,
-                "active": session_occupancy(self.sessions),
-                "affinity_pins": affinity_occupancy(self.sessions),
+                "active": sessions_active,
+                "affinity_pins": affinity_pins,
                 "sweep_interval": self.sweep_interval,
                 "sweep_max_age": self.sweep_max_age,
             },

@@ -147,11 +147,31 @@ class IPv4Net(EventHandler):
         # node IP is known only once a lease arrives (node.go
         # handleDHCPNotification publishes then).
         if not self.use_dhcp:
-            self.nodesync.publish_node_ips(
+            self._publish_node_ips(
                 (f"{self.ipam.node_ip()}/{self.config.ipam.node_interconnect().prefixlen}",),
             )
         elif self._dhcp_lease is not None:
-            self.nodesync.publish_node_ips((self._dhcp_lease.ip_address,))
+            self._publish_node_ips((self._dhcp_lease.ip_address,))
+
+    def _publish_node_ips(self, ips) -> None:
+        """Northbound publish of this node's data-plane IPs, outage-
+        tolerant: a resync served from the sqlite MIRROR (store
+        unreachable) must not fail on this store write — failing the
+        handler schedules healing, the healing resync fails on the same
+        write, and a failed healing is FATAL: the agent would kill
+        itself precisely while riding an outage out on local state
+        (found by the ISSUE 9 chaos soak's store-outage window).  The
+        publish is an idempotent refresh of our own record; the
+        reconnect resync re-runs it as soon as the store returns."""
+        from ..controller.dbwatcher import is_store_unavailable
+
+        try:
+            self.nodesync.publish_node_ips(ips)
+        except Exception as err:  # noqa: BLE001 - outage-classified below
+            if not is_store_unavailable(err):
+                raise
+            log.warning("node-IP publish deferred (store unreachable): %s",
+                        err)
 
     # ------------------------------------------------------- config builders
 
@@ -399,7 +419,7 @@ class IPv4Net(EventHandler):
         for node in self.nodesync.other_nodes().values():
             for kv in self.node_connectivity_config(node.id):
                 txn.put(kv.key, kv)
-        self.nodesync.publish_node_ips((event.ip_address,))
+        self._publish_node_ips((event.ip_address,))
         return f"DHCP lease on {event.interface}: {event.ip_address}"
 
     def _add_pod(self, event: AddPod, txn) -> str:

@@ -6,11 +6,21 @@ an agent can resync from local state while etcd is unreachable
 This is that component: the dbwatcher saves each remote snapshot here,
 applies every streamed change, and falls back to :meth:`load` when the
 remote store cannot be reached.
+
+Corruption discipline (ISSUE 9 satellite): the mirror is a CACHE, never
+the source of truth — a truncated file (agent SIGKILLed mid-write, disk
+full), a garbage file, or an undecodable row must degrade to "no mirror"
+(the dbwatcher then performs a full remote resync, whose save_snapshot
+re-populates a fresh file) and must NEVER crash the agent.  Every sqlite
+touch point therefore classifies ``sqlite3.Error`` as corruption,
+quarantines the bad file by re-creating it in place, and reports the
+operation as a miss.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import sqlite3
 import threading
 from typing import Any, Dict, Optional, Tuple
@@ -27,56 +37,162 @@ class LocalMirror:
     def __init__(self, path: str):
         self.path = path
         self._lock = threading.Lock()
-        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self.recreated = 0  # corruption observability (soak evidence)
         with self._lock:
-            self._conn.execute(
+            self._conn = self._open_or_recreate()
+
+    def _open_or_recreate(self) -> sqlite3.Connection:
+        """Open the mirror file, re-creating it from scratch when the
+        existing file is not a usable sqlite database.  Callers hold
+        ``_lock``."""
+        try:
+            return self._open(self.path)
+        except sqlite3.Error as err:
+            log.warning(
+                "mirror %s is corrupt (%s): discarding and re-creating "
+                "(next resync repopulates it from the remote store)",
+                self.path, err,
+            )
+            self.recreated += 1
+            try:
+                os.remove(self.path)
+            except OSError:
+                pass
+            try:
+                return self._open(self.path)
+            except sqlite3.Error as err2:
+                # Unremovable corrupt file (read-only/failing disk):
+                # degrade to an in-memory cache — same discipline as
+                # _reset_locked; a mirror must never fail agent boot.
+                log.error(
+                    "mirror %s cannot be re-created (%s): degrading to "
+                    "an in-memory mirror", self.path, err2,
+                )
+                return self._open(":memory:")
+
+    @staticmethod
+    def _open(path: str) -> sqlite3.Connection:
+        conn = sqlite3.connect(path, check_same_thread=False)
+        try:
+            conn.execute(
                 "CREATE TABLE IF NOT EXISTS mirror (key TEXT PRIMARY KEY, value BLOB)"
             )
-            self._conn.execute(
+            conn.execute(
                 "CREATE TABLE IF NOT EXISTS meta (name TEXT PRIMARY KEY, value INTEGER)"
             )
-            self._conn.commit()
+            conn.commit()
+        except sqlite3.Error:
+            conn.close()
+            raise
+        return conn
+
+    def _reset_locked(self, cause: Exception) -> None:
+        """Quarantine a mirror that failed mid-operation: close, delete,
+        re-create empty.  Callers hold ``_lock``.  Must NEVER raise —
+        it runs inside the corruption handlers; if even the re-create
+        fails (unremovable corrupt file on a read-only disk), the
+        mirror degrades to an in-memory cache for the process lifetime
+        rather than crashing the agent."""
+        log.warning(
+            "mirror %s failed (%s): discarding and re-creating",
+            self.path, cause,
+        )
+        self.recreated += 1
+        try:
+            self._conn.close()
+        except sqlite3.Error:
+            pass
+        try:
+            os.remove(self.path)
+        except OSError:
+            pass
+        try:
+            self._conn = self._open(self.path)
+        except sqlite3.Error as err:
+            log.error(
+                "mirror %s cannot be re-created (%s): degrading to an "
+                "in-memory mirror (no outage fallback across restarts)",
+                self.path, err,
+            )
+            self._conn = self._open(":memory:")
 
     def save_snapshot(self, snap: Dict[str, Any], revision: int) -> None:
         """Replace the mirror contents with one consistent snapshot."""
         rows = [(k, codec.encode(v)) for k, v in snap.items()]
         with self._lock:
-            self._conn.execute("DELETE FROM mirror")
-            self._conn.executemany(
-                "INSERT INTO mirror (key, value) VALUES (?, ?)", rows
-            )
-            self._conn.execute(
-                "INSERT OR REPLACE INTO meta (name, value) VALUES ('revision', ?)",
-                (revision,),
-            )
-            self._conn.commit()
+            try:
+                self._write_snapshot(rows, revision)
+            except sqlite3.Error as err:
+                # Corrupt mirror: rebuild the file, then retry ONCE on
+                # the fresh database; a second failure (disk full, dead
+                # filesystem) is logged and swallowed — losing the cache
+                # must not fail the resync that produced the snapshot.
+                self._reset_locked(err)
+                try:
+                    self._write_snapshot(rows, revision)
+                except sqlite3.Error as err2:
+                    log.error("mirror %s unwritable: %s", self.path, err2)
+
+    def _write_snapshot(self, rows, revision: int) -> None:
+        self._conn.execute("DELETE FROM mirror")
+        self._conn.executemany(
+            "INSERT INTO mirror (key, value) VALUES (?, ?)", rows
+        )
+        self._conn.execute(
+            "INSERT OR REPLACE INTO meta (name, value) VALUES ('revision', ?)",
+            (revision,),
+        )
+        self._conn.commit()
 
     def apply_event(self, ev: WatchEvent) -> None:
-        """Mirror one streamed change."""
+        """Mirror one streamed change.
+
+        A failed write leaves the mirror MISSING this event; advancing
+        the recorded revision anyway would claim a completeness the file
+        no longer has, so on failure the whole file is quarantined — the
+        next remote snapshot rebuilds it consistently."""
         with self._lock:
-            if ev.is_delete:
-                self._conn.execute("DELETE FROM mirror WHERE key = ?", (ev.key,))
-            else:
+            try:
+                if ev.is_delete:
+                    self._conn.execute("DELETE FROM mirror WHERE key = ?", (ev.key,))
+                else:
+                    self._conn.execute(
+                        "INSERT OR REPLACE INTO mirror (key, value) VALUES (?, ?)",
+                        (ev.key, codec.encode(ev.value)),
+                    )
                 self._conn.execute(
-                    "INSERT OR REPLACE INTO mirror (key, value) VALUES (?, ?)",
-                    (ev.key, codec.encode(ev.value)),
+                    "INSERT OR REPLACE INTO meta (name, value) VALUES ('revision', ?)",
+                    (ev.revision,),
                 )
-            self._conn.execute(
-                "INSERT OR REPLACE INTO meta (name, value) VALUES ('revision', ?)",
-                (ev.revision,),
-            )
-            self._conn.commit()
+                self._conn.commit()
+            except sqlite3.Error as err:
+                self._reset_locked(err)
 
     def load(self) -> Optional[Tuple[Dict[str, Any], int]]:
-        """The mirrored (snapshot, revision), or None if never populated."""
+        """The mirrored (snapshot, revision), or None if never populated
+        — or if the file/contents are corrupt (the caller then treats
+        the agent as mirror-less and resyncs from the remote store)."""
         with self._lock:
-            rev = self._conn.execute(
-                "SELECT value FROM meta WHERE name = 'revision'"
-            ).fetchone()
-            if rev is None:
+            try:
+                rev = self._conn.execute(
+                    "SELECT value FROM meta WHERE name = 'revision'"
+                ).fetchone()
+                if rev is None:
+                    return None
+                rows = self._conn.execute(
+                    "SELECT key, value FROM mirror").fetchall()
+                revision = int(rev[0])
+            except (sqlite3.Error, TypeError, ValueError) as err:
+                self._reset_locked(err)
                 return None
-            rows = self._conn.execute("SELECT key, value FROM mirror").fetchall()
-        return {k: codec.decode(v) for k, v in rows}, int(rev[0])
+        try:
+            return {k: codec.decode(v) for k, v in rows}, revision
+        except Exception as err:  # noqa: BLE001 - any decode failure = corrupt
+            # Undecodable VALUE (truncated blob, stale codec): the rows
+            # cannot be trusted as one consistent snapshot.
+            with self._lock:
+                self._reset_locked(err)
+            return None
 
     def close(self) -> None:
         with self._lock:

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import logging
 import queue
+import random
 import threading
 import time
 from concurrent import futures
@@ -122,6 +123,36 @@ def not_leader_hint(err: Exception) -> Optional[str]:
             or not status[1].startswith(NOT_LEADER_PREFIX)):
         return None
     return status[1][len(NOT_LEADER_PREFIX):]
+
+
+# Watch re-establishment backoff defaults (RemoteKVStore ctor knobs).
+# Jitter is MULTIPLICATIVE: delay = base * uniform(1-j, 1+j).  Without
+# it, every agent that lost its stream in the same outage retries on
+# the same schedule — at cluster scale (the ISSUE 9 soak runs ~100
+# agents) the recovering leader takes the whole fleet's re-subscribe
+# burst in one instant, each stream parking a server worker thread.
+WATCH_BACKOFF_INITIAL = 0.05
+WATCH_BACKOFF_MAX = 2.0
+WATCH_BACKOFF_JITTER = 0.5
+
+
+def reconnect_backoff(
+    attempt: int,
+    initial: float = WATCH_BACKOFF_INITIAL,
+    cap: float = WATCH_BACKOFF_MAX,
+    jitter: float = WATCH_BACKOFF_JITTER,
+    rng: Callable[[], float] = random.random,
+) -> float:
+    """Delay before watch re-establishment attempt ``attempt`` (1-based
+    count of consecutive failures): capped exponential, then spread by
+    the multiplicative jitter.  Pure function of (attempt, rng) so the
+    schedule is unit-testable."""
+    if attempt < 1:
+        attempt = 1
+    base = min(initial * (2.0 ** (attempt - 1)), cap)
+    if jitter <= 0.0:
+        return base
+    return base * (1.0 - jitter + 2.0 * jitter * rng())
 
 
 class LeaderUnavailable(ConnectionError):
@@ -342,7 +373,7 @@ class RemoteWatcher(Watcher):
             call.cancel()
 
     def _stream_loop(self) -> None:
-        backoff = 0.05
+        attempt = 0
         failed_before = False
         while not self.closed:
             address = self._owner.address
@@ -397,7 +428,7 @@ class RemoteWatcher(Watcher):
                             # per-revision terms on the wire.
                             self.last_revision = msg["revision"]
                         self._subscribed.set()
-                        backoff = 0.05
+                        attempt = 0
                         if failed_before or diverged:
                             failed_before = False
                             self._owner._fire_reconnect()
@@ -436,8 +467,18 @@ class RemoteWatcher(Watcher):
                 return
             self._subscribed.clear()
             failed_before = True
-            time.sleep(backoff)
-            backoff = min(backoff * 2, 2.0)
+            attempt += 1
+            # Capped exponential + jitter: after a cluster-wide outage
+            # every agent's stream died in the same instant; the jitter
+            # de-synchronizes the fleet's re-subscribe storms so a
+            # recovering (or freshly elected) leader is not hit by all
+            # N streams at once (ISSUE 9 satellite).
+            time.sleep(reconnect_backoff(
+                attempt,
+                initial=self._owner.watch_backoff_initial,
+                cap=self._owner.watch_backoff_max,
+                jitter=self._owner.watch_backoff_jitter,
+            ))
 
 
 def channel_ready(channel: grpc.Channel) -> bool:
@@ -514,7 +555,10 @@ class RemoteKVStore:
     """
 
     def __init__(self, address, timeout: float = 5.0,
-                 failover_deadline: float = 8.0):
+                 failover_deadline: float = 8.0,
+                 watch_backoff_initial: float = WATCH_BACKOFF_INITIAL,
+                 watch_backoff_max: float = WATCH_BACKOFF_MAX,
+                 watch_backoff_jitter: float = WATCH_BACKOFF_JITTER):
         if isinstance(address, str):
             addresses = [a.strip() for a in address.split(",") if a.strip()]
         else:
@@ -528,6 +572,10 @@ class RemoteKVStore:
         self._failover = len(addresses) > 1
         self.timeout = timeout
         self.failover_deadline = failover_deadline
+        # Watch re-establishment schedule (see reconnect_backoff).
+        self.watch_backoff_initial = watch_backoff_initial
+        self.watch_backoff_max = watch_backoff_max
+        self.watch_backoff_jitter = watch_backoff_jitter
         self._target_lock = threading.Lock()
         self._targets: Dict[str, _Target] = {}
         self._active = addresses[0]
@@ -682,17 +730,35 @@ class RemoteKVStore:
 
     # --------------------------------------------------------- HA helpers
 
+    def _probe_rpc(self, address: Optional[str], method: str,
+                   request: dict) -> dict:
+        """A per-replica diagnostic RPC (HaStatus/LocalDump) with the
+        same outage-eviction discipline as _rpc: these bypass failover
+        on purpose (the caller targets ONE replica), but a channel
+        dialed before that replica's port was bound hangs past any
+        reconnect backoff (the PR 1 pathology) — without eviction every
+        later probe of a healthy replica rides the doomed channel and
+        reports UNAVAILABLE forever (found by the ISSUE 9 soak's
+        leader-election wait)."""
+        address = address or self._active
+        try:
+            return self._target(address).calls[method](
+                request, timeout=self.timeout)
+        except grpc.RpcError as e:
+            if _code_of(e) in OUTAGE_CODES:
+                self._evict_target(address)
+            raise
+
     def ha_status(self, address: Optional[str] = None) -> dict:
         """The HA election status of one replica (UNIMPLEMENTED on a
         standalone server)."""
-        return self._target(address).calls["HaStatus"]({}, timeout=self.timeout)
+        return self._probe_rpc(address, "HaStatus", {})
 
     def local_dump(self, prefix: str = "",
                    address: Optional[str] = None) -> dict:
         """A replica's LOCAL store view (served by followers too —
         possibly stale; the replication-lag observability surface)."""
-        return self._target(address).calls["LocalDump"](
-            {"prefix": prefix}, timeout=self.timeout)
+        return self._probe_rpc(address, "LocalDump", {"prefix": prefix})
 
     # ------------------------------------------------------------ interface
 

@@ -365,6 +365,21 @@ def cmd_health(server: str, out, raw: bool = False,
     if raw:
         print(json.dumps(d, indent=2), file=out)
         return 0
+    ctl = d.get("controller")
+    if ctl:
+        age = ctl.get("last_resync_age_s")
+        print(f"controller: resyncs={ctl.get('resync_count', 0)}  events="
+              f"{ctl.get('events_processed', 0)}  event_errors="
+              f"{ctl.get('event_errors', 0)}  healing="
+              f"{ctl.get('healing_completed', 0)}/"
+              f"{ctl.get('healing_scheduled', 0)} done/sched "
+              f"(failed={ctl.get('healing_failed', 0)}"
+              f"{', pending' if ctl.get('healing_pending') else ''})"
+              f"  last-resync="
+              f"{'never' if age is None else f'{age:.1f}s ago'}", file=out)
+    if "shards" not in d and "dispatch_errors" not in d:
+        # Control-plane-only agent: no datapath section to render.
+        return 0
     if "shards" not in d:
         # Solo runner: flat health dict, no supervisor.
         q = d.get("quarantine") or {}

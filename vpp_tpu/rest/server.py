@@ -185,9 +185,14 @@ class AgentRestServer:
     def get_inspect(self) -> dict:
         """Live datapath introspection (`netctl inspect`, the vppcli
         analog): classify/NAT table stats, session + affinity
-        occupancy, ring depths, punt counters, dispatch config."""
+        occupancy, ring depths, punt counters, dispatch config — plus
+        the controller resilience snapshot when a control plane is
+        wired (ISSUE 9 satellite)."""
         dp = self._resolve_datapath()
-        return {"node": self.node_name, **dp.inspect()}
+        out = {"node": self.node_name, **dp.inspect()}
+        if self.controller is not None:
+            out["controller"] = self.controller.status()
+        return out
 
     def _resolve_datapath(self):
         dp = self.datapath() if callable(self.datapath) else self.datapath
@@ -196,10 +201,23 @@ class AgentRestServer:
         return dp
 
     def get_health(self) -> dict:
-        """Datapath fault-domain health (`netctl health`): per-shard
-        supervision state, ejection/rejoin/steer counters, poisoned-
-        batch quarantine totals, table-swap rollbacks."""
-        return {"node": self.node_name, **self._resolve_datapath().health()}
+        """Agent health (`netctl health`): controller resilience
+        counters (healing resyncs scheduled/completed/failed, event
+        errors, last-resync age — ISSUE 9 "no silent healing loop"
+        oracle) plus, when a datapath is attached, the fault-domain
+        view — per-shard supervision state, ejection/rejoin/steer
+        counters, poisoned-batch quarantine totals, swap rollbacks.
+        Control-plane-only agents (no datapath) serve the controller
+        section alone instead of 404ing."""
+        out = {"node": self.node_name}
+        if self.controller is not None:
+            out["controller"] = self.controller.status()
+        dp = self.datapath() if callable(self.datapath) else self.datapath
+        if dp is not None:
+            out.update(dp.health())
+        elif self.controller is None:
+            raise LookupError("no datapath")
+        return out
 
     def post_health_recover(self, query: dict) -> dict:
         """Expedite ejected shards into probation (skip the backoff);

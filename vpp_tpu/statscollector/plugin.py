@@ -181,6 +181,47 @@ class _SpanCollector:
         )
 
 
+class _ControllerCollector:
+    """Control-plane resilience telemetry (ISSUE 9 satellite): healing
+    resync counters, event errors and last-resync age from
+    ``Controller.status()`` — the Prometheus face of the same snapshot
+    REST ``/contiv/v1/health`` and ``netctl health`` serve, so alerting
+    can catch a silent healing loop (scheduled climbing, completed
+    flat) without scraping REST."""
+
+    def __init__(self, controller):
+        self.controller = controller
+
+    def collect(self):
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+        )
+
+        status = self.controller.status()
+        for name, key, help_text in (
+            ("controlplane_resyncs_total", "resync_count",
+             "resync events processed"),
+            ("controlplane_events_total", "events_processed",
+             "controller events processed"),
+            ("controlplane_event_errors_total", "event_errors",
+             "controller events that ended in error"),
+            ("controlplane_healing_scheduled_total", "healing_scheduled",
+             "healing resyncs scheduled after event errors"),
+            ("controlplane_healing_completed_total", "healing_completed",
+             "healing resyncs that completed cleanly"),
+            ("controlplane_healing_failed_total", "healing_failed",
+             "healing resyncs that failed (fatal)"),
+        ):
+            yield CounterMetricFamily(
+                name, help_text, value=float(status.get(key) or 0))
+        age = status.get("last_resync_age_s")
+        yield GaugeMetricFamily(
+            "controlplane_last_resync_age_seconds",
+            "seconds since the last resync landed (-1 = never)",
+            value=-1.0 if age is None else float(age))
+
+
 class StatsCollector(EventHandler):
     """Maps data-plane interface counters to pods and exports gauges."""
 
@@ -200,6 +241,7 @@ class StatsCollector(EventHandler):
         }
         self._datapath_collector: Optional[_DatapathCollector] = None
         self._span_collector: Optional[_SpanCollector] = None
+        self._controller_collector: Optional[_ControllerCollector] = None
 
     # ------------------------------------------------------------- datapath
 
@@ -225,6 +267,16 @@ class StatsCollector(EventHandler):
             self.registry.register(self._span_collector)
         else:
             self._span_collector.tracker = tracker
+
+    def register_controller(self, controller) -> None:
+        """Export the controller's resilience counters (healing resyncs
+        scheduled/completed/failed, event errors, last-resync age);
+        re-registering swaps the controller (restart case)."""
+        if self._controller_collector is None:
+            self._controller_collector = _ControllerCollector(controller)
+            self.registry.register(self._controller_collector)
+        else:
+            self._controller_collector.controller = controller
 
     # ----------------------------------------------------------- data plane
 

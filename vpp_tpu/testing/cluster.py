@@ -81,6 +81,25 @@ def timeout_mult() -> float:
     return _TIMEOUT_MULT
 
 
+def free_ports(n: int) -> List[int]:
+    """``n`` currently-free TCP ports (bind :0, read, close) — the one
+    shared allocator for every multi-process harness (HA ensembles, the
+    chaos soak, the OS-process tests); inherently racy between close
+    and the child's bind, like every ephemeral-port scheme."""
+    import socket
+
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
 def wait_for(cond, timeout: float = 5.0, interval: float = 0.02) -> bool:
     """Poll ``cond`` until true or until ``timeout`` (scaled by the
     machine-speed multiplier) expires."""

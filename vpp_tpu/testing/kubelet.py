@@ -1,0 +1,299 @@
+"""Fake kubelet — execs the REAL CNI shim the way kubelet does.
+
+Every prior CNI test called the shim's ``main()`` in-process; nothing
+kubelet-shaped had ever touched the artifacts a cluster actually runs
+on: the conflist the DaemonSet installs into ``/etc/cni/net.d``, the
+wrapper binary it writes into ``/opt/cni/bin``, and the CNI exec
+protocol (CNI_* environment + netconf on stdin + result JSON on stdout)
+between them.  This harness closes that gap (ROADMAP #3 / VERDICT r5
+gaps #2-#3):
+
+- it PARSES the real ``deploy/cni/10-vpp-tpu.conflist`` (the file the
+  install-cni init container copies onto every host) and refuses to run
+  if the ``vpp-tpu-cni`` plugin entry is missing;
+- ``add``/``delete`` EXEC the real shim binary (``python -m
+  vpp_tpu.cni.shim`` — exactly what the installed ``vpp-tpu-cni``
+  wrapper script execs) as a subprocess with kubelet's CNI_* env and
+  the conflist-derived netconf on stdin, against a LIVE agent's CNI
+  gRPC server — or its REST fallback route (``transport="http"``, the
+  grpc-less-host path, forced via ``VPP_TPU_CNI_TRANSPORT``);
+- :func:`validate_manifests` cross-checks the rendered chart and the
+  static k8s manifest against what the harness actually invoked: same
+  conflist file, same plugin-type→binary name, same shim module, same
+  gRPC/REST ports — so the manifests can no longer drift from the
+  tested path.
+
+The only divergence from a host kubelet: the conflist's grpcServer/
+httpServer addresses are overridden per invocation to reach the target
+agent's ephemeral test ports (the DaemonSet reaches its agent on fixed
+host ports; tests cannot).  The override rides the netconf exactly
+where the production values sit, so the shim's parsing path is
+identical.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import threading
+from typing import Any, Dict, List, Optional
+
+PLUGIN_TYPE = "vpp-tpu-cni"
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+DEFAULT_CONFLIST = REPO_ROOT / "deploy" / "cni" / "10-vpp-tpu.conflist"
+SHIM_MODULE = "vpp_tpu.cni.shim"
+
+
+class CNIError(RuntimeError):
+    """A CNI invocation failed: carries the spec error object."""
+
+    def __init__(self, command: str, code: int, msg: str, returncode: int):
+        super().__init__(f"CNI {command} failed (code {code}): {msg}")
+        self.command = command
+        self.code = code
+        self.msg = msg
+        self.returncode = returncode
+
+
+def pod_ip(result: Dict[str, Any]) -> str:
+    """The allocated pod IP of an ADD result (address sans prefix)."""
+    return result["ips"][0]["address"].split("/")[0]
+
+
+class FakeKubelet:
+    """Drives pod ADD/DEL through the real CNI shim binary."""
+
+    def __init__(
+        self,
+        grpc_server: Optional[str] = None,
+        http_server: Optional[str] = None,
+        conflist_path: Optional[str] = None,
+        transport: str = "grpc",
+        python: str = sys.executable,
+        timeout: float = 60.0,
+    ):
+        if transport not in ("grpc", "http"):
+            raise ValueError(f"transport must be grpc|http, not {transport!r}")
+        self.conflist_path = pathlib.Path(conflist_path or DEFAULT_CONFLIST)
+        with open(self.conflist_path) as fh:
+            self.conflist = json.load(fh)
+        plugins = [p for p in self.conflist.get("plugins", [])
+                   if p.get("type") == PLUGIN_TYPE]
+        if not plugins:
+            raise ValueError(
+                f"{self.conflist_path} has no plugin of type "
+                f"{PLUGIN_TYPE!r} — nothing for kubelet to exec")
+        self.plugin = plugins[0]
+        self.grpc_server = grpc_server
+        self.http_server = http_server
+        self.transport = transport
+        self.python = python
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.invocations: List[Dict[str, Any]] = []  # exec evidence
+
+    # ----------------------------------------------------------- netconf
+
+    def netconf(self) -> Dict[str, Any]:
+        """The network config kubelet passes on stdin: the conflist's
+        vpp-tpu-cni plugin entry plus the list-level name/cniVersion
+        (the CNI runtime's plugin-conf merge), with the agent address
+        override applied in place of the production host ports."""
+        conf = dict(self.plugin)
+        conf["name"] = self.conflist.get("name", "")
+        conf["cniVersion"] = self.conflist.get("cniVersion", "")
+        if self.grpc_server:
+            conf["grpcServer"] = self.grpc_server
+        if self.http_server:
+            conf["httpServer"] = self.http_server
+        return conf
+
+    # -------------------------------------------------------------- exec
+
+    def _exec(self, command: str, pod_name: str, namespace: str,
+              container_id: Optional[str], netns: Optional[str]) -> dict:
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        container_id = container_id or f"cni-{pod_name}-{seq}"
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update({
+            "CNI_COMMAND": command,
+            "CNI_CONTAINERID": container_id,
+            "CNI_NETNS": netns or f"/proc/{seq}/ns/net",
+            "CNI_IFNAME": "eth0",
+            "CNI_ARGS": (
+                f"IgnoreUnknown=1;K8S_POD_NAMESPACE={namespace};"
+                f"K8S_POD_NAME={pod_name};"
+                f"K8S_POD_INFRA_CONTAINER_ID={container_id}"
+            ),
+            "CNI_PATH": "/opt/cni/bin",
+        })
+        if self.transport == "http":
+            env["VPP_TPU_CNI_TRANSPORT"] = "http"
+        proc = subprocess.run(
+            [self.python, "-m", SHIM_MODULE],
+            input=json.dumps(self.netconf()),
+            capture_output=True, text=True,
+            cwd=str(REPO_ROOT), env=env, timeout=self.timeout,
+        )
+        record = {
+            "command": command,
+            "pod": f"{namespace}/{pod_name}",
+            "container_id": container_id,
+            "transport": self.transport,
+            "rc": proc.returncode,
+        }
+        with self._lock:
+            self.invocations.append(record)
+        try:
+            result = json.loads(proc.stdout) if proc.stdout.strip() else {}
+        except ValueError as err:
+            raise CNIError(
+                command, -1,
+                f"shim printed non-JSON: {proc.stdout!r} "
+                f"(stderr: {proc.stderr!r})", proc.returncode) from err
+        if proc.returncode != 0:
+            raise CNIError(command, int(result.get("code", -1)),
+                           str(result.get("msg", proc.stderr)),
+                           proc.returncode)
+        return result
+
+    def add(self, pod_name: str, namespace: str = "default",
+            container_id: Optional[str] = None,
+            netns: Optional[str] = None) -> dict:
+        """CNI ADD; returns the spec 0.3.1 result JSON (ips/routes)."""
+        result = self._exec("ADD", pod_name, namespace, container_id, netns)
+        if result.get("cniVersion") != self.conflist.get("cniVersion"):
+            raise CNIError("ADD", -1,
+                           f"result cniVersion {result.get('cniVersion')!r}"
+                           f" != conflist {self.conflist.get('cniVersion')!r}",
+                           0)
+        if not result.get("ips"):
+            raise CNIError("ADD", -1, f"result has no ips: {result}", 0)
+        return result
+
+    def delete(self, pod_name: str, namespace: str = "default",
+               container_id: Optional[str] = None,
+               netns: Optional[str] = None) -> dict:
+        return self._exec("DEL", pod_name, namespace, container_id, netns)
+
+    def version(self) -> dict:
+        """CNI VERSION through the exec protocol (no agent involved)."""
+        env = dict(os.environ, CNI_COMMAND="VERSION")
+        env.pop("XLA_FLAGS", None)
+        proc = subprocess.run(
+            [self.python, "-m", SHIM_MODULE], input="",
+            capture_output=True, text=True,
+            cwd=str(REPO_ROOT), env=env, timeout=self.timeout,
+        )
+        return json.loads(proc.stdout)
+
+
+# ---------------------------------------------------------------------------
+# Manifest cross-validation: the deploy artifacts must describe exactly
+# the invocation path the harness exercises.
+# ---------------------------------------------------------------------------
+
+
+def _agent_daemonset(docs) -> Dict[str, Any]:
+    for doc in docs:
+        if doc and doc.get("kind") == "DaemonSet" \
+                and doc["metadata"]["name"] == "vpp-tpu-agent":
+            return doc
+    raise AssertionError("no vpp-tpu-agent DaemonSet in the manifests")
+
+
+def _arg_value(args: List[str], flag: str) -> Optional[str]:
+    """``--flag=value`` or ``--flag value`` from a container args list."""
+    for i, arg in enumerate(args):
+        if arg.startswith(flag + "="):
+            return arg.split("=", 1)[1]
+        if arg == flag and i + 1 < len(args):
+            return args[i + 1]
+    return None
+
+
+def _validate_daemonset(kubelet: FakeKubelet, docs,
+                        source: str) -> Dict[str, Any]:
+    ds = _agent_daemonset(docs)
+    spec = ds["spec"]["template"]["spec"]
+    install = next(c for c in spec["initContainers"]
+                   if c["name"] == "install-cni")
+    install_text = " ".join(install.get("args", []))
+
+    # 1. The conflist the init container installs is the FILE this
+    # harness parsed (path inside the image mirrors the repo layout).
+    rel = kubelet.conflist_path.relative_to(REPO_ROOT).as_posix()
+    assert rel in install_text, (
+        f"{source}: install-cni does not install {rel} "
+        f"(args: {install_text!r})")
+    assert kubelet.conflist_path.name in install_text
+
+    # 2. The binary name written into /opt/cni/bin matches the plugin
+    # type kubelet resolves from the conflist — a renamed plugin type
+    # would leave kubelet exec'ing a binary that does not exist.
+    assert f"/host/opt/cni/bin/{PLUGIN_TYPE}" in install_text, (
+        f"{source}: install-cni does not write the {PLUGIN_TYPE!r} binary")
+
+    # 3. The wrapper execs the SAME shim module this harness execs.
+    assert SHIM_MODULE in install_text, (
+        f"{source}: the CNI wrapper does not exec {SHIM_MODULE}")
+
+    # 4. The agent's ports match the conflist's server addresses: the
+    # shim dials grpcServer/httpServer from the netconf, so a port
+    # drift between ConfigMap-land and conflist-land bricks every ADD.
+    agent = spec["containers"][0]
+    cni_port = _arg_value(agent["args"], "--cni-port")
+    rest_port = _arg_value(agent["args"], "--rest-port")
+    grpc_port = kubelet.plugin["grpcServer"].rsplit(":", 1)[1]
+    http_port = kubelet.plugin["httpServer"].rsplit(":", 1)[1]
+    assert cni_port == grpc_port, (
+        f"{source}: agent --cni-port={cni_port} but conflist grpcServer "
+        f"port is {grpc_port}")
+    assert rest_port == http_port, (
+        f"{source}: agent --rest-port={rest_port} but conflist httpServer "
+        f"port is {http_port}")
+    return {
+        "source": source,
+        "conflist": rel,
+        "plugin_type": PLUGIN_TYPE,
+        "shim_module": SHIM_MODULE,
+        "cni_port": cni_port,
+        "rest_port": rest_port,
+    }
+
+
+def validate_manifests(kubelet: FakeKubelet) -> List[Dict[str, Any]]:
+    """Validate the static k8s manifest AND the default chart render
+    against the invocation path the harness exercises; returns one
+    evidence record per source, raises AssertionError on any drift."""
+    import importlib.util
+
+    import yaml
+
+    results = []
+    static = list(yaml.safe_load_all(
+        (REPO_ROOT / "deploy" / "k8s" / "vpp-tpu.yaml").read_text()))
+    results.append(_validate_daemonset(kubelet, static, "deploy/k8s"))
+
+    # Render the chart with default values through its real entrypoint.
+    spec = importlib.util.spec_from_file_location(
+        "render_chart", REPO_ROOT / "scripts" / "render_chart.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    import contextlib
+    import io
+
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = mod.main([])
+    assert rc == 0, "chart render failed"
+    rendered = list(yaml.safe_load_all(out.getvalue()))
+    results.append(_validate_daemonset(kubelet, rendered, "deploy/chart"))
+    return results

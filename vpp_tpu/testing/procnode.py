@@ -8,28 +8,348 @@ runs ONE full agent stack in its own OS process, connected to the
 cluster's KVStoreServer over gRPC:
 
     python -m vpp_tpu.testing.procnode --store 127.0.0.1:PORT \\
-        --name node-2 [--mirror /tmp/node-2.db] [--heartbeat-prefix P]
+        --name node-2 [--mirror /tmp/node-2.db] [--heartbeat-prefix P] \\
+        [--cni-port 0] [--datapath N] [--rest-port 0]
 
 The agent is the same plugin wiring as SimNode (controller, dbwatcher
 with sqlite mirror, nodesync ID allocation through atomic store ops,
 policy/service stacks with scheduler-routed TPU tables).  A heartbeat
 key is written back to the store every interval carrying what the agent
-currently believes (resync count, known pods, table swap counts), which
-is how tests observe cross-process convergence.
+currently believes (resync count, known pods, table swap counts, the
+controller resilience snapshot, parity-probe results), which is how
+tests observe cross-process convergence.
+
+ISSUE 9 additions for the cluster-scale chaos soak:
+
+- ``--cni-port`` serves the agent's RemoteCNI gRPC endpoint so a
+  kubelet-shaped harness (:mod:`.kubelet`) can exec the REAL shim
+  binary against this agent for pod ADD/DEL; the bound port rides the
+  heartbeat (``cni``) for discovery.
+- ``--datapath N`` attaches an N-shard :class:`ShardedDataplane`
+  (native rings, tables swapped by the scheduler applicators exactly
+  like the production agent), so the soak's fault scheduler can arm
+  PR 3 shard faults over this agent's REST surface and watch
+  ejection/steer/rejoin happen in a REAL process under REAL frames.
+- **parity probes**: the conductor bumps a round counter under
+  ``PROBE_KEY``; the agent then evaluates a deterministic flow sample
+  through BOTH the jit pipeline (and the sharded datapath, when
+  attached) and the mock-engine oracle its policy stack feeds, and
+  reports agreement in the heartbeat — the soak's bit-for-bit verdict
+  oracle, per node, across processes.
+- **boot retry**: constructing the agent while the store is unreachable
+  (agent SIGKILLed and restarted inside a store-outage window) retries
+  with capped backoff instead of crashing — the crash-looping
+  DaemonSet-pod analog; an agent that was ALREADY up rides the outage
+  out on its sqlite mirror.
 """
 
 from __future__ import annotations
 
 import argparse
+import ipaddress
 import json
+import logging
+import random
 import signal
 import sys
 import time
 import types
+from typing import Dict, List, Optional, Set, Tuple
 
 from ..kvstore.remote import RemoteKVStore
 
+log = logging.getLogger(__name__)
+
 HEARTBEAT_PREFIX = "/vpp-tpu/test/heartbeat/"
+# The conductor bumps {"round": N} here to trigger a parity-probe round
+# on every agent (see _ParityProber); results ride the heartbeat.
+PROBE_KEY = "/vpp-tpu/test/soak/probe"
+
+# Probe flows use src ports in [PROBE_SPORT, BACKGROUND_SPORT); the
+# datapath keep-alive traffic uses >= BACKGROUND_SPORT and is excluded
+# from every parity comparison (the test_chaos sacrificial convention).
+PROBE_SPORT = 40000
+BACKGROUND_SPORT = 50000
+
+PROBE_BATCH = 32          # fixed probe batch shape: ONE pipeline compile
+PROBE_PORTS = (80, 443, 9, 8080)
+
+
+def _is_outage(exc: Exception) -> bool:
+    from ..controller.dbwatcher import is_store_unavailable
+
+    return is_store_unavailable(exc)
+
+
+# ---------------------------------------------------------------------------
+# Sharded-datapath attachment (the soak's shard-fault target)
+# ---------------------------------------------------------------------------
+
+
+class AgentDatapath:
+    """An N-shard datapath wired to the agent's table applicators the
+    way the production agent wires its runner: compiled tables swap in
+    atomically per transaction, a swap failure propagates into the txn
+    (→ healing escalation), and the REST surface serves health/faults/
+    flight for this engine."""
+
+    def __init__(self, node, shards: int, batch_size: int = 8,
+                 max_vectors: int = 2):
+        from ..datapath import NativeRing, ShardedDataplane, VxlanOverlay
+        from ..ops.classify import build_rule_tables
+        from ..ops.nat import build_nat_tables
+        from ..ops.packets import ip_to_u32
+        from ..ops.pipeline import make_route_config
+        from .cluster import timeout_mult
+
+        self.node = node
+        self.ios = [tuple(NativeRing() for _ in range(4))
+                    for _ in range(shards)]
+        node_ip = f"192.168.16.{node.nodesync.node_id}"
+        self.dp = ShardedDataplane(
+            acl=node.policy_renderer.tables
+            if node.policy_renderer.tables is not None
+            else build_rule_tables([], {}),
+            nat=node.nat_renderer.tables
+            if node.nat_renderer.tables is not None
+            else build_nat_tables([]),
+            route=make_route_config(node.ipam),
+            overlay=VxlanOverlay(local_ip=ip_to_u32(node_ip),
+                                 local_node_id=node.nodesync.node_id),
+            shard_ios=self.ios,
+            batch_size=batch_size,
+            max_vectors=max_vectors,
+            session_capacity=1 << 12,
+            # Short enough that a soak's dispatch-hang drill blows the
+            # deadline within its window, long enough that the FIRST
+            # dispatch's jit compile (no prewarm; N agents compiling
+            # concurrently on a loaded box) never falsely ejects.
+            dispatch_deadline=15.0 * timeout_mult(),
+            prewarm=False,
+        )
+        # Same hook discipline as Agent._start_datapath: hook FIRST,
+        # then pull whatever is already compiled, so no compile can fall
+        # between.  A TableSwapError raised here propagates through the
+        # applicator into the event transaction — the PR 3 healing
+        # escalation path the soak's swap-fail drill exercises.
+        node.acl_applicator.on_compiled = \
+            lambda t: self.dp.update_tables(acl=t)
+        node.nat_applicator.on_compiled = \
+            lambda t: self.dp.update_tables(nat=t)
+        self.dp.update_tables(acl=node.policy_renderer.tables,
+                              nat=node.nat_renderer.tables)
+        self._bg_seq = 0
+        # Background frames land on a high host address of this node's
+        # pod subnet: routed local (delivered), never a real pod.
+        subnet = node.ipam.pod_subnet_this_node
+        self._bg_dst = str(subnet.network_address + subnet.num_addresses - 2)
+        self._bg_src = str(subnet.network_address + subnet.num_addresses - 3)
+
+    def pump(self) -> None:
+        """One keep-alive turn: a sacrificial frame per shard (so armed
+        dispatch faults actually fire and ejected shards re-probe), one
+        supervised poll, rings drained so nothing accumulates."""
+        from .frames import build_frame
+
+        self._bg_seq += 1
+        sport = BACKGROUND_SPORT + (self._bg_seq % 8000)
+        for io_set in self.ios:
+            io_set[0].send([build_frame(self._bg_src, self._bg_dst, 6,
+                                        sport, 80)])
+        self.dp.poll()
+        self.drain_outputs()
+
+    def drain_outputs(self) -> List[bytes]:
+        """Empty every shard's tx/local/host ring; returns the local
+        (delivered-to-pod) frames for callers that inspect them."""
+        delivered: List[bytes] = []
+        for io_set in self.ios:
+            io_set[1].recv_batch(1 << 12)
+            delivered += io_set[2].recv_batch(1 << 12)
+            io_set[3].recv_batch(1 << 12)
+        return delivered
+
+    def probe(self, flows: List[Tuple[str, str, int, int, int]]
+              ) -> Set[Tuple[str, str, int, int, int]]:
+        """Drive probe flows as real frames round-robin over ALL shard
+        rings (ejected shards' frames must steer to survivors) and
+        return the delivered 5-tuples in the probe port range."""
+        from .frames import build_frame, frame_tuple
+
+        self.drain_outputs()
+        for i, flow in enumerate(flows):
+            self.ios[i % len(self.ios)][0].send([build_frame(*flow)])
+        self.dp.drain()
+        out = {
+            frame_tuple(f) for f in self.drain_outputs()
+            if PROBE_SPORT <= frame_tuple(f)[3] < BACKGROUND_SPORT
+        }
+        return out
+
+    def close(self) -> None:
+        self.dp.close()
+
+
+# ---------------------------------------------------------------------------
+# Mock-engine parity probing (the soak's verdict oracle)
+# ---------------------------------------------------------------------------
+
+
+def known_pods(node) -> List:
+    """Snapshot of the policy cache's pods, safe against the controller
+    thread mutating the dict mid-iteration (retried; a torn read here
+    crashed the heartbeat loop under soak churn)."""
+    for _ in range(8):
+        try:
+            return list(node.policy.cache._pods.values())
+        except RuntimeError:  # dict changed size during iteration
+            continue
+    return []
+
+
+def probe_flows(node, round_no: int, count: int = PROBE_BATCH,
+                local_only: bool = False,
+                ) -> List[Tuple[str, str, int, int, int]]:
+    """A deterministic flow sample over the pods this agent currently
+    knows (seeded by the probe round, so every process draws the same
+    sample for the same cluster view).  Service VIPs are never targeted
+    — NAT rewrite would make the plain-ACL oracle the wrong reference.
+    """
+    pods = sorted(p.ip_address for p in known_pods(node) if p.ip_address)
+    if local_only:
+        subnet = node.ipam.pod_subnet_this_node
+        pods = [ip for ip in pods
+                if ipaddress.ip_address(ip) in subnet]
+    if not pods:
+        return []
+    rng = random.Random(0xA5 ^ (round_no * 1000003))
+    flows = []
+    for i in range(count):
+        src = rng.choice(pods)
+        dst = rng.choice(pods)
+        sport = PROBE_SPORT + ((round_no * count + i) % 9000)
+        flows.append((src, dst, 6, sport, rng.choice(PROBE_PORTS)))
+    return flows
+
+
+def oracle_verdicts(node, flows) -> List[bool]:
+    """The mock-engine verdict per flow: the source pod's ingress table
+    and the destination pod's egress table (the MockACLEngine
+    connection semantics), over the SAME rendered tables the TPU
+    pipeline compiled from — absence of tables means allow."""
+    from ..models import ProtocolType
+    from .aclengine import Verdict, evaluate_table
+
+    tables = dict(node.oracle.tables)  # consistent shallow view
+    by_ip = {}
+    for pod_tables in tables.values():
+        if pod_tables.pod_ip is not None:
+            by_ip[str(pod_tables.pod_ip.network_address)] = pod_tables
+    out = []
+    for src, dst, proto, sport, dport in flows:
+        src_ip = ipaddress.ip_address(src)
+        dst_ip = ipaddress.ip_address(dst)
+        ok = True
+        src_t = by_ip.get(src)
+        if src_t is not None:
+            ok = evaluate_table(src_t.ingress, src_ip, dst_ip,
+                                ProtocolType.TCP, sport, dport) \
+                is Verdict.ALLOWED
+        if ok:
+            dst_t = by_ip.get(dst)
+            if dst_t is not None:
+                ok = evaluate_table(dst_t.egress, src_ip, dst_ip,
+                                    ProtocolType.TCP, sport, dport) \
+                    is Verdict.ALLOWED
+        out.append(ok)
+    return out
+
+
+class _ParityProber:
+    """Runs one parity round when the conductor bumps PROBE_KEY.
+
+    A probe racing an in-flight policy commit can legitimately disagree
+    (oracle renderer commits inside the handler, device tables swap at
+    txn commit), so a round only REPORTS a mismatch when it persists
+    across retries with a stable table generation — the conductor
+    additionally quiesces churn before probing.
+    """
+
+    RETRIES = 3
+
+    def __init__(self, node, datapath: Optional[AgentDatapath]):
+        self.node = node
+        self.datapath = datapath
+        self.last = {"round": 0, "checked": 0, "mismatches": 0,
+                     "detail": []}
+
+    def maybe_run(self, probe_value) -> None:
+        if not isinstance(probe_value, dict):
+            return
+        round_no = int(probe_value.get("round", 0))
+        if round_no <= self.last["round"]:
+            return
+        self.last = self.run(round_no)
+
+    def run(self, round_no: int) -> dict:
+        import numpy as np
+
+        result = {"round": round_no, "checked": 0, "mismatches": 0,
+                  "detail": []}
+        for attempt in range(self.RETRIES):
+            gen_before = self.node.acl_applicator.compile_count
+            mismatches: List[str] = []
+            checked = 0
+
+            # ---- pipeline-level: jit pipeline vs oracle ------------
+            flows = probe_flows(self.node, round_no + attempt)
+            if flows:
+                padded = flows + [flows[0]] * (PROBE_BATCH - len(flows))
+                res = self.node.send(padded)
+                tpu = np.asarray(res.allowed)[:len(flows)]
+                oracle = oracle_verdicts(self.node, flows)
+                checked += len(flows)
+                for flow, t, o in zip(flows, tpu, oracle):
+                    if bool(t) != bool(o):
+                        mismatches.append(
+                            f"pipeline {flow}: tpu={bool(t)} oracle={o}")
+
+            # ---- datapath-level: delivered frames vs oracle --------
+            if self.datapath is not None:
+                dflows = probe_flows(self.node, round_no + attempt,
+                                     count=16, local_only=True)
+                if dflows:
+                    dflows = list(dict.fromkeys(dflows))  # unique frames
+                    delivered = self.datapath.probe(dflows)
+                    oracle = oracle_verdicts(self.node, dflows)
+                    expect = {f for f, ok in zip(dflows, oracle) if ok}
+                    checked += len(dflows)
+                    for f in sorted(expect - delivered):
+                        mismatches.append(f"datapath {f}: oracle=True "
+                                          "not delivered")
+                    for f in sorted(delivered - expect):
+                        mismatches.append(f"datapath {f}: oracle=False "
+                                          "delivered")
+
+            stable = (self.node.acl_applicator.compile_count == gen_before)
+            result["checked"] = checked
+            result["mismatches"] = len(mismatches)
+            result["detail"] = mismatches[:4]
+            if not mismatches or attempt == self.RETRIES - 1:
+                # A final attempt that disagreed while tables were still
+                # moving is INCONCLUSIVE, not clean: surface the counts
+                # and flag it — the conductor must never read a raced
+                # round as a passing one.
+                if mismatches and not stable:
+                    result["unstable"] = True
+                return result
+            time.sleep(0.2)  # tables moved (or about to): settle, retry
+        return result
+
+
+# ---------------------------------------------------------------------------
+# The agent process
+# ---------------------------------------------------------------------------
 
 
 def run_agent(
@@ -41,6 +361,8 @@ def run_agent(
     stop_event=None,
     hostnet_netns: str = "",
     rest_port: int = -1,
+    cni_port: int = -1,
+    datapath_shards: int = 0,
 ) -> None:
     from .cluster import SimNode
 
@@ -48,14 +370,41 @@ def run_agent(
     # SimNode only consumes ``cluster.store`` — a remote client slots in
     # where the in-process store object sat.
     shim = types.SimpleNamespace(store=store)
-    node = SimNode(shim, name, mirror_path=mirror_path or None)
+    # Boot retry: a restart landing inside a store-outage window (the
+    # soak's SIGKILL-during-outage combo) must wait the outage out, not
+    # die — kubelet would crash-loop the DaemonSet pod the same way.
+    node = None
+    backoff = 0.2
+    while stop_event is None or not stop_event.is_set():
+        try:
+            node = SimNode(shim, name, mirror_path=mirror_path or None)
+            break
+        except Exception as err:  # noqa: BLE001 - classified below
+            if not _is_outage(err):
+                raise
+            log.warning("store unreachable during agent boot (%s); "
+                        "retrying in %.1fs", err, backoff)
+            if stop_event is not None and stop_event.wait(backoff):
+                break
+            if stop_event is None:
+                time.sleep(backoff)
+            backoff = min(backoff * 2, 2.0)
+    if node is None:
+        store.close()
+        return
+
+    datapath = None
+    if datapath_shards > 0:
+        datapath = AgentDatapath(node, datapath_shards)
+
     rest = None
     rest_bound = 0
     if rest_port >= 0:
-        # Serve the agent REST API (ipam/dump/nodes/pods/...) so
-        # cross-process harnesses — the CRD telemetry crawl above all —
-        # can interrogate this agent like a production one.  The bound
-        # port rides the heartbeat for discovery (0 = ephemeral).
+        # Serve the agent REST API (ipam/dump/nodes/pods/health/faults/
+        # ...) so cross-process harnesses — the CRD telemetry crawl, the
+        # soak's fault scheduler — can interrogate and ARM this agent
+        # like a production one.  The bound port rides the heartbeat
+        # for discovery (0 = ephemeral).
         from ..rest.server import AgentRestServer
 
         rest = AgentRestServer(
@@ -63,8 +412,21 @@ def run_agent(
             dbwatcher=node.watcher, ipam=node.ipam,
             nodesync=node.nodesync, podmanager=node.podmanager,
             scheduler=node.scheduler, store=store, port=rest_port,
+            datapath=datapath.dp if datapath is not None else None,
+            spans=node.controller.spans,
         )
         rest_bound = rest.start()
+
+    cni = None
+    cni_bound = 0
+    if cni_port >= 0:
+        # The kubelet↔agent boundary: the REAL RemoteCNI gRPC service,
+        # exec'd against by the fake-kubelet harness's shim subprocess.
+        from ..cni.rpc import CNIServer
+
+        cni = CNIServer(node.podmanager, port=cni_port)
+        cni_bound = cni.start()
+
     hostnet = None
     if hostnet_netns:
         # Program REAL kernel networking (confined to the named netns):
@@ -79,31 +441,73 @@ def run_agent(
         node.scheduler.register_applicator(hostnet)
         node.scheduler.replay()
 
+    prober = _ParityProber(node, datapath)
     seq = 0
     try:
         while stop_event is None or not stop_event.is_set():
             seq += 1
+            if datapath is not None:
+                try:
+                    datapath.pump()
+                except Exception:  # noqa: BLE001 - chaos drills inject here
+                    log.exception("datapath pump error")
             beat = {
                 "name": name,
                 "seq": seq,
                 "node_id": node.nodesync.node_id,
                 "resync_count": node.controller._resync_count,
                 "mirror_resyncs": node.watcher.resynced_from_mirror,
+                "mirror_recreated": (
+                    node.watcher._mirror.recreated
+                    if node.watcher._mirror is not None else 0),
                 "pods": sorted(
-                    f"{p.namespace}/{p.name}" for p in node.policy.cache._pods
+                    f"{p.namespace}/{p.name}" for p in known_pods(node)
                 ),
                 "acl_swaps": node.acl_applicator.compile_count,
                 "nat_mappings": len(node.nat_applicator.mappings()),
+                "controller": node.controller.status(),
                 "rest": f"127.0.0.1:{rest_bound}" if rest_bound else "",
+                "cni": f"127.0.0.1:{cni_bound}" if cni_bound else "",
             }
+            if datapath is not None:
+                h = datapath.dp.health()
+                beat["datapath"] = {
+                    "shards_total": h["shards_total"],
+                    "shards_serving": h["shards_serving"],
+                    "ejections": h["ejections"],
+                    "rejoins": h["rejoins"],
+                    "swap_rollbacks": h["swap_rollbacks"],
+                }
+            beat["parity"] = dict(prober.last)
+            probe_value = None
             try:
                 store.put(heartbeat_prefix + name, beat)
+                probe_value = store.get(PROBE_KEY)
             except Exception:  # noqa: BLE001 - store outage: keep beating
                 pass
+            # The probe runs OUTSIDE the store-outage swallow: a real
+            # probe bug (pipeline eval crash, datapath drain failure)
+            # must be logged and reported as a failed round, not
+            # silently retried into a conductor-side timeout.
+            if probe_value is not None:
+                try:
+                    prober.maybe_run(probe_value)
+                except Exception as err:  # noqa: BLE001 - reported below
+                    log.exception("parity probe crashed")
+                    prober.last = {
+                        "round": int(probe_value.get("round", 0))
+                        if isinstance(probe_value, dict) else 0,
+                        "checked": 0, "mismatches": 1,
+                        "detail": [f"probe crashed: {err}"],
+                    }
             time.sleep(heartbeat_interval)
     finally:
+        if cni is not None:
+            cni.stop()
         if rest is not None:
             rest.stop()
+        if datapath is not None:
+            datapath.close()
         node.stop()
         store.close()
         if hostnet is not None:
@@ -119,18 +523,28 @@ def main(argv=None) -> int:
     parser.add_argument("--name", required=True)
     parser.add_argument("--mirror", default="")
     parser.add_argument("--heartbeat-prefix", default=HEARTBEAT_PREFIX)
+    parser.add_argument("--heartbeat-interval", type=float, default=0.1)
     parser.add_argument("--hostnet-netns", default="",
                         help="program real kernel networking inside this netns")
     parser.add_argument("--rest-port", type=int, default=-1,
                         help="serve the agent REST API (0 = ephemeral port, "
                              "published in the heartbeat; -1 = off)")
+    parser.add_argument("--cni-port", type=int, default=-1,
+                        help="serve the RemoteCNI gRPC endpoint for "
+                             "kubelet-exec'd shims (0 = ephemeral port, "
+                             "published in the heartbeat; -1 = off)")
+    parser.add_argument("--datapath", type=int, default=0,
+                        help="attach an N-shard frame datapath (0 = off) — "
+                             "the soak's shard-fault target")
     args = parser.parse_args(argv)
 
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
     print(json.dumps({"agent": args.name, "store": args.store}), flush=True)
     run_agent(args.store, args.name, mirror_path=args.mirror,
               heartbeat_prefix=args.heartbeat_prefix,
-              hostnet_netns=args.hostnet_netns, rest_port=args.rest_port)
+              heartbeat_interval=args.heartbeat_interval,
+              hostnet_netns=args.hostnet_netns, rest_port=args.rest_port,
+              cni_port=args.cni_port, datapath_shards=args.datapath)
     return 0
 
 

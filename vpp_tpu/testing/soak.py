@@ -1,0 +1,996 @@
+"""Chaos soak conductor — the cluster-scale combined-fault proof.
+
+ROADMAP #3 / ISSUE 9 tentpole: correctness under churn-PLUS-failover is
+a different property from correctness under either alone (HyperNAT,
+arXiv:2111.08193, makes the same argument for cloud NAT), and the PR 1
+leader-kill, PR 3 shard-fault and PR 2 delta-swap machinery had never
+been fired *simultaneously* at scale.  This conductor drives a procnode
+mega-cluster — every agent a full control-plane stack in its own OS
+process over a 3-replica HA store of OS processes — through recorded,
+replayable pod/policy/service churn whose pod ADD/DELs exec the REAL
+CNI shim binary via the fake-kubelet harness (:mod:`.kubelet`), while a
+fault scheduler concurrently fires:
+
+- **leader SIGKILL** (PR 1): the HA store leader dies mid-churn, a
+  follower takes over, the corpse rejoins and catches up;
+- **store-outage windows**: every replica SIGSTOPped — agents ride the
+  outage out headless on their sqlite mirrors (REST-triggered resyncs
+  prove the mirror fallback), CNI ADDs keep landing agent-locally, and
+  the deferred K8s reflections flush on recovery;
+- **shard faults** (PR 3): dispatch-raise ejections, dispatch-hang
+  deadline ejections, and swap-fail rollbacks — armed over each
+  agent's REST fault surface, healed through probation/rejoin and the
+  controller's healing resync;
+- **agent SIGKILL-and-restart**: the whole agent process dies and a
+  replacement (same name, same mirror) adopts its node ID and
+  reconverges.
+
+The oracle after every phase: each agent's heartbeat must report the
+conductor's expected pod set (convergence), a healthy healing ledger
+(scheduled == completed, none failed, none pending — "no silent healing
+loop"), serving shards, and a **mock-engine verdict-parity probe** with
+zero mismatches (procnode evaluates a deterministic flow sample through
+the jit pipeline AND its sharded datapath against the ACL oracle).
+Every event is appended to a JSONL record (``SOAK_r08.jsonl``) together
+with PR 6 telemetry evidence (config-propagation spans + latency
+histograms pulled from agent REST).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import pathlib
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from .cluster import free_ports, timeout_mult, wait_for
+from .kubelet import FakeKubelet, pod_ip
+from .procnode import HEARTBEAT_PREFIX, PROBE_KEY
+
+log = logging.getLogger(__name__)
+
+REPO = pathlib.Path(__file__).resolve().parents[2]
+
+WEB = {"app": "web"}
+DB = {"app": "db"}
+
+
+# ---------------------------------------------------------------------------
+# Configuration
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SoakConfig:
+    """One soak run.  ``smoke()`` is the tier-1 shape (seconds-scale,
+    every fault class fired at least once); ``full()`` is the `make
+    soak` acceptance shape (≥50 agents, ≥1000 CNI ops, ≥2 leader
+    kills, ≥2 outage windows, ≥4 shard faults, ≥2 agent restarts)."""
+
+    agents: int = 8
+    datapath_agents: int = 2      # first N agents carry sharded datapaths
+    datapath_shards: int = 2
+    parity_agents: int = 4        # heartbeat parity probes asserted on first N
+    pods: int = 12                # initial deploy (counted as CNI ADDs)
+    churn_ops: int = 28           # further churn ops on top of the deploys
+    churn_rate: float = 12.0      # target ops/sec within a churn slice
+    cni_parallelism: int = 8      # concurrent shim subprocesses
+    leader_kills: int = 1
+    store_outages: int = 1
+    outage_seconds: float = 2.5
+    agent_kills: int = 1
+    shard_faults: int = 3         # rotates eject / hang / swap-fail
+    ha_replicas: int = 3
+    store_heartbeat: float = 0.1
+    store_lease: float = 0.8
+    heartbeat_interval: float = 0.25
+    convergence_timeout: float = 90.0
+    seed: int = 8
+    workdir: str = ""             # mirrors + child logs ("" = tmp)
+    out_path: str = ""            # JSONL event record ("" = off)
+    churn_script_path: str = ""   # replay a recorded script instead
+
+    @staticmethod
+    def smoke(workdir: str, out_path: str = "") -> "SoakConfig":
+        return SoakConfig(workdir=workdir, out_path=out_path)
+
+    @staticmethod
+    def full(workdir: str, out_path: str = "SOAK_r08.jsonl") -> "SoakConfig":
+        # ~20% of churn ops are policy/service toggles, so the pod-op
+        # budget (initial deploys + ~80% of churn_ops) clears the
+        # acceptance floor of 1000 CNI ADD/DELs with margin.
+        return SoakConfig(
+            agents=50, datapath_agents=4, datapath_shards=2,
+            parity_agents=8, pods=150, churn_ops=1250, churn_rate=40.0,
+            cni_parallelism=16, leader_kills=2, store_outages=2,
+            outage_seconds=4.0, agent_kills=2, shard_faults=4,
+            heartbeat_interval=0.5, convergence_timeout=300.0,
+            workdir=workdir, out_path=out_path,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Churn scripts — recorded, deterministic, replayable
+# ---------------------------------------------------------------------------
+
+
+def generate_churn(cfg: SoakConfig) -> List[Dict[str, Any]]:
+    """A deterministic op list: pod ADD/DEL (through the CNI shim),
+    NetworkPolicy apply/withdraw, Service+Endpoints apply/withdraw.
+    Plain JSON dicts so a script saves/replays byte-identically."""
+    rng = random.Random(cfg.seed)
+    ops: List[Dict[str, Any]] = []
+    live: List[Tuple[str, str]] = []     # (pod, node)
+    n_pod = 0
+    policies_live: Set[str] = set()
+    svc_live = False
+
+    def add_pod():
+        nonlocal n_pod
+        n_pod += 1
+        name = f"soak-{n_pod}"
+        node = f"node-{rng.randrange(cfg.agents) + 1}"
+        labels = WEB if n_pod % 3 else DB
+        live.append((name, node))
+        ops.append({"op": "pod-add", "pod": name, "node": node,
+                    "labels": dict(labels)})
+
+    for _ in range(cfg.pods):
+        add_pod()
+    for _ in range(cfg.churn_ops):
+        roll = rng.random()
+        if roll < 0.42 or len(live) < max(2, cfg.pods // 2):
+            add_pod()
+        elif roll < 0.78 and live:
+            name, node = live.pop(rng.randrange(len(live)))
+            ops.append({"op": "pod-del", "pod": name, "node": node})
+        elif roll < 0.90:
+            if "deny-web" in policies_live and rng.random() < 0.5:
+                policies_live.discard("deny-web")
+                ops.append({"op": "policy-del", "name": "deny-web"})
+            else:
+                policies_live.add("deny-web")
+                ops.append({
+                    "op": "policy-apply",
+                    "manifest": {
+                        "metadata": {"name": "deny-web",
+                                     "namespace": "default"},
+                        "spec": {"podSelector": {"matchLabels": dict(WEB)},
+                                 "policyTypes": ["Ingress"],
+                                 "ingress": [{"from": [{"podSelector": {
+                                     "matchLabels": dict(WEB)}}]}]},
+                    },
+                })
+        else:
+            svc_live = not svc_live
+            ops.append({"op": "svc-apply" if svc_live else "svc-del",
+                        "name": "web"})
+    return ops
+
+
+def save_churn(ops: List[Dict[str, Any]], path: str) -> None:
+    with open(path, "w") as fh:
+        for op in ops:
+            fh.write(json.dumps(op, sort_keys=True) + "\n")
+
+
+def load_churn(path: str) -> List[Dict[str, Any]]:
+    with open(path) as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+# ---------------------------------------------------------------------------
+# Process helpers
+# ---------------------------------------------------------------------------
+
+
+def _child_env() -> Dict[str, str]:
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    # A mega-cluster of jax processes on one box: keep each child's
+    # BLAS/compile pools narrow or N agents oversubscribe every core.
+    env.setdefault("OMP_NUM_THREADS", "1")
+    env.setdefault("OPENBLAS_NUM_THREADS", "1")
+    return env
+
+
+class _Proc:
+    """A child process with its log file (stdout+stderr), so a crashed
+    agent leaves forensics and a chatty one cannot fill a pipe."""
+
+    def __init__(self, argv: List[str], log_path: pathlib.Path):
+        self.log_path = log_path
+        self.log_file = open(log_path, "ab")
+        self.proc = subprocess.Popen(
+            argv, cwd=str(REPO), env=_child_env(),
+            stdout=self.log_file, stderr=subprocess.STDOUT,
+        )
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def kill(self, sig=signal.SIGKILL) -> None:
+        if self.alive():
+            self.proc.send_signal(sig)
+
+    def reap(self, timeout: float = 10.0) -> None:
+        try:
+            self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait(timeout=timeout)
+        self.log_file.close()
+
+
+def _http(server: str, path: str, method: str = "GET",
+          timeout: float = 30.0):
+    req = urllib.request.Request(f"http://{server}{path}", method=method)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:  # noqa: S310
+        body = resp.read().decode()
+    try:
+        return json.loads(body)
+    except ValueError:
+        return body
+
+
+# ---------------------------------------------------------------------------
+# The conductor
+# ---------------------------------------------------------------------------
+
+
+class SoakCluster:
+    """Owns every process of one soak run and conducts the phases."""
+
+    def __init__(self, cfg: SoakConfig):
+        self.cfg = cfg
+        self.workdir = pathlib.Path(cfg.workdir or "/tmp/vpp-tpu-soak")
+        self.workdir.mkdir(parents=True, exist_ok=True)
+        self.mult = timeout_mult()
+        self.rng = random.Random(cfg.seed ^ 0xC1A0)
+        self.store_ports: List[int] = []
+        self.store_procs: Dict[int, _Proc] = {}       # port -> proc
+        self.agent_procs: Dict[str, _Proc] = {}       # name -> proc
+        self.kubelets: Dict[str, FakeKubelet] = {}    # name -> harness
+        self.client = None                            # conductor's store
+        self.k8s = None
+        self.ksr = None
+        self.names = [f"node-{i + 1}" for i in range(cfg.agents)]
+        self._model_lock = threading.Lock()
+        self.live_pods: Dict[str, str] = {}           # pod -> node
+        self.pod_ips: Dict[str, str] = {}
+        self._container_ids: Dict[str, str] = {}
+        self._deferred_k8s: List[Tuple[str, dict]] = []
+        self._outage_on = False
+        self.probe_round = 0
+        self.events: List[dict] = []
+        self._out_fh = open(cfg.out_path, "a") if cfg.out_path else None
+        self.report: Dict[str, Any] = {
+            "agents": cfg.agents,
+            "cni_adds": 0, "cni_dels": 0, "cni_errors": 0,
+            "leader_kills": 0, "store_outages": 0,
+            "agent_restarts": 0, "shard_faults": 0,
+            "parity_rounds": 0, "parity_checked": 0,
+            "parity_mismatches": 0, "unconverged": 0,
+            "mirror_resyncs": 0, "healing_failed": 0,
+            "errors": [],
+        }
+
+    # ------------------------------------------------------------ recording
+
+    def record(self, event: str, **fields) -> None:
+        rec = {"ts": round(time.time(), 3), "event": event, **fields}
+        self.events.append(rec)
+        if self._out_fh is not None:
+            self._out_fh.write(json.dumps(rec, sort_keys=True,
+                                          default=str) + "\n")
+            self._out_fh.flush()
+
+    # ---------------------------------------------------------------- store
+
+    def _spawn_replica(self, port: int) -> _Proc:
+        members = ",".join(f"127.0.0.1:{p}" for p in self.store_ports)
+        return _Proc(
+            [sys.executable, "-m", "vpp_tpu.kvstore",
+             "--host", "127.0.0.1", "--port", str(port),
+             "--join", members,
+             "--heartbeat-interval", str(self.cfg.store_heartbeat),
+             "--lease-timeout", str(self.cfg.store_lease * self.mult),
+             "--max-watchers", str(max(64, self.cfg.agents * 2 + 16))],
+            self.workdir / f"store-{port}.log",
+        )
+
+    @property
+    def members(self) -> str:
+        return ",".join(f"127.0.0.1:{p}" for p in self.store_ports)
+
+    def _leader_address(self) -> Optional[str]:
+        for port in self.store_ports:
+            addr = f"127.0.0.1:{port}"
+            try:
+                if self.client.ha_status(addr)["role"] == "leader":
+                    return addr
+            except Exception:  # noqa: BLE001 - replica down/electing
+                continue
+        return None
+
+    # ---------------------------------------------------------------- start
+
+    def start(self) -> None:
+        from ..ksr import KSRPlugin, KVBroker
+        from ..kvstore.remote import RemoteKVStore
+        from .k8s import FakeK8sCluster
+
+        cfg = self.cfg
+        self.record("start", config=dataclasses.asdict(cfg))
+        self.store_ports = free_ports(cfg.ha_replicas)
+        for port in self.store_ports:
+            self.store_procs[port] = self._spawn_replica(port)
+        self.client = RemoteKVStore(
+            self.members, timeout=2.0,
+            failover_deadline=20.0 * self.mult)
+        assert wait_for(lambda: self._leader_address() is not None,
+                        timeout=60.0), "HA store never elected a leader"
+
+        self.k8s = FakeK8sCluster()
+        self.ksr = KSRPlugin(self.k8s, KVBroker(self.client))
+        self.ksr.init(start_monitor=False)
+
+        # Agents, staggered to soften the ID-allocation storm.
+        for name in self.names:
+            self.agent_procs[name] = self._spawn_agent(name)
+            time.sleep(0.05)
+        deadline_per = max(120.0, 3.0 * cfg.agents)
+        assert wait_for(
+            lambda: all(self.heartbeat(n) is not None
+                        for n in self.agent_procs),
+            timeout=deadline_per,
+        ), ("agents never all heartbeat: missing="
+            + ",".join(n for n in self.agent_procs
+                       if self.heartbeat(n) is None))
+        for name in self.names:
+            beat = self.heartbeat(name)
+            # One designated agent execs the shim over the stdlib HTTP
+            # fallback — the grpc-less-host path, same binary.
+            transport = "http" if name == "node-2" and beat["rest"] \
+                else "grpc"
+            self.kubelets[name] = FakeKubelet(
+                grpc_server=beat["cni"], http_server=beat["rest"],
+                transport=transport,
+            )
+        self.record("agents-up", count=len(self.agent_procs))
+
+    def _spawn_agent(self, name: str) -> _Proc:
+        cfg = self.cfg
+        idx = int(name.split("-")[1]) - 1
+        argv = [sys.executable, "-m", "vpp_tpu.testing.procnode",
+                "--store", self.members, "--name", name,
+                "--mirror", str(self.workdir / f"{name}.db"),
+                "--rest-port", "0", "--cni-port", "0",
+                "--heartbeat-interval", str(cfg.heartbeat_interval)]
+        if idx < cfg.datapath_agents:
+            argv += ["--datapath", str(cfg.datapath_shards)]
+        return _Proc(argv, self.workdir / f"{name}.log")
+
+    def heartbeat(self, name: str) -> Optional[dict]:
+        try:
+            return self.client.get(HEARTBEAT_PREFIX + name)
+        except Exception:  # noqa: BLE001 - store mid-fault
+            return None
+
+    def rest_of(self, name: str) -> Optional[str]:
+        beat = self.heartbeat(name)
+        return beat.get("rest") if beat else None
+
+    # ---------------------------------------------------------------- churn
+
+    def _apply_k8s(self, kind: str, manifest: dict) -> None:
+        """Apply through KSR unless the store is in an outage window —
+        then defer (the apiserver is alive, its reflection queues) and
+        flush on recovery."""
+        if self._outage_on:
+            self._deferred_k8s.append((kind, manifest))
+            return
+        self.k8s.apply(kind, manifest)
+
+    def _delete_k8s(self, kind: str, name: str) -> None:
+        if self._outage_on:
+            self._deferred_k8s.append((f"{kind}-del", {"name": name}))
+            return
+        self.k8s.delete(kind, name, "default")
+
+    def _flush_deferred(self) -> None:
+        deferred, self._deferred_k8s = self._deferred_k8s, []
+        for kind, manifest in deferred:
+            if kind.endswith("-del"):
+                self.k8s.delete(kind[:-4], manifest["name"], "default")
+            else:
+                self.k8s.apply(kind, manifest)
+        if deferred:
+            self.record("deferred-flush", count=len(deferred))
+
+    def _cni(self, node: str, fn_name: str, *args, **kw):
+        """One CNI exec with bounded retry: kubelet retries a node whose
+        agent is mid-restart (our agent-SIGKILL drill runs concurrently
+        with churn), and the harness is re-bound to the respawned
+        agent's fresh ports between attempts."""
+        last: Optional[Exception] = None
+        for attempt in range(8):
+            try:
+                return getattr(self.kubelets[node], fn_name)(*args, **kw)
+            except Exception as err:  # noqa: BLE001 - retried, then surfaced
+                last = err
+                time.sleep(1.5 * self.mult)
+        raise last
+
+    def _exec_op(self, op: Dict[str, Any]) -> None:
+        kind = op["op"]
+        try:
+            if kind == "pod-add":
+                result = self._cni(op["node"], "add", op["pod"])
+                ip = pod_ip(result)
+                with self._model_lock:
+                    self.report["cni_adds"] += 1
+                    self.live_pods[op["pod"]] = op["node"]
+                    self.pod_ips[op["pod"]] = ip
+                    self._container_ids[op["pod"]] = \
+                        self.kubelets[op["node"]].invocations[-1][
+                            "container_id"]
+                self._apply_k8s("pods", {
+                    "metadata": {"name": op["pod"], "namespace": "default",
+                                 "labels": op.get("labels", {})},
+                    "spec": {"nodeName": op["node"]},
+                    "status": {"podIP": ip},
+                })
+            elif kind == "pod-del":
+                with self._model_lock:
+                    container = self._container_ids.pop(op["pod"], None)
+                self._cni(op["node"], "delete", op["pod"],
+                          container_id=container)
+                with self._model_lock:
+                    self.report["cni_dels"] += 1
+                    self.live_pods.pop(op["pod"], None)
+                    self.pod_ips.pop(op["pod"], None)
+                self._delete_k8s("pods", op["pod"])
+            elif kind == "policy-apply":
+                self._apply_k8s("networkpolicies", op["manifest"])
+            elif kind == "policy-del":
+                self._delete_k8s("networkpolicies", op["name"])
+            elif kind == "svc-apply":
+                self._apply_service()
+            elif kind == "svc-del":
+                self._delete_k8s("services", "web")
+            else:
+                raise ValueError(f"unknown churn op {kind!r}")
+        except Exception as err:  # noqa: BLE001 - recorded, run continues
+            self.report["cni_errors"] += 1
+            self.report["errors"].append(f"{kind} {op.get('pod', '')}: {err}")
+            self.record("churn-error", op=kind, error=str(err))
+
+    def _apply_service(self) -> None:
+        with self._model_lock:
+            snapshot = [(p, self.pod_ips[p], n)
+                        for p, n in self.live_pods.items()
+                        if p in self.pod_ips]
+        backends = snapshot[:4]
+        self._apply_k8s("services", {
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"clusterIP": "10.96.0.10", "selector": dict(WEB),
+                     "ports": [{"name": "http", "protocol": "TCP",
+                                "port": 80, "targetPort": 8080}]},
+        })
+        self._apply_k8s("endpoints", {
+            "metadata": {"name": "web", "namespace": "default"},
+            "subsets": [{
+                "addresses": [
+                    {"ip": ip, "nodeName": node,
+                     "targetRef": {"kind": "Pod", "name": pod,
+                                   "namespace": "default"}}
+                    for pod, ip, node in backends],
+                "ports": [{"name": "http", "port": 8080,
+                           "protocol": "TCP"}],
+            }] if backends else [],
+        })
+
+    def run_churn(self, ops: List[Dict[str, Any]]) -> threading.Thread:
+        """Execute a churn slice at the configured rate on a worker
+        pool (CNI execs are subprocesses; parallelism hides their exec
+        latency).  Per-pod ordering is preserved because a pod's DEL
+        only ever appears after its ADD in the script and ops are
+        submitted in order to a pool keyed FIFO."""
+        def runner():
+            with ThreadPoolExecutor(self.cfg.cni_parallelism) as pool:
+                t0 = time.monotonic()
+                pending = []
+                by_pod: Dict[str, Any] = {}
+                for i, op in enumerate(ops):
+                    due = t0 + i / self.cfg.churn_rate
+                    delay = due - time.monotonic()
+                    if delay > 0:
+                        time.sleep(delay)
+                    dep = by_pod.get(op.get("pod"))
+                    if dep is not None:
+                        # same-pod ordering: DEL waits for its ADD
+                        dep.result()
+                    fut = pool.submit(self._exec_op, op)
+                    if op.get("pod"):
+                        by_pod[op["pod"]] = fut
+                    pending.append(fut)
+                for fut in pending:
+                    fut.result()
+
+        thread = threading.Thread(target=runner, name="soak-churn")
+        thread.start()
+        return thread
+
+    # ---------------------------------------------------------------- faults
+
+    def fault_leader_kill(self) -> None:
+        leader = self._leader_address()
+        assert leader is not None, "no leader to kill"
+        port = int(leader.rsplit(":", 1)[1])
+        self.record("fault", kind="leader-kill", leader=leader)
+        proc = self.store_procs[port]
+        proc.kill()           # SIGKILL
+        proc.reap()
+        assert wait_for(
+            lambda: self._leader_address() not in (None, leader),
+            timeout=30.0 * self.mult,
+        ), "no new leader after SIGKILL"
+        # Rejoin the corpse; it catches up via snapshot install.
+        self.store_procs[port] = self._spawn_replica(port)
+        assert wait_for(lambda: self._replica_ok(port), timeout=60.0), \
+            f"replica :{port} never rejoined"
+        self.report["leader_kills"] += 1
+        self.record("fault-done", kind="leader-kill",
+                    new_leader=self._leader_address())
+
+    def _replica_ok(self, port: int) -> bool:
+        try:
+            self.client.ha_status(f"127.0.0.1:{port}")
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+    def fault_store_outage(self) -> None:
+        """SIGSTOP every replica: a full store outage window.  Agents
+        must ride it out headless — REST-triggered resyncs during the
+        window land on the sqlite mirror (asserted via the heartbeat's
+        mirror_resyncs after recovery), CNI ADDs keep working
+        agent-locally, data planes keep forwarding."""
+        self.record("fault", kind="store-outage",
+                    seconds=self.cfg.outage_seconds)
+        mirror_before = self._mirror_resyncs_total()
+        # Resolve REST addresses BEFORE freezing the store — heartbeats
+        # are unreadable during the window.
+        probed = [(n, self.rest_of(n)) for n in self.names[:3]]
+        probed = [(n, r) for n, r in probed if r]
+        self._outage_on = True
+        for proc in self.store_procs.values():
+            proc.kill(signal.SIGSTOP)
+        # Ask a few agents to resync WHILE headless: the snapshot RPC
+        # fails over, exhausts the window, and falls back to the mirror.
+        headless_adds = 0
+        for name, rest in probed:
+            try:
+                _http(rest, "/controller/resync", method="POST",
+                      timeout=60.0)
+            except Exception as err:  # noqa: BLE001
+                self.record("churn-error", op="headless-resync",
+                            error=f"{name}: {err}")
+        # Headless CNI: the agent allocates pod state with no store.
+        for i, name in enumerate(self.names[:2]):
+            pod = f"headless-{self.report['store_outages']}-{i}"
+            try:
+                # Retried: the agent's event loop can be parked for a
+                # failover window inside a mirror resync mid-outage; a
+                # later attempt lands once the loop frees up.
+                result = self._cni(name, "add", pod)
+                headless_adds += 1
+                with self._model_lock:
+                    self.report["cni_adds"] += 1
+                    self.live_pods[pod] = name
+                    self.pod_ips[pod] = pod_ip(result)
+                    self._container_ids[pod] = \
+                        self.kubelets[name].invocations[-1]["container_id"]
+                self._apply_k8s("pods", {      # defers until recovery
+                    "metadata": {"name": pod, "namespace": "default",
+                                 "labels": dict(WEB)},
+                    "spec": {"nodeName": name},
+                    "status": {"podIP": self.pod_ips[pod]},
+                })
+            except Exception as err:  # noqa: BLE001
+                self.report["errors"].append(f"headless CNI: {err}")
+        time.sleep(self.cfg.outage_seconds)
+        for proc in self.store_procs.values():
+            proc.kill(signal.SIGCONT)
+        self._outage_on = False
+        assert wait_for(lambda: self._leader_address() is not None,
+                        timeout=30.0 * self.mult), \
+            "store never recovered from SIGSTOP window"
+        self._flush_deferred()
+        mirror_after_ok = wait_for(
+            lambda: self._mirror_resyncs_total() > mirror_before,
+            timeout=30.0 * self.mult)
+        self.report["mirror_resyncs"] = self._mirror_resyncs_total()
+        self.report["store_outages"] += 1
+        self.record("fault-done", kind="store-outage",
+                    headless_adds=headless_adds,
+                    mirror_resyncs=self.report["mirror_resyncs"],
+                    mirror_fallback_observed=mirror_after_ok)
+        if not mirror_after_ok:
+            self.report["errors"].append(
+                "no mirror-fallback resync observed across the outage")
+
+    def _mirror_resyncs_total(self) -> int:
+        total = 0
+        for name in self.agent_procs:
+            beat = self.heartbeat(name)
+            if beat:
+                total += int(beat.get("mirror_resyncs", 0))
+        return total
+
+    def fault_agent_kill(self) -> None:
+        # Kill a non-datapath agent (a datapath corpse loses its armed-
+        # fault target role for later drills; any agent works, this
+        # just keeps the drill schedule independent).
+        pool = self.names[self.cfg.datapath_agents:] or self.names
+        name = pool[self.report["agent_restarts"] % len(pool)]
+        old = self.heartbeat(name) or {}
+        self.record("fault", kind="agent-kill", agent=name,
+                    node_id=old.get("node_id"))
+        proc = self.agent_procs[name]
+        proc.kill()           # SIGKILL, mid-whatever-it-was-doing
+        proc.reap()
+        # Drop the corpse's last heartbeat so the wait below cannot pass
+        # on stale state (and the kubelet cannot re-bind to dead ports).
+        self.client.delete(HEARTBEAT_PREFIX + name)
+        self.agent_procs[name] = self._spawn_agent(name)
+        assert wait_for(
+            lambda: self.heartbeat(name) is not None,
+            timeout=90.0 * self.mult,
+        ), f"restarted agent {name} never heartbeat"
+        beat = self.heartbeat(name)
+        assert beat["node_id"] == old.get("node_id", beat["node_id"]), \
+            f"{name} lost its node ID across restart"
+        # Rebind the kubelet to the fresh ephemeral ports.
+        self.kubelets[name] = FakeKubelet(
+            grpc_server=beat["cni"], http_server=beat["rest"],
+            transport=self.kubelets[name].transport,
+        )
+        self.report["agent_restarts"] += 1
+        self.record("fault-done", kind="agent-kill", agent=name,
+                    resync_count=beat.get("resync_count"))
+
+    def fault_shard(self, flavor: str) -> None:
+        """One PR 3 drill on a datapath agent, armed over REST:
+        ``eject`` (dispatch-raise), ``hang`` (dispatch-hang deadline),
+        ``swap-fail`` (atomic-swap rollback + healing retry)."""
+        idx = self.report["shard_faults"] % max(1, self.cfg.datapath_agents)
+        name = f"node-{idx + 1}"
+        rest = self.rest_of(name)
+        assert rest, f"no REST for datapath agent {name}"
+        self.record("fault", kind=f"shard-{flavor}", agent=name)
+        shard = self.rng.randrange(self.cfg.datapath_shards)
+
+        def dp_health():
+            try:
+                return _http(rest, "/contiv/v1/health")
+            except Exception:  # noqa: BLE001
+                return {}
+
+        if flavor in ("eject", "hang"):
+            site = "dispatch-raise" if flavor == "eject" else "dispatch-hang"
+            # The hang must outlive the agent datapath's dispatch
+            # deadline (procnode arms 15s*mult) or it resolves before
+            # the supervisor ever ejects; disarm below releases the
+            # wedged worker once the ejection is observed.
+            seconds = 120.0 * self.mult if flavor == "hang" else 8.0
+            _http(rest, f"/contiv/v1/faults/arm?site={site}&shard={shard}"
+                        f"&seconds={seconds}", method="POST")
+            assert wait_for(
+                lambda: (dp_health().get("shards") or [{}] * (shard + 1)
+                         )[shard].get("state") == "ejected",
+                timeout=60.0 * self.mult,
+            ), f"{name} shard {shard} never ejected under {site}"
+            _http(rest, "/contiv/v1/faults/disarm", method="POST")
+            _http(rest, f"/contiv/v1/health/recover?shard={shard}",
+                  method="POST")
+            assert wait_for(
+                lambda: dp_health().get("shards_serving")
+                == dp_health().get("shards_total"),
+                timeout=90.0 * self.mult,
+            ), f"{name} shard {shard} never rejoined"
+        elif flavor == "swap-fail":
+            before = dp_health().get("swap_rollbacks", 0)
+            _http(rest, "/contiv/v1/faults/arm?site=swap-fail&count=1",
+                  method="POST")
+            # Force a compile+swap through the control plane.
+            self._apply_k8s("networkpolicies", {
+                "metadata": {"name": f"swapfail-{self.report['shard_faults']}",
+                             "namespace": "default"},
+                "spec": {"podSelector": {"matchLabels": dict(DB)},
+                         "policyTypes": ["Ingress"], "ingress": []},
+            })
+            assert wait_for(
+                lambda: dp_health().get("swap_rollbacks", 0) > before,
+                timeout=60.0 * self.mult,
+            ), f"{name} swap-fail never rolled back"
+            # The healing resync must land the swap on retry.
+            assert wait_for(self._healing_settled(name),
+                            timeout=90.0 * self.mult), \
+                f"{name} healing never completed after swap-fail"
+            self._delete_k8s("networkpolicies",
+                             f"swapfail-{self.report['shard_faults']}")
+        else:
+            raise ValueError(flavor)
+        self.report["shard_faults"] += 1
+        self.record("fault-done", kind=f"shard-{flavor}", agent=name,
+                    health={k: v for k, v in dp_health().items()
+                            if not isinstance(v, (list, dict))})
+
+    def _healing_settled(self, name: str):
+        def check() -> bool:
+            beat = self.heartbeat(name)
+            if not beat:
+                return False
+            ctl = beat.get("controller") or {}
+            return (not ctl.get("healing_pending")
+                    and ctl.get("healing_scheduled", 0)
+                    == ctl.get("healing_completed", 0)
+                    and ctl.get("healing_failed", 0) == 0)
+        return check
+
+    # ------------------------------------------------------------ the oracle
+
+    def expected_pods(self) -> Set[str]:
+        with self._model_lock:
+            return {f"default/{p}" for p in self.live_pods}
+
+    def wait_converged(self, context: str) -> bool:
+        """Every agent's heartbeat must agree with the conductor's pod
+        set, be alive (seq advancing), and show a settled healing
+        ledger.  Datapath agents must serve every shard."""
+        expected = self.expected_pods()
+        # Liveness: each agent's seq must ADVANCE past what it was when
+        # this check began (a frozen heartbeat with a perfect snapshot
+        # is a dead agent, not a converged one).
+        start_seqs = {n: (self.heartbeat(n) or {}).get("seq", -1)
+                      for n in self.agent_procs}
+
+        def agent_ok(name: str) -> bool:
+            beat = self.heartbeat(name)
+            if beat is None:
+                return False
+            if beat.get("seq", 0) <= start_seqs.get(name, -1) \
+                    and start_seqs.get(name, -1) >= 0:
+                return False  # heartbeat has not advanced: stalled
+            if set(beat.get("pods", ())) != expected:
+                return False
+            ctl = beat.get("controller") or {}
+            if ctl.get("healing_pending") or ctl.get("healing_failed", 0):
+                return False
+            if ctl.get("healing_scheduled", 0) != \
+                    ctl.get("healing_completed", 0):
+                return False
+            dp = beat.get("datapath")
+            if dp and dp["shards_serving"] != dp["shards_total"]:
+                return False
+            return True
+
+        ok = wait_for(lambda: all(agent_ok(n) for n in self.agent_procs),
+                      timeout=self.cfg.convergence_timeout,
+                      interval=0.25)
+        if not ok:
+            bad = [n for n in self.names if not agent_ok(n)]
+            self.report["unconverged"] += len(bad)
+            detail = {}
+            for n in bad[:4]:
+                beat = self.heartbeat(n) or {}
+                detail[n] = {
+                    "pods_delta": sorted(
+                        set(beat.get("pods", ())) ^ expected)[:6],
+                    "controller": beat.get("controller"),
+                    "datapath": beat.get("datapath"),
+                }
+            self.record("unconverged", context=context, agents=bad,
+                        detail=detail)
+            self.report["errors"].append(
+                f"unconverged after {context}: {bad}")
+        else:
+            self.record("converged", context=context,
+                        pods=len(expected))
+        # Recomputed (not accumulated): each agent's counter is already
+        # cumulative over its lifetime.
+        self.report["healing_failed"] = sum(
+            int(((self.heartbeat(n) or {}).get("controller") or {})
+                .get("healing_failed", 0))
+            for n in self.agent_procs)
+        return ok
+
+    def parity_round(self, context: str) -> bool:
+        """Trigger a probe round on every agent and assert zero
+        mock-engine verdict mismatches on the parity cohort."""
+        self.probe_round += 1
+        round_no = self.probe_round
+        self.client.put(PROBE_KEY, {"round": round_no})
+        cohort = self.names[:self.cfg.parity_agents]
+
+        def done(name: str) -> bool:
+            beat = self.heartbeat(name)
+            return bool(beat) and \
+                (beat.get("parity") or {}).get("round", 0) >= round_no
+
+        ok = wait_for(lambda: all(done(n) for n in cohort),
+                      timeout=self.cfg.convergence_timeout)
+        mismatches = 0
+        checked = 0
+        details = []
+        for name in cohort:
+            parity = (self.heartbeat(name) or {}).get("parity") or {}
+            if parity.get("round", 0) >= round_no:
+                checked += int(parity.get("checked", 0))
+                mismatches += int(parity.get("mismatches", 0))
+                if parity.get("mismatches"):
+                    details.append({name: parity.get("detail")})
+        self.report["parity_rounds"] += 1
+        self.report["parity_checked"] += checked
+        self.report["parity_mismatches"] += mismatches
+        if not ok:
+            late = [n for n in cohort if not done(n)]
+            self.report["unconverged"] += len(late)
+            self.report["errors"].append(
+                f"parity round {round_no} never completed on {late}")
+        self.record("parity", context=context, round=round_no,
+                    checked=checked, mismatches=mismatches,
+                    detail=details)
+        return ok and mismatches == 0
+
+    def collect_telemetry(self) -> None:
+        """PR 6 evidence: propagation spans + latency histograms from a
+        sample of agents, recorded alongside the soak events."""
+        for name in self.names[:3]:
+            rest = self.rest_of(name)
+            if not rest:
+                continue
+            try:
+                spans = _http(rest, "/contiv/v1/spans?limit=0")
+                self.record("telemetry-spans", agent=name,
+                            status=spans.get("status"))
+            except Exception as err:  # noqa: BLE001
+                self.record("churn-error", op="telemetry", error=str(err))
+        for name in self.names[:self.cfg.datapath_agents]:
+            rest = self.rest_of(name)
+            if not rest:
+                continue
+            try:
+                inspect = _http(rest, "/contiv/v1/inspect")
+                self.record("telemetry-latency", agent=name,
+                            latency=inspect.get("latency"),
+                            counters={
+                                k: v for k, v in
+                                (inspect.get("counters") or {}).items()
+                                if k.endswith("_total")})
+            except Exception as err:  # noqa: BLE001
+                self.record("churn-error", op="telemetry", error=str(err))
+
+    # ------------------------------------------------------------- conduct
+
+    def _fault_plan(self) -> List[Tuple[str, Optional[str]]]:
+        cfg = self.cfg
+        shard_flavors = ["eject", "swap-fail", "hang", "eject"]
+        plan: List[Tuple[str, Optional[str]]] = []
+        plan += [("leader-kill", None)] * cfg.leader_kills
+        plan += [("shard", shard_flavors[i % len(shard_flavors)])
+                 for i in range(cfg.shard_faults)]
+        plan += [("agent-kill", None)] * cfg.agent_kills
+        plan += [("store-outage", None)] * cfg.store_outages
+        self.rng.shuffle(plan)
+        # A store outage as the very first drill would stall the first
+        # churn slice's reflections before any state exists — rotate
+        # until a churn-compatible drill leads (bounded: a plan of only
+        # outages stays as shuffled).
+        for _ in range(len(plan)):
+            if plan[0][0] != "store-outage":
+                break
+            plan.append(plan.pop(0))
+        return plan
+
+    def conduct(self) -> Dict[str, Any]:
+        cfg = self.cfg
+        t0 = time.time()
+        if cfg.churn_script_path:
+            ops = load_churn(cfg.churn_script_path)
+        else:
+            ops = generate_churn(cfg)
+        script_path = self.workdir / "churn_script.jsonl"
+        save_churn(ops, str(script_path))   # the replayable record
+        self.record("churn-script", ops=len(ops), path=str(script_path))
+
+        plan = self._fault_plan()
+        # Phase 0 churn (the initial deploys) runs alone so fault drills
+        # hit a cluster that has state; from phase 1 on, churn and
+        # faults run CONCURRENTLY — the combined-fire property this
+        # soak exists to demonstrate.
+        initial, rest = ops[:cfg.pods], ops[cfg.pods:]
+        per_drill = max(1, (len(rest) + max(1, len(plan)) - 1)
+                        // max(1, len(plan)))
+        slices = [rest[i * per_drill:(i + 1) * per_drill]
+                  for i in range(max(1, len(plan)))]
+
+        churn = self.run_churn(initial)
+        churn.join()
+        self.wait_converged("initial-deploy")
+        self.parity_round("initial-deploy")
+
+        for i, (kind, arg) in enumerate(plan):
+            churn_slice = slices[i] if i < len(slices) else []
+            churn = self.run_churn(churn_slice)
+            try:
+                if kind == "leader-kill":
+                    self.fault_leader_kill()
+                elif kind == "store-outage":
+                    self.fault_store_outage()
+                elif kind == "agent-kill":
+                    self.fault_agent_kill()
+                elif kind == "shard":
+                    self.fault_shard(arg)
+            except AssertionError as err:
+                self.report["errors"].append(f"{kind}: {err}")
+                self.record("fault-failed", kind=kind, error=str(err))
+            finally:
+                churn.join()
+            self.wait_converged(f"after-{kind}")
+            self.parity_round(f"after-{kind}")
+
+        self.collect_telemetry()
+        self.report["duration_s"] = round(time.time() - t0, 1)
+        self.report["churn_ops"] = len(ops)
+        self.report["ok"] = (
+            self.report["parity_mismatches"] == 0
+            and self.report["unconverged"] == 0
+            and self.report["healing_failed"] == 0
+            and not self.report["errors"]
+        )
+        self.record("summary", **self.report)
+        return self.report
+
+    # ----------------------------------------------------------------- stop
+
+    def stop(self) -> None:
+        for proc in self.store_procs.values():
+            proc.kill(signal.SIGCONT)  # un-freeze before killing
+        for proc in list(self.agent_procs.values()):
+            proc.kill(signal.SIGTERM)
+        for proc in list(self.agent_procs.values()):
+            proc.reap()
+        for proc in self.store_procs.values():
+            proc.kill()
+            proc.reap()
+        if self.client is not None:
+            self.client.close()
+        if self._out_fh is not None:
+            self._out_fh.close()
+
+
+def run_soak(cfg: SoakConfig) -> Dict[str, Any]:
+    cluster = SoakCluster(cfg)
+    try:
+        cluster.start()
+        return cluster.conduct()
+    finally:
+        cluster.stop()
