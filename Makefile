@@ -5,9 +5,10 @@ PY ?= python
 
 .PHONY: test test-race verify verify-ha verify-churn verify-faults \
         verify-adaptive verify-static verify-telemetry verify-soak soak \
-        lint bench \
+        verify-cluster-obs lint bench \
         bench-suite bench-sweep bench-scale bench-latency bench-frames \
-        bench-churn bench-adaptive images native native-sanitize
+        bench-churn bench-adaptive bench-history images native \
+        native-sanitize
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -131,6 +132,20 @@ verify-soak:
 	    -q $(if $(RUN_SLOW),,-m 'not slow') --continue-on-collection-errors \
 	    -p no:cacheprovider -p no:xdist -p no:randomly
 
+# Cluster-observability verification (ISSUE 10): span stitching and
+# histogram cross-node merge properties, the fleet aggregator's
+# partial-failure contract (unreachable/SIGSTOPped agents are reported
+# gaps with last-seen ages, never hangs), a procnode multi-agent run
+# asserting one store write stitches into a cluster span covering all
+# nodes with monotone adoption lags, `netctl cluster` with a dead agent
+# (gap shown, exit 0), and the dispatch round-chain attribution — plus
+# the static gate with the cluster-surface obs-parity pins.
+verify-cluster-obs:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_cluster_obs.py \
+	    -q $(if $(RUN_SLOW),,-m 'not slow') --continue-on-collection-errors \
+	    -p no:cacheprovider -p no:xdist -p no:randomly
+	$(PY) scripts/check_static.py vpp_tpu/ --rule obs-parity
+
 # The full mega-cluster chaos soak (the ISSUE 9 acceptance run): ≥50
 # agents, ≥1000 pod ADD/DEL through the real exec'd CNI shim, ≥2 leader
 # kills, ≥2 store-outage windows, ≥4 shard faults, ≥2 agent restarts —
@@ -142,7 +157,7 @@ soak:
 # The aggregate verification gate: static battery + every subsystem's
 # verify target, soak-smoke included.
 verify: lint verify-static verify-ha verify-churn verify-adaptive \
-        verify-telemetry verify-faults verify-soak
+        verify-telemetry verify-faults verify-cluster-obs verify-soak
 	@echo verify OK
 
 bench:
@@ -162,6 +177,13 @@ bench-latency:
 
 bench-frames:
 	$(PY) scripts/frame_bench.py
+
+# Perf trajectory across every recorded BENCH*_r* artifact: one
+# series-per-metric view with round-over-round deltas and regression
+# flags (ISSUE 10 satellite) — a reader over the recorded evidence,
+# never a re-run.  BENCH_HISTORY_CHECK=1 exits nonzero on regressions.
+bench-history:
+	$(PY) scripts/bench_history.py $(if $(BENCH_HISTORY_CHECK),--check)
 
 native:
 	$(MAKE) -C native/hostshim

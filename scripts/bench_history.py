@@ -1,0 +1,281 @@
+#!/usr/bin/env python
+"""Perf-trajectory report over the repo's recorded bench artifacts.
+
+ISSUE 10 satellite: every PR re-records benches into per-round files
+(``BENCH_r05.json``, ``BENCHSUITE_r05.jsonl``, ``BENCHSWEEP_r04.jsonl``
+...), which makes any single round readable and the TRAJECTORY
+unreadable — "did config 3 regress between r3 and r5" means hand-diffing
+five files.  This script consolidates every ``BENCH*_r*`` artifact in
+the repo root into one series-per-metric view:
+
+- the **headline** series from ``BENCH_r*.json`` (median Mpps + the
+  capability band when recorded);
+- every ``*.jsonl`` suite keyed by its rows' ``config``/``metric``
+  label, tracking ``value`` (plus unit) per round;
+- per-series round-over-round deltas, with a REGRESSION flag when the
+  newest round drops more than ``--threshold`` (default 10%) below the
+  previous recorded round.
+
+Usage::
+
+    python scripts/bench_history.py                # table to stdout
+    python scripts/bench_history.py --json         # machine-readable
+    python scripts/bench_history.py --check        # exit 1 on regressions
+    make bench-history
+
+Flags regressions, never re-runs benches: this is a reader over the
+recorded evidence (stdlib only, safe anywhere).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+_ROUND_RE = re.compile(r"_r(\d+)\.jsonl?$")
+
+
+def _round_of(path: pathlib.Path) -> Optional[int]:
+    m = _ROUND_RE.search(path.name)
+    return int(m.group(1)) if m else None
+
+
+def _series_of(path: pathlib.Path) -> str:
+    """BENCHSWEEP_r04.jsonl → BENCHSWEEP; BENCH_headline_r02.json →
+    BENCH_headline; BENCH_r05.json → BENCH."""
+    return _ROUND_RE.sub("", path.name)
+
+
+def _headline_value(obj: dict) -> Optional[dict]:
+    """Extract the headline record from a BENCH_r*.json wrapper: the
+    pre-parsed block when present, else the last JSON line with a
+    "metric" key in the captured stdout tail."""
+    parsed = obj.get("parsed")
+    if isinstance(parsed, dict) and "value" in parsed:
+        return parsed
+    best = None
+    for line in (obj.get("tail") or "").splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and '"metric"' in line):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "value" in rec:
+            best = rec
+    return best
+
+
+def _jsonl_rows(path: pathlib.Path) -> List[dict]:
+    rows = []
+    try:
+        for line in path.read_text().splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                rows.append(rec)
+    except OSError:
+        return []
+    return rows
+
+
+# Label-ish fields that identify a row's series (joined in this order)
+# and numeric discriminators that keep parameter-sweep rows apart.
+_KEY_FIELDS = ("sweep", "scale", "lat", "bench", "config", "metric",
+               "mode", "variant", "side", "op", "discipline", "name",
+               "tier", "case", "backend")
+_KEY_INTS = ("dispatch_pkts", "vectors", "devices", "batch", "rules",
+             "pods", "services", "shards", "agents")
+# One primary value per row, by priority; rows with none fall back to
+# every ``*_mpps`` field as sub-series (the sweep files compare
+# disciplines side by side in one row).
+_VALUE_FIELDS = ("value", "achieved_mpps_median", "median_mpps", "median",
+                 "mpps", "speedup", "p50_step_us", "p50_ms", "p50_us")
+
+
+def _row_key(rec: dict) -> Optional[str]:
+    """A stable per-row series key inside one suite file."""
+    parts = [str(rec[f]) for f in _KEY_FIELDS
+             if isinstance(rec.get(f), str)]
+    parts += [f"{f}={rec[f]}" for f in _KEY_INTS
+              if isinstance(rec.get(f), int)]
+    return "/".join(parts) if parts else None
+
+
+def _row_values(rec: dict) -> Dict[str, float]:
+    """{value-field: value} — usually one primary value, else every
+    ``*_mpps`` column as its own sub-series."""
+    for field in _VALUE_FIELDS:
+        v = rec.get(field)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return {field: float(v)}
+    return {
+        f: float(v) for f, v in rec.items()
+        if f.endswith("_mpps") and isinstance(v, (int, float))
+        and not isinstance(v, bool)
+    }
+
+
+def collect(root: pathlib.Path) -> Dict[str, Dict[str, Dict[int, float]]]:
+    """{suite: {series_key: {round: value}}} over every BENCH* artifact
+    in the repo root (plus SOAK/FRAMEBENCH/MESHOVERHEAD and friends —
+    anything matching ``*_rNN.json[l]`` with value-shaped rows)."""
+    out: Dict[str, Dict[str, Dict[int, float]]] = {}
+    for path in sorted(root.glob("*_r[0-9]*.json*")):
+        rnd = _round_of(path)
+        if rnd is None:
+            continue
+        suite = _series_of(path)
+        if path.suffix == ".json":
+            try:
+                obj = json.loads(path.read_text())
+            except (OSError, ValueError):
+                continue
+            rec = _headline_value(obj) if isinstance(obj, dict) else None
+            if rec is not None:
+                series = out.setdefault(suite, {})
+                series.setdefault("headline", {})[rnd] = float(rec["value"])
+                cap = rec.get("capability")
+                if isinstance(cap, dict) and "median" in cap:
+                    series.setdefault("capability", {})[rnd] = \
+                        float(cap["median"])
+            continue
+        for rec in _jsonl_rows(path):
+            key = _row_key(rec)
+            if key is None:
+                continue
+            for field, value in _row_values(rec).items():
+                series_key = key if field == "value" else f"{key}.{field}"
+                # Last row wins per (key, round): suites append
+                # refinements within one recording.
+                out.setdefault(suite, {}).setdefault(
+                    series_key, {})[rnd] = value
+    return out
+
+
+def trajectory(history: Dict[str, Dict[str, Dict[int, float]]],
+               threshold: float) -> Tuple[List[dict], List[dict]]:
+    """Flatten into report rows + the regression list.  A regression is
+    the LATEST round dropping > threshold below the round before it
+    (older dips that later recovered are history, not action items)."""
+    rows: List[dict] = []
+    regressions: List[dict] = []
+    for suite in sorted(history):
+        for key in sorted(history[suite]):
+            points = history[suite][key]
+            rounds = sorted(points)
+            if not rounds:
+                continue
+            latest = rounds[-1]
+            prev = rounds[-2] if len(rounds) >= 2 else None
+            delta_pct = None
+            flagged = False
+            if prev is not None and points[prev]:
+                delta_pct = 100.0 * (points[latest] - points[prev]) \
+                    / abs(points[prev])
+                # Direction comes from the measured FIELD (the series
+                # suffix collect() appended): time-valued fields regress
+                # UPWARD, throughput-valued ones downward.  Substring
+                # checks on labels are a trap ("flat" contains "lat").
+                field = key.rsplit(".", 1)[-1] if "." in key else "value"
+                lower_is_better = (field.endswith(("_us", "_ms"))
+                                   or "overhead" in field
+                                   or "latency" in field)
+                if lower_is_better:
+                    flagged = delta_pct > threshold * 100.0
+                else:
+                    flagged = delta_pct < -threshold * 100.0
+            row = {
+                "suite": suite,
+                "series": key,
+                "rounds": rounds,
+                "values": {f"r{r:02d}": points[r] for r in rounds},
+                "latest": points[latest],
+                "delta_pct": (round(delta_pct, 1)
+                              if delta_pct is not None else None),
+                "regression": flagged,
+            }
+            rows.append(row)
+            if flagged:
+                regressions.append(row)
+    return rows, regressions
+
+
+def _render(rows: List[dict], out) -> None:
+    widths = None
+    header = ["SUITE", "SERIES", "TREND", "LATEST", "DELTA%", "FLAG"]
+    table = []
+    for row in rows:
+        trend = " ".join(
+            f"r{r:02d}:{row['values'][f'r{r:02d}']:g}"
+            for r in row["rounds"][-4:])
+        table.append([
+            row["suite"], row["series"][:44], trend,
+            f"{row['latest']:g}",
+            "-" if row["delta_pct"] is None else f"{row['delta_pct']:+.1f}",
+            "REGRESSION" if row["regression"] else "",
+        ])
+    all_rows = [header] + table
+    widths = [max(len(str(r[i])) for r in all_rows)
+              for i in range(len(header))]
+    for i, r in enumerate(all_rows):
+        print("  ".join(str(c).ljust(w) for c, w in zip(r, widths)).rstrip(),
+              file=out)
+        if i == 0:
+            print("  ".join("-" * w for w in widths), file=out)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--root", default=str(REPO),
+                        help="directory holding the BENCH* artifacts")
+    parser.add_argument("--threshold", type=float, default=0.10,
+                        help="regression flag threshold (fraction)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable report")
+    parser.add_argument("--out", default="",
+                        help="also write the JSON report to this path")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 when any series regressed")
+    args = parser.parse_args(argv)
+
+    history = collect(pathlib.Path(args.root))
+    rows, regressions = trajectory(history, args.threshold)
+    report = {"series": rows, "regressions": regressions,
+              "threshold": args.threshold}
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        if not rows:
+            print("bench_history: no BENCH*_r* artifacts found",
+                  file=sys.stderr)
+            return 1
+        _render(rows, sys.stdout)
+        print(f"\n{len(rows)} series across "
+              f"{len({r['suite'] for r in rows})} suites; "
+              f"{len(regressions)} regression(s) at "
+              f"{args.threshold:.0%} threshold")
+        for row in regressions:
+            print(f"REGRESSION {row['suite']}/{row['series']}: "
+                  f"{row['delta_pct']:+.1f}% at latest round")
+    if args.out:
+        pathlib.Path(args.out).write_text(json.dumps(report, indent=1))
+    if args.check and regressions:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Fleet observability sweep — the cluster aggregator as a script.
+
+ISSUE 10: one command that answers the fleet-scope questions the
+per-node surfaces cannot — cluster percentile latency, cross-node
+propagation spans (one store write stitched over every node that
+adopted it), per-node health rollups, straggler detection — with
+unreachable agents reported as gaps (last-seen age), never hangs.
+
+Agents come from either:
+
+- ``--servers name=host:port,...`` — an explicit list, or
+- ``--store host:port[,host:port...]`` — heartbeat discovery off the
+  cluster store (the procnode/soak convention: every agent's beat
+  carries its REST address), which keeps following agents across
+  SIGKILL-restarts onto fresh ephemeral ports.
+
+Examples::
+
+    python scripts/cluster_obs.py --servers a=127.0.0.1:9001,b=... top
+    python scripts/cluster_obs.py --store 127.0.0.1:7001 latency
+    python scripts/cluster_obs.py --store 127.0.0.1:7001 spans --watch 5
+    python scripts/cluster_obs.py --servers ... --json > fleet.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from vpp_tpu.netctl.cli import cmd_cluster, parse_servers  # noqa: E402
+from vpp_tpu.statscollector.cluster import (  # noqa: E402
+    ClusterScraper,
+    heartbeat_servers,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("action", nargs="?", default="top",
+                        choices=["top", "latency", "spans"])
+    parser.add_argument("--servers", default="",
+                        help="explicit agent list (name=host:port,...)")
+    parser.add_argument("--store", default="",
+                        help="discover agents from this store's "
+                             "heartbeats (HA member list accepted)")
+    parser.add_argument("--heartbeat-prefix",
+                        default="/vpp-tpu/test/heartbeat/")
+    parser.add_argument("--timeout", type=float, default=3.0)
+    parser.add_argument("--limit", type=int, default=10)
+    parser.add_argument("--straggler-factor", type=float, default=3.0)
+    parser.add_argument("--json", action="store_true",
+                        help="dump the full summary as JSON")
+    parser.add_argument("--watch", type=float, default=0.0,
+                        help="re-sweep every N seconds (Ctrl-C stops)")
+    args = parser.parse_args(argv)
+
+    if args.store:
+        from vpp_tpu.kvstore.remote import RemoteKVStore
+
+        store = RemoteKVStore(args.store)
+
+        def servers():
+            return heartbeat_servers(store, args.heartbeat_prefix)
+    elif args.servers:
+        servers = parse_servers(args.servers)
+    else:
+        parser.error("need --servers or --store")
+
+    # ONE scraper for the process lifetime: under --watch its last-seen
+    # map persists across sweeps, so a node that dies mid-watch shows a
+    # real "last-seen Ns ago" age in its gap row (a fresh scraper per
+    # sweep would print "never" forever).
+    scraper = ClusterScraper(servers, timeout=args.timeout,
+                             straggler_factor=args.straggler_factor)
+
+    def sweep() -> int:
+        if not scraper.servers():
+            print("cluster_obs: no agents discovered", file=sys.stderr)
+            return 1
+        if args.json:
+            summary = scraper.summary()
+            print(json.dumps(summary, indent=1, default=str))
+            # Same contract as the rendered paths: success only while
+            # ANY agent answered (exit-code alerting must see a fully
+            # dark fleet as a failure, JSON mode included).
+            return 0 if summary.get("nodes_ok") else 1
+        return cmd_cluster(sys.stdout, args.action, limit=args.limit,
+                           scraper=scraper)
+
+    code = sweep()
+    try:
+        while args.watch > 0:
+            time.sleep(args.watch)
+            print()
+            code = sweep()
+    except KeyboardInterrupt:
+        pass
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

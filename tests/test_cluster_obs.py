@@ -1,0 +1,627 @@
+"""Cluster observability plane (ISSUE 10).
+
+Four layers, each tested where it lives:
+
+- **Stitching/merge math** (pure units): cross-node span stitching by
+  store revision — monotone adoption lags, straggler naming, the
+  latest-span-per-(node, revision) rule; bucket-exact histogram merges
+  across snapshots (property-tested against direct in-process merges);
+  node-skew detection.
+- **Aggregator contract**: concurrent scrapes with per-request
+  timeouts; an unreachable agent is a REPORTED GAP (named, with
+  last-seen age) — never a hang, never a silent omission — including
+  the nastiest shape: a SIGSTOPped procnode whose socket accepts and
+  never answers (regression for the ISSUE 10 fix).
+- **Cross-process integration**: a real multi-agent procnode cluster
+  over a live store — one store write produces a stitched cluster span
+  covering ALL nodes (same revision on every agent, monotone lags);
+  ``netctl cluster latency|spans|top`` renders merged percentiles with
+  one agent deliberately dead, shown as a gap, exit 0.
+- **Round-chain attribution** (satellite): a driven runner splits its
+  dispatch wall into wait/materialize/restore/stitch histograms under
+  ``inspect()["dispatch"]["rounds"]``, merged across shards.
+"""
+
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+import jax.numpy as jnp
+
+from vpp_tpu.datapath import (
+    DataplaneRunner,
+    InMemoryRing,
+    NativeRing,
+    ShardedDataplane,
+    VxlanOverlay,
+)
+from vpp_tpu.netctl.cli import main as netctl_main, parse_servers
+from vpp_tpu.ops.classify import build_rule_tables
+from vpp_tpu.ops.nat import build_nat_tables
+from vpp_tpu.ops.packets import ip_to_u32
+from vpp_tpu.ops.pipeline import RouteConfig
+from vpp_tpu.statscollector.cluster import ClusterScraper, heartbeat_servers
+from vpp_tpu.telemetry import Log2Histogram
+from vpp_tpu.telemetry.cluster import (
+    latency_skew,
+    merge_latency_snapshots,
+    stitch_spans,
+)
+from vpp_tpu.testing.cluster import wait_for
+from vpp_tpu.testing.frames import build_frame
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Stitching units
+# ---------------------------------------------------------------------------
+
+
+def _span(rev, started, total_us, node_unused=None, event="KubeStateChange",
+          propagated=True):
+    return {"revision": rev, "started": started, "total_us": total_us,
+            "event": event, "detail": f"rev {rev}", "propagated": propagated,
+            "span_id": rev, "stages": []}
+
+
+def test_stitch_groups_by_revision_with_monotone_lags():
+    t0 = 1000.0
+    per_node = {
+        "node-1": [_span(7, t0, 100.0), _span(8, t0 + 5, 80.0)],
+        "node-2": [_span(7, t0 + 0.001, 200.0), _span(8, t0 + 5.002, 90.0)],
+        "node-3": [_span(7, t0 + 0.010, 150.0)],
+    }
+    out = stitch_spans(per_node)
+    assert [s["revision"] for s in out] == [8, 7]  # newest first
+    seven = out[1]
+    assert seven["nodes"] == 3
+    assert set(seven["node_names"]) == {"node-1", "node-2", "node-3"}
+    # Anchor = earliest start; lags ordered and consistent.
+    assert seven["anchor"] == t0
+    assert seven["first_node"] == "node-1"
+    assert 0 <= seven["first_lag_us"] <= seven["p50_lag_us"] \
+        <= seven["p99_lag_us"] <= seven["last_lag_us"]
+    # node-3: (t0+0.010 + 150us) - t0 = 10150us — the wavefront's tail.
+    assert seven["last_node"] == "node-3"
+    assert seven["last_lag_us"] == pytest.approx(10150.0, abs=1.0)
+    assert seven["propagated_nodes"] == 3
+    # Revision 8 was seen by only two nodes; still stitched (>= 2).
+    assert out[0]["nodes"] == 2
+
+
+def test_stitch_drops_lone_revisions_and_zero_revisions():
+    per_node = {
+        "node-1": [_span(5, 1.0, 10.0), _span(0, 1.0, 10.0)],
+        "node-2": [_span(0, 1.0, 10.0)],
+    }
+    assert stitch_spans(per_node) == []
+    # min_nodes=1 keeps the lone revision (single-node clusters).
+    assert len(stitch_spans(per_node, min_nodes=1)) == 1
+
+
+def test_stitch_names_stragglers():
+    t0 = 50.0
+    per_node = {f"node-{i}": [_span(3, t0, 100.0)] for i in range(1, 9)}
+    # One node adopts 3 seconds late: >> 3x the ~100us median.
+    per_node["node-9"] = [_span(3, t0 + 3.0, 100.0)]
+    out = stitch_spans(per_node)
+    assert len(out) == 1
+    stragglers = out[0]["stragglers"]
+    assert [s["node"] for s in stragglers] == ["node-9"]
+    assert stragglers[0]["lag_us"] > 1e6
+
+
+def test_stitch_keeps_latest_span_per_node_revision():
+    """A node that replayed the same revision (mirror resync) counts
+    once, with its LATEST span."""
+    per_node = {
+        "node-1": [_span(4, 10.0, 100.0), _span(4, 20.0, 100.0)],
+        "node-2": [_span(4, 10.0, 100.0)],
+    }
+    out = stitch_spans(per_node)
+    assert out[0]["nodes"] == 2
+    assert out[0]["last_node"] == "node-1"
+    assert out[0]["last_lag_us"] == pytest.approx(10.0 * 1e6 + 100, abs=1.0)
+
+
+# ---------------------------------------------------------------------------
+# Histogram cross-node merge property
+# ---------------------------------------------------------------------------
+
+
+def test_merge_snapshots_property_equals_direct_merge():
+    """Recording into N per-node histograms, snapshotting each (the
+    REST wire form), and merging the snapshots must equal merging the
+    histograms directly — exact bucket counts, identical percentiles."""
+    import random
+
+    rng = random.Random(42)
+    nodes = {}
+    direct = []
+    for n in range(5):
+        h = Log2Histogram()
+        for _ in range(rng.randrange(0, 400)):
+            h.record_us(rng.uniform(0, 1e6) ** rng.uniform(0.5, 1.0))
+        nodes[f"node-{n}"] = {"dispatch_rt": h.snapshot()}
+        direct.append(h)
+    merged = merge_latency_snapshots(nodes, names=("dispatch_rt",))
+    expect = Log2Histogram().merged(direct)
+    assert merged["dispatch_rt"]["count"] == expect.count
+    for q in ("p50", "p90", "p99", "p999"):
+        assert merged["dispatch_rt"][q] == expect.snapshot()[q]
+    assert merged["dispatch_rt"]["sum_us"] == \
+        pytest.approx(expect.sum_us, rel=1e-6)
+
+
+def test_merge_snapshots_tolerates_missing_and_empty_nodes():
+    h = Log2Histogram()
+    h.record_us(100.0)
+    nodes = {
+        "with": {"dispatch_rt": h.snapshot()},
+        "empty": {"dispatch_rt": Log2Histogram().snapshot()},
+        "absent": {},
+        "none": None,
+    }
+    merged = merge_latency_snapshots(nodes, names=("dispatch_rt",))
+    assert merged["dispatch_rt"]["count"] == 1
+
+
+def test_latency_skew_flags_straggler_node():
+    def snap(us, n=50):
+        h = Log2Histogram()
+        for _ in range(n):
+            h.record_us(us)
+        return {"dispatch_rt": h.snapshot()}
+
+    per_node = {f"node-{i}": snap(100.0) for i in range(6)}
+    per_node["node-slow"] = snap(5000.0)
+    per_node["node-idle"] = {"dispatch_rt": Log2Histogram().snapshot()}
+    skew = latency_skew(per_node)
+    assert [s["node"] for s in skew["stragglers"]] == ["node-slow"]
+    assert skew["cluster_median_us"] <= 256.0
+    # The idle node contributes a row but never a straggler verdict.
+    rows = {r["node"]: r for r in skew["per_node"]}
+    assert rows["node-idle"]["samples"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Aggregator contract (in-process agents + dead/hung sockets)
+# ---------------------------------------------------------------------------
+
+
+def make_route():
+    return RouteConfig(
+        pod_subnet_base=jnp.asarray(ip_to_u32("10.1.0.0"), dtype=jnp.uint32),
+        pod_subnet_mask=jnp.asarray(0xFFFF0000, dtype=jnp.uint32),
+        this_node_base=jnp.asarray(ip_to_u32("10.1.1.0"), dtype=jnp.uint32),
+        this_node_mask=jnp.asarray(0xFFFFFF00, dtype=jnp.uint32),
+        host_bits=jnp.asarray(8, dtype=jnp.int32),
+    )
+
+
+def make_runner(**kw):
+    rings = [InMemoryRing() for _ in range(4)]
+    kw.setdefault("batch_size", 8)
+    kw.setdefault("max_vectors", 2)
+    runner = DataplaneRunner(
+        acl=build_rule_tables([], {}),
+        nat=build_nat_tables(
+            [], nat_loopback="10.1.1.254", snat_ip="192.168.16.1",
+            snat_enabled=True, pod_subnet="10.1.0.0/16",
+        ),
+        route=make_route(),
+        overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"),
+                             local_node_id=1),
+        source=rings[0], tx=rings[1], local=rings[2], host=rings[3],
+        **kw,
+    )
+    return runner, rings
+
+
+@pytest.fixture()
+def rest_agents():
+    """Two in-process AgentRestServers, one with a driven datapath."""
+    from vpp_tpu.controller.eventloop import Controller
+    from vpp_tpu.controller.txn import TxnSink
+    from vpp_tpu.rest.server import AgentRestServer
+
+    class Sink(TxnSink):
+        def commit(self, txn):
+            pass
+
+    stops = []
+    servers = {}
+    runner, rings = make_runner()
+    rings[0].send([build_frame("10.1.1.2", "10.1.1.3", 6, 40000 + i, 80)
+                   for i in range(16)])
+    runner.drain()
+    for name, datapath in (("node-a", runner), ("node-b", None)):
+        ctl = Controller(handlers=[], sink=Sink())
+        ctl.start()
+        rest = AgentRestServer(node_name=name, controller=ctl,
+                               datapath=datapath, port=0)
+        port = rest.start()
+        servers[name] = f"127.0.0.1:{port}"
+        stops.append((rest, ctl))
+    yield servers, runner
+    for rest, ctl in stops:
+        rest.stop()
+        ctl.stop()
+    runner.close()
+
+
+def test_scraper_partial_failure_reports_gap_not_omission(rest_agents):
+    servers, _ = rest_agents
+    targets = dict(servers)
+    targets["node-dead"] = "127.0.0.1:1"  # nothing listens here
+    scraper = ClusterScraper(targets, timeout=2.0)
+    t0 = time.monotonic()
+    scrapes = scraper.scrape()
+    assert time.monotonic() - t0 < 20.0
+    by_node = {s.node: s for s in scrapes}
+    assert len(scrapes) == 3          # every configured node reported
+    assert by_node["node-a"].ok and by_node["node-b"].ok
+    dead = by_node["node-dead"]
+    assert not dead.ok and dead.error
+    assert dead.last_seen_age_s is None   # never seen
+    # The rollup carries the gap as data.
+    summary = scraper.summary(scrapes)
+    assert summary["nodes_ok"] == 2
+    assert summary["nodes_unreachable"] == 1
+    assert [g["node"] for g in summary["gaps"]] == ["node-dead"]
+    # node-b has no datapath: its inspect 404s but it is NOT a gap.
+    assert by_node["node-b"].inspect is None
+    # Cluster latency merged from the one datapath node.
+    lat = summary["latency"]["dispatch_rt"]
+    assert lat["count"] > 0 and lat["p99"] >= lat["p50"] > 0
+
+
+def test_scraper_tracks_last_seen_age_across_sweeps(rest_agents):
+    servers, _ = rest_agents
+    scraper = ClusterScraper(dict(servers), timeout=2.0)
+    scraper.scrape()
+
+    # The same scraper re-pointed at a dead port (agent died between
+    # sweeps): the gap carries how stale our last good view is.
+    scraper._servers = {"node-a": "127.0.0.1:1",
+                        "node-b": servers["node-b"]}
+    time.sleep(0.05)
+    by_node = {s.node: s for s in scraper.scrape()}
+    assert not by_node["node-a"].ok
+    assert by_node["node-a"].last_seen_age_s is not None
+    assert by_node["node-a"].last_seen_age_s >= 0.05
+
+
+def test_spanless_agent_is_partial_stack_not_gap():
+    """An agent serving health/inspect but 404ing /contiv/v1/spans (no
+    span tracker wired — the REST absent-component contract) must scrape
+    as OK with spans=None, never as an unreachable gap."""
+    from vpp_tpu.rest.server import AgentRestServer
+
+    runner, rings = make_runner()
+    rings[0].send([build_frame("10.1.1.2", "10.1.1.3", 6, 43000, 80)])
+    runner.drain()
+    rest = AgentRestServer(node_name="spanless", datapath=runner, port=0)
+    port = rest.start()
+    try:
+        scraper = ClusterScraper({"spanless": f"127.0.0.1:{port}"},
+                                 timeout=2.0)
+        scrapes = scraper.scrape()
+        assert scrapes[0].ok, scrapes[0].error
+        assert scrapes[0].spans is None
+        assert scrapes[0].health is not None
+        summary = scraper.summary(scrapes)
+        assert summary["nodes_ok"] == 1 and not summary["gaps"]
+        assert summary["latency"]["dispatch_rt"]["count"] > 0
+    finally:
+        rest.stop()
+        runner.close()
+
+
+def test_scraper_bounded_on_accepting_but_silent_socket(rest_agents):
+    """The SIGSTOP shape without the process: a socket that ACCEPTS
+    (kernel backlog) and never answers must cost ~one timeout and come
+    back as a gap."""
+    import socket
+
+    servers, _ = rest_agents
+    silent = socket.socket()
+    silent.bind(("127.0.0.1", 0))
+    silent.listen(1)
+    try:
+        targets = dict(servers)
+        targets["node-frozen"] = f"127.0.0.1:{silent.getsockname()[1]}"
+        scraper = ClusterScraper(targets, timeout=1.5)
+        t0 = time.monotonic()
+        summary = scraper.summary()
+        elapsed = time.monotonic() - t0
+        assert elapsed < 15.0, f"scrape hung {elapsed:.1f}s on a silent socket"
+        assert [g["node"] for g in summary["gaps"]] == ["node-frozen"]
+        assert summary["nodes_ok"] == 2
+    finally:
+        silent.close()
+
+
+def test_netctl_cluster_latency_with_dead_agent_exits_zero(rest_agents):
+    servers, _ = rest_agents
+    spec = ",".join(f"{n}={s}" for n, s in servers.items())
+    spec += ",node-dead=127.0.0.1:1"
+    out = io.StringIO()
+    rc = netctl_main(["cluster", "latency", "--servers", spec,
+                      "--timeout", "2.0"], out=out)
+    text = out.getvalue()
+    assert rc == 0, text
+    assert "GAP node-dead" in text
+    assert "2/3 agents reporting" in text
+    assert "dispatch_rt:" in text and "p99=" in text
+    # top + spans render over the same sweep shape without error.
+    for action in ("top", "spans"):
+        out = io.StringIO()
+        assert netctl_main(["cluster", action, "--servers", spec,
+                            "--timeout", "2.0"], out=out) == 0
+    # All agents dead -> exit 1 (no fleet view at all).
+    out = io.StringIO()
+    assert netctl_main(["cluster", "latency", "--servers",
+                        "a=127.0.0.1:1", "--timeout", "1.0"],
+                       out=out) == 1
+
+
+def test_parse_servers_forms():
+    assert parse_servers("a=1.2.3.4:80,b=5.6.7.8:81") == \
+        {"a": "1.2.3.4:80", "b": "5.6.7.8:81"}
+    assert parse_servers("1.2.3.4:80") == {"1.2.3.4:80": "1.2.3.4:80"}
+    assert parse_servers("") == {}
+
+
+def test_shape_cluster_panel_schema(rest_agents):
+    from vpp_tpu.uibackend.views import shape_cluster
+
+    servers, _ = rest_agents
+    scraper = ClusterScraper(dict(servers), timeout=2.0)
+    shaped = shape_cluster(scraper.summary())
+    assert shaped["nodes_ok"] == 2
+    assert {r["node"] for r in shaped["per_node"]} == set(servers)
+    assert shaped["latency"]["dispatch_rt"]["count"] > 0
+    assert shape_cluster(None) == {}
+    assert shape_cluster({}) == {}
+
+
+# ---------------------------------------------------------------------------
+# Round-chain attribution (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_rounds_attribution_in_inspect():
+    runner, rings = make_runner()
+    rings[0].send([build_frame("10.1.1.2", "10.1.1.3", 6, 41000 + i, 80)
+                   for i in range(32)])
+    runner.drain()
+    rounds = runner.inspect()["dispatch"]["rounds"]
+    assert set(rounds) == {"wait", "materialize", "restore", "stitch"}
+    n = rounds["materialize"]["count"]
+    assert n > 0
+    # Every round saw every harvested dispatch, and the device block
+    # (materialize) actually took measurable time.
+    assert all(rounds[name]["count"] == n for name in rounds)
+    assert rounds["materialize"]["sum_us"] > 0
+    assert rounds["materialize"]["p99"] >= rounds["materialize"]["p50"]
+    runner.close()
+
+
+def test_rounds_merge_across_shards():
+    def ios(n):
+        return [tuple(NativeRing() for _ in range(4)) for _ in range(n)]
+
+    dp = ShardedDataplane(
+        acl=build_rule_tables([], {}),
+        nat=build_nat_tables(
+            [], nat_loopback="10.1.1.254", snat_ip="192.168.16.1",
+            snat_enabled=True, pod_subnet="10.1.0.0/16",
+        ),
+        route=make_route(),
+        overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"),
+                             local_node_id=1),
+        shard_ios=ios(2), batch_size=8, max_vectors=2,
+    )
+    try:
+        for i, r in enumerate(dp.shards):
+            r.source.send(
+                [build_frame("10.1.1.2", "10.1.1.3", 6, 42000 + 10 * i + j,
+                             80) for j in range(8)])
+        dp.drain()
+        merged = dp.inspect()["dispatch"]["rounds"]
+        per_shard = [r.rounds["materialize"].count for r in dp.shards]
+        assert all(c > 0 for c in per_shard)
+        assert merged["materialize"]["count"] == sum(per_shard)
+    finally:
+        dp.close()
+
+
+# ---------------------------------------------------------------------------
+# Cross-process integration: procnode cluster, stitching, SIGSTOP
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def procnode_cluster(tmp_path_factory):
+    """A live 3-agent procnode cluster over an in-process store, with a
+    KSR feeding it k8s state — the smallest real cluster that can
+    stitch a span across every node."""
+    from vpp_tpu.ksr import KSRPlugin, KVBroker
+    from vpp_tpu.kvstore import KVStore, KVStoreServer
+    from vpp_tpu.testing.k8s import FakeK8sCluster
+    from vpp_tpu.testing.procnode import HEARTBEAT_PREFIX
+
+    store = KVStore()
+    server = KVStoreServer(store)
+    port = server.start()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.setdefault("OMP_NUM_THREADS", "1")
+    names = ("node-1", "node-2", "node-3")
+
+    def spawn(name, datapath=0):
+        argv = [sys.executable, "-m", "vpp_tpu.testing.procnode",
+                "--store", f"127.0.0.1:{port}", "--name", name,
+                "--rest-port", "0", "--heartbeat-interval", "0.2"]
+        if datapath:
+            argv += ["--datapath", str(datapath)]
+        return subprocess.Popen(argv, env=env, cwd=REPO,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+
+    children = {"node-1": spawn("node-1", datapath=1),
+                "node-2": spawn("node-2", datapath=1),
+                "node-3": spawn("node-3")}
+
+    def beat(name):
+        return store.get(HEARTBEAT_PREFIX + name) or {}
+
+    k8s = FakeK8sCluster()
+    ksr = KSRPlugin(k8s, KVBroker(store))
+    ksr.init(start_monitor=False)
+    try:
+        assert wait_for(lambda: all(beat(n).get("rest") for n in names),
+                        timeout=120), \
+            {n: bool(beat(n).get("rest")) for n in names}
+        yield store, k8s, children, beat, names
+    finally:
+        for child in children.values():
+            child.terminate()
+        for child in children.values():
+            try:
+                child.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                child.kill()
+                child.wait(timeout=10)
+        server.stop()
+
+
+def _cluster_scraper(store, beat, names, **kw):
+    def servers():
+        return {n: beat(n)["rest"] for n in names if beat(n).get("rest")}
+    kw.setdefault("timeout", 5.0)
+    return ClusterScraper(servers, **kw)
+
+
+def test_one_store_write_stitches_across_all_nodes(procnode_cluster):
+    """The tentpole property: ONE k8s write → every agent's controller
+    mints a span carrying the same store revision → the aggregator
+    stitches a cluster span covering all N nodes with monotone lags."""
+    store, k8s, children, beat, names = procnode_cluster
+    rev_before = store.revision
+    k8s.apply("pods", {
+        "metadata": {"name": "stitch-pod", "namespace": "default",
+                     "labels": {"app": "web"}},
+        "spec": {"nodeName": "node-1"},
+        "status": {"podIP": "10.1.1.77"},
+    })
+    scraper = _cluster_scraper(store, beat, names)
+
+    def full_coverage():
+        spans = scraper.cluster_spans(min_nodes=len(names))
+        return [s for s in spans.get("stitched") or []
+                if s["revision"] > rev_before]
+    assert wait_for(lambda: len(full_coverage()) >= 1, timeout=60,
+                    interval=1.0), scraper.cluster_spans()
+    span = full_coverage()[0]
+    assert span["nodes"] == len(names)
+    assert set(span["node_names"]) == set(names)
+    assert 0 <= span["first_lag_us"] <= span["p50_lag_us"] \
+        <= span["p99_lag_us"] <= span["last_lag_us"]
+    assert span["event"] == "Kubernetes State Change"
+    # heartbeat discovery resolves the same fleet.
+    assert set(heartbeat_servers(store)) >= set(names)
+    k8s.delete("pods", "stitch-pod", "default")
+
+
+def test_cluster_latency_merges_across_datapath_agents(procnode_cluster):
+    store, k8s, children, beat, names = procnode_cluster
+    scraper = _cluster_scraper(store, beat, names)
+
+    def merged_count():
+        lat = scraper.cluster_latency()
+        return (lat["latency"].get("dispatch_rt") or {}).get("count", 0)
+    # Both datapath agents pump keep-alive frames; their histograms
+    # merge bucket-wise into one cluster distribution.
+    assert wait_for(lambda: merged_count() > 0, timeout=60, interval=1.0)
+    lat = scraper.cluster_latency()
+    skew = lat["skew"]
+    rows = {r["node"]: r for r in skew["per_node"]}
+    assert set(rows) >= {"node-1", "node-2"}
+    assert lat["latency"]["dispatch_rt"]["p99"] >= \
+        lat["latency"]["dispatch_rt"]["p50"]
+
+
+def test_sigstopped_agent_is_reported_gap_not_hang(procnode_cluster):
+    """ISSUE 10 regression: a SIGSTOPped agent's REST socket accepts
+    connections (kernel backlog) and never answers — the scrape must
+    come back within the timeout bound with the node as a gap carrying
+    a last-seen age, and every other node's data intact."""
+    store, k8s, children, beat, names = procnode_cluster
+    scraper = _cluster_scraper(store, beat, names, timeout=2.0)
+    scrapes = scraper.scrape()          # all up: last-seen baseline
+    assert all(s.ok for s in scrapes), [(s.node, s.error) for s in scrapes]
+
+    os.kill(children["node-3"].pid, signal.SIGSTOP)
+    try:
+        time.sleep(0.3)
+        t0 = time.monotonic()
+        scrapes = scraper.scrape()
+        elapsed = time.monotonic() - t0
+        assert elapsed < 25.0, f"scrape hung {elapsed:.1f}s on SIGSTOP"
+        by_node = {s.node: s for s in scrapes}
+        frozen = by_node["node-3"]
+        assert not frozen.ok
+        assert frozen.error
+        assert frozen.last_seen_age_s is not None \
+            and frozen.last_seen_age_s > 0
+        assert by_node["node-1"].ok and by_node["node-2"].ok
+        summary = scraper.summary(scrapes)
+        assert [g["node"] for g in summary["gaps"]] == ["node-3"]
+        # netctl over the same fleet: gap shown, exit 0.
+        servers = {n: beat(n)["rest"] for n in names}
+        spec = ",".join(f"{n}={s}" for n, s in servers.items())
+        out = io.StringIO()
+        rc = netctl_main(["cluster", "top", "--servers", spec,
+                          "--timeout", "2.0"], out=out)
+        assert rc == 0, out.getvalue()
+        assert "GAP node-3" in out.getvalue()
+    finally:
+        os.kill(children["node-3"].pid, signal.SIGCONT)
+    assert wait_for(lambda: all(s.ok for s in scraper.scrape()),
+                    timeout=30), "node-3 never recovered after SIGCONT"
+
+
+def test_cluster_obs_script_discovers_from_store(procnode_cluster, tmp_path):
+    """scripts/cluster_obs.py --store: heartbeat discovery + the same
+    rendering path, end to end as a subprocess."""
+    store, k8s, children, beat, names = procnode_cluster
+    port = None
+    for n in names:
+        rest = beat(n).get("rest")
+        assert rest
+    # The script needs the store's gRPC port; recover it from the
+    # fixture's server via any heartbeat-carrying client knowledge —
+    # the store object here is in-process, so ask the OS instead: the
+    # agents were spawned with --store 127.0.0.1:<port>.
+    args = children["node-1"].args
+    port = args[args.index("--store") + 1]
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "cluster_obs.py"),
+         "top", "--store", port, "--timeout", "5"],
+        capture_output=True, text=True, timeout=300,
+        cwd=REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "agents reporting" in proc.stdout
+    for n in names:
+        assert n in proc.stdout

@@ -363,6 +363,36 @@ def test_soak_smoke_all_fault_classes_with_parity(tmp_path):
     assert {"start", "churn-script", "fault", "fault-done", "parity",
             "converged", "summary"} <= kinds
     assert (tmp_path / "work" / "churn_script.jsonl").exists()
+    # ISSUE 10 fleet evidence: at least one STITCHED cluster propagation
+    # span covering every agent (one store write, N nodes' spans joined
+    # on its revision, monotone adoption lags)...
+    cluster_spans = [e["span"] for e in events
+                     if e["event"] == "cluster-span"]
+    assert cluster_spans, "no cluster-span evidence recorded"
+    full = [s for s in cluster_spans if s["nodes"] >= cfg.agents]
+    assert full, f"no stitched span covered all {cfg.agents} agents: " \
+                 f"{[s['nodes'] for s in cluster_spans]}"
+    span = full[0]
+    assert span["revision"] > 0
+    assert len(span["node_names"]) == span["nodes"]
+    assert 0 <= span["first_lag_us"] <= span["p50_lag_us"] \
+        <= span["p99_lag_us"] <= span["last_lag_us"]
+    # ...plus one drill evidence timeline PER drill, each healed.
+    timelines = [e for e in events if e["event"] == "drill-timeline"]
+    n_drills = (report["leader_kills"] + report["store_outages"]
+                + report["agent_restarts"] + report["shard_faults"])
+    assert len(timelines) >= n_drills
+    assert all(t["converged"] and t.get("heal_s", 0) >= 0
+               for t in timelines), timelines
+    assert any(t["first_degraded_at"] for t in timelines), \
+        "no drill's degradation was ever observed by the monitor"
+    assert any(t["cleared_at"] for t in timelines)
+    # Cluster-merged latency rollup present with the datapath agents
+    # reporting real samples.
+    lat_events = [e for e in events if e["event"] == "cluster-latency"]
+    assert lat_events
+    assert any((e["latency"].get("dispatch_rt") or {}).get("count", 0) > 0
+               for e in lat_events)
 
 
 @pytest.mark.slow

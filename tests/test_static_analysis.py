@@ -567,6 +567,93 @@ class _DatapathCollector:
     assert len(unwaived) == 1 and "p999" in unwaived[0].message
 
 
+def test_obs_must_flag_cluster_panel_key_aggregator_dropped():
+    """ISSUE 10 surface: the dashboard cluster panel reads aggregator
+    summary keys — a renamed per-node rollup field must flag (the
+    fleet panel would blank during the incident it exists for)."""
+    views = """
+def shape_cluster(summary):
+    rows = [r.get("shards_live") for r in summary.get("per_node") or []]
+    return {"rows": rows}
+"""
+    producer = """
+class ClusterScraper:
+    def summary(self):
+        return {"per_node": [{"node": "a", "shards_serving": 1}]}
+"""
+    project = Project.from_sources({
+        "vpp_tpu/uibackend/views.py": views,
+        "vpp_tpu/statscollector/cluster.py": producer,
+    })
+    unwaived, _ = _run(project, _obs_checker(
+        schema_pairs=(("shape_cluster", ("ClusterScraper.summary",)),)))
+    msgs = [f.message for f in unwaived]
+    assert any("shards_live" in m for m in msgs)
+    assert not any("'per_node'" in m for m in msgs)
+
+
+def test_obs_must_pass_cluster_surfaces_alignment():
+    """Must-pass: netctl cluster + dashboard panel reading exactly what
+    the aggregator (summary rows, stitched spans, skew) produces."""
+    views = """
+def shape_cluster(summary):
+    spans = [{"rev": s.get("revision"), "lag": s.get("p99_lag_us")}
+             for s in summary.get("spans") or []]
+    return {"ok": summary.get("nodes_ok", 0), "spans": spans}
+
+
+def cmd_cluster(out, summary):
+    for gap in summary.get("gaps") or []:
+        out.append(gap.get("node"))
+    return summary.get("nodes_ok")
+"""
+    producer = """
+def stitch_spans(per_node):
+    return [{"revision": 1, "p99_lag_us": 2.0}]
+
+
+class ClusterScraper:
+    def summary(self):
+        return {"nodes_ok": 1, "gaps": self._gaps(), "spans": []}
+
+    def _gaps(self):
+        return [{"node": "a", "server": "b"}]
+"""
+    project = Project.from_sources({
+        "vpp_tpu/uibackend/views.py": views,
+        "vpp_tpu/statscollector/cluster.py": producer,
+    })
+    unwaived, _ = _run(project, _obs_checker(
+        schema_pairs=(
+            ("shape_cluster", ("ClusterScraper.summary",
+                               "ClusterScraper._gaps", "stitch_spans")),
+            ("cmd_cluster", ("ClusterScraper.summary",
+                             "ClusterScraper._gaps", "stitch_spans")),
+        )))
+    assert unwaived == [], [f.format() for f in unwaived]
+
+
+def test_obs_must_flag_netctl_cluster_key_nobody_produces():
+    """Must-flag: `netctl cluster` rendering a straggler field the skew
+    helper no longer emits — the CLI column would silently go dash."""
+    cli = """
+def cmd_cluster(out, skew):
+    for s in skew.get("stragglers") or []:
+        out.append(s.get("lag_ratio"))
+"""
+    producer = """
+def latency_skew(per_node):
+    return {"stragglers": [{"node": "a", "value_us": 1.0}]}
+"""
+    project = Project.from_sources({
+        "vpp_tpu/netctl/cli.py": cli,
+        "vpp_tpu/telemetry/cluster.py": producer,
+    })
+    unwaived, _ = _run(project, _obs_checker(
+        schema_pairs=(("cmd_cluster", ("latency_skew",)),)))
+    assert len(unwaived) == 1 and "lag_ratio" in unwaived[0].message
+
+
 def test_obs_must_pass_clean_fixture():
     src = """
 from dataclasses import dataclass

@@ -99,6 +99,19 @@ def test_nodes_directory(backend):
     assert json.loads(body) == ["node1"]
 
 
+def test_cluster_route_serves_fleet_panel(backend):
+    """ISSUE 10: /api/cluster sweeps every directory node through the
+    fleet aggregator and returns the shaped cluster panel — reachable
+    agents counted, never an error for a partial fleet."""
+    status, body = get(backend, "/api/cluster")
+    assert status == 200
+    shaped = json.loads(body)
+    assert shaped["nodes_total"] == 1
+    assert shaped["nodes_ok"] == 1
+    assert [r["node"] for r in shaped["per_node"]] == ["node1"]
+    assert "latency" in shaped and "spans" in shaped
+
+
 def test_netctl_route(backend):
     req = urllib.request.Request(
         f"http://127.0.0.1:{backend.port}/api/netctl",

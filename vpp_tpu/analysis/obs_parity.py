@@ -57,6 +57,22 @@ DEFAULT_SCHEMA_PAIRS = (
                     "AgentRestServer.get_health",
                     "DataplaneRunner.health",
                     "ShardedDataplane.health")),
+    # ISSUE 10 cluster surfaces: the dashboard's cluster panel and the
+    # `netctl cluster` subcommands both read the fleet aggregator's
+    # literal schema (ClusterScraper.summary rows + gaps, the stitched
+    # spans, the skew report, merged histogram snapshots) — a renamed
+    # aggregator key would blank the fleet view on every surface at
+    # once, during exactly the incident it exists for.
+    ("shape_cluster", ("ClusterScraper.summary",
+                       "ClusterScraper._gaps",
+                       "stitch_spans",
+                       "latency_skew",
+                       "Log2Histogram.snapshot")),
+    ("cmd_cluster", ("ClusterScraper.summary",
+                     "ClusterScraper._gaps",
+                     "stitch_spans",
+                     "latency_skew",
+                     "Log2Histogram.snapshot")),
 )
 DEFAULT_METRICS_PAIR = ("DataplaneRunner.metrics",
                         "ShardedDataplane._aggregate_counters")

@@ -161,11 +161,17 @@ class DBResync(Event):
 
     def __init__(self, kube_state: Optional[KubeStateData] = None,
                  external_config: Optional[Dict[str, Any]] = None,
-                 local: bool = False):
+                 local: bool = False, revision: int = 0):
         super().__init__()
         self.kube_state: KubeStateData = kube_state if kube_state is not None else {}
         self.external_config: Dict[str, Any] = external_config or {}
         self.local = local
+        # Store revision the snapshot corresponds to (ISSUE 10): the
+        # cluster-wide anchor that lets one node's propagation span be
+        # stitched against every other node's — replicas serve
+        # bit-identical revisions (PR 1), so equal revision means "the
+        # same cluster state write" on every agent.
+        self.revision = revision
 
     @property
     def method(self) -> EventMethod:
@@ -182,12 +188,17 @@ class KubeStateChange(UpdateEvent):
 
     name = "Kubernetes State Change"
 
-    def __init__(self, resource: str, key: str, prev_value: Any, new_value: Any):
+    def __init__(self, resource: str, key: str, prev_value: Any,
+                 new_value: Any, revision: int = 0):
         super().__init__()
         self.resource = resource
         self.key = key
         self.prev_value = prev_value
         self.new_value = new_value
+        # The store revision that carried this change (ISSUE 10): the
+        # watch event's revision, identical on every agent that saw the
+        # same write — the cross-node span stitch key.
+        self.revision = revision
 
     def __str__(self) -> str:
         op = "update"
@@ -204,10 +215,12 @@ class ExternalConfigChange(UpdateEvent):
 
     name = "External Config Change"
 
-    def __init__(self, source: str, changes: Dict[str, Any], blocking: bool = False):
+    def __init__(self, source: str, changes: Dict[str, Any],
+                 blocking: bool = False, revision: int = 0):
         super().__init__(blocking=blocking)
         self.source = source
         self.changes = changes  # key -> new value (None = delete)
+        self.revision = revision  # store revision, 0 when not DB-carried
 
     def __str__(self) -> str:
         return f"{self.name} [source={self.source}, keys={sorted(self.changes)}]"

@@ -129,10 +129,10 @@ class DBWatcher:
             self._resync_revision = revision
             if self._mirror is not None:
                 self._mirror.save_snapshot(snap, revision)
-            event = self._push_resync(snap)
+            event = self._push_resync(snap, revision)
         return event
 
-    def _push_resync(self, snap) -> DBResync:
+    def _push_resync(self, snap, revision: int = 0) -> DBResync:
         kube_state = {r.keyword: {} for r in registry.DB_RESOURCES}
         external = {}
         for key, value in snap.items():
@@ -142,7 +142,8 @@ class DBWatcher:
             resource = registry.resource_for_key(key)
             if resource is not None:
                 kube_state[resource.keyword][key] = value
-        event = DBResync(kube_state=kube_state, external_config=external)
+        event = DBResync(kube_state=kube_state, external_config=external,
+                         revision=revision)
         self.controller.push_event(event)
         return event
 
@@ -161,7 +162,7 @@ class DBWatcher:
         )
         self._resync_revision = revision
         self.resynced_from_mirror += 1
-        return self._push_resync(snap)
+        return self._push_resync(snap, revision)
 
     # ----------------------------------------------------------------- watch
 
@@ -182,9 +183,14 @@ class DBWatcher:
             self._push_change(ev)
 
     def _push_change(self, ev: WatchEvent) -> None:
+        # The watch event's revision rides the controller event into its
+        # propagation span (ISSUE 10): one store write lands with the
+        # SAME revision on every agent, which is what lets the cluster
+        # aggregator stitch all nodes' spans for that write together.
         if ev.key.startswith(EXTERNAL_CONFIG_PREFIX):
             self.controller.push_event(
-                ExternalConfigChange(source="db", changes={ev.key: ev.value})
+                ExternalConfigChange(source="db", changes={ev.key: ev.value},
+                                     revision=ev.revision)
             )
             return
         resource = registry.resource_for_key(ev.key)
@@ -197,5 +203,6 @@ class DBWatcher:
                 key=ev.key,
                 prev_value=ev.prev_value,
                 new_value=ev.value,
+                revision=ev.revision,
             )
         )

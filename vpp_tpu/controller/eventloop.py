@@ -381,8 +381,13 @@ class Controller:
         # so its total is the full event→device propagation latency.
         # Downstream stages (applicator compile, device swap, per-shard
         # adoption) stamp into it through the telemetry thread-local;
-        # no context threads through handler signatures.
-        span = self.spans.start(event.name, str(event))
+        # no context threads through handler signatures.  The store
+        # revision that triggered the event (watch delivery / resync
+        # snapshot) anchors the span cluster-wide: every agent that
+        # adopted the same write minted a span with the same revision
+        # (the ISSUE 10 cross-node stitch key).
+        span = self.spans.start(event.name, str(event),
+                                revision=getattr(event, "revision", 0))
         record.span_id = span.span_id
         try:
             self._process_event_spanned(event, record)

@@ -48,7 +48,12 @@ from ..ops.pipeline import (
 )
 from ..ops.slowpath import HostSlowPath
 from ..shim.hostshim import FrameBatch, HostShim, NativeLoop, NativeRing
-from ..telemetry import FlightRecorder, LatencyRecorder, record_stage
+from ..telemetry import (
+    FlightRecorder,
+    LatencyRecorder,
+    Log2Histogram,
+    record_stage,
+)
 from ..testing.faults import (
     SITE_DISPATCH_HANG,
     SITE_DISPATCH_RAISE,
@@ -72,6 +77,11 @@ class TableSwapError(RuntimeError):
 
 
 _BATCH_FIELDS = ("src_ip", "dst_ip", "protocol", "src_port", "dst_port")
+
+# The per-dispatch host rounds the attribution histograms split the
+# admit→harvest wall into (see DataplaneRunner.rounds).  Order is the
+# execution order within one harvested dispatch.
+DISPATCH_ROUNDS = ("wait", "materialize", "restore", "stitch")
 
 
 @dataclasses.dataclass
@@ -392,6 +402,18 @@ class DataplaneRunner:
         # readers merge/copy on read.
         self.telemetry = LatencyRecorder()
         self.flight = FlightRecorder()
+        # Round-chain attribution (ISSUE 10 satellite): where each
+        # dispatch's host wall actually goes, per round of the
+        # admit→harvest chain — `wait` (in-flight window: dispatch
+        # enqueue → harvest begin), `materialize` (the host block on the
+        # device program's outputs — the flat-safe commit→re-probe→
+        # finalize chain surfaces HERE as transfer wait), `restore` (the
+        # host slow path: punt servicing + reply restores), `stitch`
+        # (quarantine screen + rewrite apply + TX).  Single-writer log2
+        # histograms fed from perf_counter stamps the harvest already
+        # brackets — zero device syncs added; this is the per-round
+        # evidence ROADMAP #1's fusion work is judged against.
+        self.rounds = {name: Log2Histogram() for name in DISPATCH_ROUNDS}
         # Monotonic table generation: bumped once per adopted swap so
         # flight-recorder rows and packet traces pin the exact tables a
         # batch dispatched under (correlates with propagation spans).
@@ -841,7 +863,9 @@ class DataplaneRunner:
     def _observe_harvest(self, k: int, t_admit: float, depth: int,
                          t_harvest: Optional[float] = None, ts: int = 0,
                          frames: int = 0, sent: int = 0,
-                         denied: int = 0) -> None:
+                         denied: int = 0,
+                         t_materialized: Optional[float] = None,
+                         t_restored: Optional[float] = None) -> None:
         """Feed one per-dispatch wall-time sample to the governor, the
         latency histograms, and the flight recorder.  Unpipelined
         batches (admitted with nothing in flight) time the full
@@ -866,6 +890,21 @@ class DataplaneRunner:
             t_admit, t_harvest if t_harvest is not None else t_admit,
             now, frames,
         )
+        # Round-chain attribution (pure arithmetic on stamps the harvest
+        # already took — hot-path-sync clean): split this dispatch's
+        # host wall into its rounds.  The intermediate stamps are only
+        # taken on the real harvest paths; bench-style callers that
+        # omit them record nothing (no fake zeros in the histograms).
+        if t_harvest is not None:
+            self.rounds["wait"].record_us((t_harvest - t_admit) * 1e6)
+            if t_materialized is not None:
+                self.rounds["materialize"].record_us(
+                    (t_materialized - t_harvest) * 1e6)
+                if t_restored is not None:
+                    self.rounds["restore"].record_us(
+                        (t_restored - t_materialized) * 1e6)
+                    self.rounds["stitch"].record_us(
+                        (now - t_restored) * 1e6)
         self.flight.note_dispatch(
             ts=ts, k=k, frames=frames, sent=sent, denied=denied,
             backlog=self.governor.backlog, inflight=depth,
@@ -1316,10 +1355,16 @@ class DataplaneRunner:
         # until the slot cycles, which cannot happen before this
         # harvest returns (n_slots > max_inflight).
         orig = {key: arr[:n] for key, arr in soa.items()}
+        # Round-attribution stamps (harvest path — the sanctioned sync
+        # side): everything above this line since t_h0 was the blocking
+        # materialisation of the device program's outputs; the slow
+        # path below is the host `restore` round.
+        t_mat = time.perf_counter()
         slow_drops = self._slowpath_and_trace(
             orig, rew, allowed, route_tag, node_id,
             punt, reply_hit, dnat_hit, snat_hit, ts, k,
         )
+        t_slow = time.perf_counter()
         poison_drops = self._quarantine_rows(
             result, n, lambda row: self._native.slot_frame(slot, row))
         c = np.zeros(NativeLoop.HARVEST_COUNTERS, dtype=np.uint64)
@@ -1345,7 +1390,8 @@ class DataplaneRunner:
             # check could not see — re-derive before the next bypass.
             self._bypass_recheck = True
         self._observe_harvest(k, t_admit, depth, t_harvest=t_h0, ts=int(ts),
-                              frames=n, sent=sent, denied=denied)
+                              frames=n, sent=sent, denied=denied,
+                              t_materialized=t_mat, t_restored=t_slow)
         return sent
 
     # ------------------------------------------------------- python engine
@@ -1437,10 +1483,12 @@ class DataplaneRunner:
             "src_port": np.asarray(fb.batch.src_port)[:n],
             "dst_port": np.asarray(fb.batch.dst_port)[:n],
         }
+        t_mat = time.perf_counter()  # round stamp; see _harvest_native
         slow_drops = self._slowpath_and_trace(
             orig, rew, allowed, route_tag, node_id,
             punt, reply_hit, dnat_hit, snat_hit, ts, k,
         )
+        t_slow = time.perf_counter()
         poison_drops = self._quarantine_rows(result, n, fb.frame)
 
         # -------------------------------------------- native apply + TX
@@ -1490,7 +1538,8 @@ class DataplaneRunner:
         if self._bypass_tables:
             self._bypass_recheck = True  # see _harvest_native
         self._observe_harvest(k, t_admit, depth, t_harvest=t_h0, ts=int(ts),
-                              frames=n, sent=sent, denied=denied)
+                              frames=n, sent=sent, denied=denied,
+                              t_materialized=t_mat, t_restored=t_slow)
         return sent
 
     # ------------------------------------------------------ shared harvest
@@ -1679,6 +1728,11 @@ class DataplaneRunner:
             "mesh": str(self.mesh.shape) if self.mesh is not None else "",
             "governor": self.governor.snapshot(),
             "prewarm": self.prewarm,
+            # Round-chain attribution (ISSUE 10 satellite): per-round
+            # host-gap distributions of the dispatch chain — the
+            # direct evidence for ROADMAP #1's round-fusion work.
+            "rounds": {name: hist.snapshot()
+                       for name, hist in self.rounds.items()},
         }
 
     def inspect_rings(self) -> Dict[str, Dict[str, int]]:

@@ -721,6 +721,16 @@ class ShardedDataplane:
         gov["samples"] = sum(r.governor.samples for r in self.shards)
         gov["per_shard_k"] = [r.governor.current_k for r in self.shards]
         gov["per_shard_backlog"] = [r.governor.backlog for r in self.shards]
+        # Whole-node round-chain attribution: every shard's per-round
+        # histograms merged on read (same discipline as the latency
+        # pillars below; shard 0's solo view would miss the others).
+        from ..telemetry import Log2Histogram
+
+        base["dispatch"]["rounds"] = {
+            name: Log2Histogram().merged(
+                r.rounds[name] for r in self.shards).snapshot()
+            for name in self.shards[0].rounds
+        }
         # Whole-node latency view: merged across every shard's
         # single-writer recorders (shard 0's solo view would miss the
         # other shards' samples); flight status aggregates similarly.

@@ -33,8 +33,15 @@ Analog of ``plugins/netctl`` + ``cmd/contiv-netctl`` (cmd/root.go
 - ``flight``     the datapath flight recorder: the last N dispatch
                  records per shard (K, backlog, in-flight depth, table
                  generation, verdicts, round-trip µs) for post-mortems
+- ``cluster``    fleet scope (ISSUE 10): scrape MANY agents at once —
+                 ``cluster top`` per-node health rollup, ``cluster
+                 latency`` cluster-merged p50/p99/p99.9 + straggler
+                 detection, ``cluster spans`` store writes stitched
+                 across every node that adopted them; unreachable
+                 agents are reported gaps, never hangs (exit 0)
 
-Run: ``python -m vpp_tpu.netctl <command> [--server host:port]``.
+Run: ``python -m vpp_tpu.netctl <command> [--server host:port]``;
+``cluster`` takes ``--servers name=host:port,...`` instead.
 """
 
 from __future__ import annotations
@@ -253,6 +260,117 @@ def cmd_flight(server: str, out, raw: bool = False, limit: int = 20) -> int:
     return 0
 
 
+def parse_servers(spec: str) -> dict:
+    """``name=host:port,name2=host:port`` (or bare ``host:port`` items,
+    named after themselves) → {name: server} for the cluster scraper."""
+    servers = {}
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        name, sep, addr = item.partition("=")
+        servers[name if sep else item] = addr if sep else item
+    return servers
+
+
+def _fmt_age(age) -> str:
+    return "never" if age is None else f"{age:.1f}s ago"
+
+
+def cmd_cluster(out, action: str, servers_spec: str = "", raw: bool = False,
+                limit: int = 10, timeout: float = 3.0,
+                factor: float = 3.0, scraper=None) -> int:
+    """Fleet-scope commands (ISSUE 10): one concurrent sweep over every
+    agent in ``--servers``; an unreachable agent is printed as a GAP
+    row with its last-seen age and the command still exits 0 — partial
+    visibility beats none during exactly the incidents that cause
+    partial visibility.  Exit 1 only when NO agent answered.
+
+    ``scraper`` lets a long-lived caller (``cluster_obs.py --watch``)
+    reuse one ClusterScraper across sweeps so gap rows carry real
+    last-seen ages; a one-shot CLI invocation has no history and
+    prints ``never``.  The ``latency`` action renders no span data, so
+    its sweep skips the per-agent span-ring transfers (cheap at fleet
+    scale); ``top``/``spans`` consume them (per-node propagated counts,
+    the stitched table), and ``--raw`` always fetches everything — a
+    raw dump must never render unfetched fields as plausible zeros."""
+    from ..statscollector.cluster import ClusterScraper
+
+    if scraper is None:
+        servers = parse_servers(servers_spec)
+        if not servers:
+            print("netctl: cluster needs --servers name=host:port,...",
+                  file=sys.stderr)
+            return 1
+        scraper = ClusterScraper(servers, timeout=timeout,
+                                 straggler_factor=factor)
+    scrapes = scraper.scrape(include_spans=(action != "latency" or raw))
+    summary = scraper.summary(scrapes)
+    if raw:
+        print(json.dumps(summary, indent=2), file=out)
+        return 0 if summary.get("nodes_ok") else 1
+    print(f"cluster: {summary.get('nodes_ok', 0)}/"
+          f"{summary.get('nodes_total', 0)} agents reporting"
+          f"  unreachable={summary.get('nodes_unreachable', 0)}", file=out)
+    for gap in summary.get("gaps") or []:
+        print(f"GAP {gap.get('node')} ({gap.get('server')}): "
+              f"{gap.get('error')}  last-seen "
+              f"{_fmt_age(gap.get('last_seen_age_s'))}", file=out)
+    if action in ("", "top"):
+        rows = []
+        for r in summary.get("per_node") or []:
+            shards = ("-" if r.get("shards_total") is None
+                      else f"{r.get('shards_serving')}/{r.get('shards_total')}")
+            healing = ("pending" if r.get("healing_pending")
+                       else f"failed={r.get('healing_failed')}"
+                       if r.get("healing_failed") else "ok")
+            rows.append([
+                r.get("node"), "up" if r.get("ok") else "GAP", shards,
+                r.get("events"), r.get("event_errors"), r.get("resyncs"),
+                healing, r.get("spans_propagated"),
+                "-" if r.get("p99_dispatch_us") is None
+                else r.get("p99_dispatch_us"),
+            ])
+        print(_table(rows, ["NODE", "STATE", "SHARDS", "EVENTS", "ERRS",
+                            "RESYNCS", "HEALING", "SPANS", "P99-US"]),
+              file=out)
+    elif action == "latency":
+        lat = summary.get("latency") or {}
+        for name in ("admit_wait", "dispatch_rt", "harvest", "frame_e2e"):
+            h = lat.get(name) or {}
+            print(f"{name}: n={h.get('count', 0)}  p50={h.get('p50', 0)}us"
+                  f"  p90={h.get('p90', 0)}us  p99={h.get('p99', 0)}us"
+                  f"  p99.9={h.get('p999', 0)}us", file=out)
+        skew = summary.get("skew") or {}
+        print(f"skew[{skew.get('metric')}/{skew.get('quantile')}]: "
+              f"cluster-median={skew.get('cluster_median_us', 0)}us "
+              f"straggler>{skew.get('factor')}x", file=out)
+        for s in skew.get("stragglers") or []:
+            print(f"STRAGGLER {s.get('node')}: {s.get('value_us')}us "
+                  f"({s.get('samples')} samples)", file=out)
+    elif action == "spans":
+        rows = []
+        for sp in (summary.get("spans") or [])[:limit]:
+            stragglers = ",".join(
+                s.get("node", "") for s in sp.get("stragglers") or []) or "-"
+            rows.append([
+                sp.get("revision"), sp.get("event"), sp.get("nodes"),
+                sp.get("propagated_nodes"),
+                f"{sp.get('first_lag_us', 0):.0f}",
+                f"{sp.get('p50_lag_us', 0):.0f}",
+                f"{sp.get('p99_lag_us', 0):.0f}",
+                f"{sp.get('last_lag_us', 0):.0f}",
+                sp.get("last_node"), stragglers,
+            ])
+        print(_table(rows, ["REV", "EVENT", "NODES", "DEV", "FIRST-US",
+                            "P50-US", "P99-US", "LAST-US", "LAST-NODE",
+                            "STRAGGLERS"]), file=out)
+    else:
+        print(f"netctl: unknown cluster action {action!r}", file=sys.stderr)
+        return 1
+    return 0 if summary.get("nodes_ok") else 1
+
+
 def cmd_inspect(server: str, out, watch: float = 0.0, raw: bool = False) -> int:
     """Live datapath interrogation (the ``vppcli`` analog, reference
     plugins/netctl/cmd/root.go:55-134): classify/NAT table stats,
@@ -314,6 +432,14 @@ def cmd_inspect(server: str, out, watch: float = 0.0, raw: bool = False) -> int:
                                  f"p99.9={h['p999']}us")
             if parts:
                 print("latency: " + "   ".join(parts), file=out)
+        rounds = dp.get("rounds") or {}
+        parts = []
+        for name in ("wait", "materialize", "restore", "stitch"):
+            h = rounds.get(name) or {}
+            if h.get("count"):
+                parts.append(f"{name} p50={h['p50']}us p99={h['p99']}us")
+        if parts:
+            print("rounds: " + "   ".join(parts), file=out)
         comp = d.get("compile") or {}
         if comp:
             parts = [f"swaps acl={comp.get('acl_swaps', 0)} "
@@ -520,6 +646,21 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
                            help="full JSON instead of the summary view")
     flightcmd.add_argument("--limit", type=int, default=20,
                            help="show the most recent N records per shard")
+    clustercmd = sub.add_parser("cluster")
+    clustercmd.add_argument("action", nargs="?", default="top",
+                            choices=["top", "latency", "spans"])
+    clustercmd.add_argument("--servers", default="",
+                            help="comma list of agents to sweep "
+                                 "(name=host:port, or bare host:port)")
+    clustercmd.add_argument("--raw", action="store_true",
+                            help="full JSON instead of the summary view")
+    clustercmd.add_argument("--limit", type=int, default=10,
+                            help="show the most recent N stitched spans")
+    clustercmd.add_argument("--timeout", type=float, default=3.0,
+                            help="per-agent scrape timeout (an "
+                                 "unreachable agent is a reported gap)")
+    clustercmd.add_argument("--straggler-factor", type=float, default=3.0,
+                            help="flag nodes above N x the cluster median")
     args = parser.parse_args(argv)
 
     try:
@@ -544,6 +685,10 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
             return cmd_spans(args.server, out, args.raw, args.limit)
         if args.command == "flight":
             return cmd_flight(args.server, out, args.raw, args.limit)
+        if args.command == "cluster":
+            return cmd_cluster(out, args.action, args.servers, args.raw,
+                               args.limit, args.timeout,
+                               args.straggler_factor)
         return {
             "nodes": cmd_nodes,
             "pods": cmd_pods,

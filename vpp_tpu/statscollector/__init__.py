@@ -1,5 +1,14 @@
-"""StatsCollector — per-pod data-plane statistics → Prometheus."""
+"""StatsCollector — per-pod data-plane statistics → Prometheus, plus
+the fleet-scope REST aggregator (ISSUE 10, :mod:`.cluster`)."""
 
+from .cluster import ClusterScraper, NodeScrape, heartbeat_servers
 from .plugin import InterfaceStats, StatsCollector, counters_from_result
 
-__all__ = ["InterfaceStats", "StatsCollector", "counters_from_result"]
+__all__ = [
+    "ClusterScraper",
+    "InterfaceStats",
+    "NodeScrape",
+    "StatsCollector",
+    "counters_from_result",
+    "heartbeat_servers",
+]

@@ -1,0 +1,295 @@
+"""Fleet telemetry aggregator — scrape every agent, merge, report.
+
+ISSUE 10 tentpole, pillar 2: every observability surface up to PR 7
+stopped at the node boundary (per-agent REST, per-agent histograms,
+per-agent spans).  This scraper is the fleet face: it polls N agents'
+REST surfaces **concurrently with per-request timeouts**, tolerates
+partial failure as a first-class outcome (an unreachable node is a
+*reported gap* — name, error, last-seen age — never a hang and never a
+silent omission), and produces:
+
+- **cluster latency**: the agents' log2 histograms merged bucket-wise
+  (exact, not percentile-averaged) into cluster p50/p90/p99/p99.9 per
+  pillar — :func:`vpp_tpu.telemetry.cluster.merge_latency_snapshots`;
+- **node skew / stragglers**: nodes whose p99 (or adoption lag) exceeds
+  k× the cluster median — :func:`vpp_tpu.telemetry.cluster.latency_skew`;
+- **stitched propagation spans**: one store write traced across every
+  node that adopted it, by revision —
+  :func:`vpp_tpu.telemetry.cluster.stitch_spans`;
+- **per-node health rollups**: shards serving, healing ledger, event
+  errors, span counts — the `netctl cluster top` table.
+
+Used three ways (one implementation): as a library (the soak conductor
+builds drill evidence timelines from its scrapes), as ``netctl cluster
+top|latency|spans``, and as ``scripts/cluster_obs.py`` (which can
+discover agents from the store's heartbeats).
+
+Timeout discipline: a SIGSTOPped agent's REST socket ACCEPTS (the
+kernel backlog answers) and then never responds — only a per-request
+read timeout turns that into a bounded, reported gap.  Every request
+carries one, and the pool fans out so one frozen node cannot serialize
+the sweep: up to ``pool`` nodes (default 128 — past the 100-node
+design point), ``scrape()``'s wall time is bounded by ~one timeout,
+not ``N ×``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional
+
+from ..telemetry.cluster import (
+    DEFAULT_STRAGGLER_FACTOR,
+    latency_skew,
+    merge_latency_snapshots,
+    stitch_spans,
+)
+
+DEFAULT_TIMEOUT = 3.0
+# Upper cap on concurrent scrape threads.  The sweep's "~one timeout,
+# not N×" wall-time bound holds while the fleet fits the pool — the
+# threads are idle-on-I/O, so the default comfortably covers the
+# 100-node design point.
+DEFAULT_POOL = 128
+
+
+@dataclasses.dataclass
+class NodeScrape:
+    """One agent's slice of one scrape sweep."""
+
+    node: str
+    server: str
+    ok: bool = False
+    error: str = ""
+    elapsed_ms: float = 0.0
+    last_seen_age_s: Optional[float] = None  # None = never seen
+    inspect: Optional[dict] = None
+    spans: Optional[dict] = None
+    health: Optional[dict] = None
+
+
+class ClusterScraper:
+    """Concurrent, partial-failure-tolerant poller over agent REST.
+
+    ``servers`` maps node name → ``host:port`` of its AgentRestServer;
+    pass a callable to re-resolve each sweep (agents restart onto fresh
+    ephemeral ports — the soak's kill drills — and a fleet scraper must
+    follow).  ``fetch`` is injectable for tests.
+    """
+
+    def __init__(
+        self,
+        servers,
+        timeout: float = DEFAULT_TIMEOUT,
+        pool: int = DEFAULT_POOL,
+        straggler_factor: float = DEFAULT_STRAGGLER_FACTOR,
+        fetch: Optional[Callable[[str, str, float], dict]] = None,
+    ):
+        self._servers = servers
+        self.timeout = timeout
+        self.pool = pool
+        self.straggler_factor = straggler_factor
+        self._fetch = fetch or _http_json
+        # Wall timestamp of the last SUCCESSFUL scrape per node, kept
+        # across sweeps: a gap is reported with how stale our view of
+        # that node is, which is what paging decisions need.
+        self._last_seen: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ scraping
+
+    def servers(self) -> Dict[str, str]:
+        resolved = self._servers() if callable(self._servers) else self._servers
+        return dict(resolved)
+
+    def _scrape_one(self, node: str, server: str, light: bool = False,
+                    include_spans: bool = True) -> NodeScrape:
+        import urllib.error
+
+        out = NodeScrape(node=node, server=server)
+        t0 = time.monotonic()
+        transport_dead = False
+        if not light:
+            try:
+                out.inspect = self._fetch(server, "/contiv/v1/inspect",
+                                          self.timeout)
+            except urllib.error.HTTPError as err:
+                # The agent ANSWERED (e.g. 404: no datapath attached) —
+                # a partial stack, not an outage; the control-plane
+                # surfaces below still count.
+                out.inspect = None
+                out.error = str(err)
+            except Exception as err:  # noqa: BLE001 - timeout/refused/reset
+                # Transport-level failure: a frozen (SIGSTOPped) agent's
+                # socket accepts and never answers, a dead one refuses.
+                # Don't pay two more timeouts on the same corpse — one
+                # gap, bounded at ~one timeout.
+                out.inspect = None
+                out.error = str(err) or type(err).__name__
+                transport_dead = True
+        if not transport_dead:
+            if not light and include_spans:
+                try:
+                    out.spans = self._fetch(
+                        server, "/contiv/v1/spans?limit=0", self.timeout)
+                except urllib.error.HTTPError:
+                    # Answered without a span tracker (partial stack —
+                    # the REST contract 404s absent components): same
+                    # rule as inspect above, NOT an outage.
+                    out.spans = None
+                except Exception as err:  # noqa: BLE001
+                    out.error = str(err) or type(err).__name__
+                    transport_dead = True
+        if not transport_dead:
+            try:
+                out.health = self._fetch(server, "/contiv/v1/health",
+                                         self.timeout)
+                out.ok = True
+                out.error = ""
+            except Exception as err:  # noqa: BLE001 - the reported gap
+                out.ok = False
+                out.error = str(err) or type(err).__name__
+        out.elapsed_ms = round((time.monotonic() - t0) * 1e3, 1)
+        now = time.time()
+        with self._lock:
+            if out.ok:
+                self._last_seen[node] = now
+            seen = self._last_seen.get(node)
+        out.last_seen_age_s = (round(now - seen, 3)
+                               if seen is not None else None)
+        return out
+
+    def scrape(self, light: bool = False,
+               include_spans: bool = True) -> List[NodeScrape]:
+        """One concurrent sweep over every agent.  Always returns one
+        entry per configured node — reachable or not — and its wall
+        time is bounded by the per-request timeout, not by node count,
+        for fleets up to ``pool`` nodes (a frozen agent costs its own
+        slot, nobody else's; beyond the pool cap sweeps serialize in
+        pool-sized waves).  ``light``
+        fetches health only — the cheap sweep a high-frequency monitor
+        (the soak's drill timeline sampler) runs; ``include_spans=
+        False`` skips the per-agent span-ring dumps for callers that
+        render no spans (latency/top sweeps over a 100-node fleet
+        should not pay 100 ring transfers per call)."""
+        servers = self.servers()
+        if not servers:
+            return []
+        with ThreadPoolExecutor(min(self.pool, max(1, len(servers)))) as ex:
+            futures = {
+                node: ex.submit(self._scrape_one, node, server, light,
+                                include_spans)
+                for node, server in sorted(servers.items())
+            }
+            return [futures[node].result() for node in sorted(futures)]
+
+    # ----------------------------------------------------------- rollups
+
+    def cluster_latency(self, scrapes: Optional[List[NodeScrape]] = None
+                        ) -> dict:
+        """Cluster-merged latency distributions + per-node skew."""
+        if scrapes is None:
+            scrapes = self.scrape(include_spans=False)
+        per_node = {
+            s.node: (s.inspect or {}).get("latency") or {}
+            for s in scrapes if s.ok and s.inspect
+        }
+        return {
+            "nodes_reporting": len(per_node),
+            "latency": merge_latency_snapshots(per_node),
+            "skew": latency_skew(per_node,
+                                 straggler_factor=self.straggler_factor),
+            "gaps": self._gaps(scrapes),
+        }
+
+    def cluster_spans(self, scrapes: Optional[List[NodeScrape]] = None,
+                      min_nodes: int = 2, limit: int = 0) -> dict:
+        """Stitched cross-node propagation spans, newest first."""
+        scrapes = self.scrape() if scrapes is None else scrapes
+        per_node = {
+            s.node: (s.spans or {}).get("spans") or []
+            for s in scrapes if s.ok and s.spans
+        }
+        return {
+            "nodes_reporting": len(per_node),
+            "stitched": stitch_spans(
+                per_node, min_nodes=min_nodes,
+                straggler_factor=self.straggler_factor, limit=limit),
+            "gaps": self._gaps(scrapes),
+        }
+
+    def summary(self, scrapes: Optional[List[NodeScrape]] = None) -> dict:
+        """The fleet rollup (`netctl cluster top` / dashboard panel):
+        reachability, per-node health one-liners, cluster latency, and
+        the freshest stitched spans, in one pass over one sweep."""
+        scrapes = self.scrape() if scrapes is None else scrapes
+        rows = []
+        for s in scrapes:
+            ctl = (s.health or {}).get("controller") or {}
+            lat = ((s.inspect or {}).get("latency") or {}
+                   ).get("dispatch_rt") or {}
+            spans_status = (s.spans or {}).get("status") or {}
+            rows.append({
+                "node": s.node,
+                "server": s.server,
+                "ok": s.ok,
+                "error": s.error,
+                "last_seen_age_s": s.last_seen_age_s,
+                "scrape_ms": s.elapsed_ms,
+                "shards_serving": (s.health or {}).get("shards_serving"),
+                "shards_total": (s.health or {}).get("shards_total"),
+                "events": ctl.get("events_processed", 0),
+                "event_errors": ctl.get("event_errors", 0),
+                "resyncs": ctl.get("resync_count", 0),
+                "healing_pending": bool(ctl.get("healing_pending")),
+                "healing_failed": ctl.get("healing_failed", 0),
+                "spans_propagated": spans_status.get("spans_propagated", 0),
+                "p99_dispatch_us": lat.get("p99"),
+            })
+        latency = self.cluster_latency(scrapes)
+        spans = self.cluster_spans(scrapes, limit=8)
+        return {
+            "nodes_total": len(scrapes),
+            "nodes_ok": sum(1 for s in scrapes if s.ok),
+            "nodes_unreachable": sum(1 for s in scrapes if not s.ok),
+            "gaps": self._gaps(scrapes),
+            "per_node": rows,
+            "latency": latency.get("latency"),
+            "skew": latency.get("skew"),
+            "spans": spans.get("stitched"),
+        }
+
+    @staticmethod
+    def _gaps(scrapes: List[NodeScrape]) -> List[dict]:
+        """Unreachable nodes as explicit records — the aggregator's
+        partial-failure contract (a gap is data, not an exception)."""
+        return [
+            {"node": s.node, "server": s.server, "error": s.error,
+             "last_seen_age_s": s.last_seen_age_s}
+            for s in scrapes if not s.ok
+        ]
+
+
+def _http_json(server: str, path: str, timeout: float) -> dict:
+    req = urllib.request.Request(f"http://{server}{path}", method="GET")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:  # noqa: S310
+        return json.loads(resp.read().decode())
+
+
+def heartbeat_servers(store, prefix: str = "/vpp-tpu/test/heartbeat/"
+                      ) -> Dict[str, str]:
+    """Agent discovery off the cluster store's heartbeats (the procnode
+    convention: each beat carries its REST address) — what
+    ``scripts/cluster_obs.py --store`` and the soak conductor use, so
+    the scraper follows agents across SIGKILL-restarts onto their fresh
+    ephemeral ports."""
+    servers: Dict[str, str] = {}
+    for key, beat in store.list(prefix):
+        if isinstance(beat, dict) and beat.get("rest"):
+            servers[beat.get("name") or key[len(prefix):]] = beat["rest"]
+    return servers

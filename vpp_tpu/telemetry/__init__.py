@@ -13,8 +13,13 @@ Three pillars, one design rule — the hot path pays arithmetic only:
   config-propagation histogram.
 - :mod:`.flight` — a bounded per-shard ring of dispatch records,
   snapshotted next to the forensic pcap on ejection/quarantine.
+- :mod:`.cluster` — the fleet-scope math (ISSUE 10): cross-node span
+  stitching by store revision, bucket-exact histogram merges across
+  agents, node-skew/straggler detection.  Pure functions; the REST
+  scraping lives in :mod:`vpp_tpu.statscollector.cluster`.
 """
 
+from .cluster import latency_skew, merge_latency_snapshots, stitch_spans
 from .flight import FlightRecorder
 from .hist import LATENCY_HISTOGRAMS, LatencyRecorder, Log2Histogram
 from .spans import SpanTracker, current_span_id, record_stage
@@ -26,5 +31,8 @@ __all__ = [
     "Log2Histogram",
     "SpanTracker",
     "current_span_id",
+    "latency_skew",
+    "merge_latency_snapshots",
     "record_stage",
+    "stitch_spans",
 ]

@@ -1,0 +1,178 @@
+"""Cluster-scope telemetry math — span stitching + cross-node merges.
+
+ISSUE 10 tentpole, pillar 1: PR 6 gave every agent a per-node
+propagation story (one controller's event → compile → swap → adoption),
+but a 50–100-node cluster's operational question is different — *when
+one policy/service write lands in the store, how long until EVERY node
+serves it, and which nodes straggle?*  The answer needs no cross-agent
+protocol: the HA store replicates revisions bit-identically (PR 1), the
+watch delivery threads each write's revision into the controller event
+(dbwatcher), and the event's span records it (``Span.revision``).  One
+write therefore leaves N spans — one per agent — all carrying the SAME
+revision, and stitching is a pure host-side group-by over the agents'
+``/contiv/v1/spans`` dumps.
+
+This module is deliberately free of any I/O: it takes the span dicts /
+histogram snapshots the REST surfaces already serve and produces the
+cluster views.  The scraping half (concurrent REST polling, partial-
+failure tolerance) lives in :mod:`vpp_tpu.statscollector.cluster`.
+
+Stitched-span semantics: per revision, the anchor is the EARLIEST span
+start across nodes (the closest observable proxy for the store commit —
+the first agent whose watch delivered the write); each node's
+*adoption lag* is its span's completion (start + total) minus that
+anchor.  first/last/p50/p99 lags summarize the propagation wavefront,
+and a node whose lag exceeds ``straggler_factor ×`` the cluster median
+is named a straggler.  Wall clocks across agents are only comparable to
+the cluster's clock-sync quality — same box in the harnesses, NTP in
+production — which is exactly the resolution fleet operators act on.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+from .hist import LATENCY_HISTOGRAMS, Log2Histogram
+
+# A node is a straggler when its adoption lag (or latency percentile)
+# exceeds this factor times the cluster median — k=3 keeps ordinary
+# jitter quiet while real stalls (GC pause, store reconnect, compile
+# storm) are an order of magnitude out.
+DEFAULT_STRAGGLER_FACTOR = 3.0
+
+
+def _pct(sorted_values: List[float], q: float) -> float:
+    """Exact percentile over a small sorted list (nearest-rank); the
+    cluster has tens of nodes, not millions of samples — no buckets."""
+    if not sorted_values:
+        return 0.0
+    idx = max(0, min(len(sorted_values) - 1,
+                     int(round(q * (len(sorted_values) - 1)))))
+    return sorted_values[idx]
+
+
+def stitch_spans(
+    per_node_spans: Dict[str, List[dict]],
+    min_nodes: int = 2,
+    straggler_factor: float = DEFAULT_STRAGGLER_FACTOR,
+    limit: int = 0,
+) -> List[dict]:
+    """Group every node's span dumps by store revision into cluster
+    propagation spans.
+
+    ``per_node_spans`` maps node name → that agent's span dicts (the
+    ``spans`` list of ``GET /contiv/v1/spans``).  Revisions seen on
+    fewer than ``min_nodes`` nodes are dropped (a lone span stitches
+    nothing).  Returns newest-first, ``limit``-bounded when > 0.
+    """
+    by_rev: Dict[int, Dict[str, dict]] = {}
+    for node, spans in per_node_spans.items():
+        for span in spans or ():
+            rev = int(span.get("revision") or 0)
+            if rev <= 0:
+                continue
+            # One event per (node, revision): a node replaying the same
+            # revision (mirror resync) keeps its LATEST span — the one
+            # describing the state it currently serves.
+            slot = by_rev.setdefault(rev, {})
+            prev = slot.get(node)
+            if prev is None or span.get("started", 0) >= prev.get("started", 0):
+                slot[node] = span
+
+    out: List[dict] = []
+    for rev in sorted(by_rev, reverse=True):
+        nodes = by_rev[rev]
+        if len(nodes) < min_nodes:
+            continue
+        t0 = min(float(s.get("started") or 0.0) for s in nodes.values())
+        lags = []
+        for node, span in nodes.items():
+            done = (float(span.get("started") or 0.0)
+                    + float(span.get("total_us") or 0.0) / 1e6)
+            lags.append((node, max(0.0, (done - t0) * 1e6)))
+        lags.sort(key=lambda nl: nl[1])
+        lag_values = [us for _, us in lags]
+        median = _pct(lag_values, 0.5)
+        stragglers = [
+            {"node": node, "lag_us": round(us, 1)}
+            for node, us in lags
+            if median > 0 and us > straggler_factor * median
+        ]
+        first_node, first_lag = lags[0]
+        last_node, last_lag = lags[-1]
+        sample = nodes[last_node]
+        out.append({
+            "revision": rev,
+            "event": sample.get("event", ""),
+            "detail": sample.get("detail", ""),
+            "nodes": len(nodes),
+            "node_names": [node for node, _ in lags],
+            "propagated_nodes": sum(
+                1 for s in nodes.values() if s.get("propagated")),
+            "anchor": round(t0, 6),
+            "first_node": first_node,
+            "first_lag_us": round(first_lag, 1),
+            "last_node": last_node,
+            "last_lag_us": round(last_lag, 1),
+            "p50_lag_us": round(median, 1),
+            "p99_lag_us": round(_pct(lag_values, 0.99), 1),
+            "stragglers": stragglers,
+        })
+        if limit > 0 and len(out) >= limit:
+            break
+    return out
+
+
+def merge_latency_snapshots(
+    per_node_latency: Dict[str, dict],
+    names: Iterable[str] = LATENCY_HISTOGRAMS,
+) -> Dict[str, dict]:
+    """Merge N agents' ``inspect()["latency"]`` sections into cluster
+    distributions: per pillar, sum the raw log2 buckets every snapshot
+    now carries and re-derive the percentiles — the same merge-on-read
+    the sharded engine does across shards, one level up."""
+    out: Dict[str, dict] = {}
+    for name in names:
+        hists = [
+            Log2Histogram.from_buckets(
+                ((lat or {}).get(name) or {}).get("buckets"),
+                ((lat or {}).get(name) or {}).get("sum_us") or 0.0)
+            for lat in per_node_latency.values()
+        ]
+        out[name] = Log2Histogram().merged(hists).snapshot()
+    return out
+
+
+def latency_skew(
+    per_node_latency: Dict[str, dict],
+    metric: str = "dispatch_rt",
+    quantile_key: str = "p99",
+    straggler_factor: float = DEFAULT_STRAGGLER_FACTOR,
+) -> dict:
+    """Node-skew detection: a node whose ``metric`` ``p99`` exceeds
+    ``straggler_factor ×`` the cluster median of that percentile is a
+    straggler — the per-node view fleet dashboards page on."""
+    per_node: List[dict] = []
+    values: List[float] = []
+    for node in sorted(per_node_latency):
+        snap = (per_node_latency[node] or {}).get(metric) or {}
+        value = float(snap.get(quantile_key) or 0.0)
+        if snap.get("count"):
+            values.append(value)
+        per_node.append({"node": node, "value_us": round(value, 1),
+                         "samples": int(snap.get("count") or 0)})
+    values.sort()
+    median = _pct(values, 0.5)
+    stragglers = [
+        row for row in per_node
+        if row["samples"] and median > 0
+        and row["value_us"] > straggler_factor * median
+    ]
+    return {
+        "metric": metric,
+        "quantile": quantile_key,
+        "factor": straggler_factor,
+        "cluster_median_us": round(median, 1),
+        "per_node": per_node,
+        "stragglers": stragglers,
+    }

@@ -134,7 +134,26 @@ class Log2Histogram:
             "p90": round(self.percentile_us(0.90, counts), 1),
             "p99": round(self.percentile_us(0.99, counts), 1),
             "p999": round(self.percentile_us(0.999, counts), 1),
+            # Sparse raw buckets ([index, count] pairs), so a REMOTE
+            # reader — the ISSUE 10 cluster aggregator scraping N
+            # agents' REST — can merge distributions EXACTLY instead of
+            # averaging percentiles (which has no meaning): cluster p99
+            # comes from summed buckets, same math as the per-node read.
+            "buckets": [[i, c] for i, c in enumerate(counts) if c],
         }
+
+    @classmethod
+    def from_buckets(cls, buckets, sum_us: float = 0.0) -> "Log2Histogram":
+        """Rebuild a histogram from a snapshot's sparse ``buckets`` list
+        (the aggregator's wire→merge path); tolerates None/empty."""
+        out = cls()
+        for pair in buckets or ():
+            idx, c = int(pair[0]), int(pair[1])
+            if 0 <= idx < N_BUCKETS and c > 0:
+                out.counts[idx] += c
+                out.count += c
+        out.sum_us = float(sum_us)
+        return out
 
     def cumulative(self) -> Tuple[List[Tuple[str, float]], float]:
         """Prometheus exposition shape: ([(le, cumulative_count)...]

@@ -64,10 +64,16 @@ class Span:
     span_id: int
     name: str
     detail: str = ""
-    started: float = 0.0         # wall clock, for display only
+    started: float = 0.0         # wall clock, for display only + stitching
     _t0: float = 0.0             # perf_counter base
     stages: List[Tuple[str, float, Dict]] = field(default_factory=list)
     total_us: float = 0.0
+    # The cluster-store revision that triggered this event (ISSUE 10):
+    # 0 for events that did not come off the store (shutdown, healing
+    # timers); for watch-delivered changes and resyncs it is the SAME
+    # number on every agent that saw the write — the key the cluster
+    # aggregator stitches cross-node spans on.
+    revision: int = 0
 
     def stamp(self, stage: str, dur_s: float, **extra) -> None:
         if len(self.stages) < MAX_STAGES:
@@ -83,9 +89,13 @@ class Span:
             "span_id": self.span_id,
             "event": self.name,
             "detail": self.detail,
-            "started": round(self.started, 3),
+            # 6 decimals (µs resolution): cross-node adoption lags are
+            # sub-millisecond on one box, and the stitcher subtracts
+            # these wall stamps — 3 decimals quantized every lag to ms.
+            "started": round(self.started, 6),
             "total_us": round(self.total_us, 1),
             "propagated": self.propagated,
+            "revision": self.revision,
             "stages": [
                 {"stage": s, "us": round(us, 1), **extra}
                 for s, us, extra in self.stages
@@ -122,7 +132,8 @@ class SpanTracker:
 
     # ---------------------------------------------------------- lifecycle
 
-    def start(self, name: str, detail: str = "") -> Span:
+    def start(self, name: str, detail: str = "",
+              revision: int = 0) -> Span:
         """Mint a span and make it the thread's current one."""
         with self._lock:
             self._seq += 1
@@ -131,6 +142,7 @@ class SpanTracker:
         span = Span(
             span_id=span_id, name=name, detail=detail,
             started=time.time(), _t0=time.perf_counter(),
+            revision=revision,
         )
         _current.span = span
         return span

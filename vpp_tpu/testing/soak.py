@@ -34,6 +34,19 @@ the jit pipeline AND its sharded datapath against the ACL oracle).
 Every event is appended to a JSONL record (``SOAK_r08.jsonl``) together
 with PR 6 telemetry evidence (config-propagation spans + latency
 histograms pulled from agent REST).
+
+ISSUE 10 (drill evidence timelines): the binary converged/parity
+verdict says nothing about *how long* a drill took to heal fleet-wide.
+A :class:`ClusterScraper` now rides along — a monitor thread sweeps
+every agent's REST health during each drill — and every drill emits a
+structured ``drill-timeline`` event: fault armed → first node observed
+degraded (named) → fault cleared (store recovered / injection
+disarmed / corpse respawned) → last node converged, with per-node
+first-converged stamps.  After convergence points the conductor also
+records **stitched cluster propagation spans** (``cluster-span``
+events): one store write traced across every agent that adopted it,
+with first/p50/p99/last adoption lags — the quantitative healing
+evidence the fleet-scope observability plane exists to produce.
 """
 
 from __future__ import annotations
@@ -53,6 +66,7 @@ import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from ..statscollector.cluster import ClusterScraper
 from .cluster import free_ports, timeout_mult, wait_for
 from .kubelet import FakeKubelet, pod_ip
 from .procnode import HEARTBEAT_PREFIX, PROBE_KEY
@@ -248,6 +262,96 @@ def _http(server: str, path: str, method: str = "GET",
         return body
 
 
+class _DrillMonitor:
+    """Samples fleet health over REST during ONE fault drill (ISSUE 10)
+    and assembles the drill's evidence timeline.
+
+    A sampler thread runs light (health-only) aggregator sweeps; the
+    first sweep in which a node reports degraded — unreachable, shards
+    not all serving, or healing pending/failed — stamps
+    ``first_degraded``.  The drill code marks the instant the fault was
+    *cleared* (store SIGCONTed, injection disarmed, corpse respawned)
+    via :meth:`mark`; convergence stamps come from the conductor's
+    ``wait_converged`` per-node first-ok times.  Everything is wall
+    clock, same box as the drills themselves."""
+
+    def __init__(self, scraper: ClusterScraper, kind: str,
+                 interval: float = 0.5):
+        self.scraper = scraper
+        self.kind = kind
+        self.interval = interval
+        self.armed_at = time.time()
+        self.first_degraded_at: Optional[float] = None
+        self.first_degraded_node: Optional[str] = None
+        self.degraded_nodes: Set[str] = set()
+        self.marks: Dict[str, float] = {}
+        self.samples = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="drill-monitor", daemon=True)
+        self._thread.start()
+
+    @staticmethod
+    def _degraded(scrape) -> bool:
+        if not scrape.ok:
+            return True
+        health = scrape.health or {}
+        total = health.get("shards_total")
+        if total is not None and health.get("shards_serving") != total:
+            return True
+        ctl = health.get("controller") or {}
+        return bool(ctl.get("healing_pending") or ctl.get("healing_failed"))
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sweep = self.scraper.scrape(light=True)
+            except Exception:  # noqa: BLE001 - store outage mid-resolve
+                sweep = []
+            now = time.time()
+            self.samples += 1
+            for scrape in sweep:
+                if self._degraded(scrape):
+                    self.degraded_nodes.add(scrape.node)
+                    if self.first_degraded_at is None:
+                        self.first_degraded_at = now
+                        self.first_degraded_node = scrape.node
+            self._stop.wait(self.interval)
+
+    def mark(self, name: str) -> None:
+        """Stamp a drill instant (e.g. ``cleared``) once — the first
+        call wins, later re-marks of the same phase are ignored."""
+        self.marks.setdefault(name, time.time())
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
+
+    def timeline(self, convergence: Optional[dict]) -> Dict[str, Any]:
+        """The drill's evidence record (→ ``drill-timeline`` jsonl)."""
+        conv = convergence or {}
+        last_at = conv.get("last_converged_at")
+        out: Dict[str, Any] = {
+            "drill": self.kind,
+            "armed_at": round(self.armed_at, 3),
+            "samples": self.samples,
+            "first_degraded_at": (round(self.first_degraded_at, 3)
+                                  if self.first_degraded_at else None),
+            "first_degraded_node": self.first_degraded_node,
+            "degraded_nodes": sorted(self.degraded_nodes),
+            "cleared_at": (round(self.marks["cleared"], 3)
+                           if "cleared" in self.marks else None),
+            "last_converged_at": (round(last_at, 3) if last_at else None),
+            "last_converged_node": conv.get("last_node"),
+            "converged": bool(conv.get("ok")),
+        }
+        if self.first_degraded_at is not None:
+            out["detect_s"] = round(self.first_degraded_at - self.armed_at, 3)
+        if last_at:
+            out["heal_s"] = round(last_at - self.armed_at, 3)
+        return out
+
+
 # ---------------------------------------------------------------------------
 # The conductor
 # ---------------------------------------------------------------------------
@@ -277,6 +381,14 @@ class SoakCluster:
         self._deferred_k8s: List[Tuple[str, dict]] = []
         self._outage_on = False
         self.probe_round = 0
+        # Fleet aggregator (ISSUE 10): REST addresses resolved from
+        # heartbeats, cached so sweeps keep working while the store is
+        # SIGSTOPped; the monitor + cluster-span/latency evidence all
+        # ride this one scraper.
+        self.scraper = ClusterScraper(self._scraper_servers, timeout=5.0)
+        self._servers_cache: Dict[str, str] = {}
+        self._drill_monitor: Optional[_DrillMonitor] = None
+        self.last_convergence: Dict[str, Any] = {}
         self.events: List[dict] = []
         self._out_fh = open(cfg.out_path, "a") if cfg.out_path else None
         self.report: Dict[str, Any] = {
@@ -395,6 +507,23 @@ class SoakCluster:
     def rest_of(self, name: str) -> Optional[str]:
         beat = self.heartbeat(name)
         return beat.get("rest") if beat else None
+
+    def _scraper_servers(self) -> Dict[str, str]:
+        """REST targets for the fleet scraper, re-resolved from the
+        heartbeats each sweep (agent restarts rebind ports) with the
+        last good map cached — a store-outage window must not blind the
+        monitor to agents whose REST is still perfectly reachable."""
+        try:
+            from ..statscollector.cluster import heartbeat_servers
+
+            servers = {n: s for n, s in
+                       heartbeat_servers(self.client).items()
+                       if n in self.agent_procs}
+        except Exception:  # noqa: BLE001 - store mid-outage: use cache
+            servers = {}
+        if servers:
+            self._servers_cache = servers
+        return dict(self._servers_cache)
 
     # ---------------------------------------------------------------- churn
 
@@ -539,6 +668,10 @@ class SoakCluster:
 
     # ---------------------------------------------------------------- faults
 
+    def _mark_drill(self, name: str) -> None:
+        if self._drill_monitor is not None:
+            self._drill_monitor.mark(name)
+
     def fault_leader_kill(self) -> None:
         leader = self._leader_address()
         assert leader is not None, "no leader to kill"
@@ -551,6 +684,7 @@ class SoakCluster:
             lambda: self._leader_address() not in (None, leader),
             timeout=30.0 * self.mult,
         ), "no new leader after SIGKILL"
+        self._mark_drill("cleared")  # a leader serves again
         # Rejoin the corpse; it catches up via snapshot install.
         self.store_procs[port] = self._spawn_replica(port)
         assert wait_for(lambda: self._replica_ok(port), timeout=60.0), \
@@ -622,6 +756,7 @@ class SoakCluster:
         assert wait_for(lambda: self._leader_address() is not None,
                         timeout=30.0 * self.mult), \
             "store never recovered from SIGSTOP window"
+        self._mark_drill("cleared")  # store recovered
         self._flush_deferred()
         mirror_after_ok = wait_for(
             lambda: self._mirror_resyncs_total() > mirror_before,
@@ -664,6 +799,7 @@ class SoakCluster:
             lambda: self.heartbeat(name) is not None,
             timeout=90.0 * self.mult,
         ), f"restarted agent {name} never heartbeat"
+        self._mark_drill("cleared")  # the replacement process beats
         beat = self.heartbeat(name)
         assert beat["node_id"] == old.get("node_id", beat["node_id"]), \
             f"{name} lost its node ID across restart"
@@ -708,6 +844,7 @@ class SoakCluster:
                 timeout=60.0 * self.mult,
             ), f"{name} shard {shard} never ejected under {site}"
             _http(rest, "/contiv/v1/faults/disarm", method="POST")
+            self._mark_drill("cleared")  # injection disarmed
             _http(rest, f"/contiv/v1/health/recover?shard={shard}",
                   method="POST")
             assert wait_for(
@@ -730,6 +867,7 @@ class SoakCluster:
                 lambda: dp_health().get("swap_rollbacks", 0) > before,
                 timeout=60.0 * self.mult,
             ), f"{name} swap-fail never rolled back"
+            self._mark_drill("cleared")  # count=1 plan exhausted firing
             # The healing resync must land the swap on retry.
             assert wait_for(self._healing_settled(name),
                             timeout=90.0 * self.mult), \
@@ -792,9 +930,37 @@ class SoakCluster:
                 return False
             return True
 
-        ok = wait_for(lambda: all(agent_ok(n) for n in self.agent_procs),
+        # Per-node convergence wavefront (ISSUE 10): stamp each agent's
+        # FIRST ok (dropped again if it regresses before everyone else
+        # arrives) — the drill timeline's "last node converged" and the
+        # straggler name come from here.
+        first_ok: Dict[str, float] = {}
+
+        def sweep_ok() -> bool:
+            all_good = True
+            for n in self.agent_procs:
+                if agent_ok(n):
+                    first_ok.setdefault(n, time.time())
+                else:
+                    first_ok.pop(n, None)
+                    all_good = False
+            return all_good
+
+        ok = wait_for(sweep_ok,
                       timeout=self.cfg.convergence_timeout,
                       interval=0.25)
+        last_node, last_at = None, None
+        if ok and first_ok:
+            last_node = max(first_ok, key=first_ok.get)
+            last_at = first_ok[last_node]
+        self.last_convergence = {
+            "context": context,
+            "ok": ok,
+            "last_node": last_node,
+            "last_converged_at": last_at,
+            "per_node_first_ok": {n: round(t, 3)
+                                  for n, t in sorted(first_ok.items())},
+        }
         if not ok:
             bad = [n for n in self.names if not agent_ok(n)]
             self.report["unconverged"] += len(bad)
@@ -859,6 +1025,41 @@ class SoakCluster:
                     checked=checked, mismatches=mismatches,
                     detail=details)
         return ok and mismatches == 0
+
+    def record_cluster_evidence(self, context: str) -> None:
+        """ISSUE 10 evidence: ONE full aggregator sweep over every
+        agent → the best-coverage stitched propagation span (one store
+        write traced across the fleet, adoption-lag percentiles,
+        stragglers named) and the cluster-merged latency rollup, both
+        into the jsonl record."""
+        try:
+            scrapes = self.scraper.scrape()
+        except Exception as err:  # noqa: BLE001 - evidence, not oracle
+            self.record("churn-error", op="cluster-evidence",
+                        error=str(err))
+            return
+        spans = self.scraper.cluster_spans(scrapes, limit=0)
+        stitched = spans.get("stitched") or []
+        full_coverage = [s for s in stitched
+                         if s["nodes"] >= len(self.agent_procs)]
+        best = max(stitched, key=lambda s: (s["nodes"], s["revision"]),
+                   default=None)
+        if best is not None:
+            self.record("cluster-span", context=context,
+                        agents=len(self.agent_procs),
+                        stitched_total=len(stitched),
+                        full_coverage=len(full_coverage), span=best)
+        latency = self.scraper.cluster_latency(scrapes)
+        trimmed = {
+            name: {k: v for k, v in (snap or {}).items() if k != "buckets"}
+            for name, snap in (latency.get("latency") or {}).items()
+        }
+        skew = latency.get("skew") or {}
+        self.record("cluster-latency", context=context,
+                    nodes_reporting=latency.get("nodes_reporting", 0),
+                    gaps=latency.get("gaps"), latency=trimmed,
+                    cluster_median_us=skew.get("cluster_median_us"),
+                    stragglers=skew.get("stragglers"))
 
     def collect_telemetry(self) -> None:
         """PR 6 evidence: propagation spans + latency histograms from a
@@ -936,10 +1137,18 @@ class SoakCluster:
         churn.join()
         self.wait_converged("initial-deploy")
         self.parity_round("initial-deploy")
+        self.record_cluster_evidence("initial-deploy")
 
         for i, (kind, arg) in enumerate(plan):
             churn_slice = slices[i] if i < len(slices) else []
             churn = self.run_churn(churn_slice)
+            # Drill evidence timeline (ISSUE 10): the monitor sweeps
+            # fleet health over REST for the whole drill — armed →
+            # first degraded → cleared → last converged lands in the
+            # jsonl whether the drill passes or fails.
+            monitor = _DrillMonitor(self.scraper, kind,
+                                    interval=0.5 * self.mult)
+            self._drill_monitor = monitor
             try:
                 if kind == "leader-kill":
                     self.fault_leader_kill()
@@ -949,14 +1158,24 @@ class SoakCluster:
                     self.fault_agent_kill()
                 elif kind == "shard":
                     self.fault_shard(arg)
-            except AssertionError as err:
+            except Exception as err:  # noqa: BLE001 - incl. REST I/O errors
+                # ANY drill failure (assertion or a mid-drill transport
+                # error against a dying agent) is recorded and the run
+                # continues — report["ok"] goes false via errors, and
+                # the timeline below still ships: the crashed drill is
+                # exactly the one whose forensics matter.
                 self.report["errors"].append(f"{kind}: {err}")
                 self.record("fault-failed", kind=kind, error=str(err))
             finally:
                 churn.join()
-            self.wait_converged(f"after-{kind}")
+                self.wait_converged(f"after-{kind}")
+                monitor.stop()
+                self._drill_monitor = None
+                self.record("drill-timeline",
+                            **monitor.timeline(self.last_convergence))
             self.parity_round(f"after-{kind}")
 
+        self.record_cluster_evidence("final")
         self.collect_telemetry()
         self.report["duration_s"] = round(time.time() - t0, 1)
         self.report["churn_ops"] = len(ops)

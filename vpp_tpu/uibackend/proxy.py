@@ -79,6 +79,28 @@ class UIBackend:
         self.port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # One fleet scraper for the backend's lifetime (ISSUE 10): its
+        # last-seen map persists across /api/cluster requests, so the
+        # panel's gap rows carry real ages (a per-request scraper would
+        # report every outage as "never seen").
+        self._scraper = None
+        self._scraper_lock = threading.Lock()
+
+    def _cluster_scraper(self):
+        from ..statscollector.cluster import ClusterScraper
+
+        def servers():
+            out = {}
+            for name in self.list_nodes():
+                server = self.node_directory(name)
+                if server:
+                    out[name] = server
+            return out
+
+        with self._scraper_lock:
+            if self._scraper is None:
+                self._scraper = ClusterScraper(servers)
+            return self._scraper
 
     # ----------------------------------------------------------------- auth
 
@@ -176,6 +198,18 @@ class UIBackend:
         if path == "/api/nodes-directory":
             names = sorted(self.list_nodes()) if self.list_nodes else []
             return 200, "application/json", json.dumps(names).encode()
+
+        if path == "/api/cluster":
+            # The fleet panel (ISSUE 10): one concurrent sweep over
+            # every agent in the directory, shaped for the dashboard.
+            # Unreachable agents arrive as gap rows inside the payload
+            # — the page renders partial fleets, it never blanks.
+            from .views import shape_cluster
+
+            if self.list_nodes is None:
+                return 502, "text/plain", b"no node directory"
+            shaped = shape_cluster(self._cluster_scraper().summary())
+            return 200, "application/json", json.dumps(shaped).encode()
 
         if path.startswith("/api/views/"):
             # Shaped dashboard view models (vpp_tpu/uibackend/views.py):

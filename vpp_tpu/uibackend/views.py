@@ -257,6 +257,72 @@ def shape_latency(inspect: Optional[dict]) -> Dict[str, Any]:
     return out
 
 
+def shape_cluster(summary: Optional[dict]) -> Dict[str, Any]:
+    """The dashboard's cluster panel (ISSUE 10): the fleet rollup an
+    operator reads when the question is "is the CLUSTER healthy" —
+    reachability (gaps named, with last-seen ages), cluster-merged
+    latency percentiles, straggler nodes, and the freshest stitched
+    propagation spans.  Every key consumed here is produced by the
+    aggregator (``ClusterScraper.summary`` and the telemetry stitch/
+    skew helpers) — the obs-parity checker holds the two together so
+    this panel can never silently go blank.  Empty when no aggregator
+    ran (single-node deployments hide the panel)."""
+    if not summary:
+        return {}
+    lat = summary.get("latency") or {}
+    skew = summary.get("skew") or {}
+    rows = []
+    for r in summary.get("per_node") or []:
+        rows.append({
+            "node": r.get("node", ""),
+            "ok": bool(r.get("ok")),
+            "error": r.get("error", ""),
+            "last_seen_age_s": r.get("last_seen_age_s"),
+            "shards_serving": r.get("shards_serving"),
+            "shards_total": r.get("shards_total"),
+            "events": r.get("events", 0),
+            "event_errors": r.get("event_errors", 0),
+            "healing_pending": bool(r.get("healing_pending")),
+            "healing_failed": r.get("healing_failed", 0),
+            "p99_dispatch_us": r.get("p99_dispatch_us"),
+        })
+    spans = []
+    for sp in (summary.get("spans") or [])[:8]:
+        spans.append({
+            "revision": sp.get("revision", 0),
+            "event": sp.get("event", ""),
+            "nodes": sp.get("nodes", 0),
+            "p50_lag_us": sp.get("p50_lag_us", 0.0),
+            "p99_lag_us": sp.get("p99_lag_us", 0.0),
+            "last_lag_us": sp.get("last_lag_us", 0.0),
+            "last_node": sp.get("last_node", ""),
+            "stragglers": sp.get("stragglers") or [],
+        })
+    latency = {}
+    for name in ("admit_wait", "dispatch_rt", "harvest", "frame_e2e"):
+        h = lat.get(name) or {}
+        latency[name] = {
+            "count": h.get("count", 0),
+            "p50": h.get("p50", 0.0),
+            "p99": h.get("p99", 0.0),
+            "p999": h.get("p999", 0.0),
+        }
+    return {
+        "nodes_total": summary.get("nodes_total", 0),
+        "nodes_ok": summary.get("nodes_ok", 0),
+        "nodes_unreachable": summary.get("nodes_unreachable", 0),
+        "gaps": summary.get("gaps") or [],
+        "per_node": rows,
+        "latency": latency,
+        "skew": {
+            "metric": skew.get("metric", ""),
+            "cluster_median_us": skew.get("cluster_median_us", 0.0),
+            "stragglers": skew.get("stragglers") or [],
+        },
+        "spans": spans,
+    }
+
+
 def shape_views(dump: List[dict], ipam: dict, trace: dict,
                 trace_ip: Optional[str] = None,
                 inspect: Optional[dict] = None) -> Dict[str, Any]:
