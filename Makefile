@@ -5,9 +5,9 @@ PY ?= python
 
 .PHONY: test test-race verify verify-ha verify-churn verify-faults \
         verify-adaptive verify-static verify-telemetry verify-soak soak \
-        verify-cluster-obs lint bench \
+        verify-cluster-obs verify-dispatch lint bench \
         bench-suite bench-sweep bench-scale bench-latency bench-frames \
-        bench-churn bench-adaptive bench-history images native \
+        bench-churn bench-adaptive bench-history bench-rounds images native \
         native-sanitize
 
 test:
@@ -57,6 +57,31 @@ verify-adaptive:
 
 bench-adaptive:
 	$(PY) scripts/bench_adaptive.py --check
+
+# Dispatch round-chain verification (ISSUE 11): the flat-punt /
+# packed-harvest test subset (device semantics, verdict parity at
+# every governor K on both engines, packed round-trip properties),
+# then the two round-fusion gates at reduced scale — bench_rounds.py
+# asserts the packed harvest blocks on <= 2 materialisations per batch
+# with a lower materialize p50 at equal load (simulated-floor row is
+# the judged one on CPU, always labelled), and mesh_overhead.py
+# asserts the STRUCTURAL round cut on the 8-device virtual mesh:
+# flat-punt's partitioned-session sharded program compiles to strictly
+# fewer collectives than flat-safe's, at wall-time parity (emulated
+# collectives carry no interconnect latency, so the removed round
+# cannot show as wall time here — see the script docstring).
+# Full-scale recordings are `make bench-rounds` /
+# `python scripts/mesh_overhead.py --check`.
+verify-dispatch:
+	JAX_PLATFORMS=cpu $(PY) -m pytest \
+	    tests/test_pipeline.py tests/test_governor.py \
+	    -q $(if $(RUN_SLOW),,-m 'not slow') --continue-on-collection-errors \
+	    -p no:cacheprovider -p no:xdist -p no:randomly
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_rounds.py --smoke --check
+	JAX_PLATFORMS=cpu $(PY) scripts/mesh_overhead.py --smoke --check
+
+bench-rounds:
+	$(PY) scripts/bench_rounds.py --check
 
 # Telemetry verification (ISSUE 8): the histogram/span/flight suites
 # (single-writer vs reader-merge property, bucket boundaries, the full
@@ -157,7 +182,8 @@ soak:
 # The aggregate verification gate: static battery + every subsystem's
 # verify target, soak-smoke included.
 verify: lint verify-static verify-ha verify-churn verify-adaptive \
-        verify-telemetry verify-faults verify-cluster-obs verify-soak
+        verify-dispatch verify-telemetry verify-faults verify-cluster-obs \
+        verify-soak
 	@echo verify OK
 
 bench:

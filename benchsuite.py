@@ -32,7 +32,12 @@ from vpp_tpu.models import ProtocolType
 from vpp_tpu.ops.classify import NO_TABLE, build_rule_tables
 from vpp_tpu.ops.nat import NatMapping, build_nat_tables, empty_sessions
 from vpp_tpu.ops.packets import ip_to_u32, make_batch
-from vpp_tpu.ops.pipeline import ROUTE_REMOTE, make_route_config, pipeline_step_jit
+from vpp_tpu.ops.pipeline import (
+    ROUTE_REMOTE,
+    make_route_config,
+    pipeline_step_jit,
+    unpack_verdicts,
+)
 from vpp_tpu.policy.renderer.api import Action, ContivRule
 
 import bench  # the config-5 stress builders live in bench.py
@@ -50,7 +55,9 @@ def _measure(acl, nat, route, batch, iters, rounds=3, step=None):
     is split into 256-packet vectors and dispatched with the flat-safe
     discipline (batch-parallel with post-commit same-dispatch-reply
     reconciliation; pass ``step=pipeline_scan_ts0_jit`` for the sequential
-    scan).  Returns (best_mpps, flat_result).
+    scan).  Returns (best_mpps, packed_result) — unpack verdict reads
+    with ``_unpack`` AFTER every measurement is done (see main()'s
+    deferred-verification note).
 
     Best-of-``rounds``: the shared-TPU tunnel shows high run-to-run
     variance, and the max is the honest estimate of what the pipeline
@@ -73,7 +80,7 @@ def _measure(acl, nat, route, batch, iters, rounds=3, step=None):
     # host-side arange per dispatch is an extra tunnel round trip,
     # measured at a 40-100% tax in r4), and leaves come back flat.
     result = step(acl, nat, route, sessions, batches, jnp.int32(0))
-    result.allowed.block_until_ready()
+    result.packed.block_until_ready()
     sessions = result.sessions
     best = 0.0
     ts = k
@@ -83,10 +90,18 @@ def _measure(acl, nat, route, batch, iters, rounds=3, step=None):
             result = step(acl, nat, route, sessions, batches, jnp.int32(ts))
             ts += k
             sessions = result.sessions
-        result.allowed.block_until_ready()
+        result.packed.block_until_ready()
         dt = (time.perf_counter() - t0) / iters
         best = max(best, n / dt / 1e6)
     return best, result
+
+
+def _unpack(packed_result):
+    """Verify-time host unpack of one packed dispatch result (pays the
+    D2H transfer — call only after every measurement is done)."""
+    import numpy as np
+
+    return unpack_verdicts(np.asarray(packed_result.packed))
 
 
 def _report(config, metric, mpps):
@@ -138,7 +153,8 @@ def config1(batch_size, iters):
     _report(1, "pod-to-pod single node, no policies", mpps)
 
     def verify():
-        assert bool(res.allowed.all()), "pod-to-pod with no policies must pass"
+        assert bool(_unpack(res).allowed.all()), \
+            "pod-to-pod with no policies must pass"
     return verify
 
 
@@ -177,7 +193,7 @@ def config2(batch_size, iters):
     _report(2, "policy suite (~20 ACL rules)", mpps)
 
     def verify():
-        assert bool(res.allowed.any()), "some flows match PERMIT rules"
+        assert bool(_unpack(res).allowed.any()), "some flows match PERMIT rules"
     return verify
 
 
@@ -195,7 +211,7 @@ def config3(batch_size, iters):
     _report(3, "ClusterIP, 8 backends, NAT44 LB", mpps)
 
     def verify():
-        assert bool(res.dnat_hit.all()), "all service flows must DNAT"
+        assert bool(_unpack(res).dnat_hit.all()), "all service flows must DNAT"
     return verify
 
 
@@ -217,8 +233,9 @@ def config4(batch_size, iters):
     _report(4, "2-node VXLAN overlay + SNAT egress", mpps)
 
     def verify():
-        assert bool((res.route == ROUTE_REMOTE).any()), "expected VXLAN-bound flows"
-        assert bool(res.snat_hit.any()), "expected SNAT egress flows"
+        v = _unpack(res)
+        assert bool((v.route == ROUTE_REMOTE).any()), "expected VXLAN-bound flows"
+        assert bool(v.snat_hit.any()), "expected SNAT egress flows"
     return verify
 
 
@@ -230,7 +247,8 @@ def config5(batch_size, iters):
     _report(5, "10k ACL rules + 1k services stress", mpps)
 
     def verify():
-        assert bool(res.dnat_hit.any()) and bool(res.snat_hit.any())
+        v = _unpack(res)
+        assert bool(v.dnat_hit.any()) and bool(v.snat_hit.any())
     return verify
 
 
@@ -257,7 +275,7 @@ def sweep(iters):
         # Flat dispatch: one n-wide batch per device call.
         sessions = empty_sessions(1 << 16)
         r = pipeline_step_jit(acl, nat, route, sessions, batch, jnp.int32(0))
-        r.allowed.block_until_ready()
+        r.packed.block_until_ready()
         sessions = r.sessions
         it = max(20, min(400, 16384 * iters // n))
         flat_best, ts = 0.0, 0
@@ -267,7 +285,7 @@ def sweep(iters):
                 ts += 1
                 r = pipeline_step_jit(acl, nat, route, sessions, batch, jnp.int32(ts))
                 sessions = r.sessions
-            r.allowed.block_until_ready()
+            r.packed.block_until_ready()
             flat_best = max(flat_best, n / ((time.perf_counter() - t0) / it) / 1e6)
         # Vector-scan dispatch: n/256 vectors per device call.
         k = n // VECTOR_SIZE
@@ -276,7 +294,7 @@ def sweep(iters):
         r = pipeline_scan_ts0_jit(
             acl, nat, route, sessions, batches, jnp.int32(0)
         )
-        r.allowed.block_until_ready()
+        r.packed.block_until_ready()
         sessions = r.sessions
         scan_best, ts = 0.0, k
         for _ in range(3):
@@ -286,10 +304,15 @@ def sweep(iters):
                                           jnp.int32(ts))
                 ts += k
                 sessions = r.sessions
-            r.allowed.block_until_ready()
+            r.packed.block_until_ready()
             scan_best = max(scan_best, n / ((time.perf_counter() - t0) / it) / 1e6)
         # Flat-safe dispatch (production): batch-parallel + reconcile.
         safe_best, _ = _measure(acl, nat, route, batch, it)
+        # Flat-punt (round-cut): straggler restores punted to the host.
+        from vpp_tpu.ops.pipeline import pipeline_flat_punt_ts0_jit
+
+        punt_best, _ = _measure(acl, nat, route, batch, it,
+                                step=pipeline_flat_punt_ts0_jit)
         print(
             json.dumps(
                 {
@@ -299,6 +322,7 @@ def sweep(iters):
                     "flat_mpps": round(flat_best, 2),
                     "scan_mpps": round(scan_best, 2),
                     "safe_mpps": round(safe_best, 2),
+                    "punt_mpps": round(punt_best, 2),
                 }
             ),
             flush=True,
@@ -325,7 +349,8 @@ def latency(iters):
     import jax
 
     from vpp_tpu.ops.pipeline import (
-        VECTOR_SIZE, pipeline_flat_safe_ts0_jit, pipeline_scan_ts0_jit,
+        VECTOR_SIZE, pipeline_flat_punt_ts0_jit, pipeline_flat_safe_ts0_jit,
+        pipeline_scan_ts0_jit,
     )
 
     acl, nat, route, _, pod_ips, mappings = bench.build_stress_state()
@@ -334,7 +359,7 @@ def latency(iters):
         batch = bench.build_traffic(pod_ips, mappings, n)
         k = n // VECTOR_SIZE
         batches = jax.tree_util.tree_map(lambda a: a.reshape(k, VECTOR_SIZE), batch)
-        for disc in ("flat", "scan", "flat-safe"):
+        for disc in ("flat", "scan", "flat-safe", "flat-punt"):
             sessions = empty_sessions(1 << 16)
             ts = 0
 
@@ -346,11 +371,13 @@ def latency(iters):
                     ts += 1
                 else:
                     step = (pipeline_flat_safe_ts0_jit if disc == "flat-safe"
+                            else pipeline_flat_punt_ts0_jit
+                            if disc == "flat-punt"
                             else pipeline_scan_ts0_jit)
                     r = step(acl, nat, route, sessions, batches, jnp.int32(ts))
                     ts += k
                 sessions = r.sessions
-                return r.allowed
+                return r.packed
 
             p50_s, p99_s, p999_s = bench.sample_dispatch_latency(
                 dispatch, samples=n_lat_samples
@@ -451,7 +478,7 @@ def scale(iters):
         jax.clear_caches()
         sessions = empty_sessions(1 << 16)
         r = pipeline_step_jit(acl, nat, route, sessions, batch, jnp.int32(0))
-        r.allowed.block_until_ready()
+        r.packed.block_until_ready()
         sessions = r.sessions
         best, ts = 0.0, 0
         for _ in range(3):
@@ -460,7 +487,7 @@ def scale(iters):
                 ts += 1
                 r = pipeline_step_jit(acl, nat, route, sessions, batch, jnp.int32(ts))
                 sessions = r.sessions
-            r.allowed.block_until_ready()
+            r.packed.block_until_ready()
             best = max(best, len(flows) / ((time.perf_counter() - t0) / iters) / 1e6)
         report(label, best)
     os.environ.pop("VPP_TPU_FORCE_DENSE", None)

@@ -16,11 +16,19 @@ the repo root into one series-per-metric view:
   newest round drops more than ``--threshold`` (default 10%) below the
   previous recorded round.
 
+``--check`` (the gate) exits 1 only on flagged series whose latest
+point sits at the repo's NEWEST recorded round — i.e. on what the
+current PR's re-recording actually made worse.  Flags on series last
+touched rounds ago (the r05 headline-policy switch, suites only a TPU
+environment can re-record) stay visible in the table as history, but
+history is not an action item for the PR being gated (same philosophy
+as the per-series rule: older dips that later recovered don't flag).
+
 Usage::
 
     python scripts/bench_history.py                # table to stdout
     python scripts/bench_history.py --json         # machine-readable
-    python scripts/bench_history.py --check        # exit 1 on regressions
+    python scripts/bench_history.py --check        # exit 1 on newest-round regressions
     make bench-history
 
 Flags regressions, never re-runs benches: this is a reader over the
@@ -102,7 +110,8 @@ _KEY_INTS = ("dispatch_pkts", "vectors", "devices", "batch", "rules",
 # every ``*_mpps`` field as sub-series (the sweep files compare
 # disciplines side by side in one row).
 _VALUE_FIELDS = ("value", "achieved_mpps_median", "median_mpps", "median",
-                 "mpps", "speedup", "p50_step_us", "p50_ms", "p50_us")
+                 "mpps", "speedup", "p50_step_us", "p50_ms", "p50_us",
+                 "materialize_p50_us")
 
 
 def _row_key(rec: dict) -> Optional[str]:
@@ -151,6 +160,24 @@ def collect(root: pathlib.Path) -> Dict[str, Dict[str, Dict[int, float]]]:
                 if isinstance(cap, dict) and "median" in cap:
                     series.setdefault("capability", {})[rnd] = \
                         float(cap["median"])
+                # Per-round dispatch attribution (ISSUE 11): the
+                # headline's `rounds` block quotes p50/p99 µs per
+                # wait/materialize/restore/stitch round — tracked as
+                # their own series so the packed-harvest fusion stays
+                # judgeable round over round (the _us suffix gives the
+                # regression flag its lower-is-better direction).
+                rounds = rec.get("rounds")
+                if isinstance(rounds, dict):
+                    for rname, snap in sorted(rounds.items()):
+                        if not isinstance(snap, dict):
+                            continue
+                        for field in ("p50_us", "p99_us"):
+                            val = snap.get(field)
+                            if isinstance(val, (int, float)) and \
+                                    not isinstance(val, bool):
+                                series.setdefault(
+                                    f"rounds.{rname}.{field}", {},
+                                )[rnd] = float(val)
             continue
         for rec in _jsonl_rows(path):
             key = _row_key(rec)
@@ -253,7 +280,14 @@ def main(argv=None) -> int:
 
     history = collect(pathlib.Path(args.root))
     rows, regressions = trajectory(history, args.threshold)
+    # The gate scopes to the NEWEST recorded round: a flagged series
+    # last touched rounds ago is history the current PR did not record
+    # (and often cannot — TPU-only suites in a CPU environment); a
+    # flagged series AT the newest round is what this PR made worse.
+    newest = max((r["rounds"][-1] for r in rows), default=0)
+    gated = [r for r in regressions if r["rounds"][-1] == newest]
     report = {"series": rows, "regressions": regressions,
+              "gated_regressions": gated, "newest_round": newest,
               "threshold": args.threshold}
     if args.json:
         print(json.dumps(report, indent=1))
@@ -266,13 +300,16 @@ def main(argv=None) -> int:
         print(f"\n{len(rows)} series across "
               f"{len({r['suite'] for r in rows})} suites; "
               f"{len(regressions)} regression(s) at "
-              f"{args.threshold:.0%} threshold")
+              f"{args.threshold:.0%} threshold "
+              f"({len(gated)} at the newest round r{newest:02d})")
         for row in regressions:
+            stale = "" if row["rounds"][-1] == newest else \
+                f" [history: last recorded r{row['rounds'][-1]:02d}]"
             print(f"REGRESSION {row['suite']}/{row['series']}: "
-                  f"{row['delta_pct']:+.1f}% at latest round")
+                  f"{row['delta_pct']:+.1f}% at latest round{stale}")
     if args.out:
         pathlib.Path(args.out).write_text(json.dumps(report, indent=1))
-    if args.check and regressions:
+    if args.check and gated:
         return 1
     return 0
 

@@ -1,0 +1,195 @@
+"""Equal-load A/B of the packed single-transfer harvest (ISSUE 11).
+
+The tentpole claim: the harvest used to block on ~12 separate
+``np.asarray`` device→host materialisations per batch (7 verdict
+leaves + the rewritten 5-tuple), each a round trip on a remote-TPU
+tunnel; the in-program packing tail fuses them into ONE contiguous
+uint32 [4, B] array, so the harvest's ``materialize`` round blocks on
+a single transfer and unpacks host-side with numpy views.
+
+This harness measures exactly that round at EQUAL load: the same
+flat-safe dispatch stream (same tables, traffic, K) harvested two
+ways —
+
+- ``unpacked``: a 12-leaf result (the pre-ISSUE-11 jit output shape,
+  reconstructed here since the production entry points are packed
+  now), one blocking ``np.asarray`` per leaf;
+- ``packed``: the production packed entry point, one materialisation
+  + host-side unpack (``unpack_verdicts``).
+
+Per-batch materialize wall time is recorded into the SAME log2
+histogram class the runner's ``rounds["materialize"]`` attribution
+uses, so the artifact and `netctl inspect` quote one methodology.
+
+On a locally-attached CPU backend a materialisation is a ~free view,
+so besides the real measurement the harness replays the A/B with a
+LABELLED simulated per-transfer round-trip floor (``--floor-us``,
+default rows at 0 and 100 µs — the bench_adaptive.py emulation
+pattern): every blocking device materialisation pays the floor, which
+is how the remote-tunnel transfer mode actually behaves
+(scripts/tunnel_d2h_probe.py).  Simulated rows are always labelled.
+
+Usage::
+
+    python scripts/bench_rounds.py [--vectors 64] [--iters 40]
+        [--floor-us 100] [--check]
+
+``--check`` exits 1 unless the packed side blocks on at most 2
+materialisations per batch AND its floored materialize p50 lands
+below the unpacked side's.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--vectors", type=int, default=64,
+                        help="K of the dispatched [K, 256] batch "
+                             "(64 = the production headline shape)")
+    parser.add_argument("--iters", type=int, default=40)
+    parser.add_argument("--rules", type=int, default=10000)
+    parser.add_argument("--services", type=int, default=1000)
+    parser.add_argument("--floor-us", type=float, default=100.0,
+                        help="simulated per-materialisation round-trip "
+                             "floor for the second row pair (0 skips)")
+    parser.add_argument("--check", action="store_true")
+    parser.add_argument("--smoke", action="store_true",
+                        help="reduced scale for CI gates")
+    args = parser.parse_args(argv)
+    if args.smoke:
+        args.vectors = min(args.vectors, 8)
+        args.iters = min(args.iters, 12)
+        args.rules, args.services = 256, 64
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import bench
+    from vpp_tpu.ops.nat import empty_sessions
+    from vpp_tpu.ops.pipeline import (
+        VECTOR_SIZE,
+        flatten_scan_result,
+        pipeline_flat_safe,
+        pipeline_flat_safe_ts0_jit,
+        unpack_verdicts,
+    )
+    from vpp_tpu.telemetry import Log2Histogram
+
+    acl, nat, route, _, pod_ips, mappings = bench.build_stress_state(
+        n_rules=args.rules, n_services=args.services
+    )
+    k = args.vectors
+    b = k * VECTOR_SIZE
+    flat = bench.build_traffic(pod_ips, mappings, b)
+    vecs = jax.tree_util.tree_map(
+        lambda a: a.reshape(k, VECTOR_SIZE), flat)
+
+    # The pre-ISSUE-11 output shape: the SAME flat-safe program minus
+    # the packing tail — 12 separate leaves to materialise.  (Local
+    # jax.jit is fine here: bench scripts are outside the
+    # jit-discipline checker's ops/+datapath/ scope, and this wrapper
+    # exists precisely to reconstruct the retired shape for the A/B.)
+    def _unpacked_ts0(acl_, nat_, route_, sessions_, batches_, ts0):
+        kk = batches_.src_ip.shape[0]
+        tss = ts0 + jnp.arange(1, kk + 1, dtype=jnp.int32)
+        return flatten_scan_result(
+            pipeline_flat_safe(acl_, nat_, route_, sessions_, batches_, tss))
+
+    unpacked_jit = jax.jit(_unpacked_ts0, donate_argnums=(3,))
+
+    def harvest_leaves(res):
+        """Every device leaf a harvest must materialise: the result's
+        output arrays minus the session table (threaded to the next
+        dispatch on device, never read back).  MEASURED from the
+        actual result structure, not assumed — if a future pipeline
+        change sneaks an extra un-packed output past the packing
+        tail, the count (and the --check gate) catches it."""
+        return jax.tree_util.tree_leaves(
+            [v for f, v in zip(res._fields, res) if f != "sessions"])
+
+    def run_side(side, floor_us):
+        """One measured pass; returns (hist, transfers_per_batch)."""
+        sessions = empty_sessions(1 << 16)
+        hist = Log2Histogram()
+        floor_s = floor_us * 1e-6
+        step = pipeline_flat_safe_ts0_jit if side == "packed" \
+            else unpacked_jit
+        # Warm-up dispatch (compile outside the timed loop).
+        r = step(acl, nat, route, sessions, vecs, jnp.int32(0))
+        mats = len(harvest_leaves(r))
+        harvest_leaves(r)[0].block_until_ready()
+        sessions = r.sessions
+        ts = k
+        for _ in range(args.iters):
+            r = step(acl, nat, route, sessions, vecs, jnp.int32(ts))
+            ts += k
+            sessions = r.sessions
+            t0 = time.perf_counter()
+            arrs = []
+            for leaf in harvest_leaves(r):
+                arrs.append(np.asarray(leaf))  # one blocking transfer each
+                if floor_s:
+                    time.sleep(floor_s)
+            if side == "packed":
+                unpack_verdicts(arrs[0])    # the host-side view split
+            hist.record_s(time.perf_counter() - t0)
+        return hist, mats
+
+    meta = {
+        "bench": "rounds-materialize-ab",
+        "dispatch_pkts": b,
+        "vectors": k,
+        "rules": args.rules,
+        "backend": jax.default_backend(),
+        "smoke": bool(args.smoke),
+    }
+    results = {}
+    floors = [0.0] + ([args.floor_us] if args.floor_us > 0 else [])
+    for floor_us in floors:
+        for side in ("unpacked", "packed"):
+            hist, mats = run_side(side, floor_us)
+            snap = hist.snapshot()
+            key = (side, floor_us)
+            results[key] = (snap, mats)
+            print(json.dumps({
+                **meta,
+                "side": side,
+                "materializations_per_batch": mats,
+                "simulated_floor_us": floor_us,
+                "simulated": floor_us > 0,
+                "materialize_p50_us": snap["p50"],
+                "materialize_p99_us": snap["p99"],
+            }), flush=True)
+
+    if args.check:
+        floor = floors[-1]
+        packed_snap, packed_mats = results[("packed", floor)]
+        unpacked_snap, _ = results[("unpacked", floor)]
+        ok = packed_mats <= 2 and packed_snap["p50"] < unpacked_snap["p50"]
+        print(json.dumps({
+            "check": "packed harvest: <=2 materializations and lower "
+                     "materialize p50 at equal load",
+            "floor_us": floor,
+            "packed_materializations": packed_mats,
+            "packed_p50_us": packed_snap["p50"],
+            "unpacked_p50_us": unpacked_snap["p50"],
+            "ok": ok,
+        }), flush=True)
+        if not ok:
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

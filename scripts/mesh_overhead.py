@@ -6,17 +6,40 @@ vs GSPMD-sharded over an 8-device mesh, for both session placements
 (2^16) — isolating what the data/rules partition + the session-scatter
 combine collectives add to a step.
 
+MESHOVERHEAD_r05 structure finding: the ~4x sharded tax of the safe
+disciplines is the session-table synchronization ROUND COUNT (each
+dependent scatter/gather over the table is a collective), not the
+placement.  ISSUE 11's ``flat-punt`` discipline implements the cut
+that artifact proposed — keep the one tagged post-commit probe, punt
+detected stragglers to the host instead of paying the dependent
+restore rounds — and this harness now measures it beside flat-safe so
+the round-cut is directly judgeable.
+
+How the cut is judged: on VIRTUAL CPU devices an emulated collective
+is a shared-memory copy with no interconnect latency, so the removed
+round does NOT show as wall time here (measured at parity; r09).  What
+IS deterministic on any backend is the compiled PROGRAM STRUCTURE, so
+each flat discipline's sharded program is also lowered and its
+collectives counted (``collectives`` rows): with partitioned sessions
+flat-punt compiles to strictly fewer collectives than flat-safe — the
+finalize-dependent meta re-check gather's combine is gone — which is
+exactly the dependent session-table round that pays ICI latency on a
+real mesh.  ``--check`` asserts (a) that structural cut and (b)
+flat-punt's sharded wall time holds parity with flat-safe's within
+``--parity-tol`` (the punt tail must not be a net loss).  `make
+verify-dispatch` gates on the reduced-scale ``--smoke`` shape.
+
 Caveat (stated in the artifact): with one real TPU chip in the
 environment, the mesh runs on 8 VIRTUAL CPU devices
 (xla_force_host_platform_device_count), so the numbers measure GSPMD
 partitioning + emulated-collective overhead on host shapes, NOT ICI
 latency.  The artifact's purpose is (a) the overhead STRUCTURE
-(replicated vs partitioned sessions; which placement pays more per
-step) and (b) proof the sharded step is driven end-to-end over many
-steps — real-ICI numbers need a multi-chip slice.
+(which discipline pays how many rounds; replicated vs partitioned
+sessions) and (b) proof the sharded step is driven end-to-end over
+many steps — real-ICI numbers need a multi-chip slice.
 
 Usage: python scripts/mesh_overhead.py [--devices 8] [--batch 4096]
-       [--iters 30]
+       [--iters 30] [--smoke] [--check] [--parity-tol 0.15]
 """
 
 from __future__ import annotations
@@ -36,7 +59,31 @@ def main(argv=None) -> int:
     parser.add_argument("--batch", type=int, default=4096)
     parser.add_argument("--iters", type=int, default=30)
     parser.add_argument("--capacity", type=int, default=1 << 16)
+    parser.add_argument("--smoke", action="store_true",
+                        help="reduced scale (small tables/batch/iters) "
+                             "for the make verify-dispatch gate")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 unless flat-punt's partitioned-"
+                             "session sharded program compiles to "
+                             "strictly fewer collectives than flat-safe's "
+                             "AND its sharded wall time holds parity")
+    parser.add_argument("--parity-tol", type=float, default=0.15,
+                        help="--check: max relative wall-time excess of "
+                             "flat-punt's sharded p50 over flat-safe's, "
+                             "averaged over both placements (fraction; "
+                             "default 15%% — virtual-mesh runs are noisy "
+                             "and the structural cut is the primary gate)")
     args = parser.parse_args(argv)
+
+    n_rules, n_services = 10000, 1000
+    if args.smoke:
+        # Small tables + batch: the ROUND STRUCTURE (what --check judges)
+        # is scale-independent — the dependent session-table collectives
+        # exist at any size — while the run fits a verify-gate budget.
+        n_rules, n_services = 256, 64
+        args.batch = min(args.batch, 1024)
+        args.iters = min(args.iters, 10)
+        args.capacity = min(args.capacity, 1 << 12)
 
     from vpp_tpu.parallel.mesh import ensure_devices
 
@@ -51,6 +98,7 @@ def main(argv=None) -> int:
     from vpp_tpu.ops.nat import empty_sessions
     from vpp_tpu.ops.pipeline import (
         VECTOR_SIZE,
+        pipeline_flat_punt_ts0_jit,
         pipeline_flat_safe_ts0_jit,
         pipeline_scan_ts0_jit,
         pipeline_step_jit,
@@ -65,7 +113,7 @@ def main(argv=None) -> int:
                      f"[K, {VECTOR_SIZE}] shapes)")
 
     acl, nat, route, _, pod_ips, mappings = bench.build_stress_state(
-        n_rules=10000, n_services=1000
+        n_rules=n_rules, n_services=n_services
     )
     flat_batch = bench.build_traffic(pod_ips, mappings, args.batch)
     k = args.batch // VECTOR_SIZE
@@ -73,27 +121,28 @@ def main(argv=None) -> int:
         lambda a: a.reshape(k, VECTOR_SIZE), flat_batch
     )
 
-    # The r4/r5 dispatch surface: the flat step (raw upper bound), the
-    # PRODUCTION flat-safe ts0 discipline (commit-first) and the
-    # sequential vector scan — each measured single-device and sharded
-    # per session placement, so the overhead story covers the shapes
-    # the runner actually dispatches.
+    # The dispatch surface: the flat step (raw upper bound), the
+    # PRODUCTION flat-safe ts0 discipline (commit-first), the flat-punt
+    # round-cut (ISSUE 11), and the sequential vector scan — each
+    # measured single-device and sharded per session placement, so the
+    # overhead story covers the shapes the runner actually dispatches.
     disciplines = {
         "flat": (pipeline_step_jit, flat_batch),
         "flat-safe-ts0": (pipeline_flat_safe_ts0_jit, vec_batch),
+        "flat-punt-ts0": (pipeline_flat_punt_ts0_jit, vec_batch),
         "scan-ts0": (pipeline_scan_ts0_jit, vec_batch),
     }
 
     def measure(step, batch, a, n, r, sessions, put_batch):
         b = put_batch(batch)
         res = step(a, n, r, sessions, b, jnp.int32(0))
-        res.allowed.block_until_ready()
+        res.packed.block_until_ready()
         sess = res.sessions
         lats = []
         for i in range(args.iters):
             t0 = time.perf_counter()
             res = step(a, n, r, sess, b, jnp.int32((i + 1) * max(1, k)))
-            res.allowed.block_until_ready()
+            res.packed.block_until_ready()
             lats.append(time.perf_counter() - t0)
             sess = res.sessions
         lats.sort()
@@ -109,37 +158,103 @@ def main(argv=None) -> int:
         rows.append({"mode": "single-device", "discipline": disc,
                      "p50_step_us": round(singles[disc], 1)})
 
+    # Collectives in one compiled sharded program — the deterministic
+    # round-count evidence (see module docstring).  Counted over the
+    # optimized HLO the backend actually runs.
+    collective_ops = ("all-reduce", "all-gather", "reduce-scatter",
+                      "collective-permute", "all-to-all")
+
+    def collective_counts(step, a, n, r, s, b):
+        txt = step.lower(a, n, r, s, b, jnp.int32(0)).compile().as_text()
+        counts = {op: 0 for op in collective_ops}
+        for line in txt.splitlines():
+            line = line.lstrip()
+            if "=" not in line:
+                continue
+            rhs = line.split("=", 1)[1].lstrip()
+            # "f32[...]{...} all-reduce(...)" — the op name leads the
+            # call; startswith on the shape-stripped rhs avoids
+            # matching operand references.
+            body = rhs.split(" ", 1)[1] if " " in rhs else rhs
+            for op in collective_ops:
+                if body.startswith(op):
+                    counts[op] += 1
+        return {op: c for op, c in counts.items() if c}, sum(counts.values())
+
+    sharded_p50s: dict = {}
+    collectives: dict = {}
     mesh = make_mesh(args.devices)
     for partitioned in (False, True):
+        mode = (f"mesh-{args.devices}-partitioned-sessions" if partitioned
+                else f"mesh-{args.devices}-replicated-sessions")
         for disc, (step, batch) in disciplines.items():
             with mesh:
                 a, n, r, s = shard_dataplane(
                     mesh, acl, nat, route, empty_sessions(args.capacity),
                     partition_sessions=partitioned,
                 )
+                b = shard_batch(mesh, batch)
                 us = measure(
                     step, batch, a, n, r, s,
-                    put_batch=lambda b: shard_batch(mesh, b),
+                    put_batch=lambda _: b,
                 )
-            rows.append({
-                "mode": (f"mesh-{args.devices}-partitioned-sessions"
-                         if partitioned
-                         else f"mesh-{args.devices}-replicated-sessions"),
-                "discipline": disc,
-                "p50_step_us": round(us, 1),
-                "overhead_vs_single": round(us / singles[disc], 2),
-            })
+                row = {
+                    "mode": mode,
+                    "discipline": disc,
+                    "p50_step_us": round(us, 1),
+                    "overhead_vs_single": round(us / singles[disc], 2),
+                }
+                if disc in ("flat-safe-ts0", "flat-punt-ts0"):
+                    kinds, total = collective_counts(step, a, n, r, s, b)
+                    collectives[(disc, partitioned)] = total
+                    row["collectives"] = total
+                    row["collective_kinds"] = kinds
+            sharded_p50s.setdefault(disc, []).append(us)
+            rows.append(row)
 
     meta = {
         "batch": args.batch,
         "session_capacity": args.capacity,
         "devices": args.devices,
+        "rules": n_rules,
         "backend": jax.default_backend(),
+        "smoke": bool(args.smoke),
         "note": "virtual CPU devices: structure/correctness of the "
                 "sharding overhead, not ICI latency",
     }
     for row in rows:
         print(json.dumps({**meta, **row}), flush=True)
+
+    if args.check:
+        # (a) The structural round-cut, deterministic at any scale:
+        # with partitioned sessions flat-punt's compiled sharded
+        # program must carry strictly fewer collectives than
+        # flat-safe's (the finalize-dependent meta re-check gather's
+        # combine is the one it sheds).  (b) Wall-time parity on this
+        # virtual mesh: emulated collectives have no interconnect
+        # latency, so the cut cannot SHOW here — but the punt tail
+        # must not be a net loss either.
+        safe_coll = collectives[("flat-safe-ts0", True)]
+        punt_coll = collectives[("flat-punt-ts0", True)]
+        safe_us = sum(sharded_p50s["flat-safe-ts0"]) / \
+            len(sharded_p50s["flat-safe-ts0"])
+        punt_us = sum(sharded_p50s["flat-punt-ts0"]) / \
+            len(sharded_p50s["flat-punt-ts0"])
+        excess = punt_us / safe_us - 1.0 if safe_us > 0 else 0.0
+        verdict = {
+            "check": "flat-punt round-cut vs flat-safe (sharded)",
+            "flat_safe_collectives_partitioned": safe_coll,
+            "flat_punt_collectives_partitioned": punt_coll,
+            "structural_cut": punt_coll < safe_coll,
+            "flat_safe_sharded_p50_us": round(safe_us, 1),
+            "flat_punt_sharded_p50_us": round(punt_us, 1),
+            "wall_excess": round(excess, 3),
+            "parity_tol": args.parity_tol,
+            "ok": punt_coll < safe_coll and excess <= args.parity_tol,
+        }
+        print(json.dumps(verdict), flush=True)
+        if not verdict["ok"]:
+            return 1
     return 0
 
 

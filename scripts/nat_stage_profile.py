@@ -199,7 +199,7 @@ def main() -> int:
         r = pipeline_flat_safe_ts0_jit(
             acl, nat, route, full_state["sessions"], vecs, jnp.int32(0))
         full_state["sessions"] = r.sessions
-        return r.allowed
+        return r.packed
 
     full = bench._timed_rounds(full_dispatch, b, n_iters=20, rounds=5)
 

@@ -284,11 +284,14 @@ def test_prewarm_reruns_on_table_swap_shapes():
 
 
 @pytest.mark.parametrize("ring_cls", [NativeRing, InMemoryRing])
-def test_governed_verdict_parity_with_mock_engines_at_every_k(ring_cls):
+@pytest.mark.parametrize("dispatch", ["flat-safe", "flat-punt"])
+def test_governed_verdict_parity_with_mock_engines_at_every_k(
+        ring_cls, dispatch):
     """Mixed allowed/denied traffic in waves sized to make the governor
     select K = 1, 2, 4 and 8: delivery must match the mock-engine
-    oracle exactly at every chosen K, on both engines."""
-    runner, (rx, tx, local, host) = _make_runner(ring_cls)
+    oracle exactly at every chosen K, on both engines — for the
+    production flat-safe discipline AND the flat-punt round-cut."""
+    runner, (rx, tx, local, host) = _make_runner(ring_cls, dispatch=dispatch)
     flows, expected = [], []
     port = 40000
     for wave_k in (1, 2, 4, 8):
@@ -308,6 +311,157 @@ def test_governed_verdict_parity_with_mock_engines_at_every_k(ring_cls):
     assert set(runner.governor.k_hist) == {1, 2, 4, 8}
     assert runner.counters.dropped_denied == sum(
         len(w) for w in flows) - len(expected)
+
+
+# -------------------------------------------- flat-punt straggler punts
+
+
+def _straggler_world():
+    """ACL-free tables with one DNAT service: a forward commits a
+    device session, so its reply sharing the SAME admitted batch is a
+    straggler the flat-punt probe must detect."""
+    from vpp_tpu.ops.nat import NatMapping
+
+    ipam = IPAM(IPAMConfig(), node_id=1)
+    acl = build_rule_tables([], {})
+    nat = build_nat_tables(
+        [NatMapping("10.96.0.10", 80, 6, [("10.1.1.3", 8080, 1)])],
+        snat_enabled=False, pod_subnet="10.1.0.0/16",
+    )
+    return acl, nat, make_route_config(ipam)
+
+
+@pytest.mark.parametrize("ring_cls", [NativeRing, InMemoryRing])
+def test_flat_punt_straggler_reaches_oracle_via_host_slow_path(ring_cls):
+    """ISSUE 11 acceptance: a same-dispatch reply detected by the
+    flat-punt probe must reach the oracle verdict via the host slow
+    path — delivered with the restored (VIP) headers the next-dispatch
+    device restore would have produced — never a silent
+    mistranslation, on BOTH engines."""
+    acl, nat, route = _straggler_world()
+    rx, tx, local, host = (ring_cls() for _ in range(4))
+    runner = DataplaneRunner(
+        acl=acl, nat=nat, route=route,
+        overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"),
+                             local_node_id=1),
+        source=rx, tx=tx, local=local, host=host,
+        batch_size=8, max_vectors=8, dispatch="flat-punt",
+    )
+    fwd = build_frame("10.1.1.2", "10.96.0.10", 6, 41000, 80)
+    reply = build_frame("10.1.1.3", "10.1.1.2", 6, 8080, 41000)
+    rx.send([fwd, reply])           # ONE wave -> one coalesced dispatch
+    runner.drain()
+    delivered = sorted(frame_tuple(f) for f in local.recv_batch(1 << 10))
+    # Oracle: forward DNAT'ed to the backend; reply restored to the
+    # VIP:80 source (exactly what flat-safe restores on device / the
+    # device table restores one dispatch later).
+    assert delivered == sorted([
+        ("10.1.1.2", "10.1.1.3", 6, 41000, 8080),
+        ("10.96.0.10", "10.1.1.2", 6, 80, 41000),
+    ])
+    assert runner.counters.straggler_punts == 1
+    assert runner.counters.straggler_restores == 1
+    # Resolved host-side, not via a recorded host session.
+    assert len(runner.slow) == 0
+    assert runner.metrics()["datapath_straggler_punts_total"] == 1
+    runner.close()
+
+
+@pytest.mark.parametrize("ring_cls", [NativeRing, InMemoryRing])
+def test_flat_punt_session_serves_reply_next_dispatch(ring_cls):
+    """The straggler punt must not damage the forward's device session:
+    the SAME reply tuple arriving one dispatch later restores on
+    device (no straggler, no punt)."""
+    acl, nat, route = _straggler_world()
+    rx, tx, local, host = (ring_cls() for _ in range(4))
+    runner = DataplaneRunner(
+        acl=acl, nat=nat, route=route,
+        overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"),
+                             local_node_id=1),
+        source=rx, tx=tx, local=local, host=host,
+        batch_size=8, max_vectors=8, dispatch="flat-punt",
+    )
+    rx.send([build_frame("10.1.1.2", "10.96.0.10", 6, 42000, 80)])
+    runner.drain()
+    rx.send([build_frame("10.1.1.3", "10.1.1.2", 6, 8080, 42000)])
+    runner.drain()
+    delivered = sorted(frame_tuple(f) for f in local.recv_batch(1 << 10))
+    assert ("10.96.0.10", "10.1.1.2", 6, 80, 42000) in delivered
+    assert runner.counters.straggler_punts == 0
+    assert runner.counters.punts == 0
+    runner.close()
+
+
+# ------------------------------------------- packed-harvest satellites
+
+
+@pytest.mark.parametrize("ring_cls", [NativeRing, InMemoryRing])
+def test_harvest_blocks_on_single_device_materialization(ring_cls,
+                                                         monkeypatch):
+    """ISSUE 11 acceptance: the harvest must block on at most 2 device
+    materialisations per batch (down from ~12) — with the packed tail
+    it is exactly ONE (the [4, B] packed array); every other np.asarray
+    in the harvest touches host-side buffers only."""
+    import numpy as real_np
+
+    from vpp_tpu.datapath import runner as runner_mod
+
+    runner, (rx, *_rest) = _make_runner(ring_cls)
+    rx.send([build_frame("10.1.1.2", _POD, 6, 40000 + i, 80)
+             for i in range(16)])
+    assert runner._admit()
+    device_mats = []
+    real_asarray = real_np.asarray
+
+    def counting_asarray(obj, *args, **kwargs):
+        if hasattr(obj, "block_until_ready"):   # device array
+            device_mats.append(type(obj).__name__)
+        return real_asarray(obj, *args, **kwargs)
+
+    monkeypatch.setattr(runner_mod.np, "asarray", counting_asarray)
+    runner._harvest()
+    monkeypatch.undo()
+    assert len(device_mats) == 1, device_mats
+    runner.close()
+
+
+def test_python_harvest_conditional_copy_counter():
+    """The native harvest's conditional-copy gating now applies to the
+    python engine too: all-fast-path batches skip the packed-row copy
+    on BOTH engines, counted like admit_copy_saved_bytes (8 bytes per
+    row: the two rewritten-IP rows)."""
+    runner, (rx, *_rest) = _make_runner(InMemoryRing)
+    frames = [build_frame("10.1.1.2", _POD, 6, 40000 + i, 80)
+              for i in range(16)]
+    rx.send(frames)
+    runner.drain()
+    assert runner.counters.harvest_copy_saved_bytes == 8 * len(frames)
+    assert runner.metrics()["datapath_harvest_copy_saved_bytes_total"] \
+        == 8 * len(frames)
+    runner.close()
+
+
+@pytest.mark.parametrize("ring_cls", [NativeRing, InMemoryRing])
+def test_harvest_copies_when_slow_path_can_fire(ring_cls):
+    """Live host sessions (or punts) force the copying path — the
+    zero-copy fast path must never hand the slow path read-only (or
+    donated) device views to mutate."""
+    acl, nat, route = _straggler_world()
+    rx, tx, local, host = (ring_cls() for _ in range(4))
+    runner = DataplaneRunner(
+        acl=acl, nat=nat, route=route,
+        overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"),
+                             local_node_id=1),
+        source=rx, tx=tx, local=local, host=host,
+        batch_size=8, max_vectors=8, dispatch="flat-punt",
+    )
+    # The same-dispatch straggler wave punts -> mutable harvest.
+    rx.send([build_frame("10.1.1.2", "10.96.0.10", 6, 43000, 80),
+             build_frame("10.1.1.3", "10.1.1.2", 6, 8080, 43000)])
+    runner.drain()
+    assert runner.counters.harvest_copy_saved_bytes == 0
+    assert runner.counters.straggler_restores == 1
+    runner.close()
 
 
 # ------------------------------------------------- in-flight window depth

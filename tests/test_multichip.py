@@ -27,7 +27,7 @@ pytestmark = pytest.mark.skipif(
 from vpp_tpu.ops.classify import build_rule_tables
 from vpp_tpu.ops.nat import NatMapping, build_nat_tables, empty_sessions
 from vpp_tpu.ops.packets import ip_to_u32, make_batch
-from vpp_tpu.ops.pipeline import RouteConfig, pipeline_step_jit
+from vpp_tpu.ops.pipeline import RouteConfig, pipeline_step_jit, unpack_verdicts
 from vpp_tpu.parallel import make_mesh, shard_dataplane, sharded_pipeline_step
 from vpp_tpu.parallel.mesh import shard_batch
 
@@ -56,16 +56,21 @@ FWD = [(f"10.1.1.{10 + (i % 8)}", "10.96.0.10", 6, 41000 + i, 80)
        for i in range(64)]
 
 
+def _uv(packed_result):
+    """Host-unpacked verdict view of one packed dispatch result."""
+    return unpack_verdicts(np.asarray(packed_result.packed))
+
+
 def _reply_flows(fwd_result):
     """Reply 5-tuples for each DNAT'ed forward flow of a result."""
-    b = fwd_result.batch
+    v = _uv(fwd_result)
     return [
         (
-            str(np.asarray(b.dst_ip)[i] >> 24 & 0xFF) + "."
-            + str(np.asarray(b.dst_ip)[i] >> 16 & 0xFF) + "."
-            + str(np.asarray(b.dst_ip)[i] >> 8 & 0xFF) + "."
-            + str(np.asarray(b.dst_ip)[i] & 0xFF),
-            FWD[i][0], 6, int(np.asarray(b.dst_port)[i]), FWD[i][3],
+            str(v.dst_ip[i] >> 24 & 0xFF) + "."
+            + str(v.dst_ip[i] >> 16 & 0xFF) + "."
+            + str(v.dst_ip[i] >> 8 & 0xFF) + "."
+            + str(v.dst_ip[i] & 0xFF),
+            FWD[i][0], 6, int(v.dst_port[i]), FWD[i][3],
         )
         for i in range(len(FWD))
     ]
@@ -95,12 +100,13 @@ def test_multistep_sessions_on_mesh_match_single_device(partition_sessions):
     single1, single2 = _run_two_steps(
         pipeline_step_jit, acl, nat, route, empty_sessions(1024)
     )
-    assert bool(np.asarray(single1.dnat_hit).all())
+    sv1, sv2 = _uv(single1), _uv(single2)
+    assert bool(sv1.dnat_hit.all())
     # Replies restore for exactly the forwards whose session committed
     # on device (punted forwards are the host slow path's business).
-    fwd_ok = ~np.asarray(single1.punt)
+    fwd_ok = ~sv1.punt
     assert fwd_ok.sum() >= len(FWD) - 8, "too many commit punts for the test"
-    np.testing.assert_array_equal(np.asarray(single2.reply_hit), fwd_ok)
+    np.testing.assert_array_equal(sv2.reply_hit, fwd_ok)
 
     mesh = make_mesh(8)
     with mesh:
@@ -114,23 +120,20 @@ def test_multistep_sessions_on_mesh_match_single_device(partition_sessions):
             shard=lambda b: shard_batch(mesh, b),
         )
 
-    for sr, mr in ((single1, mesh1), (single2, mesh2)):
-        np.testing.assert_array_equal(np.asarray(sr.allowed), np.asarray(mr.allowed))
-        np.testing.assert_array_equal(np.asarray(sr.reply_hit), np.asarray(mr.reply_hit))
-        np.testing.assert_array_equal(np.asarray(sr.punt), np.asarray(mr.punt))
-        np.testing.assert_array_equal(
-            np.asarray(sr.batch.src_ip), np.asarray(mr.batch.src_ip))
-        np.testing.assert_array_equal(
-            np.asarray(sr.batch.dst_ip), np.asarray(mr.batch.dst_ip))
-        np.testing.assert_array_equal(
-            np.asarray(sr.batch.src_port), np.asarray(mr.batch.src_port))
-        np.testing.assert_array_equal(
-            np.asarray(sr.batch.dst_port), np.asarray(mr.batch.dst_port))
+    for sv, mr in ((sv1, mesh1), (sv2, mesh2)):
+        mv = _uv(mr)
+        np.testing.assert_array_equal(sv.allowed, mv.allowed)
+        np.testing.assert_array_equal(sv.reply_hit, mv.reply_hit)
+        np.testing.assert_array_equal(sv.punt, mv.punt)
+        np.testing.assert_array_equal(sv.src_ip, mv.src_ip)
+        np.testing.assert_array_equal(sv.dst_ip, mv.dst_ip)
+        np.testing.assert_array_equal(sv.src_port, mv.src_port)
+        np.testing.assert_array_equal(sv.dst_port, mv.dst_port)
     # Device-restored replies carry the VIP on the mesh path too.
-    rh = np.asarray(mesh2.reply_hit)
+    mv2 = _uv(mesh2)
+    rh = mv2.reply_hit
     assert rh.sum() >= len(FWD) - 8
-    assert bool((np.asarray(mesh2.batch.src_ip)[rh]
-                 == ip_to_u32("10.96.0.10")).all())
+    assert bool((mv2.src_ip[rh] == ip_to_u32("10.96.0.10")).all())
 
 
 def test_runner_on_mesh_matches_unsharded_runner():

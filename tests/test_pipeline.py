@@ -156,6 +156,7 @@ def test_denied_flow_creates_no_session():
 
 
 def test_mesh_sharded_pipeline_matches_single_device():
+    from vpp_tpu.ops.pipeline import unpack_verdicts
     from vpp_tpu.parallel import make_mesh, shard_dataplane, sharded_pipeline_step
     from vpp_tpu.parallel.mesh import shard_batch
 
@@ -173,9 +174,11 @@ def test_mesh_sharded_pipeline_matches_single_device():
         step = sharded_pipeline_step(mesh)
         sharded = step(acl_s, nat_s, route_s, sess_s, batch_s, jnp.int32(0))
 
-    np.testing.assert_array_equal(np.asarray(single.allowed), np.asarray(sharded.allowed))
-    np.testing.assert_array_equal(np.asarray(single.batch.dst_ip), np.asarray(sharded.batch.dst_ip))
-    np.testing.assert_array_equal(np.asarray(single.route), np.asarray(sharded.route))
+    # The production step returns the PACKED single-transfer result.
+    v = unpack_verdicts(np.asarray(sharded.packed))
+    np.testing.assert_array_equal(np.asarray(single.allowed), v.allowed)
+    np.testing.assert_array_equal(np.asarray(single.batch.dst_ip), v.dst_ip)
+    np.testing.assert_array_equal(np.asarray(single.route), v.route)
 
 
 def test_scan_matches_sequential_steps():
@@ -489,6 +492,230 @@ def test_flat_safe_organic_reply_with_dnat_hit_across_dispatches():
     np.testing.assert_array_equal(
         np.asarray(scanned.sessions.r_src_ip) * sv,
         np.asarray(safe.sessions.r_src_ip) * fv)
+
+
+# ---------------------------------------------------------------------------
+# flat-punt discipline: flat-safe's commit + ONE tagged probe, with
+# detected same-dispatch replies PUNTED to the host instead of restored
+# on device (ISSUE 11 round-cut)
+# ---------------------------------------------------------------------------
+
+
+def test_flat_punt_matches_flat_safe_without_stragglers():
+    """Traffic with no same-dispatch replies (forwards, pod-to-pod,
+    replies whose forwards ran in an EARLIER dispatch): flat-punt must
+    be bit-identical to flat-safe — verdicts, headers, straggler mask
+    empty, and the same final session table."""
+    import jax
+
+    from vpp_tpu.ops.pipeline import pipeline_flat_punt, pipeline_flat_safe
+
+    mapping = NatMapping("10.96.0.10", 80, 6, [("10.1.1.2", 8080, 1)])
+    _, pods, acl, nat, route = build_world(mappings=[mapping])
+
+    # Dispatch 1 commits forward sessions; dispatch 2 carries their
+    # organic replies plus fresh forwards and pod-to-pod traffic.
+    fwds = [("10.1.1.3", "10.96.0.10", 6, 1000 + i, 80) for i in range(8)]
+    b1 = jax.tree_util.tree_map(
+        lambda a: a.reshape(2, 4), make_batch(fwds))
+    ts1 = jnp.arange(1, 3, dtype=jnp.int32)
+
+    mixed = [("10.1.1.2", "10.1.1.3", 6, 8080, 1000 + i) for i in range(4)]
+    mixed += [("10.1.1.3", "10.96.0.10", 6, 2000 + i, 80) for i in range(2)]
+    mixed += [("10.1.1.4", "10.1.1.5", 6, 3000 + i, 8080) for i in range(2)]
+    b2 = jax.tree_util.tree_map(
+        lambda a: a.reshape(2, 4), make_batch(mixed))
+    ts2 = jnp.arange(3, 5, dtype=jnp.int32)
+
+    s1 = pipeline_flat_safe(acl, nat, route, empty_sessions(1024), b1, ts1)
+    safe = pipeline_flat_safe(acl, nat, route, s1.sessions, b2, ts2)
+    p1, strag1 = pipeline_flat_punt(acl, nat, route, empty_sessions(1024),
+                                    b1, ts1)
+    punt, strag2 = pipeline_flat_punt(acl, nat, route, p1.sessions, b2, ts2)
+
+    assert not bool(np.asarray(strag1).any())
+    assert not bool(np.asarray(strag2).any())
+    _assert_results_equal(safe, punt)
+    assert bool(np.asarray(punt.reply_hit).any())   # organic restores ran
+    for field in ("valid", "r_src_ip", "r_dst_ip", "r_ports",
+                  "orig_src_ip", "orig_dst_ip", "orig_ports", "last_seen"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(safe.sessions, field)),
+            np.asarray(getattr(punt.sessions, field)), err_msg=field)
+
+
+def test_flat_punt_detects_and_punts_same_dispatch_reply():
+    """A reply sharing the dispatch with its forward: flat-safe restores
+    it on device; flat-punt must DETECT it (straggler mask), mark it
+    punt (never a silent mistranslation — its headers stay the pass-1
+    stateless rewrite for the host to fix), and keep the forward's
+    committed session intact for the NEXT dispatch."""
+    import jax
+
+    from vpp_tpu.ops.pipeline import pipeline_flat_punt, pipeline_step
+
+    mapping = NatMapping("10.96.0.10", 80, 6, [("10.1.1.2", 8080, 1)])
+    _, pods, acl, nat, route = build_world(mappings=[mapping])
+    fwd = ("10.1.1.3", "10.96.0.10", 6, 41000, 80)
+    reply = ("10.1.1.2", "10.1.1.3", 6, 8080, 41000)
+    filler = ("10.1.1.4", "10.1.1.5", 6, 2000, 8080)
+    flows = [fwd, reply, filler, filler]
+    batches = jax.tree_util.tree_map(
+        lambda a: a.reshape(2, 2), make_batch(flows))
+    res, strag = pipeline_flat_punt(
+        acl, nat, route, empty_sessions(1024), batches,
+        jnp.arange(1, 3, dtype=jnp.int32))
+    leaves = _flat_leaves(res)
+    sm = np.asarray(strag).reshape(-1)
+    assert list(sm) == [False, True, False, False]
+    assert bool(leaves["punt"][1]) and not bool(leaves["reply"][1])
+    # NOT mistranslated on device: headers are the stateless rewrite
+    # (identity here), left for the host straggler resolution.
+    assert u32_to_ip(int(leaves["src_ip"][1])) == "10.1.1.2"
+    # The forward's session survives and restores the SAME reply in a
+    # later dispatch exactly as flat-safe/scan would.
+    r2 = pipeline_step(acl, nat, route, res.sessions, make_batch([reply]),
+                       jnp.int32(3))
+    assert bool(r2.reply_hit[0])
+    assert u32_to_ip(int(r2.batch.src_ip[0])) == "10.96.0.10"
+
+
+def test_flat_punt_cross_aliased_bogus_sessions_punt():
+    """The flat-safe adversarial corner (two crafted twice-NAT flows
+    whose bogus sessions alias each other): flat-punt must likewise
+    undo both bogus entries and punt both rows — here via the straggler
+    mask — with no session surviving."""
+    import jax
+
+    from vpp_tpu.ops.nat import TWICE_NAT_ENABLED
+    from vpp_tpu.ops.pipeline import pipeline_flat_punt
+
+    ipam = IPAM(IPAMConfig(), node_id=1)
+    loopback = str(ipam.nat_loopback_ip())
+    maps = [
+        NatMapping(loopback, 80, 6, [("10.1.1.9", 80, 1)],
+                   twice_nat=TWICE_NAT_ENABLED),
+        NatMapping(loopback, 81, 6, [("10.1.1.8", 81, 1)],
+                   twice_nat=TWICE_NAT_ENABLED),
+    ]
+    _, pods, acl, nat, route = build_world(mappings=maps)
+    r1 = ("10.1.1.8", loopback, 6, 81, 80)
+    r2 = ("10.1.1.9", loopback, 6, 80, 81)
+    filler = ("10.1.1.4", "10.1.1.5", 6, 2000, 8080)
+    flows = [r1, filler, r2, filler]
+    batches = jax.tree_util.tree_map(
+        lambda a: a.reshape(2, 2), make_batch(flows))
+    res, strag = pipeline_flat_punt(
+        acl, nat, route, empty_sessions(1024), batches,
+        jnp.arange(1, 3, dtype=jnp.int32))
+    leaves = _flat_leaves(res)
+    assert bool(leaves["punt"][0]) and bool(leaves["punt"][2])
+    assert not bool(leaves["reply"][0]) and not bool(leaves["reply"][2])
+    # Neither bogus session survives.
+    assert int(np.asarray(res.sessions.valid).sum()) == 0
+
+
+# ---------------------------------------------------------------------------
+# packed single-transfer result: pack/unpack round trip (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+
+def test_packed_result_round_trips_bit_for_bit():
+    """The packed [4, B] array must carry the 12 harvest leaves
+    exactly: device pack -> host unpack ≡ the raw PipelineResult, and
+    the numpy pack twin produces the identical bytes."""
+    import jax
+
+    from vpp_tpu.ops.pipeline import (
+        flatten_scan_result,
+        pack_result,
+        pack_verdicts_host,
+        pipeline_flat_safe,
+        unpack_verdicts,
+    )
+
+    mapping = NatMapping("10.96.0.10", 80, 6, [("10.1.1.2", 8080, 1)])
+    _, pods, acl, nat, route = build_world(mappings=[mapping])
+    rng = np.random.RandomState(11)
+    flows = []
+    for i in range(64):
+        r = rng.rand()
+        if r < 0.4:
+            flows.append(("10.1.1.3", "10.96.0.10", 6, 1000 + i, 80))
+        elif r < 0.7:
+            flows.append((f"10.1.1.{2 + i % 4}", f"10.1.{1 + i % 3}.9",
+                          6, 2000 + i, 8080))
+        else:
+            flows.append(("10.1.1.2", "10.1.1.3", 6, 8080, 1000 + i))
+    batches = jax.tree_util.tree_map(
+        lambda a: a.reshape(4, 16), make_batch(flows))
+    ts = jnp.arange(1, 5, dtype=jnp.int32)
+    raw = flatten_scan_result(
+        pipeline_flat_safe(acl, nat, route, empty_sessions(1 << 12),
+                           batches, ts))
+    packed = pack_result(raw)
+    pk = np.asarray(packed.packed)
+    assert pk.dtype == np.uint32 and pk.shape == (4, 64)
+    v = unpack_verdicts(pk)
+
+    np.testing.assert_array_equal(v.allowed, np.asarray(raw.allowed))
+    np.testing.assert_array_equal(v.punt, np.asarray(raw.punt))
+    np.testing.assert_array_equal(v.reply_hit, np.asarray(raw.reply_hit))
+    np.testing.assert_array_equal(v.dnat_hit, np.asarray(raw.dnat_hit))
+    np.testing.assert_array_equal(v.snat_hit, np.asarray(raw.snat_hit))
+    np.testing.assert_array_equal(v.route, np.asarray(raw.route))
+    np.testing.assert_array_equal(v.node_id, np.asarray(raw.node_id))
+    np.testing.assert_array_equal(v.src_ip, np.asarray(raw.batch.src_ip))
+    np.testing.assert_array_equal(v.dst_ip, np.asarray(raw.batch.dst_ip))
+    np.testing.assert_array_equal(v.src_port, np.asarray(raw.batch.src_port))
+    np.testing.assert_array_equal(v.dst_port, np.asarray(raw.batch.dst_port))
+    assert not v.straggler.any()
+    # The sessions ride the packed result unchanged.
+    np.testing.assert_array_equal(
+        np.asarray(raw.sessions.valid), np.asarray(packed.sessions.valid))
+    # Host pack twin (the quarantine's stitcher) is bit-identical.
+    host_pk = pack_verdicts_host(
+        np.asarray(raw.allowed), np.asarray(raw.punt),
+        np.asarray(raw.reply_hit), np.asarray(raw.dnat_hit),
+        np.asarray(raw.snat_hit), np.asarray(raw.route),
+        np.asarray(raw.node_id), np.asarray(raw.batch.src_ip),
+        np.asarray(raw.batch.dst_ip), np.asarray(raw.batch.src_port),
+        np.asarray(raw.batch.dst_port))
+    np.testing.assert_array_equal(host_pk, pk)
+
+
+def test_packed_straggler_bit_round_trips():
+    """The flat-punt ts0 entry point folds the straggler mask into
+    verdict-word bit 7; unpack must recover it exactly (and the
+    verdict bits around it must be unperturbed)."""
+    import jax
+
+    from vpp_tpu.ops.pipeline import (
+        pipeline_flat_punt,
+        pipeline_flat_punt_ts0_jit,
+        unpack_verdicts,
+    )
+
+    mapping = NatMapping("10.96.0.10", 80, 6, [("10.1.1.2", 8080, 1)])
+    _, pods, acl, nat, route = build_world(mappings=[mapping])
+    fwd = ("10.1.1.3", "10.96.0.10", 6, 41000, 80)
+    reply = ("10.1.1.2", "10.1.1.3", 6, 8080, 41000)
+    filler = ("10.1.1.4", "10.1.1.5", 6, 2000, 8080)
+    batches = jax.tree_util.tree_map(
+        lambda a: a.reshape(2, 2), make_batch([fwd, reply, filler, filler]))
+
+    raw, strag = pipeline_flat_punt(
+        acl, nat, route, empty_sessions(1024), batches,
+        jnp.arange(1, 3, dtype=jnp.int32))
+    packed = pipeline_flat_punt_ts0_jit(
+        acl, nat, route, empty_sessions(1024), batches, jnp.int32(0))
+    v = unpack_verdicts(np.asarray(packed.packed))
+    np.testing.assert_array_equal(
+        v.straggler, np.asarray(strag).reshape(-1))
+    leaves = _flat_leaves(raw)
+    np.testing.assert_array_equal(v.punt, leaves["punt"])
+    np.testing.assert_array_equal(v.allowed, leaves["allowed"])
+    np.testing.assert_array_equal(v.src_ip, leaves["src_ip"])
 
 
 def test_session_keys_unique_under_load():

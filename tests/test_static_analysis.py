@@ -200,6 +200,8 @@ pipeline_extra_jit = jax.jit(pipeline_step)
 
 class DataplaneRunner:
     def _dispatch_locked(self, batch):
+        if batch:
+            return pipeline_step_jit(batch)
         return pipeline_extra_jit(batch)
 
     def _prewarm_one(self, k):
@@ -228,6 +230,123 @@ def test_jit_out_of_scope_module_not_flagged():
     project = Project.from_sources({"vpp_tpu/testing/fixmod.py": src})
     unwaived, _ = _run(project, JitDisciplineChecker())
     assert unwaived == []
+
+
+# Dead-entry-point rule (ISSUE 11): module-level pipeline_*_jit must be
+# BOTH dispatch-selectable and pre-warm-registered.
+
+
+def test_jit_must_flag_dead_pipeline_entry_point():
+    """A pipeline_*_jit no dispatch discipline selects (and the warmer
+    never compiles) is a dead entry point — exactly how a pre-packed
+    variant would rot once the production path moves on."""
+    src = """
+import jax
+
+def pipeline_step(x):
+    return x
+
+pipeline_step_jit = jax.jit(pipeline_step)
+pipeline_legacy_jit = jax.jit(pipeline_step)   # nothing selects this
+
+class DataplaneRunner:
+    def _dispatch_locked(self, batch):
+        return pipeline_step_jit(batch)
+
+    def _prewarm_one(self, k):
+        return pipeline_step_jit(k)
+"""
+    project = Project.from_sources({"vpp_tpu/ops/pipeline.py": src})
+    unwaived, _ = _run(project, JitDisciplineChecker())
+    assert len(unwaived) == 1
+    assert "pipeline_legacy_jit" in unwaived[0].message
+    assert "dispatch discipline selection" in unwaived[0].message
+    assert "pre-warm ledger" in unwaived[0].message
+
+
+def test_jit_must_flag_warmed_but_unselectable_entry_point():
+    """Warmed-but-unreachable is still dead: the warmer burning compile
+    time on a jit no discipline can dispatch hides the drift instead of
+    surfacing it."""
+    src = """
+import jax
+
+def pipeline_step(x):
+    return x
+
+pipeline_step_jit = jax.jit(pipeline_step)
+pipeline_shadow_jit = jax.jit(pipeline_step)
+
+class DataplaneRunner:
+    def _dispatch_locked(self, batch):
+        return pipeline_step_jit(batch)
+
+    def _prewarm_one(self, k):
+        pipeline_shadow_jit(k)        # warmed...
+        return pipeline_step_jit(k)   # ...but never selectable
+"""
+    project = Project.from_sources({"vpp_tpu/ops/pipeline.py": src})
+    unwaived, _ = _run(project, JitDisciplineChecker())
+    assert len(unwaived) == 1
+    assert "pipeline_shadow_jit" in unwaived[0].message
+    assert "dispatch discipline selection" in unwaived[0].message
+    assert "pre-warm ledger" not in unwaived[0].message
+
+
+def test_jit_must_pass_every_entry_point_selected_and_warmed():
+    """The production shape: several disciplines, every entry point in
+    BOTH the dispatch selection and the warmer."""
+    src = """
+import jax
+
+def pipeline_step(x):
+    return x
+
+pipeline_step_jit = jax.jit(pipeline_step)
+pipeline_flat_safe_ts0_jit = jax.jit(pipeline_step)
+pipeline_flat_punt_ts0_jit = jax.jit(pipeline_step)
+
+class DataplaneRunner:
+    def _dispatch_locked(self, batch):
+        if self.dispatch == "scan":
+            return pipeline_step_jit(batch)
+        step = (pipeline_flat_safe_ts0_jit
+                if self.dispatch == "flat-safe"
+                else pipeline_flat_punt_ts0_jit)
+        return step(batch)
+
+    def _prewarm_one(self, k):
+        for step in (pipeline_step_jit, pipeline_flat_safe_ts0_jit,
+                     pipeline_flat_punt_ts0_jit):
+            step(k)
+"""
+    project = Project.from_sources({"vpp_tpu/ops/pipeline.py": src})
+    unwaived, _ = _run(project, JitDisciplineChecker())
+    assert unwaived == [], [f.format() for f in unwaived]
+
+
+def test_jit_must_pass_non_pipeline_helper_jit_unconstrained():
+    """A module-level jit OUTSIDE the pipeline_*_jit namespace (e.g.
+    nat_step_jit) is sanctioned form and owes the dispatch nothing."""
+    src = """
+import jax
+
+def pipeline_step(x):
+    return x
+
+pipeline_step_jit = jax.jit(pipeline_step)
+nat_step_jit = jax.jit(pipeline_step)       # helper, not an entry point
+
+class DataplaneRunner:
+    def _dispatch_locked(self, batch):
+        return pipeline_step_jit(batch)
+
+    def _prewarm_one(self, k):
+        return pipeline_step_jit(k)
+"""
+    project = Project.from_sources({"vpp_tpu/ops/pipeline.py": src})
+    unwaived, _ = _run(project, JitDisciplineChecker())
+    assert unwaived == [], [f.format() for f in unwaived]
 
 
 # ----------------------------------------------------------- lock-discipline

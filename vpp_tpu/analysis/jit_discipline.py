@@ -17,6 +17,17 @@ Two invariants from the PR 4 governor work:
    and a dispatch path that can pick a jit the warmer never compiled
    reintroduces the mid-traffic compile stall the ledger exists to
    kill.
+
+3. **No dead dispatch entry points** (ISSUE 11).  ``pipeline_*_jit``
+   is the dispatch-entry-point namespace: every module-level jit of
+   that shape in scope must be BOTH pre-warm-registered AND referenced
+   from the dispatch discipline selection.  A jit no discipline can
+   select is dead weight that silently drifts from the production
+   semantics (the pre-packed ts0 entry points rotted exactly this way
+   once the packed-harvest variants shipped); a selectable-but-unwarmed
+   one is invariant 2's compile stall.  Helper jits that are not
+   dispatch entry points must not squat on the ``pipeline_*_jit``
+   naming.
 """
 
 from __future__ import annotations
@@ -78,7 +89,9 @@ class JitDisciplineChecker(Checker):
 
     def check(self, project: Project) -> List[Finding]:
         findings: List[Finding] = []
-        module_jits: Set[str] = set()   # module-level *_jit names
+        # Module-level pipeline_*_jit assignments: name -> (file, line),
+        # for the dead-entry-point check below.
+        pipeline_jits: dict = {}
         for sf in project.files.values():
             if not self._in_scope(sf.module):
                 continue
@@ -91,9 +104,10 @@ class JitDisciplineChecker(Checker):
                         isinstance(node.value, ast.Call) and \
                         _is_jit_call(node.value, jax_aliases, jit_names):
                     for t in node.targets:
-                        if isinstance(t, ast.Name):
-                            module_jits.add(t.name if hasattr(t, "name")
-                                            else t.id)
+                        if isinstance(t, ast.Name) and \
+                                t.id.startswith("pipeline_") and \
+                                t.id.endswith("_jit"):
+                            pipeline_jits[t.id] = (sf, node.lineno)
             # jit construction inside ANY function body is flagged.
             for func in ast.walk(sf.tree):
                 if not isinstance(func, (ast.FunctionDef,
@@ -111,7 +125,8 @@ class JitDisciplineChecker(Checker):
                                 "level or cache it"
                             ),
                         ))
-        findings.extend(self._check_prewarm_registration(project))
+        findings.extend(
+            self._check_prewarm_registration(project, pipeline_jits))
         return findings
 
     # ------------------------------------------------- pre-warm registration
@@ -134,7 +149,8 @@ class JitDisciplineChecker(Checker):
     def _names_in(node: ast.AST) -> Set[str]:
         return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
 
-    def _check_prewarm_registration(self, project: Project) -> List[Finding]:
+    def _check_prewarm_registration(self, project: Project,
+                                    pipeline_jits: dict) -> List[Finding]:
         disp_sf, disp = self._find_func(project, self.dispatch_func)
         warm_sf, warm = self._find_func(project, self.prewarm_func)
         if disp is None or warm is None:
@@ -152,6 +168,32 @@ class JitDisciplineChecker(Checker):
                     f"registered with the pre-warm ledger "
                     f"({self.prewarm_func.split('.')[-1]}) — a load "
                     "spike selecting it stalls on a mid-traffic compile"
+                ),
+            ))
+        # Dead/unreachable entry points (ISSUE 11): every module-level
+        # pipeline_*_jit must be BOTH dispatch-selectable and warmed.
+        # The selectable-but-unwarmed direction is the check above
+        # (which also covers names imported from out-of-scope modules),
+        # so this one fires only for dispatch-UNREACHABLE names — one
+        # finding per dead jit, never two for the same defect.
+        for name in sorted(pipeline_jits):
+            if name in dispatch_jits:
+                continue
+            sf, line = pipeline_jits[name]
+            missing = [f"the dispatch discipline selection "
+                       f"({self.dispatch_func.split('.')[-1]})"]
+            if name not in warm_jits:
+                missing.append(
+                    f"the pre-warm ledger "
+                    f"({self.prewarm_func.split('.')[-1]})")
+            out.append(Finding(
+                rule=self.rule, path=sf.path, line=line,
+                message=(
+                    f"pipeline entry point `{name}` is not "
+                    f"referenced from {' or '.join(missing)} — a "
+                    "dead entry point drifts from the production "
+                    "semantics (rename it out of the pipeline_*_jit "
+                    "namespace if it is not a dispatch entry point)"
                 ),
             ))
         return out

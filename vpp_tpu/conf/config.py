@@ -126,9 +126,13 @@ class NetworkConfig:
     # Multi-vector dispatch discipline: "auto" picks from the measured
     # per-backend orderings (as of r4: flat-safe on every backend —
     # the commit-first restructure reversed r3's CPU ordering, see
-    # FRAMEBENCH_r04); explicit "scan" / "flat-safe" override per
-    # node, the same trace-time pattern as the NAT lookup-discipline
-    # gate (use_hmap).
+    # FRAMEBENCH_r04); explicit "scan" / "flat-safe" / "flat-punt"
+    # override per node, the same trace-time pattern as the NAT
+    # lookup-discipline gate (use_hmap).  "flat-punt" cuts the
+    # straggler-restore round off flat-safe's session-sync chain and
+    # punts detected same-dispatch replies to the host slow path —
+    # the right pick on GSPMD meshes and round-trip-bound tunnels
+    # (docs/ARCHITECTURE.md "Dispatch round chain").
     dispatch: str = "auto"
     # Coalesce governor: "adaptive" picks the per-admit pow2 K from
     # the measured ingress backlog under the added-latency SLO below;

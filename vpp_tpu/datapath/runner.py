@@ -37,16 +37,22 @@ from ..ops.nat import (
 from ..ops.classify import RuleTables
 from ..ops.packets import PacketBatch
 from ..ops.pipeline import (
+    PACKED_WORD,
     ROUTE_HOST,
     ROUTE_LOCAL,
     ROUTE_REMOTE,
     VECTOR_SIZE,
+    VERDICT_ALLOWED,
+    VERDICT_PUNT,
     RouteConfig,
+    pack_verdicts_host,
+    pipeline_flat_punt_ts0_jit,
     pipeline_flat_safe_ts0_jit,
     pipeline_scan_ts0_jit,
     pipeline_step_jit,
+    unpack_verdicts,
 )
-from ..ops.slowpath import HostSlowPath
+from ..ops.slowpath import HostSlowPath, resolve_stragglers
 from ..shim.hostshim import FrameBatch, HostShim, NativeLoop, NativeRing
 from ..telemetry import (
     FlightRecorder,
@@ -86,20 +92,14 @@ DISPATCH_ROUNDS = ("wait", "materialize", "restore", "stitch")
 
 @dataclasses.dataclass
 class _HostResult:
-    """A pipeline-result lookalike assembled on the HOST by the
-    poisoned-batch quarantine: verdict arrays are stitched together
-    from the surviving sub-dispatches (numpy, already materialised),
-    with poisoned rows forced to deny.  The harvest paths only ever
-    ``np.asarray`` these fields, so it substitutes transparently."""
+    """A packed-result lookalike assembled on the HOST by the
+    poisoned-batch quarantine: the packed verdict+rewrite rows are
+    stitched together from the surviving sub-dispatches (numpy, same
+    uint32 [4, B] layout as the device packing tail), with poisoned
+    rows forced to deny.  The harvest paths only ever materialise and
+    unpack ``.packed``, so it substitutes transparently."""
 
-    allowed: np.ndarray
-    route: np.ndarray
-    node_id: np.ndarray
-    punt: np.ndarray
-    reply_hit: np.ndarray
-    dnat_hit: np.ndarray
-    snat_hit: np.ndarray
-    batch: PacketBatch
+    packed: np.ndarray
     poisoned_rows: np.ndarray
 
 
@@ -196,6 +196,18 @@ class RunnerCounters:  # owner: shard worker — admit/dispatch/harvest/bypass a
     # packed buffer became single-pass writable (bytearray join): the
     # old np.frombuffer(join).copy() duplicated every batch.
     admit_copy_saved_bytes: int = 0
+    # Bytes the harvest did NOT copy out of the materialised packed
+    # result because nothing could mutate the verdicts (no punts, no
+    # live host sessions, solo slow path): the all-fast-path case stays
+    # zero-copy on BOTH engines (the python engine unconditionally
+    # copied every leaf before ISSUE 11).
+    harvest_copy_saved_bytes: int = 0
+    # flat-punt round-cut discipline: same-dispatch replies the device
+    # probe detected and punted, and how many of them the host resolved
+    # against the same batch's committed forwards (the rest fall to the
+    # ordinary punt path — crafted aliasing corners only).
+    straggler_punts: int = 0
+    straggler_restores: int = 0
 
     def as_dict(self) -> Dict[str, int]:
         return {f"datapath_{k}_total": v for k, v in dataclasses.asdict(self).items()}
@@ -272,15 +284,23 @@ class DataplaneRunner:
         # re-probes (pipeline_flat_safe) — faster at the production
         # coalesce on TPU, restores same-VECTOR replies the scan
         # cannot, and punts crafted-aliasing corners to the host slow
-        # path instead of restoring them.  "auto" (default) picks per
-        # the backend this runner dispatches to.  As of r4 the pick is
-        # flat-safe EVERYWHERE: the commit-first restructure deleted
-        # the pre-table restore probe, and the r3 CPU ordering (scan
-        # ~45% ahead) REVERSED — flat-safe now measures ~70% ahead of
-        # scan on CPU too (FRAMEBENCH_r04: 1.9-2.0 vs 1.1-1.2 Mpps
-        # e2e).  The knob stays: scan remains selectable per node and
-        # "auto" keeps the seam for backends where the ordering may
-        # differ again.
+        # path instead of restoring them.  "flat-punt" (ISSUE 11) is
+        # flat-safe with the straggler RESTORE cut: detected
+        # same-dispatch replies punt to the host slow path (resolved
+        # there against the same batch's forwards — never silently
+        # mistranslated like plain flat), trimming the one read that
+        # depends on the finalize scatter — the dependent session-sync
+        # round MESHOVERHEAD_r05 showed each cost a collective on a
+        # sharded mesh.  "auto" (default) picks per the backend this
+        # runner dispatches to.  As of r4 the pick is flat-safe
+        # EVERYWHERE: the commit-first restructure deleted the
+        # pre-table restore probe, and the r3 CPU ordering (scan ~45%
+        # ahead) REVERSED — flat-safe now measures ~70% ahead of scan
+        # on CPU too (FRAMEBENCH_r04: 1.9-2.0 vs 1.1-1.2 Mpps e2e).
+        # The knob stays: scan/flat-punt remain selectable per node
+        # (pick flat-punt on meshes / round-trip-bound tunnels, see
+        # docs/ARCHITECTURE.md "Dispatch round chain") and "auto"
+        # keeps the seam for backends where the ordering may differ.
         dispatch: str = "auto",
         # Sharing hooks for the multi-shard engine (shards.py): a common
         # DeviceSessionState (one device session table for all shards),
@@ -329,7 +349,7 @@ class DataplaneRunner:
         # (enforced by the property setter); the governor picks the
         # per-admit K under it.
         self.max_vectors = max_vectors
-        if dispatch not in ("auto", "scan", "flat-safe"):
+        if dispatch not in ("auto", "scan", "flat-safe", "flat-punt"):
             raise ValueError(f"unknown dispatch discipline: {dispatch!r}")
         if dispatch == "auto":
             # r4 measurement: flat-safe wins on BOTH backends since the
@@ -806,7 +826,7 @@ class DataplaneRunner:
         # Fresh scratch per bucket: the jit entry points DONATE the
         # sessions argument.
         scratch = empty_sessions(self.sessions.capacity)
-        if k == 1 and self.dispatch != "flat-safe":
+        if k == 1 and self.dispatch == "scan":
             result = pipeline_step_jit(
                 self.acl, self.nat, self.route, scratch, batch, jnp.int32(1))
         else:
@@ -815,12 +835,14 @@ class DataplaneRunner:
                 batch)
             step = (
                 pipeline_flat_safe_ts0_jit if self.dispatch == "flat-safe"
+                else pipeline_flat_punt_ts0_jit
+                if self.dispatch == "flat-punt"
                 else pipeline_scan_ts0_jit
             )
             result = step(
                 self.acl, self.nat, self.route, scratch, vectors,
                 jnp.int32(0))
-        result.allowed.block_until_ready()
+        result.packed.block_until_ready()
 
     def prewarm_buckets(self) -> int:
         """Compile every pow2 dispatch bucket up to the ceiling against
@@ -1002,10 +1024,11 @@ class DataplaneRunner:
     def _dispatch_locked(self, batch: PacketBatch, k: int):  # holds: lock
         prev_ts = self._ts
         self._ts += k
-        if k == 1 and self.dispatch != "flat-safe":
-            # flat-safe handles k==1 through its own path below: the
-            # plain flat step cannot restore a reply sharing its ONE
-            # vector with the forward flow; the re-probe pass can.
+        if k == 1 and self.dispatch == "scan":
+            # The flat disciplines handle k==1 through their own path
+            # below: the plain flat step cannot restore (or detect-and-
+            # punt) a reply sharing its ONE vector with the forward
+            # flow; the re-probe pass can.
             if self.mesh is not None:
                 from ..parallel.mesh import shard_batch
 
@@ -1025,9 +1048,12 @@ class DataplaneRunner:
             # Scalar base-ts entry points: the per-vector ts vector is
             # built INSIDE the program (a host-side arange per dispatch
             # costs a full extra round trip on a remote-TPU tunnel),
-            # and the result comes back with flat [K·V] leaves.
+            # and the result comes back as ONE packed uint32 [4, K·V]
+            # array — the harvest blocks on a single materialisation.
             step = (
                 pipeline_flat_safe_ts0_jit if self.dispatch == "flat-safe"
+                else pipeline_flat_punt_ts0_jit
+                if self.dispatch == "flat-punt"
                 else pipeline_scan_ts0_jit
             )
             result = step(
@@ -1099,16 +1125,16 @@ class DataplaneRunner:
     def _quarantine_dispatch(self, batch: PacketBatch, k: int, err: Exception):
         soa = {f: np.asarray(getattr(batch, f)) for f in _BATCH_FIELDS}
         total = len(soa["src_ip"])
-        out = {
-            "allowed": np.zeros(total, dtype=bool),
-            "route": np.full(total, ROUTE_LOCAL, dtype=np.int32),
-            "node_id": np.zeros(total, dtype=np.int32),
-            "punt": np.zeros(total, dtype=bool),
-            "reply_hit": np.zeros(total, dtype=bool),
-            "dnat_hit": np.zeros(total, dtype=bool),
-            "snat_hit": np.zeros(total, dtype=bool),
-        }
-        rew = {f: soa[f].copy() for f in _BATCH_FIELDS}
+        # Host-stitched packed rows in the device packing tail's layout:
+        # rows a sub-dispatch never served default to deny + ROUTE_LOCAL
+        # over the original headers (one packer owns the bit layout).
+        zeros = np.zeros(total, dtype=np.uint32)
+        out_pk = pack_verdicts_host(
+            allowed=zeros, punt=zeros, reply_hit=zeros, dnat_hit=zeros,
+            snat_hit=zeros, route=np.full(total, ROUTE_LOCAL, np.uint32),
+            node_id=zeros, src_ip=soa["src_ip"], dst_ip=soa["dst_ip"],
+            src_port=soa["src_port"], dst_port=soa["dst_port"],
+        )
         poisoned: list = []
         last_ts = None
         # Root attempt = the whole-batch retry; halves push depth-first.
@@ -1130,27 +1156,18 @@ class DataplaneRunner:
                 continue
             last_ts = ts
             m = len(idx)
-            out["allowed"][idx] = np.asarray(res.allowed)[:m]
-            out["route"][idx] = np.asarray(res.route)[:m]
-            out["node_id"][idx] = np.asarray(res.node_id)[:m]
-            for name in ("punt", "reply_hit", "dnat_hit", "snat_hit"):
-                out[name][idx] = np.asarray(getattr(res, name))[:m]
-            for f in _BATCH_FIELDS:
-                rew[f][idx] = np.asarray(getattr(res.batch, f))[:m]
+            # ONE materialisation per surviving sub-dispatch (the
+            # packed rows), stitched into the host result.
+            out_pk[:, idx] = np.asarray(res.packed)[:, :m]
         if len(poisoned) >= total:
             # Nothing dispatched at all — a shard-level fault, not a
             # poisoned batch; surface it to the supervisor.
             raise err
         bad = np.array(sorted(poisoned), dtype=np.int64)
         if len(bad):
-            out["allowed"][bad] = 0
+            out_pk[PACKED_WORD][bad] &= np.uint32(~np.uint32(VERDICT_ALLOWED))
             self.counters.quarantined_batches += 1
-        result = _HostResult(
-            allowed=out["allowed"], route=out["route"], node_id=out["node_id"],
-            punt=out["punt"], reply_hit=out["reply_hit"],
-            dnat_hit=out["dnat_hit"], snat_hit=out["snat_hit"],
-            batch=PacketBatch(**rew), poisoned_rows=bad,
-        )
+        result = _HostResult(packed=out_pk, poisoned_rows=bad)
         return result, (last_ts if last_ts is not None else self._ts)
 
     def _subbatch(self, soa, idx: np.ndarray):
@@ -1319,6 +1336,24 @@ class DataplaneRunner:
                                k, t_admit, depth))
         return True
 
+    def _unpack_harvest(self, pk: np.ndarray, n: int):
+        """Shared by both harvest engines: unpack ONE materialised
+        packed result into the 12 verdict leaves.  The slow path
+        mutates verdicts/rewrites in place — the derived flag/tag/port
+        leaves are fresh numpy either way, so only the two
+        rewritten-IP rows (views into the materialised buffer) need a
+        copy, and only when the slow path can actually fire (punts in
+        this batch — straggler punts included — or live host
+        sessions); the all-fast-path case stays zero-copy, counted as
+        ``harvest_copy_saved_bytes``.  A shared slow path (sharded
+        engine) always copies: its emptiness can change between this
+        check and the locked slow-path pass."""
+        mutable = self._shared_host or len(self.slow) > 0 or \
+            bool((pk[PACKED_WORD][:n] & VERDICT_PUNT).any())
+        if not mutable:
+            self.counters.harvest_copy_saved_bytes += 8 * n
+        return unpack_verdicts(pk, n, writable=mutable)
+
     def _harvest_native(self) -> int:
         # Harvest-start mark: together with _observe_harvest's existing
         # end-of-harvest perf_counter this bounds the "harvest stitch"
@@ -1327,29 +1362,20 @@ class DataplaneRunner:
         # the dispatch path keeps its original timestamps untouched.
         t_h0 = time.perf_counter()
         slot, n, soa, result, ts, k, t_admit, depth = self._inflight.popleft()
-        # Materialise (blocks on THIS batch only; newer ones stay queued).
-        punt = np.asarray(result.punt)[:n]
-        reply_hit = np.asarray(result.reply_hit)[:n]
-        dnat_hit = np.asarray(result.dnat_hit)[:n]
-        snat_hit = np.asarray(result.snat_hit)[:n]
-        # The slow path mutates verdicts/rewrites in place — copy only
-        # when it can actually fire (punts in this batch or live host
-        # sessions); the all-fast-path case stays zero-copy.  A shared
-        # slow path (sharded engine) always copies: its emptiness can
-        # change between this check and the locked slow-path pass.
-        mutable = self._shared_host or bool(punt.any()) or len(self.slow) > 0
-        def mat(x):
-            arr = np.asarray(x)[:n]
-            return arr.copy() if mutable else arr
-        allowed = mat(result.allowed)
-        route_tag = mat(result.route)
-        node_id = mat(result.node_id)
+        # Materialise (blocks on THIS batch only; newer ones stay
+        # queued) — ONE device→host transfer: the packed uint32 [4, B]
+        # verdict+rewrite array the jit's packing tail produced (the
+        # 12 per-leaf np.asarray transfers this replaced each cost a
+        # round trip on a remote-TPU tunnel).
+        v = self._unpack_harvest(np.asarray(result.packed), n)
         rew = {
-            "src_ip": mat(result.batch.src_ip),
-            "dst_ip": mat(result.batch.dst_ip),
-            "protocol": np.asarray(result.batch.protocol)[:n],
-            "src_port": mat(result.batch.src_port),
-            "dst_port": mat(result.batch.dst_port),
+            "src_ip": v.src_ip,
+            "dst_ip": v.dst_ip,
+            # No pipeline stage rewrites the protocol — serve it from
+            # the host-side original headers instead of the device.
+            "protocol": soa["protocol"][:n],
+            "src_port": v.src_port,
+            "dst_port": v.dst_port,
         }
         # Orig 5-tuples are views into the slot's SoA buffers — stable
         # until the slot cycles, which cannot happen before this
@@ -1361,16 +1387,17 @@ class DataplaneRunner:
         # path below is the host `restore` round.
         t_mat = time.perf_counter()
         slow_drops = self._slowpath_and_trace(
-            orig, rew, allowed, route_tag, node_id,
-            punt, reply_hit, dnat_hit, snat_hit, ts, k,
+            orig, rew, v.allowed, v.route, v.node_id,
+            v.punt, v.reply_hit, v.dnat_hit, v.snat_hit, ts, k,
+            straggler=v.straggler,
         )
         t_slow = time.perf_counter()
         poison_drops = self._quarantine_rows(
             result, n, lambda row: self._native.slot_frame(slot, row))
         c = np.zeros(NativeLoop.HARVEST_COUNTERS, dtype=np.uint64)
         sent = self._native.harvest(
-            slot, allowed, rew["src_ip"], rew["dst_ip"],
-            rew["src_port"], rew["dst_port"], route_tag, node_id,
+            slot, v.allowed, rew["src_ip"], rew["dst_ip"],
+            rew["src_port"], rew["dst_port"], v.route, v.node_id,
             self.overlay.remote_ips, self.overlay.local_ip,
             self.overlay.local_node_id, c,
         )
@@ -1461,20 +1488,19 @@ class DataplaneRunner:
         t_h0 = time.perf_counter()  # harvest-start mark; see _harvest_native
         fb, result, ts, k, t_admit, depth = self._inflight.popleft()
         n = fb.n
-        # Materialise (blocks on THIS batch only; newer ones stay queued).
-        allowed = np.asarray(result.allowed)[:n].copy()
-        route_tag = np.asarray(result.route)[:n].copy()
-        node_id = np.asarray(result.node_id)[:n].copy()
-        punt = np.asarray(result.punt)[:n]
-        reply_hit = np.asarray(result.reply_hit)[:n]
-        dnat_hit = np.asarray(result.dnat_hit)[:n]
-        snat_hit = np.asarray(result.snat_hit)[:n]
+        # Materialise (blocks on THIS batch only; newer ones stay
+        # queued) — ONE transfer, same packed layout as the native
+        # engine, with the SAME conditional-copy gating: before ISSUE
+        # 11 this engine unconditionally copied every leaf; now the
+        # all-fast-path case is zero-copy here too, counted like
+        # admit_copy_saved_bytes.
+        v = self._unpack_harvest(np.asarray(result.packed), n)
         rew = {
-            "src_ip": np.asarray(result.batch.src_ip)[:n].copy(),
-            "dst_ip": np.asarray(result.batch.dst_ip)[:n].copy(),
-            "protocol": np.asarray(result.batch.protocol)[:n],
-            "src_port": np.asarray(result.batch.src_port)[:n].copy(),
-            "dst_port": np.asarray(result.batch.dst_port)[:n].copy(),
+            "src_ip": v.src_ip,
+            "dst_ip": v.dst_ip,
+            "protocol": np.asarray(fb.batch.protocol)[:n],
+            "src_port": v.src_port,
+            "dst_port": v.dst_port,
         }
         orig = {
             "src_ip": np.asarray(fb.batch.src_ip)[:n],
@@ -1485,13 +1511,15 @@ class DataplaneRunner:
         }
         t_mat = time.perf_counter()  # round stamp; see _harvest_native
         slow_drops = self._slowpath_and_trace(
-            orig, rew, allowed, route_tag, node_id,
-            punt, reply_hit, dnat_hit, snat_hit, ts, k,
+            orig, rew, v.allowed, v.route, v.node_id,
+            v.punt, v.reply_hit, v.dnat_hit, v.snat_hit, ts, k,
+            straggler=v.straggler,
         )
         t_slow = time.perf_counter()
         poison_drops = self._quarantine_rows(result, n, fb.frame)
 
         # -------------------------------------------- native apply + TX
+        allowed, route_tag, node_id = v.allowed, v.route, v.node_id
         rew_batch = PacketBatch(
             src_ip=rew["src_ip"], dst_ip=rew["dst_ip"], protocol=rew["protocol"],
             src_port=rew["src_port"], dst_port=rew["dst_port"],
@@ -1546,11 +1574,12 @@ class DataplaneRunner:
 
     def _slowpath_and_trace(
         self, orig, rew, allowed, route_tag, node_id,
-        punt, reply_hit, dnat_hit, snat_hit, ts, k=0,
+        punt, reply_hit, dnat_hit, snat_hit, ts, k=0, straggler=None,
     ) -> int:
-        """Host slow path (punt servicing, port fixups, reply restores)
-        + sampled packet trace — shared by both engines.  Mutates
-        ``rew``/``allowed``/``route_tag``/``node_id`` in place and
+        """Host slow path (straggler resolution, punt servicing, port
+        fixups, reply restores) + sampled packet trace — shared by both
+        engines.  Mutates ``rew``/``allowed``/``route_tag``/``node_id``
+        (and, for resolved stragglers, the verdict masks) in place and
         returns the number of slow-path drops.  Guarded by the (shared)
         host lock: in the sharded engine the slow path's session dict is
         one structure for all shards, because a punted flow's reply may
@@ -1561,14 +1590,40 @@ class DataplaneRunner:
         with self._host_lock:
             return self._slowpath_and_trace_locked(
                 orig, rew, allowed, route_tag, node_id,
-                punt, reply_hit, dnat_hit, snat_hit, ts, k,
+                punt, reply_hit, dnat_hit, snat_hit, ts, k, straggler,
             )
 
     def _slowpath_and_trace_locked(
         self, orig, rew, allowed, route_tag, node_id,
-        punt, reply_hit, dnat_hit, snat_hit, ts, k=0,
+        punt, reply_hit, dnat_hit, snat_hit, ts, k=0, straggler=None,
     ) -> int:
         slow_drops = 0
+        if straggler is not None and straggler.any():
+            # flat-punt round-cut: the device probe DETECTED these
+            # same-dispatch replies and punted instead of paying the
+            # dependent restore rounds.  Their forward packets are in
+            # this very batch — resolve host-side against the rows
+            # whose device session survived the dispatch, producing
+            # exactly the verdict flat-safe's on-device restore (or the
+            # next dispatch) would have.  Runs BEFORE record_punts so a
+            # resolved reply never records a bogus host session; misses
+            # (crafted aliasing only) stay on the ordinary punt path.
+            self.counters.straggler_punts += int(straggler.sum())
+            fwd_mask = (dnat_hit | snat_hit) & allowed & ~punt \
+                & ~reply_hit & ~straggler
+            restored = resolve_stragglers(orig, rew, straggler, fwd_mask)
+            for row, (s_ip, s_port, d_ip, d_port) in restored:
+                rew["src_ip"][row] = s_ip
+                rew["src_port"][row] = s_port
+                rew["dst_ip"][row] = d_ip
+                rew["dst_port"][row] = d_port
+                allowed[row] = True          # reflective-ACL bypass
+                reply_hit[row] = True
+                dnat_hit[row] = False
+                snat_hit[row] = False
+                punt[row] = False
+                route_tag[row], node_id[row] = self._route_of(d_ip)
+            self.counters.straggler_restores += len(restored)
         if punt.any():
             self.counters.punts += int(punt.sum())
             outcome = self.slow.record_punts(orig, rew, punt, snat_hit, ts)

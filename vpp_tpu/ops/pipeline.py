@@ -20,12 +20,22 @@ implementing the reference's per-packet pipeline ordering
   gated on the ACL verdict, so a session exists only when the forward
   direction was actually permitted — the analog of the reference's
   reflective ACL on permitted flows.
+
+PACKED HARVEST (ISSUE 11): the production jit entry points end in a
+packing tail that fuses the verdict bits (allowed/punt/reply/dnat/snat
++ straggler + route tag + node id) and the rewritten 5-tuple into ONE
+contiguous ``uint32 [4, B]`` device array, so the harvest blocks on a
+single device→host materialisation per batch (down from ~12 separate
+``np.asarray`` transfers — each a round trip on a remote-TPU tunnel)
+and unpacks host-side with cheap numpy views (:func:`unpack_verdicts`).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import NamedTuple
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -38,6 +48,7 @@ from .nat import (
     _V_OSRC,
     _V_SEEN,
     WRITE_TAG,
+    CommitResult,
     NatSessions,
     NatTables,
     affinity_commit,
@@ -211,9 +222,6 @@ def pipeline_step(
     return result._replace(sessions=new_sessions)
 
 
-pipeline_step_jit = jax.jit(pipeline_step, donate_argnums=(3,))
-
-
 # VPP's vector size: the dataplane's native unit of work.  The runner
 # assembles frames into 256-packet vectors and dispatches K of them per
 # device program (SURVEY §6: "VPP processes packets in up-to-256-packet
@@ -286,7 +294,130 @@ def pipeline_scan(
     return stacked._replace(sessions=final_sessions)
 
 
-pipeline_scan_jit = jax.jit(pipeline_scan, donate_argnums=(3,))
+class _FlatReconcile(NamedTuple):
+    """Shared state of the flat-safe/flat-punt disciplines after the
+    commit + ONE tagged post-commit probe: everything both tails need
+    to finish their (different) restore policies."""
+
+    flat: PacketBatch          # [B] original headers
+    ts_rows: jnp.ndarray       # int32 [B]
+    stateless: object          # StatelessRewrite over [B]
+    acl_ok: jnp.ndarray        # bool [B]
+    commit: CommitResult
+    sessions2: NatSessions     # finalized keys (bogus undone, tags cleared)
+    reply_pre: jnp.ndarray     # bool [B] organic reply to a pre-dispatch session
+    straggler: jnp.ndarray     # bool [B] reply whose forward is in THIS dispatch
+    slot2: jnp.ndarray         # int32 [B] the single matched slot per row
+    cap_sentinel: jnp.ndarray  # int32 [] out-of-range scatter sentinel
+
+
+def _flat_commit_and_probe(
+    acl: RuleTables,
+    nat: NatTables,
+    sessions: NatSessions,
+    batches: PacketBatch,      # leaves shaped [K, V]
+    timestamps: jnp.ndarray,   # int32 [K]
+) -> _FlatReconcile:
+    """Passes 1-3 shared by ``pipeline_flat_safe`` and
+    ``pipeline_flat_punt``: flat classify + stateless NAT, the
+    commit-first session insert (write-tagged), the ONE restore-side
+    probe whose tag split classifies every row (organic reply vs
+    straggler), and the single finalize scatter that undoes bogus
+    forward sessions and clears the write tags.  See
+    ``pipeline_flat_safe`` for the full correctness argument."""
+    k, v = batches.src_ip.shape
+
+    def flatten(a):
+        return a.reshape((k * v,) + a.shape[2:])
+
+    flat = jax.tree_util.tree_map(flatten, batches)
+    ts_rows = jnp.repeat(timestamps, v)
+    b = k * v
+    cap = sessions.capacity
+    cap_sentinel = jnp.int32(cap)
+
+    # ---- pass 1: session-independent compute ------------------------
+    src_action = classify_src(acl, flat)
+    stateless = nat_rewrite_stateless(nat, flat, sessions)
+    dst_action = classify_dst(acl, stateless.batch)
+    acl_ok = (src_action != _DENY) & (dst_action != _DENY)
+
+    # ---- pass 2: commit (insert-side probe) -------------------------
+    # Keep-alive touches for restored replies are deferred to the tail
+    # (reply_hit=False here); scatter-max is order-independent.
+    no_reply = jnp.zeros(b, dtype=bool)
+    record0 = (stateless.dnat_hit | stateless.snat_hit) & acl_ok
+    commit = nat_commit_sessions_full(
+        sessions, flat, stateless.batch, record0, no_reply,
+        jnp.zeros(b, dtype=jnp.int32), ts_rows, tag_writes=True,
+    )
+
+    # ---- pass 3: the ONE restore-side probe -------------------------
+    # tag_writes marked this batch's writes in the meta word, so the
+    # probe's own gathered rows split the matches — no separate
+    # written-mask table (the session stages are bound by the COUNT of
+    # small random-access ops, so every eliminated scatter/gather chain
+    # is throughput).
+    km2, cand2, meta2 = nat_reply_probe(commit.sessions, flat)
+    wm = (meta2 & jnp.uint32(WRITE_TAG)) != 0           # [B, W]
+    km_pre = km2 & ~wm        # matches against pre-dispatch sessions
+    # Valid slots hold unique keys, so km2 has at most ONE true way —
+    # km_pre is mutually exclusive with the written-slot matches per
+    # row and the argmax selection below is over a singleton set.
+    reply_pre = jnp.any(km_pre, axis=1)
+    hit2 = jnp.any(km2, axis=1)
+    w2 = jnp.argmax(km2, axis=1)
+    slot2 = jnp.take_along_axis(cand2, w2[:, None], axis=1)[:, 0]
+    own_write = commit.committed & (slot2 == commit.ins_slot)
+    straggler = hit2 & ~reply_pre & ~own_write
+
+    # Undo bogus forward sessions: any FRESH commit by a row that is
+    # itself a reply (organic or straggler).  Reused slots are legit
+    # pre-existing sessions being refreshed — clearing those would
+    # destroy real state, so they are excluded (crafted corners only;
+    # organic replies never DNAT/SNAT-hit and so never commit).
+    # ONE finalize scatter serves undo AND tag clearing: every
+    # committed row's slot gets its final meta (0 when undone, the
+    # bare protocol otherwise).
+    undo_rows = commit.committed & ~commit.reused & (reply_pre | straggler)
+    fin_slot = jnp.where(commit.committed, commit.ins_slot, cap_sentinel)
+    fin_meta = jnp.where(
+        undo_rows, jnp.uint32(0), flat.protocol.astype(jnp.uint32)
+    )
+    sessions2 = NatSessions(
+        key_tbl=commit.sessions.key_tbl.at[fin_slot, _K_META].set(
+            fin_meta, mode="drop"
+        ),
+        val_tbl=commit.sessions.val_tbl,
+    )
+    return _FlatReconcile(
+        flat=flat, ts_rows=ts_rows, stateless=stateless, acl_ok=acl_ok,
+        commit=commit, sessions2=sessions2, reply_pre=reply_pre,
+        straggler=straggler, slot2=slot2, cap_sentinel=cap_sentinel,
+    )
+
+
+def _restore_batch(rc: _FlatReconcile, reply_final: jnp.ndarray,
+                   vals3: jnp.ndarray) -> PacketBatch:
+    """Merge restored reply headers over the stateless rewrite.
+    Restore mapping as in nat_reply_restore: src <- original dst
+    (VIP), dst <- original src (client), ports likewise (unpacked
+    from the packed-ports word of the selected value row)."""
+    stateless = rc.stateless
+
+    def merge(a, b_):
+        return jnp.where(reply_final, a, b_)
+
+    op3 = vals3[:, _V_OPORTS]
+    return PacketBatch(
+        src_ip=merge(vals3[:, _V_ODST], stateless.batch.src_ip),
+        dst_ip=merge(vals3[:, _V_OSRC], stateless.batch.dst_ip),
+        protocol=rc.flat.protocol,
+        src_port=merge((op3 & jnp.uint32(0xFFFF)).astype(jnp.int32),
+                       stateless.batch.src_port),
+        dst_port=merge((op3 >> jnp.uint32(16)).astype(jnp.int32),
+                       stateless.batch.dst_port),
+    )
 
 
 def pipeline_flat_safe(
@@ -358,117 +489,41 @@ def pipeline_flat_safe(
     UNSAFE flat step.
     """
     k, v = batches.src_ip.shape
-
-    def flatten(a):
-        return a.reshape((k * v,) + a.shape[2:])
-
-    flat = jax.tree_util.tree_map(flatten, batches)
-    ts_rows = jnp.repeat(timestamps, v)
-    b = k * v
-    cap = sessions.capacity
-    cap_sentinel = jnp.int32(cap)
-
-    # ---- pass 1: session-independent compute ------------------------
-    src_action = classify_src(acl, flat)
-    stateless = nat_rewrite_stateless(nat, flat, sessions)
-    dst_action = classify_dst(acl, stateless.batch)
-    acl_ok = (src_action != _DENY) & (dst_action != _DENY)
-
-    # ---- pass 2: commit (insert-side probe) -------------------------
-    # Keep-alive touches for restored replies are deferred to pass 4
-    # (reply_hit=False here); scatter-max is order-independent.
-    no_reply = jnp.zeros(b, dtype=bool)
-    record0 = (stateless.dnat_hit | stateless.snat_hit) & acl_ok
-    commit = nat_commit_sessions_full(
-        sessions, flat, stateless.batch, record0, no_reply,
-        jnp.zeros(b, dtype=jnp.int32), ts_rows, tag_writes=True,
-    )
-
-    # ---- pass 3: the ONE restore-side probe -------------------------
-    # tag_writes marked this batch's writes in the meta word, so the
-    # probe's own gathered rows split the matches — no separate
-    # written-mask table (the session stages are bound by the COUNT of
-    # small random-access ops, so every eliminated scatter/gather chain
-    # is throughput).
-    km2, cand2, meta2 = nat_reply_probe(commit.sessions, flat)
-    wm = (meta2 & jnp.uint32(WRITE_TAG)) != 0           # [B, W]
-    km_pre = km2 & ~wm        # matches against pre-dispatch sessions
-    km_new = km2 & wm         # matches against this batch's writes
-    # Valid slots hold unique keys, so km2 has at most ONE true way —
-    # km_pre and km_new are mutually exclusive per row and the argmax
-    # selections below are all over singleton sets.
-    reply_pre = jnp.any(km_pre, axis=1)
-    hit2 = jnp.any(km2, axis=1)
-    w2 = jnp.argmax(km2, axis=1)
-    slot2 = jnp.take_along_axis(cand2, w2[:, None], axis=1)[:, 0]
-    own_write = commit.committed & (slot2 == commit.ins_slot)
-    straggler = hit2 & ~reply_pre & ~own_write
-
-    # Undo bogus forward sessions: any FRESH commit by a row that is
-    # itself a reply (organic or straggler).  Reused slots are legit
-    # pre-existing sessions being refreshed — clearing those would
-    # destroy real state, so they are excluded (crafted corners only;
-    # organic replies never DNAT/SNAT-hit and so never commit).
-    # ONE finalize scatter serves undo AND tag clearing: every
-    # committed row's slot gets its final meta (0 when undone, the
-    # bare protocol otherwise).
-    undo_rows = commit.committed & ~commit.reused & (reply_pre | straggler)
-    fin_slot = jnp.where(commit.committed, commit.ins_slot, cap_sentinel)
-    fin_meta = jnp.where(
-        undo_rows, jnp.uint32(0), flat.protocol.astype(jnp.uint32)
-    )
-    sessions2 = NatSessions(
-        key_tbl=commit.sessions.key_tbl.at[fin_slot, _K_META].set(
-            fin_meta, mode="drop"
-        ),
-        val_tbl=commit.sessions.val_tbl,
-    )
+    rc = _flat_commit_and_probe(acl, nat, sessions, batches, timestamps)
 
     # ---- pass 4: restores against the finalized table ---------------
     # A straggler's single matched slot may be another straggler's
     # undone bogus write — one scalar meta gather at the selected slot
     # re-checks validity (organic replies matched unwritten slots,
-    # which the finalize scatter never clears).
-    slot_pre = slot2  # singleton match: the km2 selection IS the slot
-    rslot = jnp.where(reply_pre, slot_pre, slot2)
-    meta_chk = sessions2.key_tbl[rslot, _K_META]        # [B]
-    restored_strag = straggler & (meta_chk != 0)
-    reply_final = reply_pre | restored_strag
-    vals3 = sessions2.val_tbl[rslot]  # [B, 4] — one row per restore
-    touch = jnp.where(reply_final, rslot, cap_sentinel)
+    # which the finalize scatter never clears).  This gather is the
+    # only read DEPENDENT on the finalize scatter — the round the
+    # flat-punt discipline cuts by punting stragglers instead.
+    rslot = rc.slot2  # singleton match: the km2 selection IS the slot
+    meta_chk = rc.sessions2.key_tbl[rslot, _K_META]        # [B]
+    restored_strag = rc.straggler & (meta_chk != 0)
+    reply_final = rc.reply_pre | restored_strag
+    vals3 = rc.sessions2.val_tbl[rslot]  # [B, 4] — one row per restore
+    touch = jnp.where(reply_final, rslot, rc.cap_sentinel)
     # max, not set: duplicate slots with differing per-row timestamps
     # (two restored replies to one session) scatter in undefined order.
     sessions3 = NatSessions(
-        key_tbl=sessions2.key_tbl,
-        val_tbl=sessions2.val_tbl.at[touch, _V_SEEN].max(
-            ts_rows.astype(jnp.uint32), mode="drop"
+        key_tbl=rc.sessions2.key_tbl,
+        val_tbl=rc.sessions2.val_tbl.at[touch, _V_SEEN].max(
+            rc.ts_rows.astype(jnp.uint32), mode="drop"
         ),
     )
+    stateless = rc.stateless
     if nat.has_affinity:  # static gate — compiled in only when used
         sessions3 = affinity_commit(
-            sessions3, nat, flat, stateless.midx,
-            stateless.aff_want & acl_ok & ~reply_final,
-            stateless.batch.dst_ip, stateless.batch.dst_port, ts_rows,
+            sessions3, nat, rc.flat, stateless.midx,
+            stateless.aff_want & rc.acl_ok & ~reply_final,
+            stateless.batch.dst_ip, stateless.batch.dst_port, rc.ts_rows,
         )
 
-    def merge(a, b_):
-        return jnp.where(reply_final, a, b_)
-
-    # Restore mapping as in nat_reply_restore: src <- original dst
-    # (VIP), dst <- original src (client), ports likewise (unpacked
-    # from the packed-ports word of the selected value row).
-    op3 = vals3[:, _V_OPORTS]
-    final_batch = PacketBatch(
-        src_ip=merge(vals3[:, _V_ODST], stateless.batch.src_ip),
-        dst_ip=merge(vals3[:, _V_OSRC], stateless.batch.dst_ip),
-        protocol=flat.protocol,
-        src_port=merge((op3 & jnp.uint32(0xFFFF)).astype(jnp.int32),
-                       stateless.batch.src_port),
-        dst_port=merge((op3 >> jnp.uint32(16)).astype(jnp.int32),
-                       stateless.batch.dst_port),
-    )
-    allowed_final = acl_ok | reply_final
-    punt_final = (commit.punt & ~reply_final) | (straggler & ~restored_strag)
+    final_batch = _restore_batch(rc, reply_final, vals3)
+    allowed_final = rc.acl_ok | reply_final
+    punt_final = (rc.commit.punt & ~reply_final) | \
+        (rc.straggler & ~restored_strag)
     tag, node_id = _route_tags(route, final_batch.dst_ip, allowed_final)
 
     def unflatten(a):
@@ -487,31 +542,93 @@ def pipeline_flat_safe(
     )
 
 
-pipeline_flat_safe_jit = jax.jit(pipeline_flat_safe, donate_argnums=(3,))
+def pipeline_flat_punt(
+    acl: RuleTables,
+    nat: NatTables,
+    route: RouteConfig,
+    sessions: NatSessions,
+    batches: PacketBatch,      # leaves shaped [K, V]
+    timestamps: jnp.ndarray,   # int32 [K]
+) -> Tuple[PipelineResult, jnp.ndarray]:
+    """The round-cut discipline (ISSUE 11 / MESHOVERHEAD_r05 finding):
+    identical to ``pipeline_flat_safe`` through the commit + ONE
+    tagged post-commit probe, but DETECTED same-dispatch reply
+    stragglers are PUNTED to the host slow path instead of restored on
+    device.  Returns ``(result, straggler)`` where ``straggler``
+    (bool [K, V]) marks the punted same-dispatch replies — the harvest
+    resolves them host-side against the SAME batch's committed forward
+    rows (``ops.slowpath.resolve_stragglers``), so they still reach
+    the oracle verdict; plain flat is NOT an option because it
+    silently mistranslates them instead of punting.
 
+    What this buys: flat-safe's straggler restore needs a meta re-check
+    gather that DEPENDS on the finalize scatter (commit → probe →
+    finalize → re-check → touch — the longest dependent chain of the
+    discipline), and on a GSPMD mesh every dependent scatter/gather
+    round over the session table is a collective.  Cutting the
+    restore truncates the chain at the finalize: the organic-reply
+    value gather and keep-alive touch hang off the PROBE, not the
+    finalize, so the dependent session-table round count drops by one
+    and the dispatch's critical path shortens — the ~4× sharding tax
+    of MESHOVERHEAD_r05 is round-count-bound, not placement-bound.
 
-def _with_ts0(fn):
-    """Wrap a [K, V] discipline to take a SCALAR base timestamp and
-    derive the per-vector ts inside the program, returning [K·V]-flat
-    leaves.  The host-side ``jnp.arange`` the raw signatures require is
-    an extra tiny device-array creation per dispatch — on a remote-TPU
-    tunnel that is one more round trip, measured at a 40-100% tax on
-    the whole 16k-packet dispatch (r4: it was misattributed to the
-    session stages for a full round).  Vector i gets ts0 + 1 + i."""
+    Straggler frequency is workload-bound (a reply must land in the
+    very dispatch of its forward — the coalesce window, ≤1.6 ms at the
+    production shape), so the host punt is rare by construction;
+    flat-safe remains the right pick when same-dispatch replies are
+    common (e.g. loopback-heavy east-west with deep coalesce).
 
-    def stepped(acl, nat, route, sessions, batches, ts0):
-        k = batches.src_ip.shape[0]
-        tss = ts0 + jnp.arange(1, k + 1, dtype=jnp.int32)
-        return flatten_scan_result(fn(acl, nat, route, sessions, batches, tss))
+    Other differences vs flat-safe, all on adversarial corners only:
+    a detected straggler never commits an affinity pin (it is a reply;
+    flat-safe likewise excludes the ones it restores), and the
+    crafted-aliasing rows flat-safe forwards per their pass-1 rewrite
+    arrive here as ordinary unresolved punts (same punt verdict, same
+    slow-path ownership).
+    """
+    k, v = batches.src_ip.shape
+    rc = _flat_commit_and_probe(acl, nat, sessions, batches, timestamps)
 
-    return stepped
+    # ---- tail: organic restores only; stragglers punt ---------------
+    # Both the value gather and the keep-alive touch key off the probe
+    # (pass 3) — nothing here reads the finalized key table, so the
+    # finalize scatter is a chain LEAF, not a link.
+    reply_final = rc.reply_pre
+    vals3 = rc.sessions2.val_tbl[rc.slot2]  # [B, 4]
+    touch = jnp.where(reply_final, rc.slot2, rc.cap_sentinel)
+    sessions3 = NatSessions(
+        key_tbl=rc.sessions2.key_tbl,
+        val_tbl=rc.sessions2.val_tbl.at[touch, _V_SEEN].max(
+            rc.ts_rows.astype(jnp.uint32), mode="drop"
+        ),
+    )
+    stateless = rc.stateless
+    if nat.has_affinity:  # static gate — compiled in only when used
+        sessions3 = affinity_commit(
+            sessions3, nat, rc.flat, stateless.midx,
+            stateless.aff_want & rc.acl_ok & ~reply_final & ~rc.straggler,
+            stateless.batch.dst_ip, stateless.batch.dst_port, rc.ts_rows,
+        )
 
+    final_batch = _restore_batch(rc, reply_final, vals3)
+    allowed_final = rc.acl_ok | reply_final
+    punt_final = (rc.commit.punt & ~reply_final) | rc.straggler
+    tag, node_id = _route_tags(route, final_batch.dst_ip, allowed_final)
 
-# Production entry points: scalar base-ts in, flat leaves out (the
-# runner consumes flat [K·V] arrays; flattening inside the program
-# costs nothing and returns rank-1 buffers).
-pipeline_scan_ts0_jit = jax.jit(_with_ts0(pipeline_scan), donate_argnums=(3,))
-pipeline_flat_safe_ts0_jit = jax.jit(_with_ts0(pipeline_flat_safe), donate_argnums=(3,))
+    def unflatten(a):
+        return a.reshape((k, v) + a.shape[1:])
+
+    result = PipelineResult(
+        batch=jax.tree_util.tree_map(unflatten, final_batch),
+        sessions=sessions3,
+        allowed=unflatten(allowed_final),
+        route=unflatten(tag),
+        node_id=unflatten(node_id),
+        dnat_hit=unflatten(stateless.dnat_hit & ~reply_final),
+        snat_hit=unflatten(stateless.snat_hit & ~reply_final),
+        reply_hit=unflatten(reply_final),
+        punt=unflatten(punt_final),
+    )
+    return result, unflatten(rc.straggler)
 
 
 def flatten_scan_result(res: PipelineResult) -> PipelineResult:
@@ -531,3 +648,195 @@ def flatten_scan_result(res: PipelineResult) -> PipelineResult:
         reply_hit=flat(res.reply_hit),
         punt=flat(res.punt),
     )
+
+
+# ---------------------------------------------------------------------------
+# Packed single-transfer harvest (ISSUE 11 tentpole)
+# ---------------------------------------------------------------------------
+
+# Verdict-word layout (uint32 per packet, row 0 of the packed array).
+VERDICT_ALLOWED = 1 << 0
+VERDICT_PUNT = 1 << 1
+VERDICT_REPLY = 1 << 2
+VERDICT_DNAT = 1 << 3
+VERDICT_SNAT = 1 << 4
+VERDICT_ROUTE_SHIFT = 5        # bits 5-6: ROUTE_* tag (0..3)
+VERDICT_STRAGGLER = 1 << 7     # flat-punt: same-dispatch reply, punted
+VERDICT_NODE_SHIFT = 8         # bits 8-31: destination node id
+# node_id fits 24 bits by construction: it is pod-subnet arithmetic
+# ((dst - base) >> host_bits), bounded by 2^(pod_prefixlen span) — a /8
+# cluster subnet with /24 per-node chunks is 2^16 nodes; 2^24 is beyond
+# any representable IPv4 layout the RouteConfig can produce.
+
+# The packed rows (uint32 [4, B]; row-major so each leaf is ONE
+# contiguous host-side view after the single materialisation).
+PACKED_WORD = 0     # verdict bits | route << 5 | node_id << 8
+PACKED_SRC = 1      # rewritten src_ip
+PACKED_DST = 2      # rewritten dst_ip
+PACKED_PORTS = 3    # rewritten src_port << 16 | dst_port
+# (protocol is NOT packed: no pipeline stage rewrites it, so the
+# harvest reads it from the host-side original headers for free.)
+
+
+class PackedResult(NamedTuple):
+    """What the production jit entry points return: the single packed
+    verdict+rewrite array (ONE device→host transfer per harvest) plus
+    the session table threaded to the next dispatch on device."""
+
+    packed: jnp.ndarray     # uint32 [4, B]
+    sessions: NatSessions
+
+
+def pack_result(res: PipelineResult,
+                straggler: Optional[jnp.ndarray] = None) -> PackedResult:
+    """In-program packing tail: fuse the 7 verdict leaves and the
+    rewritten 5-tuple (12 separate host materialisations before ISSUE
+    11) into one contiguous uint32 [4, B] device array.  ``res`` must
+    carry flat [B] leaves."""
+    word = (
+        res.allowed.astype(jnp.uint32)
+        | (res.punt.astype(jnp.uint32) << 1)
+        | (res.reply_hit.astype(jnp.uint32) << 2)
+        | (res.dnat_hit.astype(jnp.uint32) << 3)
+        | (res.snat_hit.astype(jnp.uint32) << 4)
+        | (res.route.astype(jnp.uint32) << VERDICT_ROUTE_SHIFT)
+        | (res.node_id.astype(jnp.uint32) << VERDICT_NODE_SHIFT)
+    )
+    if straggler is not None:
+        word = word | (straggler.astype(jnp.uint32) << 7)
+    ports = (
+        (res.batch.src_port.astype(jnp.uint32) << 16)
+        | res.batch.dst_port.astype(jnp.uint32)
+    )
+    packed = jnp.stack([word, res.batch.src_ip, res.batch.dst_ip, ports])
+    return PackedResult(packed=packed, sessions=res.sessions)
+
+
+class HostVerdicts(NamedTuple):
+    """Host-side unpacked view of one packed result (numpy).  The flag
+    and port leaves are fresh writable arrays (the slow path mutates
+    them in place); ``src_ip``/``dst_ip`` are zero-copy views into the
+    packed rows unless ``writable`` asked for copies."""
+
+    allowed: np.ndarray     # bool [n]
+    punt: np.ndarray        # bool [n]
+    reply_hit: np.ndarray   # bool [n]
+    dnat_hit: np.ndarray    # bool [n]
+    snat_hit: np.ndarray    # bool [n]
+    straggler: np.ndarray   # bool [n]
+    route: np.ndarray       # int32 [n]
+    node_id: np.ndarray     # int32 [n]
+    src_ip: np.ndarray      # uint32 [n]
+    dst_ip: np.ndarray      # uint32 [n]
+    src_port: np.ndarray    # int32 [n]
+    dst_port: np.ndarray    # int32 [n]
+
+
+def unpack_verdicts(packed_rows: np.ndarray, n: Optional[int] = None,
+                    writable: bool = False) -> HostVerdicts:
+    """Split one materialised packed array (numpy uint32 [4, B]) into
+    the 12 harvest leaves with cheap numpy ops: the derived flag/tag/
+    port arrays are fresh allocations either way; the two rewritten-IP
+    rows stay zero-copy row views unless ``writable`` (the slow path
+    needs to patch restored headers in place, and a materialised
+    device buffer may be read-only)."""
+    n = packed_rows.shape[1] if n is None else n
+    word = packed_rows[PACKED_WORD][:n]
+    src = packed_rows[PACKED_SRC][:n]
+    dst = packed_rows[PACKED_DST][:n]
+    ports = packed_rows[PACKED_PORTS][:n]
+    if writable:
+        src = src.copy()
+        dst = dst.copy()
+    return HostVerdicts(
+        allowed=(word & VERDICT_ALLOWED) != 0,
+        punt=(word & VERDICT_PUNT) != 0,
+        reply_hit=(word & VERDICT_REPLY) != 0,
+        dnat_hit=(word & VERDICT_DNAT) != 0,
+        snat_hit=(word & VERDICT_SNAT) != 0,
+        straggler=(word & VERDICT_STRAGGLER) != 0,
+        route=((word >> VERDICT_ROUTE_SHIFT) & 0x3).astype(np.int32),
+        node_id=(word >> VERDICT_NODE_SHIFT).astype(np.int32),
+        src_ip=src,
+        dst_ip=dst,
+        src_port=(ports >> 16).astype(np.int32),
+        dst_port=(ports & 0xFFFF).astype(np.int32),
+    )
+
+
+def pack_verdicts_host(allowed, punt, reply_hit, dnat_hit, snat_hit,
+                       route, node_id, src_ip, dst_ip, src_port, dst_port,
+                       straggler=None) -> np.ndarray:
+    """Numpy twin of :func:`pack_result`'s layout — used by the
+    poisoned-batch quarantine to assemble a host-stitched packed
+    result, and by the round-trip property tests (host pack ≡ device
+    pack bit-for-bit).  Inputs must already be HOST numpy arrays: the
+    quarantine path is hot-path-reachable and this function performs
+    no device materialisation (``.astype`` on numpy is a host cast)."""
+    word = (
+        allowed.astype(np.uint32)
+        | (punt.astype(np.uint32) << 1)
+        | (reply_hit.astype(np.uint32) << 2)
+        | (dnat_hit.astype(np.uint32) << 3)
+        | (snat_hit.astype(np.uint32) << 4)
+        | (route.astype(np.uint32) << VERDICT_ROUTE_SHIFT)
+        | (node_id.astype(np.uint32) << VERDICT_NODE_SHIFT)
+    )
+    if straggler is not None:
+        word = word | (straggler.astype(np.uint32) << 7)
+    ports = (src_port.astype(np.uint32) << 16) | dst_port.astype(np.uint32)
+    return np.stack([
+        word, src_ip.astype(np.uint32), dst_ip.astype(np.uint32), ports,
+    ])
+
+
+# ---------------------------------------------------------------------------
+# Production jit entry points
+# ---------------------------------------------------------------------------
+
+def _packed_step(acl, nat, route, sessions, batch, timestamp):
+    """Flat single-vector step + packing tail (the K=1 scan-discipline
+    dispatch shape)."""
+    return pack_result(
+        pipeline_step(acl, nat, route, sessions, batch, timestamp))
+
+
+def _with_ts0(fn):
+    """Wrap a [K, V] discipline to take a SCALAR base timestamp and
+    derive the per-vector ts inside the program, returning the PACKED
+    single-transfer result over [K·V]-flat rows.  The host-side
+    ``jnp.arange`` the raw signatures require is an extra tiny
+    device-array creation per dispatch — on a remote-TPU tunnel that
+    is one more round trip, measured at a 40-100% tax on the whole
+    16k-packet dispatch (r4: it was misattributed to the session
+    stages for a full round).  Vector i gets ts0 + 1 + i."""
+
+    def stepped(acl, nat, route, sessions, batches, ts0):
+        k = batches.src_ip.shape[0]
+        tss = ts0 + jnp.arange(1, k + 1, dtype=jnp.int32)
+        return pack_result(
+            flatten_scan_result(fn(acl, nat, route, sessions, batches, tss)))
+
+    return stepped
+
+
+def _flat_punt_ts0(acl, nat, route, sessions, batches, ts0):
+    """flat-punt's ts0 wrapper: same scalar-base-ts contract, plus the
+    straggler mask folded into the packed verdict word (bit 7)."""
+    k = batches.src_ip.shape[0]
+    tss = ts0 + jnp.arange(1, k + 1, dtype=jnp.int32)
+    res, straggler = pipeline_flat_punt(acl, nat, route, sessions,
+                                        batches, tss)
+    return pack_result(flatten_scan_result(res), straggler.reshape(-1))
+
+
+# Production entry points: scalar base-ts in (the ts0 shapes), the
+# packed single-transfer result out.  Every one of these is referenced
+# by BOTH the runner's dispatch discipline selection and its pre-warm
+# ledger — the jit-discipline checker enforces that pairing (a
+# dispatch-reachable jit the warmer never compiled stalls a load
+# spike; a warmed jit no dispatch can select is dead weight).
+pipeline_step_jit = jax.jit(_packed_step, donate_argnums=(3,))
+pipeline_scan_ts0_jit = jax.jit(_with_ts0(pipeline_scan), donate_argnums=(3,))
+pipeline_flat_safe_ts0_jit = jax.jit(_with_ts0(pipeline_flat_safe), donate_argnums=(3,))
+pipeline_flat_punt_ts0_jit = jax.jit(_flat_punt_ts0, donate_argnums=(3,))

@@ -141,6 +141,69 @@ class _HashIndex:
         return self._arr
 
 
+def resolve_stragglers(
+    orig: Dict[str, np.ndarray],
+    rewritten: Dict[str, np.ndarray],
+    straggler: np.ndarray,
+    fwd_mask: np.ndarray,
+) -> List[Tuple[int, Restore]]:
+    """Same-batch reply join for the ``flat-punt`` dispatch discipline.
+
+    A *straggler* is a reply whose forward packet sits in the SAME
+    dispatch: the device probe detected it (it matched a slot this
+    batch wrote) and punted it here instead of paying the dependent
+    device restore rounds.  Its forward flow's session lives on the
+    DEVICE table, so the recorded host sessions cannot restore it — but
+    the forward packet itself is in this very batch, already
+    materialised, so the join is pure host arithmetic: a forward row's
+    expected reply tuple is the src/dst (and port) swap of its
+    REWRITTEN headers, and the restore is the swap of its ORIGINAL
+    headers — exactly the value row the device session stores.
+
+    ``fwd_mask`` must select the rows whose device session survived
+    the dispatch ((dnat|snat) ∧ allowed ∧ ¬punt ∧ ¬reply ∧ ¬straggler);
+    the unique-reply-key table invariant makes the join unambiguous.
+    Rows that miss (their match was another straggler's undone bogus
+    write — crafted aliasing, never organic traffic) are left to the
+    ordinary punt path, the same ownership handoff flat-safe makes for
+    them.  Returns ``[(row, restore)]`` in :meth:`restore_replies`'
+    shape: restore = (src_ip, src_port, dst_ip, dst_port) of the
+    restored header."""
+    rows = np.nonzero(straggler)[0]
+    if not len(rows):
+        return []
+    fwd_rows = np.nonzero(fwd_mask)[0]
+    if not len(fwd_rows):
+        return []
+    # Stragglers are rare by construction (the forward must land in the
+    # same coalesce window); the dict is built per batch only when one
+    # was detected.
+    by_reply: Dict[ReplyKey, Restore] = {}
+    for j in fwd_rows.tolist():
+        key: ReplyKey = (
+            int(rewritten["dst_ip"][j]), int(rewritten["src_ip"][j]),
+            int(orig["protocol"][j]),
+            int(rewritten["dst_port"][j]), int(rewritten["src_port"][j]),
+        )
+        by_reply[key] = (
+            int(orig["src_ip"][j]), int(orig["src_port"][j]),
+            int(orig["dst_ip"][j]), int(orig["dst_port"][j]),
+        )
+    out: List[Tuple[int, Restore]] = []
+    for i in rows.tolist():
+        key = (int(orig["src_ip"][i]), int(orig["dst_ip"][i]),
+               int(orig["protocol"][i]),
+               int(orig["src_port"][i]), int(orig["dst_port"][i]))
+        fwd = by_reply.get(key)
+        if fwd is None:
+            continue
+        o_src_ip, o_src_port, o_dst_ip, o_dst_port = fwd
+        # Restore: src <- original dst, dst <- original src (the same
+        # mapping nat_reply_restore / restore_replies produce).
+        out.append((i, (o_dst_ip, o_dst_port, o_src_ip, o_src_port)))
+    return out
+
+
 class HostSlowPath:
     """Exact host-side session table for punted flows."""
 

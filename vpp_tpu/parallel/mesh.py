@@ -314,8 +314,9 @@ def dryrun_multichip(n_devices: int) -> None:
         batch_s = shard_batch(mesh, batch)
         step = sharded_pipeline_step(mesh)
         result = step(acl_s, nat_s, route_s, sess_s, batch_s, jnp.int32(0))
-        result.allowed.block_until_ready()
-    assert np.asarray(result.allowed).shape == (batch_size,)
+        result.packed.block_until_ready()
+    # Packed single-transfer result: uint32 [4, B] (word|src|dst|ports).
+    assert np.asarray(result.packed).shape == (4, batch_size)
 
     print(
         f"dryrun_multichip OK: mesh {dict(zip(mesh.axis_names, mesh.devices.shape))}, "
