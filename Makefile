@@ -5,10 +5,10 @@ PY ?= python
 
 .PHONY: test test-race verify verify-ha verify-churn verify-faults \
         verify-adaptive verify-static verify-telemetry verify-soak soak \
-        verify-cluster-obs verify-dispatch lint bench \
+        verify-cluster-obs verify-dispatch verify-ingress lint bench \
         bench-suite bench-sweep bench-scale bench-latency bench-frames \
-        bench-churn bench-adaptive bench-history bench-rounds images native \
-        native-sanitize
+        bench-ingress bench-churn bench-adaptive bench-history \
+        bench-rounds images native native-sanitize
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -82,6 +82,30 @@ verify-dispatch:
 
 bench-rounds:
 	$(PY) scripts/bench_rounds.py --check
+
+# Many-core host ingress verification (ISSUE 12): the fanout-handoff /
+# drain-call native units, the steering-rotation regression across an
+# eject→rejoin cycle at N=8, the global-budget ledger property suite
+# (sum of per-shard chosen-K added latency holds the ONE
+# coalesce_slo_us under skewed backlogs, on both engines, with the
+# overload case honestly accounted), the placement/ledger
+# observability surfaces — then a reduced-scale scaling smoke through
+# the official harness gating wall-clock efficiency ≥ 0.8 at N=4
+# (honest notes where the box caps real parallelism).  The full
+# recorded tier (N ∈ {1,2,4,8} at bench scale → FRAMEBENCH_r06.jsonl)
+# is `make bench-ingress`.
+verify-ingress:
+	JAX_PLATFORMS=cpu $(PY) -m pytest \
+	    tests/test_shards.py tests/test_governor.py \
+	    tests/test_native_sanitize.py \
+	    -q $(if $(RUN_SLOW),,-m 'not slow') --continue-on-collection-errors \
+	    -p no:cacheprovider -p no:xdist -p no:randomly
+	JAX_PLATFORMS=cpu $(PY) scripts/frame_bench.py --shards-tier 1,4 \
+	    --frames 2048 --rounds 3 --check --min-eff 0.8 --gate-shards 4
+
+bench-ingress:
+	$(PY) scripts/frame_bench.py --shards-tier 1,2,4,8 --check \
+	    --out FRAMEBENCH_r06.jsonl
 
 # Telemetry verification (ISSUE 8): the histogram/span/flight suites
 # (single-writer vs reader-merge property, bucket boundaries, the full
@@ -182,8 +206,8 @@ soak:
 # The aggregate verification gate: static battery + every subsystem's
 # verify target, soak-smoke included.
 verify: lint verify-static verify-ha verify-churn verify-adaptive \
-        verify-dispatch verify-telemetry verify-faults verify-cluster-obs \
-        verify-soak
+        verify-dispatch verify-ingress verify-telemetry verify-faults \
+        verify-cluster-obs verify-soak
 	@echo verify OK
 
 bench:
@@ -219,9 +243,12 @@ native:
 # path, then the native-engine test subset under them.
 #
 # - loopbench.asan runs with LEAK DETECTION ON (pure C++ process, every
-#   allocation attributable) over the mixed and threaded shapes;
+#   allocation attributable) over the mixed, threaded and sharded shapes;
 # - loopbench.tsan runs the `threaded` shape (N pushers vs one
-#   admit/harvest consumer — the ShardedDataplane contention pattern);
+#   admit/harvest consumer — the legacy contention pattern) AND the
+#   `sharded` shape (ISSUE 12: one fanout feeder distributing across N
+#   independent rings while N consumer threads drive their own
+#   admit→route→harvest loops — the real many-core front-end handoff);
 # - the pytest subset loads libhostshim.asan.so into a libasan-preloaded
 #   interpreter.  detect_leaks=0 there (CPython keeps arenas/interned
 #   objects to exit — see native/hostshim/asan.supp), and the subset
@@ -244,8 +271,13 @@ native-sanitize:
 	LSAN_OPTIONS=suppressions=native/hostshim/asan.supp \
 	    UBSAN_OPTIONS=halt_on_error=1 \
 	    native/build/loopbench.asan 16384 3 threaded 4
+	LSAN_OPTIONS=suppressions=native/hostshim/asan.supp \
+	    UBSAN_OPTIONS=halt_on_error=1 \
+	    native/build/loopbench.asan 16384 3 sharded 4
 	TSAN_OPTIONS="suppressions=native/hostshim/tsan.supp halt_on_error=1" \
 	    native/build/loopbench.tsan 8192 3 threaded 8
+	TSAN_OPTIONS="suppressions=native/hostshim/tsan.supp halt_on_error=1" \
+	    native/build/loopbench.tsan 8192 3 sharded 8
 	LD_PRELOAD=$(ASAN_LIB) \
 	    VPP_TPU_HOSTSHIM_LIB=$(CURDIR)/native/build/libhostshim.asan.so \
 	    ASAN_OPTIONS=detect_leaks=0 UBSAN_OPTIONS=halt_on_error=1 \

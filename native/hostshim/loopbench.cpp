@@ -62,6 +62,9 @@ int32_t hs_loop_hostpath(HsLoop* lp, int32_t slot_idx, uint32_t pod_base,
                          uint32_t local_ip, uint32_t local_node_id,
                          uint64_t* admit_counters, uint64_t* harvest_counters,
                          int32_t* sent_out);
+int32_t hs_fanout_push(HsRing* const* rings, int32_t n_rings,
+                       const uint8_t* buf, const uint64_t* offsets,
+                       const uint32_t* lens, int32_t n, int32_t mode);
 }
 
 namespace {
@@ -132,13 +135,19 @@ int main(int argc, char** argv) {
   // modes isolate one harvest path each for the phase profile.
   // "fused" runs the mixed mix through hs_loop_hostpath (the runner's
   // host-bypass batch) instead of split admit/route/harvest calls.
-  // "threaded" replays the ShardedDataplane shape — N producer
-  // threads pushing into the rx ring while the main thread
-  // admits/harvests concurrently — the workload `make native-sanitize`
-  // runs under TSan to race-check the HsRing mutex discipline.
+  // "threaded" replays the legacy N-pushers-vs-one-consumer shape (N
+  // producer threads pushing into ONE rx ring while the main thread
+  // admits/harvests concurrently).  "sharded" replays the REAL
+  // many-core ShardedDataplane shape (ISSUE 12): one fanout feeder
+  // distributing the stream across N independent rings via
+  // hs_fanout_push while N consumer threads each drive their own
+  // loop's admit→route→harvest — the workload `make native-sanitize`
+  // runs under TSan to race-check the fanout handoff + per-ring mutex
+  // discipline.
   const char* mode = argc > 3 ? argv[3] : "mixed";
   const bool fused = mode[0] == 'f';
   const bool threaded = mode[0] == 't';
+  const bool sharded = mode[0] == 's';
   // Clamp: atoi("garbage") and an explicit 0 both mean "no pushers",
   // which would divide by zero in the slice math below.
   const int n_pushers =
@@ -202,6 +211,146 @@ int main(int argc, char** argv) {
                          poplens.data(), 1 << 17) > 0) {
       }
   };
+
+  if (sharded) {
+    // The solo plumbing above is unused here — free it before the
+    // N-shard run (loopbench.asan runs with leak detection ON).
+    hs_loop_free(lp);
+    hs_ring_free(rx);
+    hs_ring_free(txr);
+    hs_ring_free(txl);
+    hs_ring_free(txh);
+    const int n_shards = std::max(1, argc > 4 ? atoi(argv[4]) : 4);
+    struct Shard {
+      HsRing* rx;
+      HsRing* txr;
+      HsRing* txl;
+      HsRing* txh;
+      HsLoop* lp;
+    };
+    std::vector<Shard> shards(static_cast<size_t>(n_shards));
+    std::vector<HsRing*> rx_rings(static_cast<size_t>(n_shards));
+    for (int s = 0; s < n_shards; ++s) {
+      Shard& sh = shards[s];
+      sh.rx = hs_ring_new(64u << 20, 1u << 17);
+      sh.txr = hs_ring_new(64u << 20, 1u << 17);
+      sh.txl = hs_ring_new(64u << 20, 1u << 17);
+      sh.txh = hs_ring_new(64u << 20, 1u << 17);
+      sh.lp = hs_loop_new(sh.rx, sh.txr, sh.txl, sh.txh, batch, vectors, 10, 2);
+      rx_rings[s] = sh.rx;
+    }
+    auto drain_shards = [&]() {
+      for (const Shard& sh : shards)
+        for (HsRing* r : {sh.txr, sh.txl, sh.txh})
+          while (hs_ring_pop(r, popbuf.data(), popbuf.size(), popoffs.data(),
+                             poplens.data(), 1 << 17) > 0) {
+          }
+    };
+    std::vector<double> s_mpps;
+    std::vector<double> per_shard_share(static_cast<size_t>(n_shards), 0.0);
+    uint64_t tx_total[3] = {0, 0, 0};
+    for (int r = 0; r < rounds + 1; ++r) {  // round 0 = warm-up
+      std::atomic<int> feeding{1};
+      std::atomic<int64_t> done_total{0};
+      std::vector<int64_t> done_shard(static_cast<size_t>(n_shards), 0);
+      uint64_t t0 = __rdtsc();
+      std::thread feeder([&]() {
+        const int32_t burst = 512;
+        for (int32_t i = 0; i < n_frames; i += burst) {
+          int32_t nb = std::min(burst, n_frames - i);
+          hs_fanout_push(rx_rings.data(), n_shards, buf.data(),
+                         offs.data() + i, lens.data() + i, nb, /*hash*/ 0);
+        }
+        feeding.store(0);
+      });
+      std::vector<std::thread> consumers;
+      for (int s = 0; s < n_shards; ++s) {
+        consumers.emplace_back([&, s]() {
+          Shard& sh = shards[s];
+          std::vector<uint32_t> c_src(budget), c_dst(budget);
+          std::vector<int32_t> c_proto(budget), c_sport(budget),
+              c_dport(budget);
+          std::vector<uint8_t> c_allowed(budget, 1);
+          std::vector<int32_t> c_route(budget), c_node(budget);
+          uint64_t c_admit[3] = {0, 0, 0};
+          uint64_t c_harv[6] = {0, 0, 0, 0, 0, 0};
+          int64_t done = 0;
+          bool final_pass = false;
+          while (true) {
+            int32_t k = 0;
+            int32_t n = hs_loop_admit(sh.lp, 0, c_src.data(), c_dst.data(),
+                                      c_proto.data(), c_sport.data(),
+                                      c_dport.data(), &k, c_admit,
+                                      /*k_cap=*/0);
+            if (n <= 0) {
+              if (feeding.load() > 0) {
+                std::this_thread::yield();
+                continue;
+              }
+              if (!final_pass) {
+                // One more admit after the feeder provably finished:
+                // its last push can land after our empty admit.
+                final_pass = true;
+                continue;
+              }
+              break;
+            }
+            final_pass = false;
+            for (int32_t i = 0; i < n; ++i) {
+              uint32_t d = c_dst[i];
+              c_route[i] = (d & kNodeMask) == kNodeBase   ? kRouteLocal
+                           : (d & kPodMask) == kPodBase   ? kRouteRemote
+                                                          : kRouteHost;
+              c_node[i] = static_cast<int32_t>((d - kPodBase) >> kHostBits);
+            }
+            hs_loop_harvest(sh.lp, 0, c_allowed.data(), c_src.data(),
+                            c_dst.data(), c_sport.data(), c_dport.data(),
+                            c_route.data(), c_node.data(), remote_ips.data(),
+                            kMaxNode, local_ip, 1, c_harv);
+            done += n;
+          }
+          done_shard[s] = done;
+          done_total.fetch_add(done);
+          if (r > 0)
+            for (int j = 0; j < 3; ++j)
+              __atomic_fetch_add(&tx_total[j], c_harv[j], __ATOMIC_RELAXED);
+        });
+      }
+      feeder.join();
+      for (auto& th : consumers) th.join();
+      uint64_t t1 = __rdtsc();
+      drain_shards();
+      if (r == 0 || done_total.load() == 0) continue;
+      double secs = static_cast<double>(t1 - t0) / 2.1e9;
+      s_mpps.push_back(done_total.load() / secs / 1e6);
+      for (int s = 0; s < n_shards; ++s)
+        per_shard_share[s] +=
+            static_cast<double>(done_shard[s]) / done_total.load();
+    }
+    std::sort(s_mpps.begin(), s_mpps.end());
+    double median = s_mpps.empty() ? 0.0 : s_mpps[s_mpps.size() / 2];
+    printf("{\"metric\": \"loopbench sharded (fanout feeder -> %d shards)\", "
+           "\"shards\": %d, \"frames\": %d, \"rounds\": %d, "
+           "\"median_mpps\": %.3f, \"peak_mpps\": %.3f, "
+           "\"per_shard_mpps\": %.3f, "
+           "\"share_min\": %.3f, \"share_max\": %.3f, "
+           "\"tx\": [%" PRIu64 ", %" PRIu64 ", %" PRIu64 "]}\n",
+           n_shards, n_shards, n_frames, rounds, median,
+           s_mpps.empty() ? 0.0 : s_mpps.back(), median / n_shards,
+           rounds ? *std::min_element(per_shard_share.begin(),
+                                      per_shard_share.end()) / rounds : 0.0,
+           rounds ? *std::max_element(per_shard_share.begin(),
+                                      per_shard_share.end()) / rounds : 0.0,
+           tx_total[0], tx_total[1], tx_total[2]);
+    for (Shard& sh : shards) {
+      hs_loop_free(sh.lp);
+      hs_ring_free(sh.rx);
+      hs_ring_free(sh.txr);
+      hs_ring_free(sh.txl);
+      hs_ring_free(sh.txh);
+    }
+    return 0;
+  }
 
   // Per-round phase sums; medians reported (this box shows VM-steal
   // spikes — a mean would fold multi-ms preemptions into the figure).

@@ -908,6 +908,113 @@ int32_t hs_loop_hostpath(HsLoop* lp, int32_t slot_idx, uint32_t pod_base,
   return n;
 }
 
+// Drain variant of the host-bypass batch (ISSUE 12): loop
+// admit→route→harvest until the rx ring is empty, in ONE call.  The
+// many-core front end drives one of these per shard worker wakeup —
+// at N shards the per-batch FFI/GIL crossings would otherwise
+// serialise exactly the work the scale-out exists to parallelise.
+// Returns total frames admitted; *sent_out accumulates TX counts.
+int32_t hs_loop_hostpath_drain(HsLoop* lp, int32_t slot_idx,
+                               uint32_t pod_base, uint32_t pod_mask,
+                               uint32_t node_base, uint32_t node_mask,
+                               uint32_t host_bits, const uint32_t* remote_ips,
+                               int32_t max_node_id, uint32_t local_ip,
+                               uint32_t local_node_id,
+                               uint64_t* admit_counters,
+                               uint64_t* harvest_counters,
+                               int32_t* sent_out) {
+  *sent_out = 0;
+  int64_t total = 0;
+  while (true) {
+    int32_t sent = 0;
+    int32_t n = hs_loop_hostpath(lp, slot_idx, pod_base, pod_mask, node_base,
+                                 node_mask, host_bits, remote_ips, max_node_id,
+                                 local_ip, local_node_id, admit_counters,
+                                 harvest_counters, &sent);
+    if (n < 0) return n;
+    *sent_out += sent;
+    if (n == 0) break;
+    total += n;
+  }
+  return static_cast<int32_t>(total > 0x7fffffff ? 0x7fffffff : total);
+}
+
+// ---------------------------------------------------------------------------
+// Fanout handoff — ONE feeder, N single-reader shard rings (ISSUE 12)
+// ---------------------------------------------------------------------------
+//
+// The many-core admit front end gives every shard its OWN HsRing arena
+// (frames stay pinned shard-locally from ingest to TX, exactly like
+// the solo loop), so N admit threads never contend on one ring head.
+// What remains is the handoff: a feeder (recvmmsg burst, virtual wire,
+// bench driver) that must spread one frame stream across the N rings.
+// hs_fanout_push does that in ONE call: flow-hash (symmetric, so a
+// flow's forward AND reply land on the same shard — the cache-locality
+// property PACKET_FANOUT_HASH gives kernel-socket ingest) or
+// round-robin, with ONE lock hold per target ring per call (never one
+// per frame).  Each shard ring stays effectively single-writer
+// (feeder) + single-reader (that shard's admit), so cross-shard
+// contention is pairwise on ring mutexes, never a shared cursor.
+
+}  // extern "C"
+
+namespace {
+
+// Symmetric flow hash over the 5-tuple: XOR folds src/dst (and the
+// port pair) so (a→b) and (b→a) hash identically — a shard serves both
+// directions of the flows it owns.  Non-IPv4 frames spread by length.
+inline uint32_t fanout_flow_hash(const uint8_t* frame, uint32_t len) {
+  FrameView v = parse_frame(const_cast<uint8_t*>(frame), len);
+  if (!v.valid) return len * 2654435761u;
+  uint32_t s = load_be32(v.ip + 12);
+  uint32_t d = load_be32(v.ip + 16);
+  uint32_t h = (s ^ d) * 2654435761u;
+  if (v.has_ports) {
+    uint32_t ports = static_cast<uint32_t>(load_be16(v.l4)) ^
+                     static_cast<uint32_t>(load_be16(v.l4 + 2));
+    h ^= ports * 40503u;
+  }
+  h ^= v.proto;
+  h ^= h >> 16;
+  return h;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Distribute n frames described by (offsets, lens) views into buf
+// across n_rings shard rings.  mode 0 = symmetric flow hash (shard-
+// sticky flows), mode 1 = round-robin (uniform spread regardless of
+// flow count).  Returns frames accepted; rejects land in the target
+// ring's own dropped counter (full-ring semantics unchanged).
+int32_t hs_fanout_push(HsRing* const* rings, int32_t n_rings,
+                       const uint8_t* buf, const uint64_t* offsets,
+                       const uint32_t* lens, int32_t n, int32_t mode) {
+  if (n_rings <= 0 || n <= 0) return 0;
+  if (n_rings == 1) return hs_ring_push(rings[0], buf, offsets, lens, n);
+  static thread_local std::vector<int32_t> target;
+  static thread_local uint32_t rr_cursor = 0;
+  target.resize(static_cast<size_t>(n));
+  for (int32_t i = 0; i < n; ++i) {
+    uint32_t h = (mode == 1) ? rr_cursor++
+                             : fanout_flow_hash(buf + offsets[i], lens[i]);
+    target[i] = static_cast<int32_t>(h % static_cast<uint32_t>(n_rings));
+  }
+  int32_t pushed = 0;
+  for (int32_t r = 0; r < n_rings; ++r) {
+    // One lock hold per ring per call: the feeder's cost per frame is
+    // the hash + one compare, not a mutex round trip.
+    std::lock_guard<std::mutex> g(rings[r]->mu);
+    for (int32_t i = 0; i < n; ++i) {
+      if (target[i] == r &&
+          rings[r]->push_one_locked(buf + offsets[i], lens[i]))
+        ++pushed;
+    }
+  }
+  return pushed;
+}
+
 // ---------------------------------------------------------------------------
 // AF_PACKET burst IO — recvmmsg/sendmmsg between a socket and a ring
 // ---------------------------------------------------------------------------
@@ -942,6 +1049,52 @@ int32_t hs_afp_rx(int32_t fd, HsRing* ring, int32_t max_frames) {
         ring->push_one_locked(stage.data() + i * kAfpFrameCap, msgs[i].msg_len);
       }
     }
+    total += got;
+    if (static_cast<uint32_t>(got) < want) break;
+  }
+  return total;
+}
+
+// Receive up to max_frames from fd and fan them out across n_rings
+// shard rings in the SAME call (recvmmsg burst → hs_fanout_push-style
+// distribution, no intermediate ring): the batched-ingest shape for a
+// single uplink socket feeding a many-shard admit front end where
+// PACKET_FANOUT is unavailable (one queue, no kernel fanout group).
+// mode as in hs_fanout_push.  Returns frames received.
+int32_t hs_afp_rx_fanout(int32_t fd, HsRing* const* rings, int32_t n_rings,
+                         int32_t max_frames, int32_t mode) {
+  if (n_rings <= 0) return 0;
+  static thread_local std::vector<uint8_t> stage(kAfpBurst * kAfpFrameCap);
+  mmsghdr msgs[kAfpBurst];
+  iovec iovs[kAfpBurst];
+  uint64_t offs[kAfpBurst];
+  uint32_t lens[kAfpBurst];
+  int32_t total = 0;
+  while (total < max_frames) {
+    uint32_t want = static_cast<uint32_t>(max_frames - total);
+    if (want > kAfpBurst) want = kAfpBurst;
+    for (uint32_t i = 0; i < want; ++i) {
+      iovs[i] = {stage.data() + i * kAfpFrameCap, kAfpFrameCap};
+      std::memset(&msgs[i], 0, sizeof(mmsghdr));
+      msgs[i].msg_hdr.msg_iov = &iovs[i];
+      msgs[i].msg_hdr.msg_iovlen = 1;
+    }
+    int got = recvmmsg(fd, msgs, want, MSG_DONTWAIT, nullptr);
+    if (got <= 0) break;
+    int32_t kept = 0;
+    for (int i = 0; i < got; ++i) {
+      if (msgs[i].msg_hdr.msg_flags & MSG_TRUNC) {
+        // Jumbo beyond the stage: forwarding a truncated prefix would
+        // corrupt it — count on ring 0 (the burst's drop ledger).
+        std::lock_guard<std::mutex> g(rings[0]->mu);
+        ++rings[0]->dropped;
+        continue;
+      }
+      offs[kept] = static_cast<uint64_t>(i) * kAfpFrameCap;
+      lens[kept] = msgs[i].msg_len;
+      ++kept;
+    }
+    hs_fanout_push(rings, n_rings, stage.data(), offs, lens, kept, mode);
     total += got;
     if (static_cast<uint32_t>(got) < want) break;
   }

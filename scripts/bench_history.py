@@ -125,16 +125,26 @@ def _row_key(rec: dict) -> Optional[str]:
 
 def _row_values(rec: dict) -> Dict[str, float]:
     """{value-field: value} — usually one primary value, else every
-    ``*_mpps`` column as its own sub-series."""
+    ``*_mpps`` column as its own sub-series.  Rows carrying a
+    per-shard ``efficiency`` column (the ISSUE 12 scale-out tier) get
+    it as a second sub-series: sub-linear shard scaling must be as
+    judgeable round-over-round as the absolute Mpps."""
+    out: Dict[str, float] = {}
     for field in _VALUE_FIELDS:
         v = rec.get(field)
         if isinstance(v, (int, float)) and not isinstance(v, bool):
-            return {field: float(v)}
-    return {
-        f: float(v) for f, v in rec.items()
-        if f.endswith("_mpps") and isinstance(v, (int, float))
-        and not isinstance(v, bool)
-    }
+            out[field] = float(v)
+            break
+    if not out:
+        out = {
+            f: float(v) for f, v in rec.items()
+            if f.endswith("_mpps") and isinstance(v, (int, float))
+            and not isinstance(v, bool)
+        }
+    eff = rec.get("efficiency")
+    if out and isinstance(eff, (int, float)) and not isinstance(eff, bool):
+        out["efficiency"] = float(eff)
+    return out
 
 
 def collect(root: pathlib.Path) -> Dict[str, Dict[str, Dict[int, float]]]:

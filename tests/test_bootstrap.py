@@ -92,6 +92,16 @@ class TestBootstrapConfig:
         assert stn_cfg.gateway == "192.168.1.1"
         assert net.get_interface("eth0").addresses == ()  # actually stolen
 
+    def test_many_core_ingress_knobs_parse_from_dict(self):
+        """ISSUE 12 deploy knobs: datapath_shards + shard_cores ride
+        net.conf → NetworkConfig (defaults keep the solo runner)."""
+        assert NetworkConfig.from_dict({}).datapath_shards == 1
+        assert NetworkConfig.from_dict({}).shard_cores == ""
+        cfg = NetworkConfig.from_dict(
+            {"datapath_shards": 4, "shard_cores": "0-3;4-7;8,9;10"})
+        assert cfg.datapath_shards == 4
+        assert cfg.shard_cores == "0-3;4-7;8,9;10"
+
     def test_nodeconfig_stealth_interface_triggers_stn(self):
         net = _host()
         merged, stn_cfg = bootstrap_config(

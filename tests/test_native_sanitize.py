@@ -25,7 +25,12 @@ import pytest
 
 from vpp_tpu.ops.packets import ip_to_u32
 from vpp_tpu.shim import HostShim
-from vpp_tpu.shim.hostshim import FrameBatch, NativeLoop, NativeRing
+from vpp_tpu.shim.hostshim import (
+    FanoutHandoff,
+    FrameBatch,
+    NativeLoop,
+    NativeRing,
+)
 from vpp_tpu.testing.frames import build_frame, frame_tuple, verify_checksums
 
 POD_BASE = ip_to_u32("10.1.0.0")
@@ -242,3 +247,131 @@ class TestNativeLoop:
         loop.close()
         for r in (rx, txr, txl, txh):
             r.close()
+
+    def test_hostpath_drain_matches_iterated_hostpath(self):
+        """ISSUE 12: the one-FFI-call-per-wakeup drain is byte-for-byte
+        the iterated host path — same admit/harvest counters, same TX
+        output multiset — just without N shard workers convoying on
+        per-batch GIL crossings."""
+        remote_ips = np.zeros(64, dtype=np.uint32)
+        for node in range(2, 64):
+            remote_ips[node] = ip_to_u32(f"192.168.16.{node}")
+        frames = _mixed_frames(96)
+
+        def run(drain: bool):
+            loop, rx, txr, txl, txh = self._loop()
+            rx.send(frames)
+            ac = np.zeros(NativeLoop.ADMIT_COUNTERS, dtype=np.uint64)
+            hc = np.zeros(NativeLoop.HARVEST_COUNTERS, dtype=np.uint64)
+            if drain:
+                n, sent = loop.hostpath_drain(
+                    0, POD_BASE, POD_MASK, NODE_BASE, NODE_MASK, HOST_BITS,
+                    remote_ips, ip_to_u32("192.168.16.1"), 1, ac, hc,
+                )
+            else:
+                n = sent = 0
+                while True:
+                    n1, s1 = loop.hostpath(
+                        0, POD_BASE, POD_MASK, NODE_BASE, NODE_MASK,
+                        HOST_BITS, remote_ips, ip_to_u32("192.168.16.1"),
+                        1, ac, hc,
+                    )
+                    if n1 == 0:
+                        break
+                    n, sent = n + n1, sent + s1
+            out = {
+                "n": n, "sent": sent, "ac": ac.tolist(), "hc": hc.tolist(),
+                "tx": sorted(txr.recv_batch(1 << 12)
+                             + txl.recv_batch(1 << 12)
+                             + txh.recv_batch(1 << 12)),
+            }
+            loop.close()
+            for r in (rx, txr, txl, txh):
+                r.close()
+            return out
+
+        assert run(drain=True) == run(drain=False)
+
+
+class TestFanoutHandoff:
+    """hs_fanout_push / FanoutHandoff — the single-feeder → N-shard-ring
+    distribution lane of the many-core admit front end (ISSUE 12)."""
+
+    def _rings(self, n):
+        return [NativeRing(arena_bytes=1 << 20, max_frames=512)
+                for _ in range(n)]
+
+    def test_hash_mode_is_flow_sticky_and_symmetric(self):
+        """A flow's forward AND reply land on the SAME ring (symmetric
+        5-tuple hash) — the PACKET_FANOUT_HASH locality property the
+        per-shard session/cache state depends on."""
+        rings = self._rings(4)
+        h = FanoutHandoff(rings, mode="hash")
+        flows = [(f"10.1.1.{2 + i}", f"10.1.2.{2 + i}", 6,
+                  40000 + i, 80) for i in range(64)]
+        fwd = [build_frame(s, d, p, sp, dp) for s, d, p, sp, dp in flows]
+        rev = [build_frame(d, s, p, dp, sp) for s, d, p, sp, dp in flows]
+        assert h.send(fwd) == len(fwd)
+        owner = {}
+        for r_i, ring in enumerate(rings):
+            for f in ring.recv_batch(512):
+                owner[frame_tuple(f)] = r_i
+        assert len(owner) == len(flows)
+        assert len(set(owner.values())) > 1      # actually spread
+        assert h.send(rev) == len(rev)
+        for r_i, ring in enumerate(rings):
+            for f in ring.recv_batch(512):
+                s, d, p, sp, dp = frame_tuple(f)
+                assert owner[(d, s, p, dp, sp)] == r_i, "reply left its shard"
+        for r in rings:
+            r.close()
+
+    def test_rr_mode_spreads_uniformly(self):
+        """Round-robin: one flow (hash would pin it to one shard) still
+        spreads exactly evenly."""
+        rings = self._rings(4)
+        h = FanoutHandoff(rings, mode="rr")
+        frames = [build_frame("10.1.1.2", "10.1.1.3", 6, 40000, 80)] * 32
+        assert h.send(frames) == 32
+        assert [len(r) for r in rings] == [8, 8, 8, 8]
+        for r in rings:
+            r.close()
+
+    def test_views_lane_matches_bytes_lane_and_single_ring_passthrough(self):
+        frames = _mixed_frames(24)
+        lens = np.array([len(f) for f in frames], dtype=np.uint32)
+        offsets = np.zeros(len(frames), dtype=np.uint64)
+        np.cumsum(lens[:-1], dtype=np.uint64, out=offsets[1:])
+        buf = np.frombuffer(b"".join(frames), dtype=np.uint8)
+
+        a, b = self._rings(2), self._rings(2)
+        FanoutHandoff(a, mode="hash").send(frames)
+        FanoutHandoff(b, mode="hash").send_views(buf, offsets, lens)
+        assert [r.recv_batch(512) for r in a] == [r.recv_batch(512) for r in b]
+
+        solo = self._rings(1)
+        assert FanoutHandoff(solo).send(frames) == len(frames)
+        assert solo[0].recv_batch(512) == frames
+        for r in a + b + solo:
+            r.close()
+
+    def test_full_target_ring_counts_drops_on_that_ring(self):
+        """Full-ring semantics are unchanged by the fanout path: rejects
+        land in the TARGET ring's own dropped counter."""
+        rings = [NativeRing(arena_bytes=1 << 16, max_frames=4)
+                 for _ in range(2)]
+        h = FanoutHandoff(rings, mode="rr")
+        frames = [build_frame("10.1.1.2", "10.1.1.3", 6, 40000, 80)] * 32
+        accepted = h.send(frames)
+        assert accepted == len(rings[0]) + len(rings[1]) <= 8
+        assert rings[0].dropped + rings[1].dropped == 32 - accepted
+        for r in rings:
+            r.close()
+
+    def test_rejects_empty_and_bad_mode(self):
+        with pytest.raises(ValueError):
+            FanoutHandoff([])
+        rings = self._rings(1)
+        with pytest.raises(ValueError):
+            FanoutHandoff(rings, mode="lru")
+        rings[0].close()

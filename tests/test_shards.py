@@ -360,3 +360,164 @@ def test_sharded_engine_uses_host_bypass_when_permissive():
     assert len(view["shards"]) == 3
     assert view["counters"]["datapath_bypass_batches_total"] >= 3
     assert view["rings"]["tx_local"]["frames"] == 0  # drained
+
+
+# --------------------------------------------------- many-core ingress (12)
+
+
+def test_parse_core_map():
+    """The shard_cores knob (VPP corelist-workers analog): explicit
+    per-shard lists, auto spread, empty = no pinning, count mismatch
+    rejected."""
+    import os
+
+    from vpp_tpu.datapath.shards import parse_core_map
+
+    assert parse_core_map("", 4) is None
+    assert parse_core_map("0-3;4-7;8,9;10", 4) == [
+        [0, 1, 2, 3], [4, 5, 6, 7], [8, 9], [10]]
+    assert parse_core_map("2,1,1", 1) == [[1, 2]]   # dedup + sort
+    with pytest.raises(ValueError):
+        parse_core_map("0;1", 3)                    # 2 sets, 3 shards
+    auto = parse_core_map("auto", 2)
+    usable = sorted(os.sched_getaffinity(0))
+    assert auto == [usable[0::2], usable[1::2]]     # round-robin spread
+    assert sorted(auto[0] + auto[1]) == usable      # every core assigned
+
+
+def test_steer_rotation_survives_eject_rejoin_cycle_at_n8():
+    """ISSUE 12 regression: the steering round-robin must ROTATE across
+    polls and stay coherent across eject→rejoin membership changes.
+    The old `frames[j::n]` split restarted at survivor 0 every pass, so
+    at N=8 with sub-burst steering volumes the first survivor absorbed
+    ~everything; and a cursor minted under old membership must neither
+    index out of range nor bias the new epoch."""
+    dp, ios = make_sharded(8, reinit_backoff=60.0)  # no auto-rejoin
+    try:
+        dp._eject(7, dirty=False)
+        assert dp.health_of[7].state == "ejected"
+        # 14 single-frame steering passes over 7 survivors: rotation
+        # must hand each survivor exactly 2 (the old code gave all 14
+        # to survivors[0]).
+        for i in range(14):
+            ios[7][0].send([build_frame("10.1.1.2", "10.1.1.3",
+                                        6, 40000 + i, 80)])
+            dp._steer(dp._serving())
+        counts = [len(ios[i][0]) for i in range(7)]
+        assert counts == [2] * 7, counts
+        assert dp._steered_frames == 14
+
+        # Membership change: shard 7 rejoins, shard 0 ejects.  The
+        # carried cursor is re-normalised against the NEW target list —
+        # no IndexError, no first-survivor bias in the new epoch.
+        dp.health_of[7].state = "rejoined"
+        dp._eject(0, dirty=False)
+        for i in range(7):
+            assert len(ios[i][0].recv_batch(16)) == 2  # clear phase 1
+        for i in range(14):
+            ios[0][0].send([build_frame("10.1.1.2", "10.1.1.3",
+                                        6, 41000 + i, 80)])
+            dp._steer(dp._serving())
+        counts = [len(ios[i][0]) for i in range(1, 8)]
+        assert counts == [2] * 7, counts
+
+        # Burst steering (more frames than targets in one pass) still
+        # lands a balanced split.
+        ios[0][0].send([build_frame("10.1.1.2", "10.1.1.3",
+                                    6, 42000 + i, 80) for i in range(21)])
+        dp._steer(dp._serving())
+        counts = [len(ios[i][0]) for i in range(1, 8)]
+        assert counts == [5] * 7, counts
+    finally:
+        dp.close()
+
+
+def test_ejection_releases_ledger_claim():
+    """An ejected shard's published budget claim is zeroed so a dead
+    shard's stale reservation cannot throttle the survivors; the claim
+    is re-zeroed again at probation (after quiesce) before the shard
+    re-claims."""
+    dp, ios = make_sharded(3, reinit_backoff=60.0)
+    try:
+        dp.ledger.claim(1, 400.0)
+        assert dp.ledger.available_us(0) == dp.ledger.slo_us - 400.0
+        dp._eject(1, dirty=False)
+        assert dp.ledger.available_us(0) == dp.ledger.slo_us
+        assert dp.ledger.committed_us() == 0.0
+    finally:
+        dp.close()
+
+
+def test_sharded_inspect_ledger_and_placement_surfaces():
+    """ISSUE 12 observability: the global-budget ledger and the CPU
+    placement map flow inspect → REST → `netctl inspect` → dashboard
+    Dispatch panel."""
+    import io as _io
+    import json
+    import os
+    import urllib.request
+
+    from vpp_tpu.netctl.cli import main as netctl_main
+    from vpp_tpu.rest.server import AgentRestServer
+    from vpp_tpu.uibackend.views import shape_dispatch
+
+    core0 = sorted(os.sched_getaffinity(0))[0]
+    dp, ios = make_sharded(2, shard_cores=[[core0], [core0]])
+    try:
+        for i, io_set in enumerate(ios):
+            io_set[0].send([build_frame("10.1.1.2", "10.1.1.3", 6,
+                                        40000 + 100 * i + j, 80)
+                            for j in range(8)])
+        dp.drain()
+
+        view = dp.inspect()
+        gov = view["dispatch"]["governor"]
+        led = gov["ledger"]
+        assert led["slo_us"] == dp.ledger.slo_us and led["shards"] == 2
+        assert len(led["per_shard_claim_us"]) == 2
+        # committed_us rounds the RAW sum; the per-shard list rounds
+        # each claim — they can differ in the last decimal.
+        assert led["committed_us"] == \
+            pytest.approx(sum(led["per_shard_claim_us"]), abs=0.2)
+        assert gov["ledger_constrained"] >= 0
+        placement = view["dispatch"]["placement"]
+        assert placement["shard_cores"] == [[core0], [core0]]
+        # Workers spawned during drain → the applied map records the
+        # actual pinning outcome per worker thread.
+        assert placement["applied"] == [str(core0), str(core0)]
+        assert placement["host_cores"] == os.cpu_count()
+
+        rest = AgentRestServer(node_name="n1", datapath=dp)
+        port = rest.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/contiv/v1/inspect") as resp:
+                remote = json.loads(resp.read())
+            assert remote["dispatch"]["governor"]["ledger"]["shards"] == 2
+            assert remote["dispatch"]["placement"]["applied"] == \
+                [str(core0), str(core0)]
+            out = _io.StringIO()
+            assert netctl_main(
+                ["inspect", "--server", f"127.0.0.1:{port}"], out=out) == 0
+            text = out.getvalue()
+            assert "ledger: budget=" in text and "claims: 0:" in text
+            assert f"placement: 0:{core0}->{core0}" in text
+        finally:
+            rest.stop()
+
+        panel = shape_dispatch(view)
+        assert panel["ledger"]["slo_us"] == dp.ledger.slo_us
+        assert panel["ledger"]["per_shard_claim_us"] == \
+            led["per_shard_claim_us"]
+        assert panel["placement"]["shard_cores"] == [[core0], [core0]]
+        assert panel["placement"]["applied"] == [str(core0), str(core0)]
+        # Solo runners carry neither block — the panel hides the rows.
+        solo = shape_dispatch({"dispatch": {"governor": {}}})
+        assert solo["ledger"] == {} and solo["placement"] == {}
+    finally:
+        dp.close()
+
+
+def test_shard_cores_count_mismatch_rejected():
+    with pytest.raises(ValueError, match="shard_cores maps"):
+        make_sharded(3, shard_cores=[[0], [0]])

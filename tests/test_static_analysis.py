@@ -773,6 +773,83 @@ def latency_skew(per_node):
     assert len(unwaived) == 1 and "lag_ratio" in unwaived[0].message
 
 
+def test_obs_must_flag_dispatch_panel_ledger_key_nobody_produces():
+    """ISSUE 12 must-flag: the dashboard Dispatch panel reads a
+    global-budget ledger key the GovernorLedger snapshot no longer
+    emits — the budget row would blank exactly during the saturation
+    event it exists to explain."""
+    views = """
+def shape_dispatch(inspect):
+    dp = inspect.get("dispatch") or {}
+    gov = dp.get("governor") or {}
+    led = gov.get("ledger") or {}
+    return {"committed": led.get("reserved_us", 0)}
+"""
+    producer = """
+class GovernorLedger:
+    def snapshot(self):
+        return {"slo_us": 0, "committed_us": 0,
+                "per_shard_claim_us": [], "constrained_total": 0}
+
+class ShardedDataplane:
+    def inspect(self):
+        return {"dispatch": {"governor": {}, "placement": {}}}
+"""
+    project = Project.from_sources({
+        "vpp_tpu/uibackend/views.py": views,
+        "vpp_tpu/datapath/governor.py": producer,
+    })
+    unwaived, _ = _run(project, _obs_checker(
+        schema_pairs=(("shape_dispatch",
+                       ("ShardedDataplane.inspect",
+                        "GovernorLedger.snapshot")),)))
+    msgs = [f.message for f in unwaived]
+    assert any("reserved_us" in m for m in msgs)
+    assert not any("'committed_us'" in m for m in msgs)
+
+
+def test_obs_must_pass_dispatch_panel_ledger_placement_alignment():
+    """ISSUE 12 must-pass: the panel consuming exactly the ledger
+    snapshot + placement keys the sharded inspect produces."""
+    views = """
+def shape_dispatch(inspect):
+    dp = inspect.get("dispatch") or {}
+    gov = dp.get("governor") or {}
+    led = gov.get("ledger") or {}
+    placement = dp.get("placement") or {}
+    return {
+        "committed": led.get("committed_us", 0),
+        "claims": led.get("per_shard_claim_us") or [],
+        "cores": placement.get("shard_cores") or [],
+        "applied": placement.get("applied") or [],
+    }
+"""
+    producer = """
+class GovernorLedger:
+    def snapshot(self):
+        return {"slo_us": 0, "shards": 0, "committed_us": 0,
+                "per_shard_claim_us": [], "constrained": [],
+                "constrained_total": 0}
+
+class ShardedDataplane:
+    def inspect(self):
+        base = {"dispatch": {"governor": {}}}
+        base["dispatch"]["governor"]["ledger"] = self.ledger.snapshot()
+        base["dispatch"]["placement"] = {
+            "shard_cores": [], "applied": [], "host_cores": 0}
+        return base
+"""
+    project = Project.from_sources({
+        "vpp_tpu/uibackend/views.py": views,
+        "vpp_tpu/datapath/governor.py": producer,
+    })
+    unwaived, _ = _run(project, _obs_checker(
+        schema_pairs=(("shape_dispatch",
+                       ("ShardedDataplane.inspect",
+                        "GovernorLedger.snapshot")),)))
+    assert unwaived == [], [f.format() for f in unwaived]
+
+
 def test_obs_must_pass_clean_fixture():
     src = """
 from dataclasses import dataclass

@@ -154,11 +154,20 @@ class Agent:
         # ------------------------------------------------------ data plane
         self.runner = None
         self._uplink_io = None
+        self._uplink_ios = []
         self._dp_thread: Optional[threading.Thread] = None
+        self._dp_threads = []
         self._dp_stop = threading.Event()
-        self.datapath_errors = 0
+        self.datapath_errors = 0  # guarded-by: _dp_err_lock
+        # N pump threads + the supervisor all count errors: the bare
+        # '+=' read-modify-write would drop increments exactly during
+        # the uplink incident the counter exists to explain.
+        self._dp_err_lock = threading.Lock()
         if uplink:
-            self._start_datapath(uplink)
+            if (self.config.datapath_shards or 1) > 1:
+                self._start_datapath_sharded(uplink)
+            else:
+                self._start_datapath(uplink)
 
         # ----------------------------------------------------- diagnostics
         from .rest.server import AgentRestServer
@@ -188,6 +197,32 @@ class Agent:
         self.cni_port = self.cni.start()
 
     # ---------------------------------------------------------- data plane
+
+    def _wire_runner_tables(self, installed_acl, installed_nat) -> None:
+        """Wire self.runner (solo or sharded — same contract) to the
+        table applicators.  Hook FIRST, then pull whatever the
+        renderers have already compiled — a table compiled in between
+        fires the hook, so no window exists where a compile is
+        dropped.  ``installed_*`` are the southbound-readback accessors
+        for the drift-detecting downstream resync: verify()
+        fingerprints the runner's RESIDENT tables against the last
+        compile (VERDICT r4 #2).  Compile observability (full-vs-delta
+        counts, rows/bytes shipped per swap) surfaces via
+        runner.inspect() → REST /contiv/v1/inspect → `netctl
+        inspect`."""
+        self.acl_applicator.on_compiled = \
+            lambda t: self.runner.update_tables(acl=t)
+        self.nat_applicator.on_compiled = \
+            lambda t: self.runner.update_tables(nat=t)
+        self.acl_applicator.installed_fn = installed_acl
+        self.nat_applicator.installed_fn = installed_nat
+        self.runner.compile_stats_fn = lambda: {
+            "acl": self.acl_applicator.stats().get("compile", {}),
+            "nat": self.nat_applicator.stats().get("compile", {}),
+        }
+        self.runner.update_tables(
+            acl=self.policy_renderer.tables, nat=self.nat_renderer.tables
+        )
 
     def _start_datapath(self, uplink: str) -> None:
         """Attach the native runner loop to a real interface: AF_PACKET
@@ -220,25 +255,9 @@ class Agent:
             prewarm=self.config.coalesce_prewarm,
             max_inflight=self.config.max_inflight,
         )
-        # Hook FIRST, then pull whatever the renderers have already
-        # compiled — a table compiled in between fires the hook, so no
-        # window exists where a compile is dropped.
-        self.acl_applicator.on_compiled = lambda t: self.runner.update_tables(acl=t)
-        self.nat_applicator.on_compiled = lambda t: self.runner.update_tables(nat=t)
-        # Southbound readback for the drift-detecting downstream resync:
-        # verify() fingerprints the runner's RESIDENT tables against the
-        # last compile (VERDICT r4 #2).
-        self.acl_applicator.installed_fn = lambda: self.runner.acl
-        self.nat_applicator.installed_fn = lambda: self.runner.nat
-        # Compile observability: full-vs-delta compile counts, rows/bytes
-        # shipped per swap — surfaced by runner.inspect() → REST
-        # /contiv/v1/inspect → `netctl inspect`.
-        self.runner.compile_stats_fn = lambda: {
-            "acl": self.acl_applicator.stats().get("compile", {}),
-            "nat": self.nat_applicator.stats().get("compile", {}),
-        }
-        self.runner.update_tables(
-            acl=self.policy_renderer.tables, nat=self.nat_renderer.tables
+        self._wire_runner_tables(
+            installed_acl=lambda: self.runner.acl,
+            installed_nat=lambda: self.runner.nat,
         )
         rings = (rx, tx, local, host)
 
@@ -254,7 +273,8 @@ class Agent:
                     for ring in rings[1:]:
                         moved += self._uplink_io.tx_from(ring, burst)
                 except Exception:  # noqa: BLE001 - interface flap etc.
-                    self.datapath_errors += 1
+                    with self._dp_err_lock:
+                        self.datapath_errors += 1
                     log.exception("datapath loop error (uplink %s); retrying",
                                   uplink)
                     self._dp_stop.wait(1.0)
@@ -265,14 +285,161 @@ class Agent:
         self._dp_thread = threading.Thread(target=loop, name="datapath", daemon=True)
         self._dp_thread.start()
 
+    def _start_datapath_sharded(self, uplink: str) -> None:
+        """Many-core host ingress (ISSUE 12): N datapath shards, each
+        with its own ring arenas and its own PACKET_FANOUT socket on
+        the uplink (the kernel spreads frames flow-sticky across the
+        group — DPDK RSS on kernel sockets), N per-shard recvmmsg pump
+        threads (pinned alongside their shard when an affinity map is
+        configured), one supervisor loop driving the ShardedDataplane,
+        ONE shared device session state, and ONE global coalesce-SLO
+        budget through the governor ledger."""
+        import os
+
+        from .datapath import (
+            AfPacketIO,
+            NativeRing,
+            ShardedDataplane,
+            VxlanOverlay,
+        )
+        from .datapath.shards import parse_core_map
+        from .ops.classify import build_rule_tables
+        from .ops.nat import build_nat_tables
+        from .ops.packets import ip_to_u32
+        from .ops.pipeline import make_route_config
+
+        n = self.config.datapath_shards
+        cores = parse_core_map(self.config.shard_cores, n)
+        # One fanout group per agent process: every socket in the group
+        # shares the kernel's flow-hash spread on this interface.
+        # Group ids are 16-bit per interface and pid-derived ids can
+        # collide (pids wrap above 65535): a MODE-mismatched collision
+        # fails the first socket's fanout join — retry with perturbed
+        # ids before giving up.  (A same-mode collision is silent — the
+        # kernel merges the groups — and undetectable from here; the id
+        # stays pid-derived so an operator can map group → process.)
+        ios = []
+        socks = []
+        try:
+            join_err: Optional[OSError] = None
+            for attempt in range(8):
+                group = (os.getpid() + attempt * 7919) & 0xFFFF
+                try:
+                    socks.append(AfPacketIO(uplink, fanout_group=group,
+                                            fanout_mode="hash"))
+                    break
+                except OSError as err:
+                    join_err = err
+            else:
+                raise join_err  # every candidate group id refused
+            ios.append(tuple(NativeRing() for _ in range(4)))
+            for _ in range(n - 1):
+                socks.append(AfPacketIO(uplink, fanout_group=group,
+                                        fanout_mode="hash"))
+                ios.append(tuple(NativeRing() for _ in range(4)))
+            node_ip = f"192.168.16.{self.nodesync.node_id}"
+            self.runner = ShardedDataplane(
+                acl=build_rule_tables([], {}),
+                nat=build_nat_tables([]),
+                route=make_route_config(self.ipam),
+                overlay=VxlanOverlay(
+                    local_ip=ip_to_u32(node_ip),
+                    local_node_id=self.nodesync.node_id,
+                ),
+                shard_ios=ios,
+                batch_size=self.config.batch_size,
+                max_vectors=self.config.max_vectors,
+                dispatch=self.config.dispatch,
+                coalesce=self.config.coalesce,
+                coalesce_slo_us=self.config.coalesce_slo_us,
+                prewarm=self.config.coalesce_prewarm,
+                max_inflight=self.config.max_inflight,
+                shard_cores=cores,
+            )
+        except BaseException:
+            # Agent.__init__ propagates this, so stop() never runs —
+            # the CAP_NET_RAW fanout sockets must not outlive the
+            # failed construction (a retrying supervisor re-building
+            # the Agent would accumulate leaked fds AND stale
+            # fanout-group members on the uplink).
+            for s in socks:
+                s.close()
+            raise
+        self._uplink_ios = socks
+        # Table hooks: identical contract to the solo path — the
+        # sharded engine's update_tables fans the swap out atomically.
+        self._wire_runner_tables(
+            installed_acl=lambda: self.runner.shards[0].acl,
+            installed_nat=lambda: self.runner.shards[0].nat,
+        )
+        burst = self.config.batch_size * self.config.max_vectors
+
+        def pump(i: int) -> None:
+            # The ingest/egress pump for shard i's fanout socket: pin
+            # beside the shard's worker so the rx-arena writes stay
+            # core-local to its admit (first-touch locality).
+            if cores and cores[i]:
+                try:
+                    os.sched_setaffinity(0, cores[i])
+                except OSError:
+                    pass
+            rings = ios[i]
+            sock = socks[i]
+            while not self._dp_stop.is_set():
+                try:
+                    got = sock.rx_into(rings[0], burst)
+                    moved = 0
+                    for ring in rings[1:]:
+                        moved += sock.tx_from(ring, burst)
+                except Exception:  # noqa: BLE001 - interface flap etc.
+                    with self._dp_err_lock:
+                        self.datapath_errors += 1
+                    log.exception(
+                        "datapath pump %d error (uplink %s); retrying",
+                        i, uplink)
+                    self._dp_stop.wait(1.0)
+                    continue
+                if not (got or moved):
+                    time.sleep(0.0005)  # idle
+
+        def supervise() -> None:
+            while not self._dp_stop.is_set():
+                try:
+                    sent = self.runner.poll()
+                except Exception:  # noqa: BLE001 - supervisor must survive
+                    with self._dp_err_lock:
+                        self.datapath_errors += 1
+                    log.exception("sharded datapath poll error; retrying")
+                    self._dp_stop.wait(1.0)
+                    continue
+                if not sent:
+                    time.sleep(0.0005)
+
+        self._dp_threads = [
+            threading.Thread(target=pump, args=(i,),
+                             name=f"dp-pump-{i}", daemon=True)
+            for i in range(n)
+        ]
+        self._dp_threads.append(
+            threading.Thread(target=supervise, name="dp-supervisor",
+                             daemon=True))
+        for t in self._dp_threads:
+            t.start()
+
     # ----------------------------------------------------------- lifecycle
 
     def stop(self) -> None:
         self._dp_stop.set()
         if self._dp_thread is not None:
             self._dp_thread.join(timeout=2)
+        for t in self._dp_threads:
+            t.join(timeout=2)
         if self._uplink_io is not None:
             self._uplink_io.close()
+        for sock in self._uplink_ios:
+            sock.close()
+        if self.runner is not None and hasattr(self.runner, "close"):
+            self.runner.close()
         if self.route_source is not None:
             self.route_source.close()
         if self.dhcp_source is not None:

@@ -33,8 +33,14 @@ from .core import Checker, Finding, Project, register
 
 DEFAULT_SCHEMA_PAIRS = (
     # (consumer func qualname suffix, producer func qualname suffixes)
+    # ISSUE 12 ledger/placement rows ride the same pair: the dashboard
+    # reads the global-budget ledger snapshot and the CPU placement
+    # map the sharded engine's inspect produces — a renamed ledger key
+    # would blank the budget row exactly during the saturation event
+    # it exists to explain.
     ("shape_dispatch", ("DataplaneRunner.inspect_dispatch",
                         "CoalesceGovernor.snapshot",
+                        "GovernorLedger.snapshot",
                         "ShardedDataplane.inspect",
                         "DataplaneRunner.inspect")),
     # ISSUE 8 telemetry surfaces: the dashboard latency panel and the

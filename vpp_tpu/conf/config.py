@@ -148,6 +148,20 @@ class NetworkConfig:
     # In-flight dispatch window: outstanding device dispatches the host
     # may run ahead of the oldest unharvested batch.
     max_inflight: int = 2
+    # Many-core host ingress (ISSUE 12): number of host-side datapath
+    # shards.  1 = the solo runner; N > 1 builds a ShardedDataplane
+    # with N per-shard ring arenas fed by N PACKET_FANOUT sockets on
+    # the uplink (kernel flow-hash multi-queue), N admit worker
+    # threads, and ONE shared device session state.  The N per-shard
+    # coalesce governors share coalesce_slo_us through a global-budget
+    # ledger — the added-latency SLO stays a NODE budget, not N
+    # budgets.
+    datapath_shards: int = 1
+    # Opt-in CPU affinity map, shard i → core set (VPP's
+    # corelist-workers analog): semicolon-separated per-shard core
+    # lists ("0-3;4-7;8,9"), or "auto" to spread the process's usable
+    # cores evenly across shards, or "" for no pinning (default).
+    shard_cores: str = ""
 
     @classmethod
     def from_dict(cls, data: Optional[dict]) -> "NetworkConfig":
@@ -167,6 +181,8 @@ class NetworkConfig:
             coalesce_slo_us=data.get("coalesce_slo_us", 600.0),
             coalesce_prewarm=data.get("coalesce_prewarm", True),
             max_inflight=data.get("max_inflight", 2),
+            datapath_shards=data.get("datapath_shards", 1),
+            shard_cores=data.get("shard_cores", ""),
         )
 
     def overlay(self, **kw) -> "NetworkConfig":

@@ -10,9 +10,10 @@ With NativeRing endpoints the admit/harvest loop runs in C++
 per-packet.
 """
 
-from .governor import CoalesceGovernor, pow2_vectors
+from .governor import CoalesceGovernor, GovernorLedger, pow2_vectors
 from .io import (
     AfPacketIO,
+    FanoutHandoff,
     FaultInjectingSource,
     FrameSink,
     FrameSource,
@@ -35,7 +36,9 @@ __all__ = [
     "CoalesceGovernor",
     "DataplaneRunner",
     "DeviceSessionState",
+    "FanoutHandoff",
     "FaultInjectingSource",
+    "GovernorLedger",
     "FrameSink",
     "FrameSource",
     "InMemoryRing",

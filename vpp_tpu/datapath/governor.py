@@ -45,7 +45,7 @@ a function of offered load, not a constant.
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 
 def pow2_vectors(n_frames: int, batch_size: int, cap: int) -> int:
@@ -57,6 +57,77 @@ def pow2_vectors(n_frames: int, batch_size: int, cap: int) -> int:
     while k * batch_size < n_frames and k < cap:
         k *= 2
     return k
+
+
+class GovernorLedger:
+    """Shared added-latency budget across N per-shard governors.
+
+    With one governor per shard (each shard owns its rings, so each
+    needs its own backlog view) every shard used to assume it had the
+    WHOLE ``coalesce_slo_us`` budget: at N shards the aggregate added
+    latency a saturated node could sign off on grew N-fold, silently
+    leaving the r5 production budget behind exactly when the many-core
+    front end is earning its keep.  The ledger makes the budget global:
+    each shard PUBLISHES the predicted added latency of its latest
+    chosen K (``predict_us(K) × window`` — the same quantity slo_cap
+    bounds) into its own slot, and every shard's cap is computed
+    against what the budget has left after the OTHER shards' claims.
+
+    Concurrency contract (this is hot-path state — no lock):
+
+    - every slot is SINGLE-WRITER: only shard i's worker thread writes
+      ``_claims[i]``/``_constrained[i]`` (list-item assignment of a
+      float is atomic under the GIL);
+    - readers sum the other slots and tolerate ONE-DECISION staleness:
+      two shards deciding in the same instant may briefly over-commit
+      by at most one dispatch's claim, and the very next decision on
+      either shard re-reads and corrects.  Sequentially-ordered
+      decisions never overshoot (the property the governor test pins).
+
+    The supervisor zeroes an ejected shard's claim so a dead shard's
+    stale reservation cannot starve the survivors.
+    """
+
+    def __init__(self, slo_us: float, n_shards: int):
+        self.slo_us = slo_us
+        self.n_shards = n_shards
+        self._claims: List[float] = [0.0] * n_shards  # lock-free: per-shard slots — shard i's worker writes index i (list-item float store, atomic under the GIL); release() zeroes a slot only after the supervisor has quiesced that shard; readers sum and tolerate one-decision staleness
+        self._constrained: List[int] = [0] * n_shards  # lock-free: same single-writer-slot discipline as _claims (per-shard decision counters)
+
+    def claim(self, shard: int, added_us: float) -> None:
+        """Publish shard ``shard``'s latest predicted added latency."""
+        self._claims[shard] = added_us  # holds nothing: single-writer slot
+
+    def release(self, shard: int) -> None:
+        """Zero a shard's claim (ejection / shutdown): its reservation
+        must not throttle the survivors."""
+        self._claims[shard] = 0.0
+
+    def note_constrained(self, shard: int) -> None:
+        self._constrained[shard] += 1
+
+    def available_us(self, shard: int) -> float:
+        """Budget left for ``shard``: the global SLO minus every OTHER
+        shard's published claim (never negative)."""
+        others = 0.0
+        for i, c in enumerate(self._claims):
+            if i != shard:
+                others += c
+        return max(0.0, self.slo_us - others)
+
+    def committed_us(self) -> float:
+        return sum(self._claims)
+
+    def snapshot(self) -> Dict[str, object]:
+        claims = list(self._claims)
+        return {
+            "slo_us": self.slo_us,
+            "shards": self.n_shards,
+            "committed_us": round(sum(claims), 1),
+            "per_shard_claim_us": [round(c, 1) for c in claims],
+            "constrained": list(self._constrained),
+            "constrained_total": sum(self._constrained),
+        }
 
 
 # Process-global pre-warm ledger: jit caches are per process, so once
@@ -90,6 +161,12 @@ class CoalesceGovernor:
         self.window = max(1, window)      # in-flight depth a frame may wait behind
         self.alpha = alpha
         self.enabled = enabled
+        # Global-budget coordination (sharded engine): when bound, this
+        # governor's SLO headroom is what the GovernorLedger has left
+        # after the other shards' published claims — N shards share ONE
+        # coalesce_slo_us, they do not each assume it (ISSUE 12).
+        self.ledger: Optional[GovernorLedger] = None  # owner: bound once at construction by the sharded engine, before workers start
+        self.shard_index = 0  # owner: set once at bind time, before workers start
         # Exponentially-weighted least squares for t(K) = floor + K*vec
         # (seconds).  Accumulators decay by (1-alpha) per observation.
         self._s1 = 0.0
@@ -108,8 +185,16 @@ class CoalesceGovernor:
         self.backlog = 0
         self.decisions = 0
         self.slo_breaches = 0
+        self.ledger_constrained = 0
         self.k_hist: Dict[int, int] = {}
         self.samples = 0
+
+    def bind_ledger(self, ledger: GovernorLedger, shard: int) -> None:
+        """Join a shared global-budget ledger (sharded engine only).
+        Must happen before the shard's worker thread runs — the binding
+        itself is single-assignment, never re-bound live."""
+        self.ledger = ledger
+        self.shard_index = shard
 
     # ------------------------------------------------------------ model
 
@@ -153,7 +238,15 @@ class CoalesceGovernor:
             return None
         return self.floor_us + k * (self.vec_us or 0.0)
 
-    def slo_cap(self) -> int:
+    def _budget_us(self) -> float:
+        """This decision's added-latency headroom: the whole SLO for a
+        solo governor; what the shared ledger has left after the OTHER
+        shards' claims when bound (never more than the SLO itself)."""
+        if self.ledger is None:
+            return self.slo_us
+        return min(self.slo_us, self.ledger.available_us(self.shard_index))
+
+    def slo_cap(self, budget_us: Optional[float] = None) -> int:
         """Largest pow2 K (≤ ceiling) whose predicted ADDED latency
         fits the budget: one dispatch's service time times the
         in-flight window depth, because a frame admitted into a full
@@ -161,13 +254,18 @@ class CoalesceGovernor:
         Deepening ``max_inflight`` therefore SHRINKS the cap — the
         governor compensates for deeper pipelining instead of silently
         multiplying the budget.  (Queue wait before admission is the
-        backlog term's business, not this cap's.)  Optimistic
-        (= ceiling) until the model has data."""
+        backlog term's business, not this cap's.)  With a bound
+        GovernorLedger the budget is the GLOBAL SLO headroom left by
+        the other shards — N shards share one budget instead of each
+        assuming it.  Optimistic (= ceiling) until the model has
+        data."""
+        if budget_us is None:
+            budget_us = self._budget_us()
         if self.floor_us is None or self.slo_us <= 0:
             return self.max_vectors
         k = 1
         while k * 2 <= self.max_vectors and \
-                (self.predict_us(k * 2) or 0.0) * self.window <= self.slo_us:
+                (self.predict_us(k * 2) or 0.0) * self.window <= budget_us:
             k *= 2
         return k
 
@@ -188,18 +286,45 @@ class CoalesceGovernor:
             self.backlog = int(backlog)
             k_fill = pow2_vectors(max(1, self.backlog), self.batch_size,
                                   self.max_vectors)
-        cap = self.slo_cap()
+        budget = self._budget_us()
+        cap = self.slo_cap(budget)
+        if self.ledger is not None and k_fill > cap and \
+                cap < self.slo_cap(self.slo_us):
+            # The shared ledger (other shards' load), not this shard's
+            # own SLO math, shrank the cap AND the shrunken cap binds
+            # this decision (the backlog wanted more) — counted so a
+            # sub-linear-scaling investigation can see budget contention
+            # (DEVGUIDE "Diagnosing sub-linear shard scaling").  A cap
+            # shrunk below a level the backlog never asked for is not
+            # contention: an idle shard beside a saturated one must not
+            # count millions of phantom constraints.  Guard order keeps
+            # the second slo_cap evaluation (a pow2 predict loop) off
+            # the solo hot path, where no ledger can ever shrink a cap.
+            self.ledger_constrained += 1
+            self.ledger.note_constrained(self.shard_index)
         if k_fill <= cap:
             k = k_fill
         else:
             # Queueing already dominates: clamping K below the backlog
             # would grow the queue and with it every frame's latency —
-            # follow the backlog to the ceiling and account the breach.
+            # follow the backlog to the ceiling and account the breach
+            # (against the GLOBAL budget when a ledger is bound:
+            # saturation of the shared budget is reported, not hidden).
             k = min(k_fill, self.max_vectors)
             pred = self.predict_us(k)
-            if pred is not None and pred * self.window > self.slo_us:
+            if pred is not None and pred * self.window > budget:
                 self.slo_breaches += 1
         self.current_k = k
+        # Publish this decision's claim so the OTHER shards' next caps
+        # see it.  The claim is the same quantity slo_cap bounds —
+        # predicted service time × window depth; 0 while the model is
+        # still warming (an unknown claim must not starve the fleet).
+        if self.ledger is not None:
+            pred = self.predict_us(k)
+            self.ledger.claim(
+                self.shard_index,
+                (pred or 0.0) * self.window,
+            )
         return k
 
     def admitted(self, n_frames: int, k_cap: int) -> None:
@@ -231,6 +356,7 @@ class CoalesceGovernor:
             "slo_cap": self.slo_cap(),
             "decisions": self.decisions,
             "slo_breaches": self.slo_breaches,
+            "ledger_constrained": self.ledger_constrained,
             "samples": self.samples,
             "k_histogram": {str(k): v for k, v in sorted(self.k_hist.items())},
         }

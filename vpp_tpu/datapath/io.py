@@ -18,7 +18,12 @@ from typing import Iterable, List, Optional, Protocol, Sequence
 # The C++-backed frame ring (runnerloop.cpp) — the buffer-view
 # source/sink the native runner loop consumes; re-exported here so IO
 # call sites pick between InMemoryRing (pure Python) and NativeRing.
-from ..shim.hostshim import NativeRing, afp_rx_ring, afp_tx_ring  # noqa: F401
+from ..shim.hostshim import (  # noqa: F401
+    FanoutHandoff,
+    NativeRing,
+    afp_rx_ring,
+    afp_tx_ring,
+)
 
 
 class FrameSource(Protocol):

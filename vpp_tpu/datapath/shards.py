@@ -54,6 +54,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
@@ -65,6 +66,7 @@ import numpy as np
 from ..ops.classify import RuleTables
 from ..ops.nat import NatTables
 from ..testing.faults import FaultInjector
+from .governor import GovernorLedger
 from .runner import (
     DataplaneRunner,
     DeviceSessionState,
@@ -87,6 +89,46 @@ STATE_REJOINED = "rejoined"
 # States that still receive traffic (everything but ejected).
 _SERVING_STATES = (STATE_HEALTHY, STATE_DEGRADED, STATE_PROBATION,
                    STATE_REJOINED)
+
+
+def parse_core_map(spec: str, n_shards: int) -> Optional[List[List[int]]]:
+    """Parse the ``shard_cores`` config knob into a shard→core-set map
+    (VPP's ``corelist-workers`` analog).
+
+    - ``""``     → None (no pinning)
+    - ``"auto"`` → the process's usable cores spread round-robin across
+      the shards (shard i gets cores i, i+N, i+2N, ...)
+    - ``"0-3;4-7;8,9"`` → one semicolon-separated core list per shard
+      ("a-b" ranges and comma lists compose); must name exactly
+      ``n_shards`` sets.
+    """
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    if spec == "auto":
+        try:
+            usable = sorted(os.sched_getaffinity(0))
+        except AttributeError:  # non-Linux: no affinity API, no pinning
+            return None
+        return [usable[i::n_shards] for i in range(n_shards)]
+    sets: List[List[int]] = []
+    for part in spec.split(";"):
+        cores: List[int] = []
+        for piece in part.split(","):
+            piece = piece.strip()
+            if not piece:
+                continue
+            if "-" in piece:
+                lo, hi = piece.split("-", 1)
+                cores.extend(range(int(lo), int(hi) + 1))
+            else:
+                cores.append(int(piece))
+        sets.append(sorted(set(cores)))
+    if len(sets) != n_shards:
+        raise ValueError(
+            f"shard_cores names {len(sets)} core sets for "
+            f"{n_shards} shards: {spec!r}")
+    return sets
 
 
 @dataclasses.dataclass
@@ -146,6 +188,20 @@ class ShardedDataplane:
         reinit_backoff: float = 0.25,
         reinit_backoff_max: float = 8.0,
         on_all_down: str = "fail-closed",
+        # Global added-latency budget (ISSUE 12): the N per-shard
+        # governors share ONE coalesce_slo_us through a GovernorLedger
+        # instead of each assuming the whole budget — aggregate added
+        # latency stays inside the r5 production budget as shards
+        # multiply.  Made explicit here (not **runner_kw) so the ledger
+        # and the per-shard governors agree on the number.
+        coalesce_slo_us: float = 600.0,
+        # CPU placement (ISSUE 12): opt-in affinity map shard i → core
+        # set.  Each shard's worker thread pins itself to its set at
+        # spawn (and re-pins on the fresh executor a rejoin attaches),
+        # so admit/parse/harvest cache state stays core-local — VPP's
+        # corelist-workers analog.  NUMA locality follows first-touch
+        # on the pinned core.  None/empty = no pinning (default).
+        shard_cores: Optional[Sequence[Sequence[int]]] = None,
         **runner_kw,
     ):
         if not shard_ios:
@@ -154,6 +210,11 @@ class ShardedDataplane:
             raise ValueError(
                 f"on_all_down must be 'fail-closed' or 'bypass', "
                 f"not {on_all_down!r}")
+        if shard_cores is not None and len(shard_cores) not in (
+                0, len(shard_ios)):
+            raise ValueError(
+                f"shard_cores maps {len(shard_cores)} shards but "
+                f"{len(shard_ios)} shard_ios were given")
         from ..ops.slowpath import HostSlowPath
 
         self.state = DeviceSessionState(session_capacity)
@@ -173,6 +234,7 @@ class ShardedDataplane:
                 acl=acl, nat=nat, route=route, overlay=overlay,
                 source=src, tx=tx, local=local, host=host,
                 batch_size=batch_size, max_vectors=max_vectors,
+                coalesce_slo_us=coalesce_slo_us,
                 state=self.state, slow=self.slow, tracer=self.tracer,
                 host_lock=self._host_lock,
                 faults=self.faults, shard_index=i,
@@ -180,9 +242,23 @@ class ShardedDataplane:
             )
             for i, (src, tx, local, host) in enumerate(shard_ios)
         ]
+        # ONE global added-latency budget for the whole node: every
+        # shard's governor caps against what the ledger has left after
+        # the others' claims (bound before any worker thread exists).
+        self.ledger = GovernorLedger(coalesce_slo_us, len(self.shards))
+        for i, r in enumerate(self.shards):
+            r.governor.bind_ledger(self.ledger, i)
         self.health_of: List[ShardHealth] = [
             ShardHealth() for _ in self.shards
         ]
+        # CPU placement map (opt-in): normalised to one core tuple per
+        # shard; () = unpinned.  _applied_cores[i] is written by shard
+        # i's worker thread at executor spawn and read by inspect().
+        self.shard_cores: List[Tuple[int, ...]] = [
+            tuple(cores) for cores in (shard_cores or ())
+        ] or [() for _ in self.shards]
+        # lock-free: per-shard single-writer slots (shard i's first worker run writes index i; inspect readers tolerate staleness)
+        self._applied_cores: List[Optional[str]] = [None] * len(self.shards)
         # One single-thread executor per shard (shards are not
         # re-entrant): a hung shard's executor can be ABANDONED without
         # stalling the others, and a fresh one attached at rejoin.
@@ -190,6 +266,14 @@ class ShardedDataplane:
             self._new_exec(i) for i in range(len(self.shards))
         ]
         self._stuck: Dict[int, Future] = {}  # abandoned hung futures
+        # Steering rotation cursor: where the NEXT steered frame lands
+        # in the serving-target rotation.  Normalised modulo the live
+        # target count on every use, so a cursor carried across an
+        # eject→rejoin membership change can never index a stale
+        # position or permanently bias the first survivor (ISSUE 12
+        # satellite; the old frames[j::n] split always overfed
+        # targets[0]).
+        self._steer_cursor = 0  # owner: supervisor — steering runs on the poll() caller thread only
         # Supervisor counters (whole-engine, not per shard).
         self._ejections = 0
         self._rejoins = 0
@@ -198,10 +282,27 @@ class ShardedDataplane:
         self._bypass_forwards = 0
         self._swap_rollbacks = 0
 
-    @staticmethod
-    def _new_exec(i: int) -> ThreadPoolExecutor:
+    def _new_exec(self, i: int) -> ThreadPoolExecutor:
         return ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix=f"dp-shard-{i}")
+            max_workers=1, thread_name_prefix=f"dp-shard-{i}",
+            initializer=self._pin_worker, initargs=(i,))
+
+    def _pin_worker(self, i: int) -> None:
+        """Executor initializer, running ON shard i's worker thread:
+        apply the shard's opt-in core affinity.  Failures degrade to
+        unpinned (recorded for inspect; placement is an optimisation,
+        never a correctness gate)."""
+        cores = self.shard_cores[i] if i < len(self.shard_cores) else ()
+        if not cores:
+            self._applied_cores[i] = ""
+            return
+        try:
+            os.sched_setaffinity(0, cores)
+            self._applied_cores[i] = ",".join(str(c) for c in cores)
+        except (AttributeError, OSError, ValueError) as err:
+            self._applied_cores[i] = f"error: {err}"
+            log.warning("shard %d: core pinning to %s failed: %s",
+                        i, cores, err)
 
     @property
     def engine(self) -> str:
@@ -303,6 +404,10 @@ class ShardedDataplane:
                 h.dirty = False
             if self._execs[i] is None:
                 self._execs[i] = self._new_exec(i)
+            # A hung worker that finally returned may have published a
+            # claim AFTER the ejection zeroed it; re-zero now that the
+            # shard is provably quiesced, before probation re-claims.
+            self.ledger.release(i)
             h.state = STATE_PROBATION
             h.consecutive_ok = 0
             h.consecutive_errors = 0
@@ -382,6 +487,10 @@ class ShardedDataplane:
         h.ejections += 1
         h.eject_streak += 1
         self._ejections += 1
+        # An ejected shard dispatches nothing: zero its budget claim so
+        # a dead shard's stale reservation cannot throttle the very
+        # survivors its traffic is being steered onto.
+        self.ledger.release(i)
         h.backoff = min(self.reinit_backoff_max,
                         self.reinit_backoff * (2 ** (h.eject_streak - 1)))
         h.ejected_at = time.monotonic()
@@ -402,8 +511,16 @@ class ShardedDataplane:
         """Drain ejected shards' queued source frames and redistribute
         them round-robin onto the survivors (their device results are
         identical — sessions are shared — so any shard can serve any
-        flow).  With NO survivors the ``on_all_down`` policy applies:
-        fail-closed drop, or unfiltered static host bypass."""
+        flow).  The rotation continues from ``_steer_cursor`` and is
+        re-normalised against the LIVE target list on every pass: the
+        serving set changes across eject→rejoin cycles, and a cursor
+        position minted under the old membership must neither index out
+        of range nor keep skewing frames onto whichever survivor
+        happened to sort first (at N=8 with one long-ejected shard the
+        old header-of-list split persistently overfed shard 0 by up to
+        a full burst slice per poll).  With NO survivors the
+        ``on_all_down`` policy applies: fail-closed drop, or unfiltered
+        static host bypass."""
         down = [i for i, h in enumerate(self.health_of)
                 if h.state == STATE_EJECTED]
         if not down:
@@ -427,10 +544,18 @@ class ShardedDataplane:
             if not frames:
                 continue
             if targets:
-                for j, t in enumerate(targets):
-                    chunk = frames[j::len(targets)]
-                    if chunk:
-                        t.source.send(chunk)
+                nt = len(targets)
+                # Normalise against the CURRENT epoch: after a rejoin
+                # grows (or a second ejection shrinks) the target list,
+                # the carried cursor is just a rotation offset again.
+                start = self._steer_cursor % nt
+                for j in range(min(nt, len(frames))):
+                    # Frame f goes to targets[(start + f) % nt]: the
+                    # slice below is that assignment, chunked so each
+                    # target gets ONE send per pass.
+                    chunk = frames[j::nt]
+                    targets[(start + j) % nt].source.send(chunk)
+                self._steer_cursor = (start + len(frames)) % nt
                 self._steered_frames += len(frames)
             elif self.on_all_down == "bypass":
                 self._bypass_forwards += self._bypass_forward(r, frames)
@@ -600,6 +725,12 @@ class ShardedDataplane:
             r.governor.backlog for r in self.shards)
         agg["datapath_governor_slo_breaches_total"] = sum(
             r.governor.slo_breaches for r in self.shards)
+        # Global-budget ledger gauges (sharded engine only — a solo
+        # runner has no ledger; solo ⊆ sharded parity is one-way).
+        agg["datapath_governor_ledger_committed_us"] = int(
+            self.ledger.committed_us())
+        agg["datapath_governor_ledger_constrained_total"] = sum(
+            r.governor.ledger_constrained for r in self.shards)
         # Supervisor counters: engine-level, not per shard (rollbacks
         # happen once per failed swap, so the per-runner counter — only
         # ticked by solo-runner update_tables — is overridden here).
@@ -718,9 +849,25 @@ class ShardedDataplane:
         gov["decisions"] = sum(r.governor.decisions for r in self.shards)
         gov["slo_breaches"] = sum(
             r.governor.slo_breaches for r in self.shards)
+        gov["ledger_constrained"] = sum(
+            r.governor.ledger_constrained for r in self.shards)
         gov["samples"] = sum(r.governor.samples for r in self.shards)
         gov["per_shard_k"] = [r.governor.current_k for r in self.shards]
         gov["per_shard_backlog"] = [r.governor.backlog for r in self.shards]
+        # Global-budget ledger: the shared SLO pool the per-shard caps
+        # are computed against (ISSUE 12) — committed claims, per-shard
+        # reservations, and how often the OTHER shards' load (not a
+        # shard's own SLO math) was what shrank a cap.
+        gov["ledger"] = self.ledger.snapshot()
+        # CPU/NUMA placement: the configured affinity map next to what
+        # each worker thread actually applied ("" = unpinned by
+        # config, "error: ..." = pinning failed and the shard runs
+        # unpinned, None = worker not spawned yet).
+        base["dispatch"]["placement"] = {
+            "shard_cores": [list(c) for c in self.shard_cores],
+            "applied": list(self._applied_cores),
+            "host_cores": os.cpu_count() or 0,
+        }
         # Whole-node round-chain attribution: every shard's per-round
         # histograms merged on read (same discipline as the latency
         # pillars below; shard 0's solo view would miss the others).

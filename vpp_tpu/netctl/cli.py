@@ -413,6 +413,31 @@ def cmd_inspect(server: str, out, watch: float = 0.0, raw: bool = False) -> int:
                   f"  slo={gov.get('slo_us')}us cap={gov.get('slo_cap')}"
                   f" breaches={gov.get('slo_breaches')}"
                   f"  {model}  K-hist: {hist_s}", file=out)
+        led = gov.get("ledger") or {}
+        if led:
+            claims = " ".join(
+                f"{i}:{c}" for i, c in
+                enumerate(led.get("per_shard_claim_us") or []))
+            print(f"ledger: budget={led.get('slo_us')}us "
+                  f"committed={led.get('committed_us')}us "
+                  f"constrained={led.get('constrained_total')}"
+                  f"  claims: {claims or '-'}", file=out)
+        placement = dp.get("placement") or {}
+        if placement:
+            pairs = []
+            applied = placement.get("applied") or []
+            for i, want in enumerate(placement.get("shard_cores") or []):
+                got = applied[i] if i < len(applied) else None
+                want_s = ",".join(str(c) for c in want) if want else "-"
+                if got is None:
+                    got_s = "unspawned"
+                elif got == "":
+                    got_s = "unpinned"
+                else:
+                    got_s = got
+                pairs.append(f"{i}:{want_s}->{got_s}")
+            print(f"placement: {' '.join(pairs) or '-'} "
+                  f"(host cores {placement.get('host_cores')})", file=out)
         print(f"classify: {cl['rules']} rules / {cl['tables']} tables / "
               f"{cl['pods']} pods    nat: {nt['mappings']} mappings "
               f"ring={nt['bucket_size']} "

@@ -136,10 +136,22 @@ def _load() -> ctypes.CDLL:
         ctypes.c_uint32, ctypes.c_uint32, _u64p, _u64p,
         ctypes.POINTER(ctypes.c_int32),
     ]
+    lib.hs_loop_hostpath_drain.restype = ctypes.c_int32
+    lib.hs_loop_hostpath_drain.argtypes = list(lib.hs_loop_hostpath.argtypes)
     lib.hs_afp_rx.restype = ctypes.c_int32
     lib.hs_afp_rx.argtypes = [ctypes.c_int32, ctypes.c_void_p, ctypes.c_int32]
     lib.hs_afp_tx.restype = ctypes.c_int32
     lib.hs_afp_tx.argtypes = [ctypes.c_int32, ctypes.c_void_p, ctypes.c_int32]
+    lib.hs_fanout_push.restype = ctypes.c_int32
+    lib.hs_fanout_push.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int32,
+        _u8p, _u64p, _u32p, ctypes.c_int32, ctypes.c_int32,
+    ]
+    lib.hs_afp_rx_fanout.restype = ctypes.c_int32
+    lib.hs_afp_rx_fanout.argtypes = [
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_void_p), ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32,
+    ]
     return lib
 
 
@@ -375,6 +387,34 @@ class NativeLoop:
             raise RuntimeError(f"slot {slot} is still in flight (unharvested)")
         return n, int(sent.value)
 
+    def hostpath_drain(self, slot: int, pod_base: int, pod_mask: int,
+                       node_base: int, node_mask: int, host_bits: int,
+                       remote_ips: np.ndarray, local_ip: int,
+                       local_node_id: int, admit_counters: np.ndarray,
+                       harvest_counters: np.ndarray) -> tuple:
+        """Like :meth:`hostpath` but loops until the rx ring is EMPTY
+        inside one native call — the many-core front end's per-wakeup
+        shape (ISSUE 12): N shard workers each cross the FFI/GIL
+        boundary once per wakeup instead of once per batch, so the
+        crossings cannot serialise the very work the shards
+        parallelise.  Returns ``(n_admitted_total, sent_total)``."""
+        remote_ips = np.ascontiguousarray(remote_ips, dtype=np.uint32)
+        sent = ctypes.c_int32(0)
+        n = int(self._lib.hs_loop_hostpath_drain(
+            self._ptr, slot,
+            ctypes.c_uint32(pod_base), ctypes.c_uint32(pod_mask),
+            ctypes.c_uint32(node_base), ctypes.c_uint32(node_mask),
+            ctypes.c_uint32(host_bits),
+            remote_ips.ctypes.data_as(_u32p), len(remote_ips) - 1,
+            ctypes.c_uint32(local_ip), ctypes.c_uint32(local_node_id),
+            admit_counters.ctypes.data_as(_u64p),
+            harvest_counters.ctypes.data_as(_u64p),
+            ctypes.byref(sent),
+        ))
+        if n < 0:
+            raise RuntimeError(f"slot {slot} is still in flight (unharvested)")
+        return n, int(sent.value)
+
     def slot_frame(self, slot: int, row: int) -> bytes:
         """Copy one admitted frame back out (slow path / tracing only)."""
         out = np.empty(1 << 16, dtype=np.uint8)
@@ -402,6 +442,72 @@ class NativeLoop:
             self.close()
         except Exception:
             pass
+
+
+class FanoutHandoff:
+    """Single-feeder fanout across N shard rings (ISSUE 12).
+
+    The many-core ingest handoff: ONE writer (recvmmsg pump, virtual
+    wire, bench feeder) spreads a frame stream across the per-shard
+    ``NativeRing`` arenas in one C call — symmetric flow hash by
+    default (a flow's forward and reply land on the same shard, the
+    PACKET_FANOUT_HASH cache-locality property) or round-robin.  Each
+    shard ring stays single-writer (the feeder) + single-reader (that
+    shard's admit thread), so N admit threads never contend on one
+    ring head; cross-thread contention is pairwise on each ring's own
+    mutex, with ONE lock hold per target ring per call.
+    """
+
+    MODES = {"hash": 0, "rr": 1}
+
+    def __init__(self, rings: Sequence[NativeRing], mode: str = "hash"):
+        if not rings:
+            raise ValueError("need at least one shard ring")
+        if mode not in self.MODES:
+            raise ValueError(f"unknown fanout mode {mode!r}")
+        self._lib = _shared_lib()
+        self._rings = tuple(rings)  # keep alive: C holds raw pointers
+        self.mode = mode
+        self._mode_i = self.MODES[mode]
+        self._ptrs = (ctypes.c_void_p * len(rings))(
+            *(r._ptr for r in rings))
+
+    def __len__(self) -> int:
+        return len(self._rings)
+
+    def send_views(self, buf: np.ndarray, offsets: np.ndarray,
+                   lens: np.ndarray) -> int:
+        """Distribute frames described by (offsets, lens) views into
+        buf across the shard rings; returns frames accepted."""
+        n = len(offsets)
+        if not n:
+            return 0
+        offsets = np.ascontiguousarray(offsets, dtype=np.uint64)
+        lens = np.ascontiguousarray(lens, dtype=np.uint32)
+        return int(self._lib.hs_fanout_push(
+            self._ptrs, len(self._rings), buf.ctypes.data_as(_u8p),
+            offsets.ctypes.data_as(_u64p), lens.ctypes.data_as(_u32p),
+            n, self._mode_i,
+        ))
+
+    def send(self, frames: Sequence[bytes]) -> int:
+        """bytes-compat feeder (tests / steering / virtual wires)."""
+        if not frames:
+            return 0
+        lens = np.array([len(f) for f in frames], dtype=np.uint32)
+        offsets = np.zeros(len(frames), dtype=np.uint64)
+        np.cumsum(lens[:-1], dtype=np.uint64, out=offsets[1:])
+        buf = np.frombuffer(b"".join(frames), dtype=np.uint8)
+        return self.send_views(buf, offsets, lens)
+
+    def rx_from(self, fd: int, max_frames: int = 1 << 12) -> int:
+        """Burst-receive from an AF_PACKET socket and fan out across
+        the shard rings in the same native call (recvmmsg → hash
+        distribute; the single-uplink-socket ingest shape when kernel
+        PACKET_FANOUT is unavailable)."""
+        return int(self._lib.hs_afp_rx_fanout(
+            fd, self._ptrs, len(self._rings), max_frames, self._mode_i,
+        ))
 
 
 def afp_rx_ring(fd: int, ring: NativeRing, max_frames: int) -> int:

@@ -194,6 +194,8 @@ def shape_dispatch(inspect: Optional[dict]) -> Dict[str, Any]:
         return {}
     dp = inspect.get("dispatch") or {}
     gov = dp.get("governor") or {}
+    led = gov.get("ledger") or {}
+    placement = dp.get("placement") or {}
     return {
         "engine": inspect.get("engine", ""),
         "discipline": dp.get("discipline", ""),
@@ -222,7 +224,24 @@ def shape_dispatch(inspect: Optional[dict]) -> Dict[str, Any]:
             # has its own rings); solo runners omit them.
             "per_shard_k": gov.get("per_shard_k") or [],
             "per_shard_backlog": gov.get("per_shard_backlog") or [],
+            "ledger_constrained": gov.get("ledger_constrained", 0),
         },
+        # Global coalesce-SLO budget ledger (sharded engines, ISSUE
+        # 12): the shared pool the per-shard caps are computed
+        # against — empty for solo runners (the panel hides the row).
+        "ledger": {
+            "slo_us": led.get("slo_us", 0),
+            "committed_us": led.get("committed_us", 0),
+            "per_shard_claim_us": led.get("per_shard_claim_us") or [],
+            "constrained_total": led.get("constrained_total", 0),
+        } if led else {},
+        # CPU/NUMA placement of the admit shards (opt-in affinity map
+        # next to what each worker actually applied).
+        "placement": {
+            "shard_cores": placement.get("shard_cores") or [],
+            "applied": placement.get("applied") or [],
+            "host_cores": placement.get("host_cores", 0),
+        } if placement else {},
     }
 
 
