@@ -5,7 +5,8 @@ PY ?= python
 
 .PHONY: test test-race verify verify-ha verify-churn verify-faults \
         verify-adaptive verify-static verify-telemetry verify-soak soak \
-        verify-cluster-obs verify-dispatch verify-ingress lint bench \
+        verify-cluster-obs verify-dispatch verify-ingress verify-ops \
+        lint bench \
         bench-suite bench-sweep bench-scale bench-latency bench-frames \
         bench-ingress bench-churn bench-adaptive bench-history \
         bench-rounds images native native-sanitize
@@ -195,6 +196,25 @@ verify-cluster-obs:
 	    -p no:cacheprovider -p no:xdist -p no:randomly
 	$(PY) scripts/check_static.py vpp_tpu/ --rule obs-parity
 
+# Operational-resilience verification (ISSUE 13): the version-skew
+# matrix (old↔new client/store/replica in both directions, below-floor
+# refused cleanly, unknown fields round-tripped byte-identically
+# through the codec/mirror), live HA membership change (learner
+# snapshot catch-up BEFORE voting rights, one-change-at-a-time,
+# leader-removal orderly handoff with revision identity across
+# survivors, runtime member refresh keeping long-lived watchers alive
+# across replica replacement), graceful drain/rejoin (FSM, retriable
+# code-11 CNI rejection, drained-vs-gap scraper contract, netctl
+# drain|undrain) — plus the planned-operations soak smoke firing the
+# rolling-upgrade / membership-grow+shrink / drain drills over real OS
+# processes with churn and parity probes running throughout.
+verify-ops:
+	JAX_PLATFORMS=cpu $(PY) -m pytest \
+	    tests/test_compat.py tests/test_ops.py \
+	    tests/test_kvstore_ha.py tests/test_kvstore_remote.py \
+	    -q $(if $(RUN_SLOW),,-m 'not slow') --continue-on-collection-errors \
+	    -p no:cacheprovider -p no:xdist -p no:randomly
+
 # The full mega-cluster chaos soak (the ISSUE 9 acceptance run): ≥50
 # agents, ≥1000 pod ADD/DEL through the real exec'd CNI shim, ≥2 leader
 # kills, ≥2 store-outage windows, ≥4 shard faults, ≥2 agent restarts —
@@ -207,7 +227,7 @@ soak:
 # verify target, soak-smoke included.
 verify: lint verify-static verify-ha verify-churn verify-adaptive \
         verify-dispatch verify-ingress verify-telemetry verify-faults \
-        verify-cluster-obs verify-soak
+        verify-cluster-obs verify-soak verify-ops
 	@echo verify OK
 
 bench:

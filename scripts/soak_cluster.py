@@ -34,6 +34,10 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--smoke", action="store_true",
                         help="tier-1 smoke shape (~8 agents, seconds-scale)")
+    parser.add_argument("--ops-smoke", action="store_true",
+                        help="planned-operations smoke (ISSUE 13): "
+                             "rolling-upgrade skew + store membership "
+                             "grow/shrink + drain/rejoin drills")
     parser.add_argument("--check", action="store_true",
                         help="exit nonzero on any parity mismatch, "
                              "unconverged node, or missed fault quota")
@@ -48,6 +52,9 @@ def main(argv=None) -> int:
     parser.add_argument("--store-outages", type=int, default=None)
     parser.add_argument("--agent-kills", type=int, default=None)
     parser.add_argument("--shard-faults", type=int, default=None)
+    parser.add_argument("--rolling-upgrades", type=int, default=None)
+    parser.add_argument("--membership-changes", type=int, default=None)
+    parser.add_argument("--drains", type=int, default=None)
     parser.add_argument("--seed", type=int, default=None)
     parser.add_argument("--replay", default="",
                         help="replay a recorded churn script (JSONL)")
@@ -58,7 +65,9 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="vpp-tpu-soak-")
-    if args.smoke:
+    if args.ops_smoke:
+        cfg = SoakConfig.ops_smoke(workdir, out_path=args.out)
+    elif args.smoke:
         cfg = SoakConfig.smoke(workdir, out_path=args.out)
     else:
         cfg = SoakConfig.full(workdir, out_path=args.out)
@@ -68,7 +77,10 @@ def main(argv=None) -> int:
         ("churn_rate", args.rate), ("leader_kills", args.leader_kills),
         ("store_outages", args.store_outages),
         ("agent_kills", args.agent_kills),
-        ("shard_faults", args.shard_faults), ("seed", args.seed),
+        ("shard_faults", args.shard_faults),
+        ("rolling_upgrades", args.rolling_upgrades),
+        ("membership_changes", args.membership_changes),
+        ("drains", args.drains), ("seed", args.seed),
     ):
         if value is not None:
             setattr(cfg, field_name, value)
@@ -96,6 +108,9 @@ def main(argv=None) -> int:
         ("store_outages", cfg.store_outages),
         ("agent_restarts", cfg.agent_kills),
         ("shard_faults", cfg.shard_faults),
+        ("rolling_upgrades", cfg.rolling_upgrades),
+        ("membership_changes", cfg.membership_changes),
+        ("drains", cfg.drains),
     ):
         if report[field_name] < quota:
             failures.append(

@@ -1,0 +1,315 @@
+"""Versioned compatibility for rolling-upgrade skew (ISSUE 13):
+protocol/schema stamps on store RPCs and mirror files, skew-tolerant
+decode (unknown fields preserved byte-identically, never dropped), the
+min-supported floor refusing cleanly instead of corrupting, and the
+``VPP_TPU_COMPAT_SKEW`` emulation knob the rolling-upgrade soak drill
+rides."""
+
+import sqlite3
+
+import pytest
+
+from vpp_tpu.kvstore import codec, compat
+from vpp_tpu.kvstore.compat import IncompatibleVersion
+from vpp_tpu.kvstore.mirror import LocalMirror
+from vpp_tpu.models import VppNode
+
+# The skew knob must be able to emulate a BELOW-floor build for the
+# refusal tests: adjust here if the lineage constants ever move.
+BELOW_FLOOR_SKEW = compat.MIN_PROTOCOL_VERSION - compat.PROTOCOL_VERSION - 1
+
+
+# ---------------------------------------------------------------- the knob
+
+
+def test_effective_version_follows_skew_env(monkeypatch):
+    monkeypatch.delenv(compat.SKEW_ENV, raising=False)
+    assert compat.effective_version() == compat.PROTOCOL_VERSION
+    monkeypatch.setenv(compat.SKEW_ENV, "-1")
+    assert compat.effective_version() == compat.PROTOCOL_VERSION - 1
+    monkeypatch.setenv(compat.SKEW_ENV, "bogus")
+    assert compat.effective_version() == compat.PROTOCOL_VERSION
+    # Floored at 1: there is no version-0 wire to emulate.
+    monkeypatch.setenv(compat.SKEW_ENV, "-99")
+    assert compat.effective_version() == 1
+
+
+def test_stamp_and_check_floor(monkeypatch):
+    monkeypatch.delenv(compat.SKEW_ENV, raising=False)
+    msg = compat.stamp({"key": "/x"})
+    assert msg["pv"] == compat.PROTOCOL_VERSION
+    assert compat.check(msg) == compat.PROTOCOL_VERSION
+    # Unstamped = pre-versioned lineage / in-process: accepted as 0.
+    assert compat.check({"key": "/x"}) == 0
+    # Adjacent previous version: inside the window.
+    assert compat.check({"pv": compat.MIN_PROTOCOL_VERSION}) \
+        == compat.MIN_PROTOCOL_VERSION
+    # Below the floor: an explicit refusal naming both versions.
+    with pytest.raises(IncompatibleVersion) as err:
+        compat.check({"pv": compat.MIN_PROTOCOL_VERSION - 1})
+    assert err.value.got == compat.MIN_PROTOCOL_VERSION - 1
+    assert err.value.floor == compat.MIN_PROTOCOL_VERSION
+    details = compat.incompatible_details(err.value)
+    assert compat.parse_incompatible(details) == (
+        compat.MIN_PROTOCOL_VERSION - 1, compat.MIN_PROTOCOL_VERSION)
+
+
+def test_future_skew_plants_an_unknown_probe_field(monkeypatch):
+    monkeypatch.setenv(compat.SKEW_ENV, "1")
+    msg = compat.stamp({})
+    assert msg["pv"] == compat.PROTOCOL_VERSION + 1
+    assert "x_compat_probe" in msg  # the field no current reader knows
+
+
+# ------------------------------------------- skew-tolerant codec decode
+
+
+def test_codec_preserves_unknown_dataclass_fields_byte_identically():
+    """A current-version reader consuming a record written by a NEWER
+    emulated version round-trips the fields it does not know
+    byte-identically — the mirror replay / read-modify-write path must
+    never strip a new writer's data."""
+    node = VppNode(id=3, name="node-3", ip_addresses=("192.168.16.3",))
+    wire = codec.to_jsonable(node)
+    # Emulate a future writer: fields this build's VppNode lacks.
+    wire["fields"]["x_future_weight"] = 7
+    wire["fields"]["x_future_labels"] = {"tier": "edge"}
+    blob = codec.encode(codec.from_jsonable(wire))
+    # decode -> encode is byte-identical to encoding the skewed wire
+    # form directly (sort_keys makes the comparison canonical).
+    import json
+    assert json.loads(blob.decode()) == wire
+    assert codec.encode(codec.decode(blob)) == blob
+    # The decoded object still IS this build's dataclass, equal on the
+    # known fields (dbwatcher prev/new comparisons keep working).
+    decoded = codec.decode(blob)
+    assert decoded == node
+    assert decoded._codec_unknown == {
+        "x_future_weight": 7, "x_future_labels": {"tier": "edge"}}
+
+
+def test_codec_refuses_missing_required_field_cleanly():
+    """An OLDER writer omitting a field this build requires (no
+    default) is a refused decode naming the skew suspicion — never a
+    half-constructed object."""
+    wire = codec.to_jsonable(VppNode(id=1, name="node-1"))
+    del wire["fields"]["name"]  # VppNode.name has no default
+    with pytest.raises(ValueError, match="version-skew"):
+        codec.from_jsonable(wire)
+
+
+def test_codec_older_writer_missing_defaulted_fields_decodes():
+    """Fields with defaults tolerate an older writer omitting them."""
+    wire = codec.to_jsonable(VppNode(id=1, name="node-1"))
+    del wire["fields"]["ip_addresses"]  # defaulted field
+    node = codec.from_jsonable(wire)
+    assert node.name == "node-1" and node.ip_addresses == ()
+
+
+# ------------------------------------------------- mirror schema lineage
+
+
+def test_mirror_stamps_format_and_reloads(tmp_path):
+    path = str(tmp_path / "m.db")
+    mirror = LocalMirror(path)
+    mirror.save_snapshot({"/a": {"v": 1}}, revision=5)
+    mirror.close()
+    conn = sqlite3.connect(path)
+    fmt = conn.execute(
+        "SELECT value FROM meta WHERE name = 'format'").fetchone()[0]
+    conn.close()
+    assert int(fmt) == compat.MIRROR_FORMAT_VERSION
+    reloaded = LocalMirror(path)
+    try:
+        assert reloaded.load() == ({"/a": {"v": 1}}, 5)
+    finally:
+        reloaded.close()
+
+
+def test_mirror_refuses_out_of_window_format_without_destroying(tmp_path):
+    """A format outside the supported window reads as NO MIRROR (full
+    remote resync) — a clean refusal, not a crash, and NOT the
+    corruption-quarantine path (the file survives untouched until the
+    next snapshot rewrites it)."""
+    path = str(tmp_path / "m.db")
+    mirror = LocalMirror(path)
+    mirror.save_snapshot({"/a": {"v": 1}}, revision=5)
+    mirror.close()
+    conn = sqlite3.connect(path)
+    conn.execute("INSERT OR REPLACE INTO meta (name, value) "
+                 "VALUES ('format', ?)",
+                 (compat.MIRROR_FORMAT_VERSION + 7,))
+    conn.commit()
+    conn.close()
+    reloaded = LocalMirror(path)
+    try:
+        assert reloaded.load() is None          # refused, not decoded
+        assert reloaded.recreated == 0          # NOT quarantined
+        # The agent's next resync rewrites it in this build's format
+        # and it serves again.
+        reloaded.save_snapshot({"/b": {"v": 2}}, revision=9)
+        assert reloaded.load() == ({"/b": {"v": 2}}, 9)
+    finally:
+        reloaded.close()
+
+
+def test_mirror_legacy_unstamped_file_still_loads(tmp_path):
+    path = str(tmp_path / "m.db")
+    mirror = LocalMirror(path)
+    mirror.save_snapshot({"/a": {"v": 1}}, revision=3)
+    mirror.close()
+    conn = sqlite3.connect(path)
+    conn.execute("DELETE FROM meta WHERE name = 'format'")  # pre-ISSUE-13 file
+    conn.commit()
+    conn.close()
+    reloaded = LocalMirror(path)
+    try:
+        assert reloaded.load() == ({"/a": {"v": 1}}, 3)
+    finally:
+        reloaded.close()
+
+
+def test_mirror_skewed_writer_produces_readable_old_format(tmp_path, monkeypatch):
+    """An emulated previous-version agent writes a previous-format
+    mirror the current build still reads (inside the window)."""
+    monkeypatch.setenv(compat.SKEW_ENV, "-1")
+    path = str(tmp_path / "m.db")
+    mirror = LocalMirror(path)
+    mirror.save_snapshot({"/a": {"v": 1}}, revision=2)
+    mirror.close()
+    monkeypatch.delenv(compat.SKEW_ENV)
+    reloaded = LocalMirror(path)
+    try:
+        assert reloaded.load() == ({"/a": {"v": 1}}, 2)
+    finally:
+        reloaded.close()
+
+
+# --------------------------------------- wire matrix: client <-> server
+
+
+@pytest.fixture()
+def served_store():
+    from vpp_tpu.kvstore.remote import KVStoreServer, RemoteKVStore
+    from vpp_tpu.kvstore.store import KVStore
+
+    server = KVStoreServer(KVStore(), port=0)
+    port = server.start()
+    client = RemoteKVStore(f"127.0.0.1:{port}", timeout=5.0)
+    yield server, client
+    client.close()
+    server.stop()
+
+
+def test_old_client_against_current_server(served_store, monkeypatch):
+    """Previous-version client ↔ current server: everything works —
+    the window tolerates adjacent versions in both directions."""
+    _, client = served_store
+    monkeypatch.setenv(compat.SKEW_ENV, "-1")
+    client.put("/skew/a", {"v": 1})
+    assert client.get("/skew/a") == {"v": 1}
+    watcher = client.watch(["/skew/"])
+    assert watcher.wait_subscribed(5.0)
+    client.put("/skew/b", {"v": 2})
+    assert watcher.get(timeout=5.0).key == "/skew/b"
+
+
+def test_below_floor_client_refused_cleanly(served_store, monkeypatch):
+    """A below-floor client gets an explicit IncompatibleVersion —
+    deterministic, never retried into a failover loop, and nothing was
+    decoded or applied server-side."""
+    server, client = served_store
+    monkeypatch.setenv(compat.SKEW_ENV, str(BELOW_FLOOR_SKEW))
+    assert compat.effective_version() < compat.MIN_PROTOCOL_VERSION
+    with pytest.raises(IncompatibleVersion) as err:
+        client.put("/skew/poison", {"v": 1})
+    assert err.value.floor == compat.MIN_PROTOCOL_VERSION
+    monkeypatch.delenv(compat.SKEW_ENV)
+    assert client.get("/skew/poison") is None  # nothing applied
+
+
+# -------------------------------- wire matrix: replica <-> replica (HA)
+
+
+def test_replica_protocol_tolerates_adjacent_and_refuses_below_floor():
+    """Both directions of the replica matrix: an adjacent-version
+    leader's Replicate/InstallSnapshot is applied; a below-floor one is
+    refused with the typed ``incompatible`` reply and NO entries are
+    applied (refuse-cleanly, never corrupt)."""
+    from vpp_tpu.kvstore.ha import HAEnsemble
+
+    ens = HAEnsemble(1)
+    try:
+        replica = ens.wait_leader()
+        # Force it follower-shaped for the handler (a heartbeat at a
+        # higher term from a fake leader does that organically).
+        ok = replica.handle_replicate({
+            "pv": compat.MIN_PROTOCOL_VERSION,   # emulated OLD leader
+            "term": replica.status()["term"] + 1,
+            "leader": "127.0.0.1:1",
+            "prev_index": replica.status()["last_index"],
+            "prev_term": replica.status()["last_term"],
+            "entries": [],
+        })
+        assert ok["ok"] and not ok.get("incompatible")
+        rev_before = replica.store.revision
+        refused = replica.handle_replicate({
+            "pv": compat.MIN_PROTOCOL_VERSION - 1,  # below the floor
+            "term": replica.status()["term"] + 1,
+            "leader": "127.0.0.1:1",
+            "prev_index": 0, "prev_term": 0,
+            "entries": [{"index": 1, "term": 99, "op": "put",
+                         "args": {"key": "/evil", "value": {"v": 1}}}],
+        })
+        assert refused == {
+            "ok": False, "incompatible": True,
+            "got": compat.MIN_PROTOCOL_VERSION - 1,
+            "min": compat.MIN_PROTOCOL_VERSION,
+            "term": refused["term"], "last_index": refused["last_index"],
+        }
+        assert replica.store.revision == rev_before
+        assert replica.store.get("/evil") is None
+        snap_refused = replica.handle_install_snapshot({
+            "pv": compat.MIN_PROTOCOL_VERSION - 1,
+            "term": replica.status()["term"] + 2,
+            "leader": "127.0.0.1:1",
+            "snapshot": {"/evil": {"v": 1}}, "revision": 99,
+            "last_index": 9, "last_term": 9,
+        })
+        assert snap_refused["incompatible"]
+        assert replica.store.get("/evil") is None
+
+        # OVER THE WIRE the typed reply must survive too: the replica
+        # protocol is exempt from the aborting version gate (a generic
+        # FAILED_PRECONDITION abort would reach the pushing leader as
+        # RpcError→None and the loud incompatible classification would
+        # be unreachable — caught in review).
+        from vpp_tpu.kvstore.remote import _Target
+
+        target = _Target(replica.address)
+        try:
+            wire = target.calls["Replicate"]({
+                "pv": compat.MIN_PROTOCOL_VERSION - 1,
+                "term": replica.status()["term"] + 3,
+                "leader": "127.0.0.1:1",
+                "prev_index": 0, "prev_term": 0, "entries": [],
+            }, timeout=5.0)
+            assert wire["incompatible"]
+            assert wire["got"] == compat.MIN_PROTOCOL_VERSION - 1
+        finally:
+            target.channel.close()
+    finally:
+        ens.stop()
+
+
+def test_peer_status_carries_and_tolerates_version_stamp():
+    from vpp_tpu.kvstore.election import PeerStatus
+
+    status = {"replica_id": 0, "address": "a:1", "role": "follower",
+              "term": 1, "last_index": 0, "last_term": 0, "revision": 0,
+              "pv": compat.PROTOCOL_VERSION, "x_unknown_future": 1}
+    peer = PeerStatus.from_dict(status)  # extra keys ignored, pv kept
+    assert peer.pv == compat.PROTOCOL_VERSION
+    assert PeerStatus.from_dict({k: v for k, v in status.items()
+                                 if k not in ("pv", "x_unknown_future")
+                                 }).pv == 0

@@ -10,6 +10,7 @@ import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
 
 import pytest
@@ -246,6 +247,95 @@ def test_killed_replica_rejoins_and_catches_up(ensemble):
             timeout=10.0,
         )
         assert back.role is Role.FOLLOWER
+    finally:
+        client.close()
+
+
+# ------------------------------------- live membership change (ISSUE 13)
+
+
+def test_grow_under_live_write_traffic_catches_up_bit_identically(ensemble):
+    """A brand-new EMPTY replica joins while writes keep landing: it
+    snapshot-catches up as a learner, becomes a voter only after the
+    member-add commits, and converges to the leader's exact
+    (contents, revision) view — then keeps following live."""
+    leader = ensemble.wait_leader()
+    client = ensemble.client(timeout=2.0,
+                             failover_deadline=15.0 * timeout_mult())
+    stop = False
+    wrote = []
+
+    def writer():
+        i = 0
+        while not stop:
+            client.put(f"/grow/{i:04d}", {"v": i})
+            wrote.append(i)
+            i += 1
+            time.sleep(0.005)
+
+    thread = threading.Thread(target=writer, daemon=True)
+    thread.start()
+    try:
+        assert wait_for(lambda: len(wrote) > 10, timeout=5.0)
+        new = ensemble.grow(timeout=30.0 * timeout_mult())
+        # The joiner learns its own membership from the replicated
+        # member-add entry (its snapshot install carried the OLD peer
+        # list) — one push later, not synchronously with add_replica.
+        assert wait_for(lambda: len(new.peers) == 4, timeout=10.0)
+        # Every replica (old and new) converged on the 4-member set.
+        assert wait_for(lambda: all(
+            len(r.status()["peers"]) == 4 for r in ensemble.replicas),
+            timeout=10.0)
+        n_during = len(wrote)
+        assert wait_for(
+            lambda: new.store.get(f"/grow/{n_during - 1:04d}") is not None,
+            timeout=10.0)
+    finally:
+        stop = True
+        thread.join(timeout=5.0)
+        client.close()
+    # Quiesced: all four replicas bit-identical.
+    assert wait_for(lambda: all(
+        r.store.snapshot_with_revision([""])
+        == leader.store.snapshot_with_revision([""])
+        for r in ensemble.replicas), timeout=10.0)
+    # The leader recorded the learner protocol (drill evidence).
+    adds = [e for e in leader.membership_events if e["op"] == "member-add"]
+    assert adds and adds[-1]["addr"] == new.address
+
+
+def test_remove_leader_is_an_orderly_handoff_with_zero_lost_writes(ensemble):
+    """Removing the sitting leader: survivors are synced BEFORE the
+    removal commits, a survivor takes over, and every acknowledged
+    write exists on all survivors with identical revisions."""
+    old = ensemble.wait_leader()
+    client = ensemble.client(timeout=2.0,
+                             failover_deadline=15.0 * timeout_mult())
+    try:
+        for i in range(8):
+            client.put(f"/handoff/{i}", {"v": i})
+        corpse = ensemble.shrink()      # removes the leader, kills it
+        assert corpse is old and old._removed
+        new = ensemble.wait_leader(timeout=10.0 * timeout_mult())
+        assert new.address != old.address
+        assert len(new.peers) == 2
+        # Zero lost committed writes + revision identity.
+        for i in range(8):
+            assert client.get(f"/handoff/{i}") == {"v": i}
+        views = {r.store.snapshot_with_revision([""])[1]
+                 for r in ensemble.replicas}
+        assert len(views) == 1
+        # The removed replica rejects client ops (dormant, not dead).
+        import grpc
+        direct = RemoteKVStore(old.address, timeout=2.0)
+        try:
+            with pytest.raises(grpc.RpcError):
+                direct.put("/handoff/late", {"v": 1})
+        finally:
+            direct.close()
+        # Writes keep landing on the survivor ensemble.
+        client.put("/handoff/after", {"v": 99})
+        assert client.get("/handoff/after") == {"v": 99}
     finally:
         client.close()
 

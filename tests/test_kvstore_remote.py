@@ -419,3 +419,70 @@ def test_rpc_survives_concurrent_channel_eviction():
             client.close()
     finally:
         server.stop()
+
+
+def test_watcher_survives_replica_replacement_via_member_refresh():
+    """ISSUE 13 satellite regression: the client's failover address
+    list was frozen at construction — replace a replica at runtime
+    (grow by one, remove the leader the watch stream was homed on) and
+    a long-lived watcher used to strand on the dead address.  Now the
+    member list refreshes from HaStatus peers on outage/reconnect: the
+    stream survives, keeps delivering, and the address list has
+    learned the new member and pruned the removed one."""
+    from vpp_tpu.kvstore.ha import HAEnsemble
+    from vpp_tpu.testing.cluster import timeout_mult
+
+    ens = HAEnsemble(3, lease_timeout=0.4 * timeout_mult())
+    client = ens.client(timeout=1.0,
+                        failover_deadline=15.0 * timeout_mult())
+    try:
+        watcher = client.watch(["/swap/"])
+        assert watcher.wait_subscribed(5.0)
+        client.put("/swap/before", {"v": 1})
+        assert watcher.get(timeout=5.0).key == "/swap/before"
+
+        grown = ens.grow(timeout=30.0 * timeout_mult())
+        removed = ens.shrink()  # the LEADER (serving the watch) leaves
+        # Writes keep landing via failover; the SAME stream delivers
+        # them (re-homed onto whichever survivor leads now).
+        client.put("/swap/during", {"v": 2})
+        client.put("/swap/after", {"v": 3})
+        seen = []
+        deadline = time.time() + 20.0 * timeout_mult()
+        while len(seen) < 2 and time.time() < deadline:
+            ev = watcher.get(timeout=0.5)
+            if ev is not None:
+                seen.append(ev.key)
+        assert seen == ["/swap/during", "/swap/after"]
+        # The refreshed list knows the member set as it NOW stands.
+        assert wait_for(
+            lambda: (client._refresh_members() or True)
+            and grown.address in client.addresses
+            and removed.address not in client.addresses,
+            timeout=10.0,
+        ), f"stale address list: {client.addresses}"
+    finally:
+        client.close()
+        ens.stop()
+
+
+def test_refresh_members_prunes_bogus_bootstrap_addresses():
+    """The ctor list is a bootstrap hint: refresh replaces it with the
+    ensemble's actual member list, pruning dead configured addresses
+    and keeping the active cursor on a live member."""
+    from vpp_tpu.kvstore.ha import HAEnsemble
+
+    ens = HAEnsemble(3)
+    try:
+        ens.wait_leader()
+        bogus = "127.0.0.1:1"
+        client = RemoteKVStore([bogus] + ens.addresses, timeout=1.0)
+        try:
+            assert client._refresh_members()
+            assert sorted(client.addresses) == sorted(ens.addresses)
+            assert client.address != bogus
+            client.put("/refresh/x", {"v": 1})  # serves off the new list
+        finally:
+            client.close()
+    finally:
+        ens.stop()

@@ -170,8 +170,17 @@ class Agent:
                 self._start_datapath(uplink)
 
         # ----------------------------------------------------- diagnostics
+        from .controller.drain import DrainCoordinator
         from .rest.server import AgentRestServer
 
+        # Graceful drain/rejoin (ISSUE 13): `netctl drain` gates CNI
+        # ADDs retriably, quiesces the runner, flushes flight/latency
+        # forensics; `netctl undrain` rejoins.
+        self.drain = DrainCoordinator(
+            podmanager=self.podmanager,
+            datapath=lambda: self.runner,
+            node_name=name,
+        )
         self.rest = AgentRestServer(
             node_name=name,
             controller=self.controller,
@@ -186,6 +195,7 @@ class Agent:
             # Propagation spans (ISSUE 8): the controller mints one per
             # event; REST serves the ring at /contiv/v1/spans.
             spans=self.controller.spans,
+            drain=self.drain,
             host="0.0.0.0" if rest_port else "127.0.0.1",
             port=rest_port,
         )

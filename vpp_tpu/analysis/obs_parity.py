@@ -62,7 +62,11 @@ DEFAULT_SCHEMA_PAIRS = (
     ("cmd_health", ("Controller.status",
                     "AgentRestServer.get_health",
                     "DataplaneRunner.health",
-                    "ShardedDataplane.health")),
+                    "ShardedDataplane.health",
+                    # ISSUE 13: the drain FSM's status rides the health
+                    # dict (`drain:` line in netctl health); the literal
+                    # schema lives in the locked helper.
+                    "DrainCoordinator._status_locked")),
     # ISSUE 10 cluster surfaces: the dashboard's cluster panel and the
     # `netctl cluster` subcommands both read the fleet aggregator's
     # literal schema (ClusterScraper.summary rows + gaps, the stitched

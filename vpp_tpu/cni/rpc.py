@@ -62,6 +62,8 @@ class CNIServer:
         return args.get("K8S_POD_NAME", ""), args.get("K8S_POD_NAMESPACE", "default")
 
     def add(self, request: CNIRequest, context=None) -> CNIReply:
+        from ..controller.drain import CNI_DRAINING_CODE, NodeDraining
+
         name, namespace = self._pod_identity(request)
         if not name:
             return CNIReply(result=1, error="missing K8S_POD_NAME in extra arguments")
@@ -72,6 +74,15 @@ class CNIServer:
                 container_id=request.container_id,
                 network_namespace=request.network_namespace,
             )
+        except NodeDraining as err:
+            # RETRIABLE by contract (ISSUE 13): the agent is draining,
+            # not broken — code 11 ("try again later"), message carries
+            # the AGENT_DRAINING marker so callers can distinguish it
+            # from a transient outage.  Deliberately not log.exception:
+            # an operator drain is not an error condition.
+            log.info("CNI Add for %s/%s refused: agent draining",
+                     namespace, name)
+            return CNIReply(result=CNI_DRAINING_CODE, error=str(err))
         except Exception as err:  # error propagates as non-zero CNI result
             log.exception("CNI Add failed for %s/%s", namespace, name)
             return CNIReply(result=1, error=str(err))

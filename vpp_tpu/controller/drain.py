@@ -1,0 +1,197 @@
+"""Graceful node drain / rejoin — the planned-operations FSM (ISSUE 13).
+
+``kubectl drain`` empties a node before maintenance; the CNI agent's
+half of that story is this coordinator.  Draining is NOT crashing:
+
+- new CNI ADDs are refused with a RETRIABLE error (CNI result code 11,
+  ``AGENT_DRAINING`` — kubelet-shaped callers back off and the
+  scheduler places the pod elsewhere); CNI DELs keep working — drain
+  exists precisely so pods can leave;
+- in-flight dispatch is QUIESCED through the datapath's existing drain
+  path (every admitted batch harvested, rings empty — the same idle
+  proof the shard supervisor's probation uses);
+- the final flight-recorder and latency telemetry are FLUSHED into the
+  drain status (the last-breath forensics an operator reads after the
+  node is gone);
+- the heartbeat flips to a ``drained`` TOMBSTONE — explicitly distinct
+  from crash-dead (a missing/stale heartbeat): the cluster scraper and
+  ``netctl cluster top`` report the node as *drained*, never as an
+  unreachable gap or a straggler (the ISSUE 13 gap-reporting contract).
+
+``undrain`` rejoins cleanly: ADDs accepted again, heartbeat state back
+to ``active``.  States: active → draining → drained → (undrain) →
+active.  The FSM is driven from the REST thread (``POST
+/contiv/v1/drain|undrain`` / ``netctl drain|undrain``) and READ from
+the heartbeat and CNI event threads — all shared state sits under one
+lock (machine-checked by the lock-discipline battery, not waived).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+log = logging.getLogger(__name__)
+
+STATE_ACTIVE = "active"
+STATE_DRAINING = "draining"
+STATE_DRAINED = "drained"
+
+# Marker carried by the retriable CNI rejection (and its message); the
+# CNI result code is 11 ("try again later" in the CNI error-code
+# convention — the same class as a momentarily unreachable agent).
+DRAINING_MARKER = "AGENT_DRAINING"
+CNI_DRAINING_CODE = 11
+
+
+class NodeDraining(RuntimeError):
+    """A new CNI ADD hit a draining/drained agent.  Retriable by
+    contract: the pod belongs on another node until ``undrain``."""
+
+    retriable = True
+
+    def __init__(self, node: str = ""):
+        super().__init__(
+            f"{DRAINING_MARKER}: agent{' ' + node if node else ''} is "
+            "draining; retry the pod on another node (undrain rejoins)")
+
+
+class DrainCoordinator:
+    """The per-agent drain FSM.
+
+    ``podmanager`` gains/loses its ADD gate here; ``datapath`` is the
+    live engine or a zero-arg callable resolving to it (the agent's
+    runner attaches after REST construction), quiesced and flushed on
+    drain.  Both are optional — a control-plane-only agent drains too.
+    """
+
+    def __init__(self, podmanager=None, datapath=None, node_name: str = "",
+                 on_state: Optional[Callable[[str], None]] = None):
+        self.podmanager = podmanager
+        self.datapath = datapath
+        self.node_name = node_name
+        # Optional notification hook (e.g. an eager heartbeat rewrite);
+        # called OUTSIDE the lock with the new state.
+        self._on_state = on_state
+        self._lock = threading.Lock()
+        self._state = STATE_ACTIVE     # guarded-by: _lock
+        self._drained_at: Optional[float] = None  # guarded-by: _lock — wall clock, rides the tombstone
+        self._last_flush: Dict[str, Any] = {}     # guarded-by: _lock — final flight/latency forensics
+        self.drains = 0                # guarded-by: _lock — lifetime counters (observability)
+        self.undrains = 0              # guarded-by: _lock
+        self.rejected_adds = 0         # guarded-by: _lock — CNI ADDs refused while draining
+
+    # ------------------------------------------------------------- queries
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def gate_add(self) -> None:
+        """Called by the CNI ADD path: refuse (retriably) while the
+        agent is anything but active."""
+        with self._lock:
+            if self._state == STATE_ACTIVE:
+                return
+            self.rejected_adds += 1
+        raise NodeDraining(self.node_name)
+
+    def status(self) -> dict:
+        with self._lock:
+            return self._status_locked()
+
+    # --------------------------------------------------------- transitions
+
+    def drain(self) -> dict:
+        """active → draining → drained.  Idempotent: draining a
+        drained agent reports the current status."""
+        with self._lock:
+            if self._state != STATE_ACTIVE:
+                return self._status_locked()
+            self._state = STATE_DRAINING
+        self._notify(STATE_DRAINING)
+        # 1. Gate new work FIRST: no ADD admitted after this point.
+        if self.podmanager is not None:
+            self.podmanager.set_draining(True, gate=self.gate_add)
+        # 2. Quiesce in-flight dispatch through the existing drain path
+        #    (poll-until-idle: admitted batches harvested, rings empty).
+        flush: Dict[str, Any] = {}
+        dp = self._resolve_datapath()
+        if dp is not None:
+            try:
+                drained_frames = dp.drain()
+                flush["quiesced_frames"] = int(drained_frames)
+            except Exception as err:  # noqa: BLE001 - a wedged shard must not block the drain
+                log.warning("drain quiesce error (continuing): %s", err)
+                flush["quiesce_error"] = str(err)
+            # 3. Flush the last-breath telemetry: the flight recorder
+            #    rings and the latency snapshot as they stood when the
+            #    node left — served from the drain status from now on.
+            try:
+                dump_flight = getattr(dp, "dump_flight", None)
+                if dump_flight is not None:
+                    flight = dump_flight(0)
+                    flush["flight"] = {
+                        "shards": len(flight.get("shards") or []),
+                        "dispatches_total": sum(
+                            int(s.get("dispatches_total", 0))
+                            for s in flight.get("shards") or []),
+                    }
+                inspect = getattr(dp, "inspect", None)
+                if inspect is not None:
+                    flush["latency"] = inspect().get("latency")
+            except Exception as err:  # noqa: BLE001 - forensics are best-effort
+                flush["flush_error"] = str(err)
+        with self._lock:
+            self._state = STATE_DRAINED
+            self._drained_at = time.time()
+            self._last_flush = flush
+            self.drains += 1
+            out = self._status_locked()
+        self._notify(STATE_DRAINED)
+        log.info("agent %s drained (%s)", self.node_name, flush)
+        return out
+
+    def undrain(self) -> dict:
+        """drained (or draining) → active: accept CNI ADDs again and
+        flip the heartbeat back.  Idempotent on an active agent."""
+        with self._lock:
+            if self._state == STATE_ACTIVE:
+                return self._status_locked()
+            self._state = STATE_ACTIVE
+            self._drained_at = None
+            self.undrains += 1
+            out = self._status_locked()
+        if self.podmanager is not None:
+            self.podmanager.set_draining(False)
+        self._notify(STATE_ACTIVE)
+        log.info("agent %s undrained; accepting pods again",
+                 self.node_name)
+        return out
+
+    # ------------------------------------------------------------ internals
+
+    def _status_locked(self) -> dict:  # holds: _lock
+        return {
+            "state": self._state,
+            "drained_at": self._drained_at,
+            "drains": self.drains,
+            "undrains": self.undrains,
+            "rejected_adds": self.rejected_adds,
+            "last_flush": dict(self._last_flush),
+        }
+
+    def _resolve_datapath(self):
+        dp = self.datapath() if callable(self.datapath) else self.datapath
+        return dp
+
+    def _notify(self, state: str) -> None:
+        if self._on_state is None:
+            return
+        try:
+            self._on_state(state)
+        except Exception:  # noqa: BLE001 - notification is best-effort
+            log.exception("drain state hook failed")

@@ -21,7 +21,12 @@ leader election, ordered log replication, follower snapshot catch-up.
 ``--join`` lists EVERY member (self included — matched via
 ``--advertise``, or inferred when exactly ONE member's port equals
 ``--port``; ambiguous inference is an error, not a guess).  ``--replica-of host:port`` instead asks a running member
-for the ensemble list and joins it (the rejoin convenience).
+for the ensemble list and joins it — and when this replica is NOT in
+that list, it GROWS the ensemble (ISSUE 13): it joins as a learner and
+requests ``AddReplica`` from the leader, which snapshot-catches it up
+before it ever counts toward quorum.  This is the one-command "add a
+store replica to a running fleet" operator path (see docs/DEVGUIDE.md
+"Planned operations").
 """
 
 from __future__ import annotations
@@ -104,12 +109,22 @@ def main(argv=None) -> int:
         threading.Thread(target=persist, name="store-persist", daemon=True).start()
 
     members = [m.strip() for m in args.join.split(",") if m.strip()]
+    grow_via = ""
     if args.replica_of and not members:
         probe = RemoteKVStore(args.replica_of, timeout=5.0)
         try:
-            members = probe.ha_status(args.replica_of)["peers"]
+            members = list(probe.ha_status(args.replica_of)["peers"])
         finally:
             probe.close()
+        advertise = args.advertise or (
+            f"{'127.0.0.1' if args.host == '0.0.0.0' else args.host}"
+            f":{args.port}")
+        if advertise not in members:
+            # Not listed: this is a GROW, not a rejoin — join as a
+            # learner and ask the leader to adopt us (below, once the
+            # server is bound and serving the replica protocol).
+            grow_via = args.replica_of
+            members = sorted(members + [advertise])
 
     replica = None
     if members:
@@ -127,6 +142,17 @@ def main(argv=None) -> int:
         replica.join(members)
         server = replica.server
         port = server.port
+        if grow_via:
+            # AddReplica blocks for the snapshot catch-up; the
+            # leader-following client re-homes off NOT_LEADER hints.
+            client = RemoteKVStore(
+                ",".join(m for m in members if m != replica.address),
+                timeout=60.0)
+            try:
+                result = client.add_replica(replica.address, timeout=60.0)
+                print(json.dumps({"add_replica": result}), flush=True)
+            finally:
+                client.close()
     else:
         server = KVStoreServer(store, host=args.host, port=args.port,
                                max_watchers=args.max_watchers)

@@ -10,6 +10,22 @@ round trip — dbwatcher's prev/new comparisons depend on it).
 Decoding resolves classes by qualified name but ONLY from ``vpp_tpu.*``
 modules: unlike pickle, a malicious store payload cannot name arbitrary
 constructors.
+
+Version-skew tolerance (ISSUE 13): during a rolling upgrade a reader
+can receive a dataclass payload written by an ADJACENT version.
+
+- Fields the reader does not know (a newer writer) are PRESERVED raw
+  on the decoded object (``_codec_unknown``) and re-emitted on encode,
+  so a decode→encode round trip through this process — e.g. the sqlite
+  mirror replaying a record, or a value read-modified-written — is
+  byte-identical: an old reader never strips a new writer's data.
+  Unknown fields are deliberately kept in their raw jsonable form (not
+  recursively decoded): their tags may name types this build does not
+  have.
+- Fields the writer did not send (an older writer) fall back to the
+  dataclass defaults; a missing field WITHOUT a default is a refused
+  decode (``ValueError`` naming the field and the skew suspicion) —
+  never a half-constructed object.
 """
 
 from __future__ import annotations
@@ -72,6 +88,12 @@ def to_jsonable(value: Any) -> Any:
             f.name: to_jsonable(getattr(value, f.name))
             for f in dataclasses.fields(value)
         }
+        # Re-emit fields a newer writer sent that this build's class
+        # does not declare (stashed raw by from_jsonable) — the
+        # unknown-field round-trip half of the skew contract.
+        unknown = getattr(value, "_codec_unknown", None)
+        if unknown:
+            fields.update(unknown)
         return {_TAG_DC: _qualname(type(value)), "fields": fields}
     if isinstance(value, tuple):
         return {_TAG_TUPLE: [to_jsonable(v) for v in value]}
@@ -106,8 +128,27 @@ def from_jsonable(data: Any) -> Any:
             cls = _resolve(data[_TAG_DC])
             if not dataclasses.is_dataclass(cls):
                 raise ValueError(f"{data[_TAG_DC]!r} is not a dataclass")
-            kwargs = {k: from_jsonable(v) for k, v in data["fields"].items()}
-            return cls(**kwargs)
+            known = {f.name for f in dataclasses.fields(cls)}
+            kwargs = {k: from_jsonable(v) for k, v in data["fields"].items()
+                      if k in known}
+            # Unknown fields stay RAW (their tags may name types this
+            # version lacks) and ride the instance for re-encode.
+            unknown = {k: v for k, v in data["fields"].items()
+                       if k not in known}
+            try:
+                obj = cls(**kwargs)
+            except TypeError as err:
+                # An older writer omitted a field this version requires
+                # without a default: refuse cleanly rather than invent
+                # a value (the skew floor, not a corrupt decode).
+                raise ValueError(
+                    f"cannot decode {data[_TAG_DC]!r}: {err} — likely a "
+                    "version-skewed writer omitting a newly-required "
+                    "field (new fields need defaults)") from err
+            if unknown:
+                # object.__setattr__: the model dataclasses are frozen.
+                object.__setattr__(obj, "_codec_unknown", unknown)
+            return obj
         if _TAG_ENUM in data:
             cls = _resolve(data[_TAG_ENUM])
             if not issubclass(cls, enum.Enum):

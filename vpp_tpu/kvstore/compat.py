@@ -1,0 +1,146 @@
+"""Protocol/schema versioning for rolling-upgrade skew (ISSUE 13).
+
+A real fleet is never upgraded atomically: during a rolling agent (or
+store-replica) upgrade, *adjacent versions coexist* — an old agent
+heartbeats into a new store, a new leader replicates to an old
+follower, a new agent reads a mirror file an old build wrote.  The
+reference rides this out because etcd values are protobuf (unknown
+fields round-trip) and the KSR/Bolt records carry schema lineage; this
+module is that discipline for the framework's own wire and persistence
+formats:
+
+- ``PROTOCOL_VERSION`` is stamped (``pv``) on every heartbeat record,
+  every store RPC request (client ops and the replica-to-replica
+  Replicate/InstallSnapshot/HaStatus protocol), and — as
+  ``MIRROR_FORMAT_VERSION`` — on every persisted sqlite mirror file.
+- Decode is SKEW-TOLERANT inside the supported window: a reader never
+  drops fields it does not understand (the codec preserves unknown
+  dataclass fields and re-emits them byte-identically — see
+  :mod:`.codec`), and never invents values for fields an older writer
+  did not send (new fields need defaults; a missing required field is
+  a refused decode, not a corrupt object).
+- Below ``MIN_PROTOCOL_VERSION`` the peer is REFUSED cleanly — an
+  explicit :class:`IncompatibleVersion` / ``INCOMPATIBLE_VERSION``
+  gRPC rejection that names both versions — never a silent best-effort
+  decode that corrupts state.
+- ``VPP_TPU_COMPAT_SKEW`` (an integer offset, e.g. ``-1``) makes this
+  process stamp itself as an emulated previous (or next) version, so
+  tests and the soak's rolling-upgrade drill can run a
+  "previous-version" peer against a current one without maintaining
+  two checkouts.  A positive skew additionally writes an
+  ``x_compat_probe`` field no current reader knows — the
+  unknown-field-preservation property is then exercised end to end.
+
+Version lineage (bump PROTOCOL_VERSION when the wire schema grows a
+field peers must *tolerate*; bump MIN_PROTOCOL_VERSION only when a
+version can no longer be decoded safely):
+
+- 1: pre-HA single-server wire (PR 0); no version stamp.
+- 2: HA replica protocol (PR 1) — Replicate/InstallSnapshot/HaStatus.
+- 3: operational-resilience wire (ISSUE 13) — membership RPCs,
+  drained heartbeat states, snapshot-carried peer lists.
+"""
+
+from __future__ import annotations
+
+import os
+
+PROTOCOL_VERSION = 3
+MIN_PROTOCOL_VERSION = 2
+
+# The sqlite mirror's on-disk lineage (1 = un-versioned legacy files,
+# still readable; 2 = version-stamped).  A file outside the supported
+# window reads as "no mirror" (full remote resync), never as a crash
+# and never as a silently mis-decoded cache.
+MIRROR_FORMAT_VERSION = 2
+MIN_MIRROR_FORMAT = 1
+
+SKEW_ENV = "VPP_TPU_COMPAT_SKEW"
+
+# gRPC rejection details prefix for a below-floor peer (FAILED_
+# PRECONDITION, like NOT_LEADER — the client classifies on the prefix).
+INCOMPATIBLE_PREFIX = "INCOMPATIBLE_VERSION "
+
+
+class IncompatibleVersion(Exception):
+    """The peer's stamped protocol version is below the supported
+    floor: the op was refused BEFORE any state changed."""
+
+    def __init__(self, got: int, floor: int = MIN_PROTOCOL_VERSION,
+                 context: str = ""):
+        super().__init__(
+            f"protocol version {got} below supported floor {floor}"
+            + (f" ({context})" if context else ""))
+        self.got = got
+        self.floor = floor
+
+
+def skew() -> int:
+    """The emulated version offset (0 = current build).  Read per call:
+    tests flip it with monkeypatch.setenv, subprocess drills inherit it
+    through the environment."""
+    raw = os.environ.get(SKEW_ENV, "")
+    try:
+        return int(raw) if raw else 0
+    except ValueError:
+        return 0
+
+
+def effective_version() -> int:
+    """The protocol version this process stamps on what it writes —
+    PROTOCOL_VERSION shifted by the emulation knob, floored at 1 (there
+    is no version 0 wire to emulate)."""
+    return max(1, PROTOCOL_VERSION + skew())
+
+
+def mirror_format_version() -> int:
+    """The format version stamped into sqlite mirror files (skewed
+    alongside the wire version so an emulated-old agent also writes an
+    old-format mirror)."""
+    return max(1, MIRROR_FORMAT_VERSION + skew())
+
+
+def stamp(msg: dict) -> dict:
+    """Stamp ``pv`` onto a wire message (mutates and returns it).
+    Under a positive (future-version) skew, also plants a field no
+    current reader knows — the probe that proves readers preserve,
+    never drop, unknown fields."""
+    msg["pv"] = effective_version()
+    if skew() > 0:
+        msg["x_compat_probe"] = {"emulated_pv": msg["pv"]}
+    return msg
+
+
+def check(msg: dict, context: str = "") -> int:
+    """Validate a received message's version stamp; returns the peer's
+    version (0 = unstamped legacy/in-process, accepted).  Raises
+    :class:`IncompatibleVersion` below the floor — the refuse-cleanly
+    contract: the caller must reject the op, not decode around it."""
+    got = msg.get("pv")
+    if got is None:
+        return 0
+    got = int(got)
+    if got < MIN_PROTOCOL_VERSION:
+        raise IncompatibleVersion(got, MIN_PROTOCOL_VERSION, context)
+    return got
+
+
+def incompatible_details(err: IncompatibleVersion) -> str:
+    """The gRPC abort details for a refused peer."""
+    return f"{INCOMPATIBLE_PREFIX}got={err.got} min={err.floor}"
+
+
+def parse_incompatible(details: str):
+    """``(got, floor)`` from a rejection's details, or None."""
+    if not details.startswith(INCOMPATIBLE_PREFIX):
+        return None
+    out = {}
+    for part in details[len(INCOMPATIBLE_PREFIX):].split():
+        k, _, v = part.partition("=")
+        try:
+            out[k] = int(v)
+        except ValueError:
+            continue
+    if "got" not in out or "min" not in out:
+        return None
+    return out["got"], out["min"]

@@ -48,6 +48,7 @@ class PeerStatus:
     last_term: int       # term of the last log entry
     revision: int        # store revision (tie-breaker rank component)
     leader: str = ""     # the leader this replica currently follows
+    pv: int = 0          # stamped protocol version (0 = pre-versioned)
 
     def rank(self) -> Tuple[int, int, int, int]:
         """Election rank: log position first (committed entries must
@@ -63,6 +64,7 @@ class PeerStatus:
             role=status["role"], term=status["term"],
             last_index=status["last_index"], last_term=status["last_term"],
             revision=status["revision"], leader=status.get("leader", ""),
+            pv=int(status.get("pv", 0)),
         )
 
 

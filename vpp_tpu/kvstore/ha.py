@@ -28,6 +28,27 @@ Leader reads are lease-bounded: a partitioned leader stops serving
 after ``lease_timeout`` without follower quorum (it steps down), so
 stale reads are bounded by the lease — the same trade clustered etcd
 makes for lease-based (non-quorum) reads.
+
+Live membership change (ISSUE 13, etcd's member add/remove analog):
+the ensemble can grow and shrink at runtime, one server at a time —
+
+- ``add_replica``: the joiner enters as a non-voting LEARNER; the
+  leader snapshot-catches it up and only THEN commits a ``member-add``
+  log entry (quorum over the old voters — a not-yet-caught-up replica
+  can never ack toward quorum, so a membership change can never seat a
+  voter missing committed writes);
+- ``remove_replica``: a ``member-remove`` entry; removing the sitting
+  leader first pushes every survivor fully up to date (zero lost
+  committed writes), commits the removal, then steps down so the
+  survivors elect among themselves (orderly handoff);
+- membership rides the REPLICATED LOG (snapshot installs carry the
+  voting peer list), so every replica converges on the same member set
+  the same way it converges on store contents; one change in flight at
+  a time (``MembershipChangeInProgress`` otherwise).
+
+Every replica-to-replica message is version-stamped and floor-checked
+(:mod:`.compat`): a below-floor peer is refused with an explicit
+``incompatible`` reply, never fed entries it may mis-decode.
 """
 
 from __future__ import annotations
@@ -37,10 +58,12 @@ import logging
 import threading
 import time
 from concurrent import futures as _futures
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Set
 
 import grpc
 
+from . import compat
+from .compat import IncompatibleVersion
 from .election import ElectionConfig, ElectionState, PeerStatus, Role
 from .remote import (
     NO_QUORUM_PREFIX,
@@ -72,6 +95,18 @@ class NotLeader(Exception):
 
 class NoQuorum(Exception):
     """A write could not be acknowledged by a replica majority."""
+
+
+class MembershipChangeInProgress(Exception):
+    """A second add/remove was requested while one is still running —
+    the one-server-at-a-time rule (joint consensus is out of scope;
+    single-server changes are safe only serially)."""
+
+
+class CatchupTimeout(Exception):
+    """A joining replica could not be caught up within the deadline;
+    it was dropped from the learner set and never counted toward
+    quorum — the ensemble is unchanged."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -141,8 +176,8 @@ class HAReplica:
         # the tick loop) right after an election, and one failed round
         # must not surface as NO_QUORUM to the caller.
         self._commit_timeout = 2.0 * lease_timeout
-        self.peers: List[str] = []
-        self.replica_id = 0
+        self.peers: List[str] = []  # guarded-by: _state_lock — VOTING members (live membership mutates it)
+        self.replica_id = 0         # guarded-by: _state_lock — position in sorted(peers)
         self._el: Optional[ElectionState] = None
         self._state_lock = threading.RLock()
         self._log: List[LogEntry] = []     # guarded-by: _state_lock
@@ -165,7 +200,15 @@ class HAReplica:
         # nothing about, and a matching (0, 0) cursor would silently
         # merge diverged stores.
         self._virgin = True    # guarded-by: _state_lock
-        self._followers: Dict[str, _FollowerState] = {}
+        # Live membership (ISSUE 13): ``peers`` holds VOTING members
+        # only; a joining replica sits in ``_learners`` (pushed like a
+        # follower, excluded from every quorum count) until its
+        # snapshot catch-up completes and the member-add entry commits.
+        self._learners: Set[str] = set()       # guarded-by: _state_lock
+        self._membership_inflight = ""         # guarded-by: _state_lock — one change at a time
+        self._removed = False                  # guarded-by: _state_lock — this replica left the ensemble
+        self.membership_events: List[dict] = []  # guarded-by: _state_lock — applied changes (drill evidence)
+        self._followers: Dict[str, _FollowerState] = {}  # guarded-by: _state_lock — map mutations (entry FIELDS ride each entry's own lock)
         # Peer channel cache: dialed/evicted from the tick loop, pool
         # pushes, AND client commit threads concurrently — its own lock
         # (NOT _state_lock: _peer_call blocks on the network and must
@@ -199,10 +242,11 @@ class HAReplica:
         list — identical on every replica without coordination."""
         if self.address not in peers:
             raise ValueError(f"{self.address} not in ensemble {peers}")
-        self.peers = sorted(peers)
-        self.replica_id = self.peers.index(self.address)
-        self._el = ElectionState(self.replica_id, self._config)
-        self._el.touch_lease()
+        with self._state_lock:
+            self.peers = sorted(peers)
+            self.replica_id = self.peers.index(self.address)
+            self._el = ElectionState(self.replica_id, self._config)
+            self._el.touch_lease()
         self._pool = _futures.ThreadPoolExecutor(
             max_workers=max(2, 2 * len(self.peers)),
             thread_name_prefix=f"ha-{self.replica_id}",
@@ -261,6 +305,10 @@ class HAReplica:
                 "revision": self.store.revision,
                 "leader": (el.leader if el else ""),
                 "peers": list(self.peers),
+                "learners": sorted(self._learners),
+                "membership_inflight": self._membership_inflight,
+                "removed": self._removed,
+                "pv": compat.effective_version(),
             }
 
     def _status_as_peer(self) -> PeerStatus:
@@ -293,16 +341,31 @@ class HAReplica:
                 raise NotLeader(self._el.leader if self._el else "")
             entry = LogEntry(index=self._last_index + 1, term=self._el.term,
                              op=op, args=args)
+            voters_before = list(self.peers)  # pre-apply voting set
             result = self._apply_op(op, args)
             self._append(entry)
-        others = [p for p in self.peers if p != self.address]
-        needed = len(self.peers) // 2 + 1
+        # Quorum base for THIS entry (ISSUE 13): a membership entry is
+        # never helped across the line by the member it is ABOUT —
+        # member-add is counted over the OLD voters (the caught-up
+        # joiner's ack must not vote its own membership in), and
+        # member-remove over the SURVIVORS (the departing member's own
+        # copy must not vote its removal out — leader self-removal
+        # included, so a removal can only commit held by a true
+        # survivor majority).  The snapshot also keeps the base stable
+        # if peers mutate while this loop runs.
+        if op == "member-remove":
+            base = [p for p in voters_before if p != args["addr"]]
+        else:
+            base = voters_before
+        self_votes = self.address in base
+        others = [p for p in base if p != self.address]
+        needed = len(base) // 2 + 1
         deadline = time.monotonic() + self._commit_timeout
         while True:
             # A follower acks by its match cursor reaching the entry —
             # however it got there (our push or a concurrent tick push).
             followers = self._followers
-            acked = 1 + sum(
+            acked = (1 if self_votes else 0) + sum(
                 1 for addr in others
                 if (fs := followers.get(addr)) is not None
                 and fs.match >= entry.index
@@ -311,7 +374,7 @@ class HAReplica:
                 break
             remaining = deadline - time.monotonic()
             if remaining <= 0:
-                raise NoQuorum(f"{acked}/{len(self.peers)} acks for {op}")
+                raise NoQuorum(f"{acked}/{len(base)} acks for {op}")
             lagging = [
                 addr for addr in others
                 if (fs := followers.get(addr)) is None
@@ -338,7 +401,43 @@ class HAReplica:
             return s.put_if_not_exists(args["key"], args["value"])
         if op == "compare_and_delete":
             return s.compare_and_delete(args["key"], args["expected"])
+        if op in ("member-add", "member-remove"):
+            return self._apply_membership(op, args)
         raise ValueError(f"unknown replicated op {op!r}")
+
+    def _apply_membership(self, op: str,
+                          args: Dict[str, Any]) -> List[str]:  # holds: _state_lock
+        """Apply a membership log entry.  Callers hold ``_state_lock``
+        (commit() and handle_replicate() both apply under it) — the
+        voting set, replica id and removal flag change as ONE unit.
+        Membership rides the replicated log, so every replica applies
+        the same changes in the same order — member sets converge
+        exactly like store contents."""
+        addr = args["addr"]
+        if op == "member-add":
+            if addr not in self.peers:
+                self.peers = sorted(self.peers + [addr])
+            self._learners.discard(addr)
+        else:
+            self.peers = [p for p in self.peers if p != addr]
+            self._learners.discard(addr)
+            self._followers.pop(addr, None)
+            if addr == self.address:
+                # This replica left the ensemble: go dormant (no
+                # campaigns, client ops keep getting NOT_LEADER) — the
+                # operator stops the process at leisure.
+                self._removed = True
+        if self.address in self.peers:
+            self.replica_id = self.peers.index(self.address)
+            if self._el is not None:
+                self._el.replica_id = self.replica_id
+        self.membership_events.append({
+            "op": op, "addr": addr, "peers": list(self.peers),
+            "at": time.time(),
+        })
+        log.info("%s applied %s %s -> peers=%s",
+                 self.address, op, addr, self.peers)
+        return list(self.peers)
 
     def _append(self, entry: LogEntry) -> None:  # holds: _state_lock
         self._log.append(entry)
@@ -426,14 +525,24 @@ class HAReplica:
                                  else self._log[cursor - self._base_index - 1].term)
             if entries is None:
                 return self._install_snapshot(addr, fs, term)
-            resp = self._peer_call(addr, "Replicate", {
+            resp = self._peer_call(addr, "Replicate", compat.stamp({
                 "term": term,
                 "leader": self.address,
                 "prev_index": cursor,
                 "prev_term": prev_term,
                 "entries": entries,
-            })
+            }))
             if resp is None:
+                return False
+            if resp.get("incompatible"):
+                # The follower refused our protocol version (or we
+                # refused its floor): no entries were applied; shipping
+                # a snapshot would be refused identically.  Loud — this
+                # is an operator problem (finish the rolling upgrade),
+                # not a transient.
+                log.error("follower %s refused replication: its floor "
+                          "is v%s, we stamped v%s", addr,
+                          resp.get("min"), resp.get("got"))
                 return False
             if resp["term"] > term:
                 with self._state_lock:
@@ -473,25 +582,229 @@ class HAReplica:
 
         with self._state_lock:
             snap, rev = self.store.snapshot_with_revision([""])
-            payload = {
+            payload = compat.stamp({
                 "term": term,
                 "leader": self.address,
                 "snapshot": snap,
                 "revision": rev,
                 "last_index": self._last_index,
                 "last_term": self._last_term,
-            }
+                # Config-in-snapshot: membership entries compacted out
+                # of the log still reach catching-up replicas.
+                "peers": list(self.peers),
+            })
         resp = self._peer_call(addr, "InstallSnapshot", payload,
                                timeout=4 * self._replicate_timeout)
         if resp is None or not resp.get("ok"):
+            if resp is not None and resp.get("incompatible"):
+                log.error("follower %s refused snapshot install: its "
+                          "floor is v%s, we stamped v%s", addr,
+                          resp.get("min"), resp.get("got"))
             return False
         fs.next = fs.match = payload["last_index"]
         fs.acked_at = time.monotonic()
         return True
 
+    # ------------------------------------------------- membership change
+
+    def _begin_membership(self, addr: str) -> None:  # holds: _state_lock
+        if self._membership_inflight:
+            raise MembershipChangeInProgress(
+                f"{self._membership_inflight} change still in flight "
+                "(one server at a time)")
+        self._membership_inflight = addr
+
+    def _end_membership(self) -> None:
+        with self._state_lock:
+            self._membership_inflight = ""
+
+    def add_replica(self, addr: str, timeout: float = 60.0) -> dict:
+        """Grow the ensemble by one replica (which must already be
+        bound, joined, and serving the replica protocol on ``addr``).
+
+        Protocol: the joiner enters as a non-voting LEARNER — it is
+        pushed (snapshot install + log entries) like any follower but
+        excluded from every quorum count.  Only once its confirmed
+        replication cursor reaches the leader's CURRENT log tail is the
+        ``member-add`` entry committed (quorum over the OLD voters), at
+        which point it becomes a voter everywhere the entry applies.
+        A replica that cannot catch up within ``timeout`` is dropped
+        and the ensemble is unchanged (:class:`CatchupTimeout`)."""
+        with self._state_lock:
+            if self._el is None or self._el.role is not Role.LEADER:
+                raise NotLeader(self._el.leader if self._el else "")
+            if addr in self.peers:
+                return {"already_member": True, "peers": list(self.peers)}
+            self._begin_membership(addr)
+            self._learners.add(addr)
+            fs = self._followers.get(addr)
+            if fs is None:
+                fs = self._followers[addr] = _FollowerState(
+                    next_index=self._last_index)
+        try:
+            deadline = time.monotonic() + timeout
+            while True:
+                with self._state_lock:
+                    if self._el.role is not Role.LEADER:
+                        raise NotLeader(self._el.leader)
+                    target = self._last_index
+                if fs.match >= target:
+                    # Caught up THROUGH the tail sampled this round —
+                    # the log may grow again immediately (live write
+                    # traffic), but so may any voter's lag; from here
+                    # the joiner follows like everyone else.
+                    break
+                if time.monotonic() >= deadline:
+                    raise CatchupTimeout(
+                        f"{addr} reached index {fs.match}/{target} "
+                        f"within {timeout:.1f}s; ensemble unchanged")
+                self._push(addr)
+                time.sleep(min(0.02, self._config.heartbeat_interval))
+            caught_up_index = fs.match
+            # The membership entry's quorum is counted over the OLD
+            # voters (commit() snapshots the pre-apply voting set and
+            # excludes the member the entry is about), so the literal
+            # below is enforced, not aspirational: the joiner's own
+            # ack can never vote its membership in.
+            peers = self.commit("member-add", {"addr": addr})
+            return {
+                "added": addr,
+                "peers": peers,
+                "caught_up_index": caught_up_index,
+                "member_index": self._last_index,
+                "learner_votes_counted": False,
+            }
+        finally:
+            with self._state_lock:
+                if addr in self._learners:
+                    # The member-add never APPLIED (catch-up timeout, or
+                    # deposed before commit's local apply): roll the
+                    # learner back so no phantom learner lingers in the
+                    # follower map / status forever.  Once the entry
+                    # applied, _apply_membership already promoted the
+                    # learner — even a NoQuorum raise after that point
+                    # is Raft-indeterminate (the entry usually still
+                    # commits on later ticks) and must NOT be rolled
+                    # back here.
+                    self._learners.discard(addr)
+                    self._followers.pop(addr, None)
+            self._end_membership()
+
+    def remove_replica(self, addr: str, timeout: float = 60.0) -> dict:
+        """Shrink the ensemble by one replica via a ``member-remove``
+        log entry.  Removing the sitting leader (``addr`` == our own
+        address) is the ORDERLY-HANDOFF path: every survivor is pushed
+        fully up to date first (zero lost committed writes — the next
+        leader provably holds everything), the removal commits, the
+        entry is pushed to ALL survivors, and only then does the leader
+        step down so the survivors elect among themselves."""
+        with self._state_lock:
+            if self._el is None or self._el.role is not Role.LEADER:
+                raise NotLeader(self._el.leader if self._el else "")
+            if addr not in self.peers:
+                return {"not_member": True, "peers": list(self.peers)}
+            if len(self.peers) <= 2:
+                # A 2→1 shrink leaves a single replica that can never
+                # again form a majority with anyone — refuse (etcd
+                # refuses the same way for quorum loss).
+                raise ValueError(
+                    f"refusing to shrink {len(self.peers)} -> "
+                    f"{len(self.peers) - 1}: the survivor set could "
+                    "not form a quorum")
+            self._begin_membership(addr)
+        self_removal = addr == self.address
+        try:
+            survivors = [p for p in self.peers
+                         if p not in (addr, self.address)]
+            with self._state_lock:
+                fs_removed = self._followers.get(addr)
+            if self_removal:
+                # Handoff precondition: at least the whole survivor set
+                # pushed to our tail, so no committed write exists only
+                # on the departing leader.
+                self._sync_survivors(survivors, timeout / 2)
+            peers = self.commit("member-remove", {"addr": addr})
+            if not self_removal and fs_removed is not None:
+                # Farewell push: the local apply above dropped the
+                # removed replica from peers AND its follower state, so
+                # the regular push fan-out will never tell it it left.
+                # Re-insert the state transiently and ship the entry —
+                # else the corpse keeps campaigning on a stale member
+                # list forever.  Best effort: a dead replica that
+                # rejoins later learns its removal from any survivor's
+                # snapshot/entries.
+                with self._state_lock:
+                    self._followers.setdefault(addr, fs_removed)
+                try:
+                    for _ in range(3):
+                        if self._push(addr):
+                            break
+                finally:
+                    with self._state_lock:
+                        self._followers.pop(addr, None)
+            if self_removal:
+                # The removal entry itself must reach every survivor
+                # (not just a quorum) before the handoff: a survivor
+                # elected without it would still count the corpse as a
+                # voter.  Best effort within the deadline — quorum
+                # already holds it, so a straggler catches up later.
+                self._sync_survivors(survivors, timeout / 2,
+                                     required=False)
+                with self._state_lock:
+                    self._el.step_down()
+                log.info("%s removed itself; stepped down for the "
+                         "survivor election", self.address)
+            return {
+                "removed": addr,
+                "peers": peers,
+                "handoff": self_removal,
+                "remove_index": self._last_index,
+            }
+        finally:
+            self._end_membership()
+
+    def _sync_survivors(self, survivors: List[str], timeout: float,
+                        required: bool = True) -> None:
+        """Push until every survivor's confirmed cursor reaches our
+        CURRENT tail; raise (``required``) or warn on the deadline."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._state_lock:
+                target = self._last_index
+            followers = self._followers
+            lagging = [
+                p for p in survivors
+                if (fs := followers.get(p)) is None or fs.match < target
+            ]
+            if not lagging:
+                return
+            if time.monotonic() >= deadline:
+                if required:
+                    raise NoQuorum(
+                        f"survivors {lagging} not caught up to index "
+                        f"{target}; refusing the leader handoff")
+                log.warning("handoff proceeding with lagging survivors "
+                            "%s (quorum holds the entry)", lagging)
+                return
+            _futures.wait(
+                [self._pool.submit(self._push, p) for p in lagging],
+                timeout=4 * self._replicate_timeout,
+            )
+            time.sleep(min(0.02, self._config.heartbeat_interval))
+
     # ----------------------------------------------------- follower handlers
 
     def handle_replicate(self, request: dict) -> dict:
+        try:
+            compat.check(request, "replicate")
+        except IncompatibleVersion as err:
+            # Refuse cleanly: entries from a below-floor leader must
+            # never be applied on a best-effort decode.  The reply
+            # names both versions so the leader logs WHY.
+            return {"ok": False, "incompatible": True,
+                    "got": err.got, "min": err.floor,
+                    "term": self._el.term if self._el else 0,
+                    "last_index": self._last_index}
         with self._state_lock:
             if self._el is None or not self._el.observe_heartbeat(
                     request["term"], request["leader"]):
@@ -513,6 +826,12 @@ class HAReplica:
                     "revision": self.store.revision}
 
     def handle_install_snapshot(self, request: dict) -> dict:
+        try:
+            compat.check(request, "install-snapshot")
+        except IncompatibleVersion as err:
+            return {"ok": False, "incompatible": True,
+                    "got": err.got, "min": err.floor,
+                    "term": self._el.term if self._el else 0}
         with self._state_lock:
             if self._el is None or not self._el.observe_heartbeat(
                     request["term"], request["leader"]):
@@ -523,6 +842,18 @@ class HAReplica:
             self._base_term = self._last_term = request["last_term"]
             self._rank_index, self._rank_term = self._last_index, self._last_term
             self._virgin = False
+            # Snapshots carry the voting member set (Raft's config-in-
+            # snapshot): a membership entry compacted out of the log
+            # must still reach a catching-up replica.  A learner not in
+            # the list stays a learner — _removed is set ONLY by a
+            # member-remove entry naming this replica, never by a list
+            # it simply is not in yet.
+            peers = request.get("peers")
+            if peers:
+                self.peers = sorted(str(p) for p in peers)
+                if self.address in self.peers:
+                    self.replica_id = self.peers.index(self.address)
+                    self._el.replica_id = self.replica_id
             return {"ok": True, "term": self._el.term,
                     "last_index": self._last_index,
                     "revision": self.store.revision}
@@ -540,8 +871,14 @@ class HAReplica:
     def _tick(self) -> None:
         with self._state_lock:
             role = self._el.role
+            removed = self._removed
         if role is Role.LEADER:
+            # A removed leader keeps leading until remove_replica's
+            # orderly handoff steps it down explicitly — stopping here
+            # would strand the removal commit mid-replication.
             self._lead()
+        elif removed:
+            return  # dormant: a removed replica never campaigns
         elif role is Role.FOLLOWER:
             if self._el.lease_expired():
                 with self._state_lock:
@@ -551,19 +888,27 @@ class HAReplica:
             self._campaign()
 
     def _lead(self) -> None:
-        others = [p for p in self.peers if p != self.address]
+        with self._state_lock:
+            voters = [p for p in self.peers if p != self.address]
+            learners = sorted(self._learners)
+        others = voters + [a for a in learners if a not in voters]
         if others:
             # Bounded wait: a straggler (hung snapshot install, half-dead
             # peer) keeps running on its pool thread, but heartbeats to
-            # the healthy followers must go out next tick regardless.
+            # the healthy followers — and catch-up pushes to learners —
+            # must go out next tick regardless.
             _futures.wait(
                 [self._pool.submit(self._push, p) for p in others],
                 timeout=self._config.heartbeat_interval,
             )
         now = time.monotonic()
+        # Lease freshness counts VOTERS only: a freshly-acking learner
+        # must not keep a leader alive that lost its voting majority
+        # (the not-yet-a-member-can't-vote invariant, lease edition).
         fresh = sum(
-            1 for fs in self._followers.values()
-            if now - fs.acked_at < self._config.lease_timeout
+            1 for addr, fs in self._followers.items()
+            if addr in voters
+            and now - fs.acked_at < self._config.lease_timeout
         )
         with self._state_lock:
             if (1 + fresh) * 2 > len(self.peers):
@@ -579,7 +924,8 @@ class HAReplica:
         others = [p for p in self.peers if p != self.address]
         statuses: List[Optional[PeerStatus]] = []
         for resp in self._pool.map(
-                lambda a: self._peer_call(a, "HaStatus", {}), others):
+                lambda a: self._peer_call(a, "HaStatus", compat.stamp({})),
+                others):
             statuses.append(None if resp is None else PeerStatus.from_dict(resp))
         with self._state_lock:
             role = self._el.decide(self._status_as_peer(), statuses,
@@ -628,6 +974,11 @@ class ReplicaServer(KVStoreServer):
     (leader-gated, writes through the replication commit) plus the
     replica-to-replica protocol (HaStatus / Replicate / InstallSnapshot)
     and the follower-readable LocalDump."""
+
+    # The replica protocol answers version skew ITSELF with typed
+    # `incompatible` replies (see handle_replicate) — the generic
+    # aborting gate would make that path unreachable over the wire.
+    SELF_VERSIONED = frozenset({"Replicate", "InstallSnapshot"})
 
     def __init__(self, replica: HAReplica, host: str = "127.0.0.1",
                  port: int = 0, max_watchers: int = 64):
@@ -686,6 +1037,35 @@ class ReplicaServer(KVStoreServer):
             context, "compare_and_delete",
             {"key": request["key"], "expected": request["expected"]})}
 
+    # Live membership change (ISSUE 13) — leader-gated like writes.
+    def _membership(self, context, fn: Callable, addr: str,
+                    timeout: float) -> dict:
+        try:
+            return fn(addr, timeout=timeout)
+        except NotLeader as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          NOT_LEADER_PREFIX + e.leader)
+        except MembershipChangeInProgress as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          f"MEMBERSHIP_BUSY {e}")
+        except CatchupTimeout as e:
+            context.abort(grpc.StatusCode.ABORTED, f"CATCHUP_TIMEOUT {e}")
+        except (NoQuorum, ValueError) as e:
+            context.abort(grpc.StatusCode.ABORTED, str(e))
+
+    def _add_replica(self, request: dict, context=None) -> dict:
+        # The catch-up is bounded WELL inside the client's RPC deadline
+        # so a timeout surfaces as a typed CATCHUP_TIMEOUT, not a
+        # DEADLINE_EXCEEDED whose server half keeps running.
+        return self._membership(context, self.replica.add_replica,
+                                request["addr"],
+                                float(request.get("timeout", 45.0)))
+
+    def _remove_replica(self, request: dict, context=None) -> dict:
+        return self._membership(context, self.replica.remove_replica,
+                                request["addr"],
+                                float(request.get("timeout", 45.0)))
+
     # Replica-to-replica protocol + follower-readable introspection.
     def _ha_status(self, request: dict, context=None) -> dict:
         return self.replica.status()
@@ -711,6 +1091,8 @@ class ReplicaServer(KVStoreServer):
             "Replicate": self._replicate,
             "InstallSnapshot": self._install_snapshot,
             "LocalDump": self._local_dump,
+            "AddReplica": self._add_replica,
+            "RemoveReplica": self._remove_replica,
         })
         return handlers
 
@@ -775,6 +1157,39 @@ class HAEnsemble:
         replica.join(list(self.addresses))
         self.replicas[idx] = replica
         return replica
+
+    # ------------------------------------------- live membership (ISSUE 13)
+
+    def grow(self, timeout: float = 30.0) -> HAReplica:
+        """Add one BRAND-NEW empty replica to the running ensemble:
+        bind it, join it (peers = current members + itself — it idles
+        as a deferring candidate until the leader adopts it), then run
+        the leader's learner catch-up + member-add protocol."""
+        replica = HAReplica(host=self._host,
+                            heartbeat_interval=self.heartbeat_interval,
+                            lease_timeout=self.lease_timeout,
+                            **self._replica_kw)
+        addr = replica.bind()
+        replica.join(sorted(self.addresses + [addr]))
+        leader = self.wait_leader()
+        leader.add_replica(addr, timeout=timeout)
+        self.replicas.append(replica)
+        self.addresses.append(addr)
+        return replica
+
+    def shrink(self, address: Optional[str] = None,
+               timeout: float = 30.0) -> HAReplica:
+        """Remove one member (default: the sitting LEADER — the orderly
+        handoff path) and kill its process; returns the corpse."""
+        leader = self.wait_leader()
+        address = address or leader.address
+        leader.remove_replica(address, timeout=timeout)
+        idx = self.addresses.index(address)
+        corpse = self.replicas[idx]
+        corpse.kill()
+        del self.replicas[idx]
+        del self.addresses[idx]
+        return corpse
 
     def stop(self) -> None:
         for r in self.replicas:

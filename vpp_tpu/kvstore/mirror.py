@@ -25,7 +25,7 @@ import sqlite3
 import threading
 from typing import Any, Dict, Optional, Tuple
 
-from . import codec
+from . import codec, compat
 from .store import WatchEvent
 
 log = logging.getLogger(__name__)
@@ -142,6 +142,12 @@ class LocalMirror:
             "INSERT OR REPLACE INTO meta (name, value) VALUES ('revision', ?)",
             (revision,),
         )
+        # Schema lineage stamp (ISSUE 13): load() refuses files outside
+        # the supported window instead of mis-decoding them.
+        self._conn.execute(
+            "INSERT OR REPLACE INTO meta (name, value) VALUES ('format', ?)",
+            (compat.mirror_format_version(),),
+        )
         self._conn.commit()
 
     def apply_event(self, ev: WatchEvent) -> None:
@@ -174,6 +180,11 @@ class LocalMirror:
         the agent as mirror-less and resyncs from the remote store)."""
         with self._lock:
             try:
+                fmt = self._conn.execute(
+                    "SELECT value FROM meta WHERE name = 'format'"
+                ).fetchone()
+                # Missing stamp = legacy format 1 (pre-ISSUE-13 files).
+                fmt_version = int(fmt[0]) if fmt is not None else 1
                 rev = self._conn.execute(
                     "SELECT value FROM meta WHERE name = 'revision'"
                 ).fetchone()
@@ -185,6 +196,20 @@ class LocalMirror:
             except (sqlite3.Error, TypeError, ValueError) as err:
                 self._reset_locked(err)
                 return None
+        if not (compat.MIN_MIRROR_FORMAT <= fmt_version
+                <= compat.MIRROR_FORMAT_VERSION):
+            # Outside the supported window (a downgrade reading a newer
+            # file, or a long-dead lineage): REFUSE cleanly — report
+            # "no mirror" so the caller resyncs from the remote store.
+            # The file itself is left alone; the next save_snapshot
+            # rewrites it wholesale in this build's format.
+            log.warning(
+                "mirror %s format v%d outside supported window v%d..v%d: "
+                "ignoring mirror (next resync rewrites it)",
+                self.path, fmt_version,
+                compat.MIN_MIRROR_FORMAT, compat.MIRROR_FORMAT_VERSION,
+            )
+            return None
         try:
             return {k: codec.decode(v) for k, v in rows}, revision
         except Exception as err:  # noqa: BLE001 - any decode failure = corrupt

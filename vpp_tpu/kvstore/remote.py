@@ -34,7 +34,8 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 import grpc
 
-from . import codec
+from . import codec, compat
+from .compat import IncompatibleVersion
 from .store import KVStore, WatchEvent, Watcher
 
 log = logging.getLogger(__name__)
@@ -114,6 +115,17 @@ def no_quorum(err: Exception) -> bool:
             and status[1].startswith(NO_QUORUM_PREFIX))
 
 
+def incompatible_version(err: Exception) -> Optional[tuple]:
+    """``(got, floor)`` when ``err`` is a server's below-floor version
+    refusal (ISSUE 13), else None.  Shares FAILED_PRECONDITION with
+    NOT_LEADER — the details prefix disambiguates."""
+    status = _status_of(err)
+    if (status is None
+            or status[0] is not grpc.StatusCode.FAILED_PRECONDITION):
+        return None
+    return compat.parse_incompatible(status[1])
+
+
 def not_leader_hint(err: Exception) -> Optional[str]:
     """The leader address carried by a NOT_LEADER rejection, "" when the
     rejecting replica knows no leader yet, None for any other error."""
@@ -183,6 +195,14 @@ class KVStoreServer:
 
     UNARY_WORKERS = 16
 
+    # Methods that run their OWN version handling instead of the
+    # aborting gate: the HA replica protocol answers a below-floor peer
+    # with a typed `{"incompatible": True, got, min}` reply the
+    # leader's push loop classifies (loud log, no snapshot fallback) —
+    # an abort here would reduce that to a generic RpcError→None and
+    # the typed path would be unreachable over the real wire.
+    SELF_VERSIONED: frozenset = frozenset()
+
     def __init__(self, store: KVStore, host: str = "127.0.0.1", port: int = 0,
                  max_watchers: int = 64):
         self.store = store
@@ -225,6 +245,26 @@ class KVStoreServer:
         process is not the leader (client ops are leader-only).  The
         standalone server serves unconditionally."""
 
+    def _version_gate(self, request, context) -> None:
+        """Refuse a below-floor peer BEFORE any state changes (ISSUE
+        13): an explicit INCOMPATIBLE_VERSION rejection, never a
+        best-effort decode.  Unstamped requests (legacy clients,
+        in-process callers) pass — the floor fences explicit versions,
+        not the pre-versioned lineage."""
+        try:
+            compat.check(request if isinstance(request, dict) else {})
+        except IncompatibleVersion as err:
+            if context is None:
+                raise
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          compat.incompatible_details(err))
+
+    def _versioned(self, fn: Callable) -> Callable:
+        def handler(request, context=None):
+            self._version_gate(request, context)
+            return fn(request, context)
+        return handler
+
     def _watch(self, request: dict, context) -> Iterable[dict]:
         """Server-streaming: a subscribe-ack, then one message per
         committed change.  The ack (empty key) proves the store-side
@@ -238,6 +278,7 @@ class KVStoreServer:
         event log still reached back that far; when it did not, the
         client must snapshot instead (the dbwatcher's reconnect resync).
         """
+        self._version_gate(request, context)
         self._gate(context)
         with self._watch_lock:
             if self._active_watchers >= self.max_watchers:
@@ -302,7 +343,8 @@ class KVStoreServer:
     def start(self) -> int:
         unary = {
             name: grpc.unary_unary_rpc_method_handler(
-                fn, request_deserializer=_decode, response_serializer=_encode
+                fn if name in self.SELF_VERSIONED else self._versioned(fn),
+                request_deserializer=_decode, response_serializer=_encode
             )
             for name, fn in self._unary_handlers().items()
         }
@@ -431,6 +473,12 @@ class RemoteWatcher(Watcher):
                         attempt = 0
                         if failed_before or diverged:
                             failed_before = False
+                            # The stream just survived an outage — the
+                            # ensemble may have CHANGED underneath it
+                            # (live membership change, ISSUE 13):
+                            # refresh the failover list so the NEXT
+                            # drop never strands on a replaced replica.
+                            self._owner._refresh_members()
                             self._owner._fire_reconnect()
                         continue
                     self.last_revision = max(self.last_revision, msg["revision"])
@@ -468,6 +516,11 @@ class RemoteWatcher(Watcher):
             self._subscribed.clear()
             failed_before = True
             attempt += 1
+            if attempt % 3 == 0:
+                # Persistent re-subscribe failures: the address list
+                # itself may be stale (replica replaced at runtime) —
+                # ask any answering member for the current ensemble.
+                self._owner._refresh_members()
             # Capped exponential + jitter: after a cluster-wide outage
             # every agent's stream died in the same instant; the jitter
             # de-synchronizes the fleet's re-subscribe storms so a
@@ -502,6 +555,8 @@ class _Target:
         "List", "Snapshot", "Revision",
         # HA replica surface (UNIMPLEMENTED on a standalone server).
         "HaStatus", "LocalDump", "Replicate", "InstallSnapshot",
+        # Live membership change (ISSUE 13; leader-gated).
+        "AddReplica", "RemoveReplica",
     )
 
     def __init__(self, address: str):
@@ -641,7 +696,8 @@ class RemoteKVStore:
             except Exception:  # noqa: BLE001 - eviction is best-effort
                 pass
 
-    def _call_once(self, address: str, method: str, request: dict) -> dict:
+    def _call_once(self, address: str, method: str, request: dict,
+                   timeout: Optional[float] = None) -> dict:
         """One attempt on the (cached) channel.  A concurrent outage
         eviction — the watch thread runs _evict_target too — can CLOSE
         the channel between the cache read and the invoke; grpc then
@@ -650,8 +706,10 @@ class RemoteKVStore:
         op, idempotent or not (found as a pre-existing `make test-race`
         flake while hardening the race battery in ISSUE 7)."""
         target = self._target(address)
+        request = compat.stamp(dict(request))  # version stamp (ISSUE 13)
+        timeout = timeout or self.timeout
         try:
-            return target.calls[method](request, timeout=self.timeout)
+            return target.calls[method](request, timeout=timeout)
         except ValueError as e:
             if "closed channel" not in str(e):
                 raise
@@ -661,9 +719,10 @@ class RemoteKVStore:
                 if self._targets.get(address) is target:
                     self._targets.pop(address, None)
             return self._target(address).calls[method](
-                request, timeout=self.timeout)
+                request, timeout=timeout)
 
-    def _rpc(self, method: str, request: dict) -> dict:
+    def _rpc(self, method: str, request: dict,
+             timeout: Optional[float] = None) -> dict:
         if not self._failover:
             # Historical single-server semantics: one attempt, errors
             # surface immediately (the dbwatcher's mirror fallback and
@@ -671,19 +730,30 @@ class RemoteKVStore:
             # still evicts the channel so the NEXT attempt redials.
             address = self._active
             try:
-                return self._call_once(address, method, request)
+                return self._call_once(address, method, request, timeout)
             except grpc.RpcError as e:
+                incompat = incompatible_version(e)
+                if incompat is not None:
+                    raise IncompatibleVersion(*incompat) from e
                 if _code_of(e) in OUTAGE_CODES:
                     self._evict_target(address)
                 raise
         deadline = time.monotonic() + self.failover_deadline
         backoff = 0.05
         last: Optional[Exception] = None
+        attempts = 0
         while True:
             address = self._active
             try:
-                return self._call_once(address, method, request)
+                return self._call_once(address, method, request, timeout)
             except grpc.RpcError as e:
+                incompat = incompatible_version(e)
+                if incompat is not None:
+                    # A below-floor refusal is DETERMINISTIC — every
+                    # replica applies the same floor; failover/retry
+                    # would just re-refuse.  Surface it cleanly.
+                    raise IncompatibleVersion(*incompat) from e
+                attempts += 1
                 hint = not_leader_hint(e)
                 code = _code_of(e)
                 outage = hint is None and code in OUTAGE_CODES
@@ -717,6 +787,12 @@ class RemoteKVStore:
                     if outage:
                         self._evict_target(address)
                     self._rehome(address, hint)
+                    if outage and attempts % 3 == 0:
+                        # Repeated outages can mean the configured list
+                        # is STALE (a replica was replaced at runtime —
+                        # ISSUE 13 membership change): ask any member
+                        # that still answers for the current ensemble.
+                        self._refresh_members()
             if time.monotonic() >= deadline:
                 raise LeaderUnavailable(
                     f"no serving leader among {self._addresses} within "
@@ -726,12 +802,12 @@ class RemoteKVStore:
             backoff = min(backoff * 2, 0.5)
 
     def _stub_watch(self, request: dict, address: Optional[str] = None):
-        return self._target(address).watch_call(request)
+        return self._target(address).watch_call(compat.stamp(dict(request)))
 
     # --------------------------------------------------------- HA helpers
 
     def _probe_rpc(self, address: Optional[str], method: str,
-                   request: dict) -> dict:
+                   request: dict, timeout: Optional[float] = None) -> dict:
         """A per-replica diagnostic RPC (HaStatus/LocalDump) with the
         same outage-eviction discipline as _rpc: these bypass failover
         on purpose (the caller targets ONE replica), but a channel
@@ -743,11 +819,71 @@ class RemoteKVStore:
         address = address or self._active
         try:
             return self._target(address).calls[method](
-                request, timeout=self.timeout)
+                compat.stamp(dict(request)), timeout=timeout or self.timeout)
         except grpc.RpcError as e:
             if _code_of(e) in OUTAGE_CODES:
                 self._evict_target(address)
             raise
+
+    def _refresh_members(self) -> bool:
+        """Re-learn the ensemble member list from whichever replica
+        still answers (ISSUE 13 satellite): the ctor address list is a
+        BOOTSTRAP hint, not the membership source of truth — a replica
+        replaced at runtime (live add/remove) would otherwise strand
+        every long-lived watcher and failover loop on a dead address
+        forever.  Replaces the address list wholesale (added members
+        learned, removed ones pruned); never leaves it empty; no-op
+        for single-address clients (their fail-fast semantics stand)."""
+        if not self._failover:
+            return False
+        probe_timeout = min(self.timeout, 1.0)
+        for addr in list(self._addresses):
+            try:
+                st = self._probe_rpc(addr, "HaStatus", {},
+                                     timeout=probe_timeout)
+            except Exception:  # noqa: BLE001 - dead/electing replica
+                continue
+            peers = [str(p) for p in (st.get("peers") or [])]
+            if not peers:
+                continue
+            with self._target_lock:
+                self._addresses = peers
+                if self._active not in peers:
+                    leader = st.get("leader") or ""
+                    self._active = leader if leader in peers else peers[0]
+            log.info("refreshed ensemble members from %s: %s", addr, peers)
+            return True
+        return False
+
+    def members(self) -> List[str]:
+        """The CURRENT ensemble member list as reported by a live
+        replica (refreshing this client's failover list as a side
+        effect); falls back to the locally-known addresses when no
+        replica answers."""
+        self._refresh_members()
+        return self.addresses
+
+    def add_replica(self, addr: str, timeout: float = 60.0) -> dict:
+        """Grow the ensemble by one replica (leader-gated; the server
+        snapshot-catches the learner up BEFORE it counts toward quorum
+        — the call blocks for the catch-up, hence the long timeout).
+        The server-side catch-up bound rides the request, slightly
+        inside the RPC deadline so a timeout surfaces as the typed
+        CATCHUP_TIMEOUT, not a raw DEADLINE_EXCEEDED."""
+        result = self._rpc("AddReplica",
+                           {"addr": addr, "timeout": 0.9 * timeout},
+                           timeout=timeout)
+        self._refresh_members()
+        return result
+
+    def remove_replica(self, addr: str, timeout: float = 60.0) -> dict:
+        """Shrink the ensemble by one replica (leader-gated; removing
+        the sitting leader performs an orderly handoff first)."""
+        result = self._rpc("RemoveReplica",
+                           {"addr": addr, "timeout": 0.9 * timeout},
+                           timeout=timeout)
+        self._refresh_members()
+        return result
 
     def ha_status(self, address: Optional[str] = None) -> dict:
         """The HA election status of one replica (UNIMPLEMENTED on a

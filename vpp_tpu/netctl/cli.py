@@ -33,6 +33,12 @@ Analog of ``plugins/netctl`` + ``cmd/contiv-netctl`` (cmd/root.go
 - ``flight``     the datapath flight recorder: the last N dispatch
                  records per shard (K, backlog, in-flight depth, table
                  generation, verdicts, round-trip µs) for post-mortems
+- ``drain``      graceful node drain (ISSUE 13): gate new CNI ADDs
+                 (retriable rejection), quiesce in-flight dispatch,
+                 flush flight/telemetry, flip the heartbeat to a
+                 *drained* tombstone (reported as drained, never as an
+                 unreachable gap)
+- ``undrain``    rejoin a drained agent cleanly (ADDs accepted again)
 - ``cluster``    fleet scope (ISSUE 10): scrape MANY agents at once —
                  ``cluster top`` per-node health rollup, ``cluster
                  latency`` cluster-merged p50/p99/p99.9 + straggler
@@ -311,7 +317,11 @@ def cmd_cluster(out, action: str, servers_spec: str = "", raw: bool = False,
         return 0 if summary.get("nodes_ok") else 1
     print(f"cluster: {summary.get('nodes_ok', 0)}/"
           f"{summary.get('nodes_total', 0)} agents reporting"
-          f"  unreachable={summary.get('nodes_unreachable', 0)}", file=out)
+          f"  unreachable={summary.get('nodes_unreachable', 0)}"
+          f"  drained={summary.get('nodes_drained', 0)}", file=out)
+    for name in summary.get("drained") or []:
+        # Intentionally gone (ISSUE 13): its own line, never a GAP.
+        print(f"DRAINED {name}", file=out)
     for gap in summary.get("gaps") or []:
         print(f"GAP {gap.get('node')} ({gap.get('server')}): "
               f"{gap.get('error')}  last-seen "
@@ -324,8 +334,11 @@ def cmd_cluster(out, action: str, servers_spec: str = "", raw: bool = False,
             healing = ("pending" if r.get("healing_pending")
                        else f"failed={r.get('healing_failed')}"
                        if r.get("healing_failed") else "ok")
+            state = ("up" if r.get("ok")
+                     else "drained" if r.get("state") == "drained"
+                     else "GAP")
             rows.append([
-                r.get("node"), "up" if r.get("ok") else "GAP", shards,
+                r.get("node"), state, shards,
                 r.get("events"), r.get("event_errors"), r.get("resyncs"),
                 healing, r.get("spans_propagated"),
                 "-" if r.get("p99_dispatch_us") is None
@@ -516,6 +529,10 @@ def cmd_health(server: str, out, raw: bool = False,
     if raw:
         print(json.dumps(d, indent=2), file=out)
         return 0
+    drain = d.get("drain")
+    if drain and drain.get("state") != "active":
+        print(f"drain: {drain['state']}  rejected_adds="
+              f"{drain.get('rejected_adds', 0)}", file=out)
     ctl = d.get("controller")
     if ctl:
         age = ctl.get("last_resync_age_s")
@@ -562,6 +579,29 @@ def cmd_health(server: str, out, raw: bool = False,
     ]
     print(_table(rows, ["SHARD", "STATE", "ERRS", "EJECT", "REJOIN",
                         "DISP-ERRS", "POISONED", "LAST-ERROR"]), file=out)
+    return 0
+
+
+def cmd_drain(server: str, out, undrain: bool = False) -> int:
+    """Graceful drain / rejoin of one agent (ISSUE 13): the planned
+    node-maintenance path — distinct from a crash in every surface
+    (heartbeat tombstone, cluster scraper, CNI rejection class)."""
+    action = "undrain" if undrain else "drain"
+    res = _fetch(server, f"/contiv/v1/{action}", method="POST")
+    flush = res.get("last_flush") or {}
+    extra = ""
+    if not undrain and flush:
+        parts = []
+        if "quiesced_frames" in flush:
+            parts.append(f"quiesced {flush['quiesced_frames']} frames")
+        if flush.get("flight"):
+            parts.append(f"flight flushed ({flush['flight'].get('shards', 0)}"
+                         " shards)")
+        if parts:
+            extra = "  (" + ", ".join(parts) + ")"
+    print(f"{server}: {res['state']}{extra}  drains={res['drains']} "
+          f"undrains={res['undrains']} "
+          f"rejected_adds={res['rejected_adds']}", file=out)
     return 0
 
 
@@ -641,6 +681,8 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
                          help="stream a snapshot every N seconds")
     inspect.add_argument("--raw", action="store_true",
                          help="full JSON instead of the summary view")
+    sub.add_parser("drain", parents=[common])
+    sub.add_parser("undrain", parents=[common])
     healthcmd = sub.add_parser("health", parents=[common])
     healthcmd.add_argument("--raw", action="store_true",
                            help="full JSON instead of the summary view")
@@ -703,6 +745,9 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
             return cmd_inspect(args.server, out, args.watch, args.raw)
         if args.command == "health":
             return cmd_health(args.server, out, args.raw, args.recover)
+        if args.command in ("drain", "undrain"):
+            return cmd_drain(args.server, out,
+                             undrain=args.command == "undrain")
         if args.command == "fault":
             return cmd_fault(args.server, out, args.action, args.site,
                              args.shard, args.count, args.mode, args.seconds)

@@ -116,6 +116,11 @@ class PodManager(EventHandler):
         # None = CNI-registration only (pods re-register via repeated Adds).
         self.runtime = runtime
         self._local_pods: Dict[PodID, LocalPod] = {}
+        # Drain gate (ISSUE 13): flipped by the DrainCoordinator (REST
+        # thread), read by the CNI service threads before any event is
+        # pushed.
+        self._draining = False  # lock-free: GIL-atomic bool flip; an ADD racing the flip lands on one side of it exactly like an ADD racing the operator's drain command
+        self._drain_gate = None  # lock-free: set/cleared together with _draining (same single-writer flip); the coordinator's rejection counter rides it
 
     # ------------------------------------------------------------ CNI facade
 
@@ -132,6 +137,13 @@ class PodManager(EventHandler):
         Raises the processing error on failure (the CNI binary then
         reports the error back to kubelet).
         """
+        if self._draining:
+            gate = self._drain_gate
+            if gate is not None:
+                gate()  # raises NodeDraining AND counts the rejection
+            from ..controller.drain import NodeDraining
+
+            raise NodeDraining()
         pod = LocalPod(
             id=PodID(name=name, namespace=namespace),
             container_id=container_id,
@@ -144,8 +156,17 @@ class PodManager(EventHandler):
             raise err
         return event.reply
 
+    def set_draining(self, draining: bool, gate=None) -> None:
+        """Gate/ungate new CNI ADDs (the DrainCoordinator's hook).
+        ``gate`` is the coordinator's rejecting callable (raises
+        NodeDraining and counts it).  DELs are never gated — drain
+        exists so pods can leave."""
+        self._drain_gate = gate if draining else None
+        self._draining = bool(draining)
+
     def delete_pod(self, name: str, namespace: str = "default", timeout: float = 30.0) -> None:
-        """The CNI-Del RPC. Idempotent per CNI spec."""
+        """The CNI-Del RPC. Idempotent per CNI spec — and deliberately
+        NOT drain-gated (teardown must work on a draining node)."""
         event = DeletePod(PodID(name=name, namespace=namespace))
         self.event_loop.push_event(event)
         err = event.wait(timeout)

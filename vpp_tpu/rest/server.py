@@ -83,6 +83,7 @@ class AgentRestServer:
         datapath=None,
         store=None,
         spans=None,
+        drain=None,
         host: str = "127.0.0.1",
         port: int = 0,
     ):
@@ -105,6 +106,9 @@ class AgentRestServer:
         # Propagation spans: an explicit SpanTracker, or (default) the
         # controller's own — every Controller carries one.
         self.spans = spans
+        # Graceful drain/rejoin coordinator (ISSUE 13) — `netctl
+        # drain|undrain` land here.
+        self.drain = drain
         self.host = host
         self.port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -212,12 +216,28 @@ class AgentRestServer:
         out = {"node": self.node_name}
         if self.controller is not None:
             out["controller"] = self.controller.status()
+        if self.drain is not None:
+            out["drain"] = self.drain.status()
         dp = self.datapath() if callable(self.datapath) else self.datapath
         if dp is not None:
             out.update(dp.health())
-        elif self.controller is None:
+        elif self.controller is None and self.drain is None:
             raise LookupError("no datapath")
         return out
+
+    def post_drain(self, action: str) -> dict:
+        """Graceful drain / rejoin (ISSUE 13; `netctl drain|undrain`):
+        ``drain`` gates new CNI ADDs (retriable code-11 rejection),
+        quiesces in-flight dispatch, flushes the flight/latency
+        forensics and flips the heartbeat to a *drained* tombstone;
+        ``undrain`` rejoins cleanly."""
+        if self.drain is None:
+            raise LookupError("no drain coordinator")
+        if action == "drain":
+            return self.drain.drain()
+        if action == "undrain":
+            return self.drain.undrain()
+        raise FileNotFoundError(f"drain action {action!r}")
 
     def post_health_recover(self, query: dict) -> dict:
         """Expedite ejected shards into probation (skip the backoff);
@@ -418,6 +438,9 @@ class AgentRestServer:
             return self.post_fault(path.rsplit("/", 1)[1], query)
         if method == "POST" and path == "/contiv/v1/health/recover":
             return self.post_health_recover(query)
+        if method == "POST" and path in ("/contiv/v1/drain",
+                                         "/contiv/v1/undrain"):
+            return self.post_drain(path.rsplit("/", 1)[1])
         raise FileNotFoundError(path)
 
     def start(self) -> int:

@@ -66,6 +66,11 @@ class NodeScrape:
     server: str
     ok: bool = False
     error: str = ""
+    # Heartbeat-reported lifecycle state (ISSUE 13): "active",
+    # "draining", or "drained".  A DRAINED node is intentionally gone —
+    # reported under `drained`, never as a gap or a straggler; its
+    # sweep slot is skipped entirely (it deregistered).
+    state: str = "active"
     elapsed_ms: float = 0.0
     last_seen_age_s: Optional[float] = None  # None = never seen
     inspect: Optional[dict] = None
@@ -79,7 +84,11 @@ class ClusterScraper:
     ``servers`` maps node name → ``host:port`` of its AgentRestServer;
     pass a callable to re-resolve each sweep (agents restart onto fresh
     ephemeral ports — the soak's kill drills — and a fleet scraper must
-    follow).  ``fetch`` is injectable for tests.
+    follow).  The map (or the callable's result) may instead be a
+    ROSTER dict ``{"servers": {...}, "states": {name: state}}`` —
+    :func:`heartbeat_roster` produces one — so intentionally-DRAINED
+    nodes (ISSUE 13) are reported as drained, never as unreachable
+    gaps.  ``fetch`` is injectable for tests.
     """
 
     def __init__(
@@ -99,13 +108,30 @@ class ClusterScraper:
         # across sweeps: a gap is reported with how stale our view of
         # that node is, which is what paging decisions need.
         self._last_seen: Dict[str, float] = {}
+        # Latest heartbeat lifecycle state per node (when the servers
+        # source is roster-shaped) — re-resolved with the servers each
+        # sweep, read by the caller's thread only between those points.
+        self._states: Dict[str, str] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------ scraping
 
     def servers(self) -> Dict[str, str]:
         resolved = self._servers() if callable(self._servers) else self._servers
+        if isinstance(resolved, dict) and "servers" in resolved \
+                and isinstance(resolved.get("servers"), dict):
+            states = {str(k): str(v)
+                      for k, v in (resolved.get("states") or {}).items()}
+            with self._lock:
+                self._states = states
+            return dict(resolved["servers"])
+        with self._lock:
+            self._states = {}
         return dict(resolved)
+
+    def node_state(self, node: str) -> str:
+        with self._lock:
+            return self._states.get(node, "active")
 
     def _scrape_one(self, node: str, server: str, light: bool = False,
                     include_spans: bool = True) -> NodeScrape:
@@ -180,13 +206,29 @@ class ClusterScraper:
         servers = self.servers()
         if not servers:
             return []
-        with ThreadPoolExecutor(min(self.pool, max(1, len(servers)))) as ex:
-            futures = {
-                node: ex.submit(self._scrape_one, node, server, light,
-                                include_spans)
-                for node, server in sorted(servers.items())
-            }
-            return [futures[node].result() for node in sorted(futures)]
+        # A DRAINED node deregistered on purpose (ISSUE 13): its slot
+        # is filled without a scrape — state says why it is dark, so it
+        # can never read as an unreachable gap or cost a timeout.
+        drained = {n for n in servers if self.node_state(n) == "drained"}
+        live = {n: s for n, s in servers.items() if n not in drained}
+        out = {
+            node: NodeScrape(node=node, server=servers[node], ok=False,
+                             state="drained", error="drained")
+            for node in drained
+        }
+        if live:
+            with ThreadPoolExecutor(min(self.pool,
+                                        max(1, len(live)))) as ex:
+                futures = {
+                    node: ex.submit(self._scrape_one, node, server, light,
+                                    include_spans)
+                    for node, server in sorted(live.items())
+                }
+                for node in futures:
+                    scrape = futures[node].result()
+                    scrape.state = self.node_state(node)
+                    out[node] = scrape
+        return [out[node] for node in sorted(out)]
 
     # ----------------------------------------------------------- rollups
 
@@ -238,6 +280,7 @@ class ClusterScraper:
                 "node": s.node,
                 "server": s.server,
                 "ok": s.ok,
+                "state": s.state,
                 "error": s.error,
                 "last_seen_age_s": s.last_seen_age_s,
                 "scrape_ms": s.elapsed_ms,
@@ -256,7 +299,12 @@ class ClusterScraper:
         return {
             "nodes_total": len(scrapes),
             "nodes_ok": sum(1 for s in scrapes if s.ok),
-            "nodes_unreachable": sum(1 for s in scrapes if not s.ok),
+            "nodes_unreachable": sum(
+                1 for s in scrapes if not s.ok and s.state != "drained"),
+            "nodes_drained": sum(
+                1 for s in scrapes if s.state == "drained"),
+            "drained": sorted(
+                s.node for s in scrapes if s.state == "drained"),
             "gaps": self._gaps(scrapes),
             "per_node": rows,
             "latency": latency.get("latency"),
@@ -267,11 +315,14 @@ class ClusterScraper:
     @staticmethod
     def _gaps(scrapes: List[NodeScrape]) -> List[dict]:
         """Unreachable nodes as explicit records — the aggregator's
-        partial-failure contract (a gap is data, not an exception)."""
+        partial-failure contract (a gap is data, not an exception).
+        DRAINED nodes are excluded by contract (ISSUE 13): they are
+        intentionally gone and reported under their own heading — a
+        drained node is never a gap and never a straggler."""
         return [
             {"node": s.node, "server": s.server, "error": s.error,
              "last_seen_age_s": s.last_seen_age_s}
-            for s in scrapes if not s.ok
+            for s in scrapes if not s.ok and s.state != "drained"
         ]
 
 
@@ -288,8 +339,23 @@ def heartbeat_servers(store, prefix: str = "/vpp-tpu/test/heartbeat/"
     ``scripts/cluster_obs.py --store`` and the soak conductor use, so
     the scraper follows agents across SIGKILL-restarts onto their fresh
     ephemeral ports."""
+    return heartbeat_roster(store, prefix)["servers"]
+
+
+def heartbeat_roster(store, prefix: str = "/vpp-tpu/test/heartbeat/"
+                     ) -> Dict[str, Dict[str, str]]:
+    """Like :func:`heartbeat_servers`, but roster-shaped: the REST
+    address map PLUS each agent's heartbeat lifecycle state (ISSUE 13
+    — ``active`` / ``draining`` / ``drained``).  Feed the roster to
+    :class:`ClusterScraper` so drained nodes are reported as drained,
+    never scraped into timeout gaps."""
     servers: Dict[str, str] = {}
+    states: Dict[str, str] = {}
     for key, beat in store.list(prefix):
-        if isinstance(beat, dict) and beat.get("rest"):
-            servers[beat.get("name") or key[len(prefix):]] = beat["rest"]
-    return servers
+        if not isinstance(beat, dict):
+            continue
+        name = beat.get("name") or key[len(prefix):]
+        states[name] = str(beat.get("state") or "active")
+        if beat.get("rest"):
+            servers[name] = beat["rest"]
+    return {"servers": servers, "states": states}

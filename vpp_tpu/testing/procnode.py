@@ -397,6 +397,17 @@ def run_agent(
     if datapath_shards > 0:
         datapath = AgentDatapath(node, datapath_shards)
 
+    # Graceful drain/rejoin (ISSUE 13): `netctl drain` gates CNI ADDs,
+    # quiesces the datapath and flips the heartbeat to a *drained*
+    # tombstone (state rides every beat below).
+    from ..controller.drain import DrainCoordinator
+
+    drainer = DrainCoordinator(
+        podmanager=node.podmanager,
+        datapath=(lambda: datapath.dp) if datapath is not None else None,
+        node_name=name,
+    )
+
     rest = None
     rest_bound = 0
     if rest_port >= 0:
@@ -414,6 +425,7 @@ def run_agent(
             scheduler=node.scheduler, store=store, port=rest_port,
             datapath=datapath.dp if datapath is not None else None,
             spans=node.controller.spans,
+            drain=drainer,
         )
         rest_bound = rest.start()
 
@@ -441,12 +453,18 @@ def run_agent(
         node.scheduler.register_applicator(hostnet)
         node.scheduler.replay()
 
+    from ..kvstore import compat
+
     prober = _ParityProber(node, datapath)
     seq = 0
     try:
         while stop_event is None or not stop_event.is_set():
             seq += 1
-            if datapath is not None:
+            drain_state = drainer.state
+            if datapath is not None and drain_state == "active":
+                # A drained datapath stays quiesced: the keep-alive
+                # pump would re-admit frames into the engine the drain
+                # just proved idle.
                 try:
                     datapath.pump()
                 except Exception:  # noqa: BLE001 - chaos drills inject here
@@ -454,6 +472,11 @@ def run_agent(
             beat = {
                 "name": name,
                 "seq": seq,
+                # Version stamp + drain tombstone (ISSUE 13): readers
+                # tolerate adjacent versions; "drained" is explicitly
+                # distinct from crash-dead (a missing/stale beat).
+                "pv": compat.effective_version(),
+                "state": drain_state,
                 "node_id": node.nodesync.node_id,
                 "resync_count": node.controller._resync_count,
                 "mirror_resyncs": node.watcher.resynced_from_mirror,
@@ -469,6 +492,8 @@ def run_agent(
                 "rest": f"127.0.0.1:{rest_bound}" if rest_bound else "",
                 "cni": f"127.0.0.1:{cni_bound}" if cni_bound else "",
             }
+            if drain_state == "drained":
+                beat["drained_at"] = drainer.status().get("drained_at")
             if datapath is not None:
                 h = datapath.dp.health()
                 beat["datapath"] = {

@@ -104,6 +104,11 @@ class SoakConfig:
     outage_seconds: float = 2.5
     agent_kills: int = 1
     shard_faults: int = 3         # rotates eject / hang / swap-fail
+    # Planned-operations drills (ISSUE 13).
+    rolling_upgrades: int = 0     # serial agent restarts under emulated skew
+    upgrade_agents: int = 2       # agents restarted per rolling-upgrade drill
+    membership_changes: int = 0   # store ensemble grow 3→4 + shrink 4→3
+    drains: int = 0               # netctl-drain / undrain round trips
     ha_replicas: int = 3
     store_heartbeat: float = 0.1
     store_lease: float = 0.8
@@ -119,6 +124,21 @@ class SoakConfig:
         return SoakConfig(workdir=workdir, out_path=out_path)
 
     @staticmethod
+    def ops_smoke(workdir: str, out_path: str = "") -> "SoakConfig":
+        """The planned-operations smoke (ISSUE 13): every OPERATIONS
+        drill — rolling upgrade under emulated version skew, store
+        membership grow+shrink, drain/rejoin — fired at least once over
+        a small cluster, with churn + parity probes running throughout.
+        The crash drills have their own smoke (``smoke()``)."""
+        return SoakConfig(
+            agents=4, datapath_agents=1, parity_agents=2, pods=6,
+            churn_ops=10, churn_rate=8.0, leader_kills=0,
+            store_outages=0, agent_kills=0, shard_faults=0,
+            rolling_upgrades=1, upgrade_agents=2, membership_changes=1,
+            drains=1, workdir=workdir, out_path=out_path,
+        )
+
+    @staticmethod
     def full(workdir: str, out_path: str = "SOAK_r08.jsonl") -> "SoakConfig":
         # ~20% of churn ops are policy/service toggles, so the pod-op
         # budget (initial deploys + ~80% of churn_ops) clears the
@@ -128,6 +148,8 @@ class SoakConfig:
             parity_agents=8, pods=150, churn_ops=1250, churn_rate=40.0,
             cni_parallelism=16, leader_kills=2, store_outages=2,
             outage_seconds=4.0, agent_kills=2, shard_faults=4,
+            rolling_upgrades=1, upgrade_agents=4, membership_changes=1,
+            drains=1,
             heartbeat_interval=0.5, convergence_timeout=300.0,
             workdir=workdir, out_path=out_path,
         )
@@ -221,13 +243,20 @@ def _child_env() -> Dict[str, str]:
 
 class _Proc:
     """A child process with its log file (stdout+stderr), so a crashed
-    agent leaves forensics and a chatty one cannot fill a pipe."""
+    agent leaves forensics and a chatty one cannot fill a pipe.
+    ``extra_env`` overlays the child environment — the rolling-upgrade
+    drill spawns emulated-previous-version agents this way
+    (``VPP_TPU_COMPAT_SKEW``)."""
 
-    def __init__(self, argv: List[str], log_path: pathlib.Path):
+    def __init__(self, argv: List[str], log_path: pathlib.Path,
+                 extra_env: Optional[Dict[str, str]] = None):
         self.log_path = log_path
         self.log_file = open(log_path, "ab")
+        env = _child_env()
+        if extra_env:
+            env.update(extra_env)
         self.proc = subprocess.Popen(
-            argv, cwd=str(REPO), env=_child_env(),
+            argv, cwd=str(REPO), env=env,
             stdout=self.log_file, stderr=subprocess.STDOUT,
         )
 
@@ -293,6 +322,10 @@ class _DrillMonitor:
 
     @staticmethod
     def _degraded(scrape) -> bool:
+        if getattr(scrape, "state", "") == "drained":
+            # Intentionally gone (ISSUE 13): a drained node is never
+            # "degraded" — that is the whole point of the tombstone.
+            return False
         if not scrape.ok:
             return True
         health = scrape.health or {}
@@ -381,12 +414,21 @@ class SoakCluster:
         self._deferred_k8s: List[Tuple[str, dict]] = []
         self._outage_on = False
         self.probe_round = 0
+        # Per-agent env overlay, preserved across respawns (a killed
+        # emulated-old agent must come back emulated-old) — written by
+        # the drill thread, read by respawns on the same thread.
+        self._agent_env: Dict[str, Dict[str, str]] = {}
+        # Nodes currently draining: churn pod-ADDs reroute to another
+        # node (what the scheduler does for a cordoned node); guarded
+        # by _model_lock (churn pool threads read it per op).
+        self.draining_nodes: set = set()
         # Fleet aggregator (ISSUE 10): REST addresses resolved from
         # heartbeats, cached so sweeps keep working while the store is
         # SIGSTOPped; the monitor + cluster-span/latency evidence all
         # ride this one scraper.
         self.scraper = ClusterScraper(self._scraper_servers, timeout=5.0)
         self._servers_cache: Dict[str, str] = {}
+        self._states_cache: Dict[str, str] = {}
         self._drill_monitor: Optional[_DrillMonitor] = None
         self.last_convergence: Dict[str, Any] = {}
         self.events: List[dict] = []
@@ -396,6 +438,8 @@ class SoakCluster:
             "cni_adds": 0, "cni_dels": 0, "cni_errors": 0,
             "leader_kills": 0, "store_outages": 0,
             "agent_restarts": 0, "shard_faults": 0,
+            "rolling_upgrades": 0, "membership_changes": 0, "drains": 0,
+            "drain_rejected_adds": 0,
             "parity_rounds": 0, "parity_checked": 0,
             "parity_mismatches": 0, "unconverged": 0,
             "mirror_resyncs": 0, "healing_failed": 0,
@@ -496,7 +540,8 @@ class SoakCluster:
                 "--heartbeat-interval", str(cfg.heartbeat_interval)]
         if idx < cfg.datapath_agents:
             argv += ["--datapath", str(cfg.datapath_shards)]
-        return _Proc(argv, self.workdir / f"{name}.log")
+        return _Proc(argv, self.workdir / f"{name}.log",
+                     extra_env=self._agent_env.get(name))
 
     def heartbeat(self, name: str) -> Optional[dict]:
         try:
@@ -514,16 +559,20 @@ class SoakCluster:
         last good map cached — a store-outage window must not blind the
         monitor to agents whose REST is still perfectly reachable."""
         try:
-            from ..statscollector.cluster import heartbeat_servers
+            from ..statscollector.cluster import heartbeat_roster
 
-            servers = {n: s for n, s in
-                       heartbeat_servers(self.client).items()
+            roster = heartbeat_roster(self.client)
+            servers = {n: s for n, s in roster["servers"].items()
                        if n in self.agent_procs}
+            states = {n: s for n, s in roster["states"].items()
+                      if n in self.agent_procs}
         except Exception:  # noqa: BLE001 - store mid-outage: use cache
-            servers = {}
+            servers, states = {}, {}
         if servers:
             self._servers_cache = servers
-        return dict(self._servers_cache)
+            self._states_cache = states
+        return {"servers": dict(self._servers_cache),
+                "states": dict(getattr(self, "_states_cache", {}) or {})}
 
     # ---------------------------------------------------------------- churn
 
@@ -566,29 +615,46 @@ class SoakCluster:
                 time.sleep(1.5 * self.mult)
         raise last
 
+    def _schedulable(self, node: str) -> str:
+        """The node a pod-ADD actually lands on: the scripted node,
+        unless it is DRAINING — then the first non-draining agent (what
+        the scheduler does for a cordoned node).  The substitution is
+        recorded in live_pods, so the DEL goes to the right agent."""
+        with self._model_lock:
+            if node not in self.draining_nodes:
+                return node
+            for fallback in self.names:
+                if fallback not in self.draining_nodes:
+                    return fallback
+        return node  # everything draining: let the retriable error show
+
     def _exec_op(self, op: Dict[str, Any]) -> None:
         kind = op["op"]
         try:
             if kind == "pod-add":
-                result = self._cni(op["node"], "add", op["pod"])
+                node = self._schedulable(op["node"])
+                result = self._cni(node, "add", op["pod"])
                 ip = pod_ip(result)
                 with self._model_lock:
                     self.report["cni_adds"] += 1
-                    self.live_pods[op["pod"]] = op["node"]
+                    self.live_pods[op["pod"]] = node
                     self.pod_ips[op["pod"]] = ip
                     self._container_ids[op["pod"]] = \
-                        self.kubelets[op["node"]].invocations[-1][
+                        self.kubelets[node].invocations[-1][
                             "container_id"]
                 self._apply_k8s("pods", {
                     "metadata": {"name": op["pod"], "namespace": "default",
                                  "labels": op.get("labels", {})},
-                    "spec": {"nodeName": op["node"]},
+                    "spec": {"nodeName": node},
                     "status": {"podIP": ip},
                 })
             elif kind == "pod-del":
                 with self._model_lock:
                     container = self._container_ids.pop(op["pod"], None)
-                self._cni(op["node"], "delete", op["pod"],
+                    # The ADD may have been rerouted off a draining
+                    # node: tear down where the pod actually lives.
+                    node = self.live_pods.get(op["pod"], op["node"])
+                self._cni(node, "delete", op["pod"],
                           container_id=container)
                 with self._model_lock:
                     self.report["cni_dels"] += 1
@@ -881,6 +947,215 @@ class SoakCluster:
                     health={k: v for k, v in dp_health().items()
                             if not isinstance(v, (list, dict))})
 
+    # ---------------------------------------- planned operations (ISSUE 13)
+
+    def fault_rolling_upgrade(self) -> None:
+        """Serial agent restarts under emulated version skew — the
+        rolling-DaemonSet-upgrade drill: each agent in the cohort is
+        SIGTERMed and respawned as an emulated PREVIOUS-version build
+        (``VPP_TPU_COMPAT_SKEW=-1``) or back to current, alternating —
+        so the fleet runs MIXED versions from here on, with churn and
+        parity probes exercising the skew-tolerant paths throughout."""
+        from ..kvstore import compat
+
+        cfg = self.cfg
+        pool = self.names[cfg.datapath_agents:] or self.names
+        cohort = [pool[i % len(pool)] for i in range(cfg.upgrade_agents)]
+        cohort = list(dict.fromkeys(cohort))
+        self.record("fault", kind="rolling-upgrade", agents=cohort)
+        for i, name in enumerate(cohort):
+            skew = -1 if i % 2 == 0 else 0
+            old = self.heartbeat(name) or {}
+            proc = self.agent_procs[name]
+            proc.kill(signal.SIGTERM)      # the kubelet-rolls-the-pod path
+            proc.reap()
+            self.client.delete(HEARTBEAT_PREFIX + name)
+            self._agent_env[name] = (
+                {"VPP_TPU_COMPAT_SKEW": str(skew)} if skew else {})
+            self.agent_procs[name] = self._spawn_agent(name)
+            assert wait_for(lambda: self.heartbeat(name) is not None,
+                            timeout=90.0 * self.mult), \
+                f"upgraded agent {name} never heartbeat"
+            beat = self.heartbeat(name)
+            assert beat["node_id"] == old.get("node_id", beat["node_id"]), \
+                f"{name} lost its node ID across the upgrade"
+            want_pv = max(1, compat.PROTOCOL_VERSION + skew)
+            assert int(beat.get("pv", 0)) == want_pv, \
+                f"{name} stamped pv={beat.get('pv')} (want {want_pv})"
+            self.kubelets[name] = FakeKubelet(
+                grpc_server=beat["cni"], http_server=beat["rest"],
+                transport=self.kubelets[name].transport,
+            )
+            self.record("upgrade-step", agent=name, skew=skew,
+                        pv=int(beat.get("pv", 0)),
+                        resync_count=beat.get("resync_count"))
+        self._mark_drill("cleared")  # the whole cohort beats again
+        self.report["rolling_upgrades"] += 1
+        self.record("fault-done", kind="rolling-upgrade", agents=cohort,
+                    mixed_versions=sorted({
+                        int((self.heartbeat(n) or {}).get("pv", 0))
+                        for n in self.names
+                        if self.heartbeat(n) is not None}))
+
+    def fault_membership(self) -> None:
+        """Live store-ensemble membership change mid-traffic: grow
+        3→4 (the new empty replica snapshot-catches up as a learner
+        BEFORE counting toward quorum), then shrink 4→3 by removing the
+        CURRENT LEADER (orderly handoff; zero lost committed writes —
+        asserted via revision identity across the survivors)."""
+        self.record("fault", kind="membership", members=self.members)
+        # ---- grow 3 -> 4 ---------------------------------------------
+        new_port = free_ports(1)[0]
+        new_addr = f"127.0.0.1:{new_port}"
+        self.store_ports.append(new_port)  # future respawns use 4-member list
+        self.store_procs[new_port] = self._spawn_replica(new_port)
+        assert wait_for(lambda: self._replica_ok(new_port),
+                        timeout=60.0 * self.mult), \
+            f"new replica :{new_port} never served"
+        add_result: Dict[str, Any] = {}
+        try:
+            add_result = self.client.add_replica(
+                new_addr, timeout=60.0 * self.mult)
+        except Exception as err:  # noqa: BLE001 - asserted via peers below
+            add_result = {"error": str(err)}
+        expect = sorted(f"127.0.0.1:{p}" for p in self.store_ports)
+
+        def peers_of(addr: str):
+            try:
+                return sorted(self.client.ha_status(addr)["peers"])
+            except Exception:  # noqa: BLE001
+                return None
+
+        assert wait_for(
+            lambda: all(peers_of(a) == expect for a in expect),
+            timeout=60.0 * self.mult,
+        ), f"ensemble never converged on {expect}: " \
+           f"{ {a: peers_of(a) for a in expect} }"
+        self.record("membership-grow", added=new_addr,
+                    peers=expect, result=add_result)
+
+        # ---- shrink 4 -> 3: remove the sitting LEADER ----------------
+        leader = self._leader_address()
+        assert leader is not None, "no leader to remove"
+        remove_result = self.client.remove_replica(
+            leader, timeout=60.0 * self.mult)
+        survivors = [a for a in expect if a != leader]
+        assert wait_for(
+            lambda: self._leader_address() not in (None, leader),
+            timeout=60.0 * self.mult,
+        ), "no successor leader after the orderly handoff"
+        self._mark_drill("cleared")  # a survivor leads
+        # Zero lost committed writes: every survivor converges to ONE
+        # identical (revision, contents) view.
+        def survivor_views():
+            views = []
+            for addr in survivors:
+                try:
+                    dump = self.client.local_dump("", address=addr)
+                except Exception:  # noqa: BLE001 - still settling
+                    return None
+                views.append((dump["revision"], tuple(sorted(
+                    (k, json.dumps(v, sort_keys=True, default=str))
+                    for k, v in dump["items"]))))
+            return views
+
+        assert wait_for(
+            lambda: (v := survivor_views()) is not None
+            and len(set(v)) == 1,
+            timeout=60.0 * self.mult,
+        ), "survivors diverged after the leader removal"
+        views = survivor_views()
+        # Retire the corpse process and the conductor's record of it.
+        old_port = int(leader.rsplit(":", 1)[1])
+        self.store_ports.remove(old_port)
+        corpse = self.store_procs.pop(old_port)
+        corpse.kill(signal.SIGTERM)
+        corpse.reap()
+        self.report["membership_changes"] += 1
+        self.record("fault-done", kind="membership",
+                    removed_leader=leader, survivors=survivors,
+                    survivor_revision=views[0][0] if views else None,
+                    remove_result=remove_result)
+
+    def fault_drain(self) -> None:
+        """Graceful drain / rejoin: `netctl drain`-equivalent REST on
+        one agent — new CNI ADDs refused RETRIABLY (code 11,
+        AGENT_DRAINING), heartbeat flips to the drained tombstone, the
+        cluster scraper reports it as *drained* (never a gap), then
+        undrain rejoins and a fresh ADD lands on it again."""
+        cfg = self.cfg
+        reserved = max(cfg.datapath_agents, cfg.parity_agents)
+        pool = self.names[reserved:] or self.names[-1:]
+        name = pool[self.report["drains"] % len(pool)]
+        rest = self.rest_of(name)
+        assert rest, f"no REST for drain target {name}"
+        self.record("fault", kind="drain", agent=name)
+        with self._model_lock:
+            self.draining_nodes.add(name)
+        res = _http(rest, "/contiv/v1/drain", method="POST")
+        assert res["state"] == "drained", res
+        # Retriable CNI rejection through the REAL exec'd shim.
+        probe_pod = f"drain-probe-{self.report['drains']}"
+        rejected = False
+        try:
+            self.kubelets[name].add(probe_pod)
+        except Exception as err:  # noqa: BLE001 - classified below
+            code = getattr(err, "code", None)
+            msg = getattr(err, "msg", str(err))
+            rejected = code == 11 and "AGENT_DRAINING" in str(msg)
+            if not rejected:
+                raise
+        assert rejected, \
+            f"drained {name} accepted (or mis-refused) a CNI ADD"
+        # Tombstone on the heartbeat + the scraper's drained contract.
+        assert wait_for(
+            lambda: (self.heartbeat(name) or {}).get("state") == "drained",
+            timeout=30.0 * self.mult,
+        ), f"{name} heartbeat never flipped to drained"
+        summary = self.scraper.summary(self.scraper.scrape(light=True))
+        assert name in (summary.get("drained") or []), \
+            f"scraper did not report {name} as drained: {summary.get('drained')}"
+        assert all(g.get("node") != name
+                   for g in summary.get("gaps") or []), \
+            f"drained {name} mis-reported as an unreachable gap"
+        drain_status = _http(rest, "/contiv/v1/health").get("drain") or {}
+        assert int(drain_status.get("rejected_adds") or 0) >= 1, \
+            f"{name} never counted the rejected ADD: {drain_status}"
+        self.record("drain-observed", agent=name,
+                    scraper_drained=summary.get("drained"),
+                    rejected_adds=drain_status.get("rejected_adds"),
+                    last_flush=drain_status.get("last_flush"))
+        # ---- undrain: clean rejoin -----------------------------------
+        res = _http(rest, "/contiv/v1/undrain", method="POST")
+        assert res["state"] == "active", res
+        with self._model_lock:
+            self.draining_nodes.discard(name)
+        assert wait_for(
+            lambda: (self.heartbeat(name) or {}).get("state") == "active",
+            timeout=30.0 * self.mult,
+        ), f"{name} heartbeat never flipped back to active"
+        # A fresh ADD lands on the rejoined agent (counted as churn).
+        rejoin_pod = f"drain-rejoin-{self.report['drains']}"
+        result = self._cni(name, "add", rejoin_pod)
+        with self._model_lock:
+            self.report["cni_adds"] += 1
+            self.live_pods[rejoin_pod] = name
+            self.pod_ips[rejoin_pod] = pod_ip(result)
+            self._container_ids[rejoin_pod] = \
+                self.kubelets[name].invocations[-1]["container_id"]
+        self._apply_k8s("pods", {
+            "metadata": {"name": rejoin_pod, "namespace": "default",
+                         "labels": dict(WEB)},
+            "spec": {"nodeName": name},
+            "status": {"podIP": self.pod_ips[rejoin_pod]},
+        })
+        self._mark_drill("cleared")
+        self.report["drains"] += 1
+        self.report["drain_rejected_adds"] += int(
+            drain_status.get("rejected_adds") or 0)
+        self.record("fault-done", kind="drain", agent=name,
+                    rejoin_pod=rejoin_pod)
+
     def _healing_settled(self, name: str):
         def check() -> bool:
             beat = self.heartbeat(name)
@@ -1100,6 +1375,11 @@ class SoakCluster:
                  for i in range(cfg.shard_faults)]
         plan += [("agent-kill", None)] * cfg.agent_kills
         plan += [("store-outage", None)] * cfg.store_outages
+        # Planned-operations drills (ISSUE 13) ride the same shuffled
+        # schedule as the crash drills — churn runs through all of them.
+        plan += [("rolling-upgrade", None)] * cfg.rolling_upgrades
+        plan += [("membership", None)] * cfg.membership_changes
+        plan += [("drain", None)] * cfg.drains
         self.rng.shuffle(plan)
         # A store outage as the very first drill would stall the first
         # churn slice's reflections before any state exists — rotate
@@ -1158,6 +1438,12 @@ class SoakCluster:
                     self.fault_agent_kill()
                 elif kind == "shard":
                     self.fault_shard(arg)
+                elif kind == "rolling-upgrade":
+                    self.fault_rolling_upgrade()
+                elif kind == "membership":
+                    self.fault_membership()
+                elif kind == "drain":
+                    self.fault_drain()
             except Exception as err:  # noqa: BLE001 - incl. REST I/O errors
                 # ANY drill failure (assertion or a mid-drill transport
                 # error against a dying agent) is recorded and the run
