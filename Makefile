@@ -6,10 +6,10 @@ PY ?= python
 .PHONY: test test-race verify verify-ha verify-churn verify-faults \
         verify-adaptive verify-static verify-telemetry verify-soak soak \
         verify-cluster-obs verify-dispatch verify-ingress verify-ops \
-        lint bench \
+        verify-inference lint bench \
         bench-suite bench-sweep bench-scale bench-latency bench-frames \
         bench-ingress bench-churn bench-adaptive bench-history \
-        bench-rounds images native native-sanitize
+        bench-rounds bench-infer images native native-sanitize
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -107,6 +107,27 @@ verify-ingress:
 bench-ingress:
 	$(PY) scripts/frame_bench.py --shards-tier 1,2,4,8 --check \
 	    --out FRAMEBENCH_r06.jsonl
+
+# In-network inference verification (ISSUE 14): the scorer/table/
+# renderer/CRD suites (device↔host band parity, delta-builder churn
+# property, mock-engine oracle parity at every governor K on both
+# engines incl. the quarantine action path, the CRD→delta-swap→
+# quarantine e2e demo with pcap + flight evidence, packed-word
+# round-trip property, REST/netctl/metrics/dashboard surfaces), the
+# scoring A/B gate at smoke scale (scores exactly the enrolled rows;
+# ~free under the simulated dispatch floor), and the static gate —
+# hot-path-sync must stay clean with the scorer in the dispatch path,
+# obs-parity with the inference pins.
+verify-inference:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_inference.py \
+	    -q $(if $(RUN_SLOW),,-m 'not slow') --continue-on-collection-errors \
+	    -p no:cacheprovider -p no:xdist -p no:randomly
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_infer.py --smoke --check
+	$(PY) scripts/check_static.py vpp_tpu/ --rule hot-path-sync \
+	    --rule obs-parity
+
+bench-infer:
+	$(PY) scripts/bench_infer.py --check --out BENCHINFER_r14.jsonl
 
 # Telemetry verification (ISSUE 8): the histogram/span/flight suites
 # (single-writer vs reader-merge property, bucket boundaries, the full
@@ -227,7 +248,7 @@ soak:
 # verify target, soak-smoke included.
 verify: lint verify-static verify-ha verify-churn verify-adaptive \
         verify-dispatch verify-ingress verify-telemetry verify-faults \
-        verify-cluster-obs verify-soak verify-ops
+        verify-inference verify-cluster-obs verify-soak verify-ops
 	@echo verify OK
 
 bench:

@@ -111,7 +111,12 @@ _KEY_INTS = ("dispatch_pkts", "vectors", "devices", "batch", "rules",
 # disciplines side by side in one row).
 _VALUE_FIELDS = ("value", "achieved_mpps_median", "median_mpps", "median",
                  "mpps", "speedup", "p50_step_us", "p50_ms", "p50_us",
-                 "materialize_p50_us")
+                 "materialize_p50_us",
+                 # ISSUE 14 inference A/B: the added-latency metric rows
+                 # carry exactly one of these (the _us suffix gives the
+                 # regression flag its lower-is-better direction); the
+                 # side rows' Mpps ride the generic ``mpps`` field.
+                 "added_p99_us", "added_p50_us", "added_mean_us")
 
 
 def _row_key(rec: dict) -> Optional[str]:

@@ -1,0 +1,245 @@
+"""Scoring on/off A/B of the in-network inference stage (ISSUE 14).
+
+The tentpole claim: the datapath is dispatch-floor-bound (NOTES_r05 —
+extra per-vector device compute is ~free under the host↔device round
+trip), so the fused scoring stage should cost near-zero marginal
+dispatch time AT THE GOVERNED HEADLINE SHAPE, and score-off throughput
+must be unchanged (the disabled stage compiles away — the score-off
+program is the pre-ISSUE-14 pipeline bit-for-bit).
+
+Methodology (the bench_rounds.py discipline):
+
+- the SAME flat-safe dispatch stream (same tables, traffic, K) runs
+  twice — ``score-off`` (no InferTable) and ``score-on`` (every stress
+  pod enrolled at threshold 0, action=log: every enrolled-identity
+  packet scored AND firing the cheapest action — the worst case);
+- per-dispatch wall time (dispatch + blocking materialisation of the
+  packed result) lands in the same Log2Histogram class the runner's
+  latency pillars use; Mpps = packets / median wall;
+- on a locally-attached CPU backend the device compute is host time,
+  so besides the bare rows the A/B replays with a LABELLED simulated
+  per-dispatch round-trip floor (``--floor-us``, default 0 and 2000 µs
+  ≈ the production 64×256 dispatch service time on the tunnel):
+  under the floor the scorer's compute overlaps the round trip, which
+  is how the TPU actually behaves.  Simulated rows are always
+  labelled; bare-CPU rows honestly show the host-side compute cost.
+
+Artifacts: one JSON line per (side, floor) + three ``added-latency``
+metric rows per floor (p50/p99 µs deltas at log2-bucket resolution,
+plus the EXACT mean delta — sub-bucket differences are real and the
+mean does not quantize them away; all tracked by bench_history with
+lower-is-better direction).  ``--check`` exits 1 unless (a) the
+score-on run scored EXACTLY the rows whose rewritten src/dst is an
+enrolled pod (host-computed expectation; a SNAT'd egress flow leaves
+the enrolled identity behind and is correctly un-scored), (b) the
+score-off run scored nothing, and (c) under the simulated floor the
+score-on p50 sits within ``--max-overhead`` (default 10%) of
+score-off — the ~free-under-the-floor claim.
+
+Usage::
+
+    python scripts/bench_infer.py [--vectors 64] [--iters 40]
+        [--floor-us 2000] [--smoke] [--check] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--vectors", type=int, default=64,
+                        help="K of the dispatched [K, 256] batch "
+                             "(64 = the governed headline shape)")
+    parser.add_argument("--iters", type=int, default=40)
+    parser.add_argument("--rules", type=int, default=10000)
+    parser.add_argument("--services", type=int, default=1000)
+    parser.add_argument("--floor-us", type=float, default=2000.0,
+                        help="simulated per-dispatch round-trip floor "
+                             "for the second row set (0 skips)")
+    parser.add_argument("--max-overhead", type=float, default=0.10,
+                        help="--check bound on floored score-on p50 vs "
+                             "score-off (fraction)")
+    parser.add_argument("--check", action="store_true")
+    parser.add_argument("--smoke", action="store_true",
+                        help="reduced scale for CI gates")
+    parser.add_argument("--out", default="",
+                        help="append the JSON rows to this file too")
+    args = parser.parse_args(argv)
+    if args.smoke:
+        args.vectors = min(args.vectors, 8)
+        args.iters = min(args.iters, 12)
+        args.rules, args.services = 256, 64
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import bench
+    from vpp_tpu.inference import default_model
+    from vpp_tpu.ops.infer import INFER_ACT_LOG, build_infer_table
+    from vpp_tpu.ops.nat import empty_sessions
+    from vpp_tpu.ops.packets import ip_to_u32
+    from vpp_tpu.ops.pipeline import (
+        VECTOR_SIZE,
+        pipeline_flat_safe_ts0_jit,
+        unpack_verdicts,
+    )
+    from vpp_tpu.telemetry import Log2Histogram
+
+    acl, nat, route, _, pod_ips, mappings = bench.build_stress_state(
+        n_rules=args.rules, n_services=args.services
+    )
+    k = args.vectors
+    b = k * VECTOR_SIZE
+    flat = bench.build_traffic(pod_ips, mappings, b)
+    vecs = jax.tree_util.tree_map(
+        lambda a: a.reshape(k, VECTOR_SIZE), flat)
+
+    # Worst-case enrollment: every stress pod, threshold 0 (every
+    # scored packet fires), cheapest action (log — quarantine would
+    # change the delivered set and break the equal-load contract).
+    infer_on = build_infer_table(
+        default_model().to_dict(),
+        {ip_to_u32(ip): (0, INFER_ACT_LOG) for ip in pod_ips},
+    )
+
+    # The host-side expectation the check pins the device against: a
+    # row is scored iff its REWRITTEN source or destination is an
+    # enrolled pod (a SNAT'd egress flow leaves the enrolled identity
+    # behind — correctly un-scored).
+    enrolled = np.asarray(sorted(ip_to_u32(ip) for ip in pod_ips),
+                          dtype=np.uint32)
+
+    def run_side(infer, floor_us):
+        """One measured pass: (hist, scored_per_batch, expected)."""
+        sessions = empty_sessions(1 << 16)
+        hist = Log2Histogram()
+        floor_s = floor_us * 1e-6
+        # Warm-up (compile outside the timed loop).
+        r = pipeline_flat_safe_ts0_jit(
+            acl, nat, route, sessions, vecs, jnp.int32(0), infer)
+        v = unpack_verdicts(np.asarray(r.packed))
+        scored = int(v.scored.sum())
+        expected = int((np.isin(v.src_ip, enrolled)
+                        | np.isin(v.dst_ip, enrolled)).sum())
+        sessions = r.sessions
+        ts = k
+        for _ in range(args.iters):
+            t0 = time.perf_counter()
+            r = pipeline_flat_safe_ts0_jit(
+                acl, nat, route, sessions, vecs, jnp.int32(ts), infer)
+            sessions = r.sessions
+            np.asarray(r.packed)   # the ONE blocking materialisation
+            if floor_s:
+                time.sleep(floor_s)
+            hist.record_s(time.perf_counter() - t0)
+            ts += k
+        return hist, scored, expected
+
+    meta = {
+        "bench": "infer-ab",
+        "dispatch_pkts": b,
+        "vectors": k,
+        "rules": args.rules,
+        "enrolled_pods": infer_on.num_pods,
+        "backend": jax.default_backend(),
+        "smoke": bool(args.smoke),
+    }
+    lines = []
+
+    def emit(row):
+        line = json.dumps(row)
+        print(line, flush=True)
+        lines.append(line)
+
+    results = {}
+    floors = [0.0] + ([args.floor_us] if args.floor_us > 0 else [])
+    for floor_us in floors:
+        tier = f"floor{int(floor_us)}"
+        for side, infer in (("score-off", None), ("score-on", infer_on)):
+            hist, scored, expected = run_side(infer, floor_us)
+            snap = hist.snapshot()
+            results[(side, floor_us)] = (snap, scored, expected)
+            emit({
+                **meta,
+                "side": side,
+                "tier": tier,
+                "simulated_floor_us": floor_us,
+                "simulated": floor_us > 0,
+                "scored_per_batch": scored,
+                "mpps": round(b / (snap["p50"] * 1e-6) / 1e6, 3),
+                "p50_dispatch_us": round(snap["p50"], 1),
+                "p99_dispatch_us": round(snap["p99"], 1),
+                # The log2 histogram quantizes percentiles to bucket
+                # resolution; the mean is exact (sum/count) and is what
+                # the added-mean disclosure row is computed from.
+                "mean_dispatch_us": round(snap["sum_us"] / snap["count"], 1),
+            })
+        on = results[("score-on", floor_us)][0]
+        off = results[("score-off", floor_us)][0]
+        emit({
+            **meta,
+            "metric": "added-p50",
+            "tier": tier,
+            "simulated_floor_us": floor_us,
+            "simulated": floor_us > 0,
+            "added_p50_us": round(max(0.0, on["p50"] - off["p50"]), 1),
+        })
+        emit({
+            **meta,
+            "metric": "added-p99",
+            "tier": tier,
+            "simulated_floor_us": floor_us,
+            "simulated": floor_us > 0,
+            "added_p99_us": round(max(0.0, on["p99"] - off["p99"]), 1),
+        })
+        emit({
+            **meta,
+            "metric": "added-mean",
+            "tier": tier,
+            "simulated_floor_us": floor_us,
+            "simulated": floor_us > 0,
+            "added_mean_us": round(max(
+                0.0, on["sum_us"] / on["count"]
+                - off["sum_us"] / off["count"]), 1),
+        })
+
+    ok = True
+    if args.check:
+        floor = floors[-1]
+        on, scored, expected = results[("score-on", floor)]
+        off, off_scored, _ = results[("score-off", floor)]
+        scored_ok = scored == expected > 0 and off_scored == 0
+        overhead = (on["p50"] - off["p50"]) / off["p50"] if off["p50"] else 0
+        overhead_ok = overhead <= args.max_overhead
+        ok = scored_ok and overhead_ok
+        emit({
+            "check": "score-on scores exactly the enrolled rows; "
+                     "floored score-on p50 within the overhead bound "
+                     "of score-off (~free under the dispatch floor)",
+            "floor_us": floor,
+            "scored_per_batch": scored,
+            "expected_scored": expected,
+            "dispatch_pkts": b,
+            "p50_overhead_fraction": round(overhead, 4),
+            "max_overhead": args.max_overhead,
+            "ok": ok,
+        })
+    if args.out:
+        with open(args.out, "a") as fh:
+            for line in lines:
+                fh.write(line + "\n")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

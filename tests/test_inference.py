@@ -1,0 +1,1077 @@
+"""In-network inference plane (ISSUE 14).
+
+Pillars, each tested at its own layer and then through the full stack:
+
+- **Packed-word layout** — the named masks are the single source of
+  truth: a randomized bit-for-bit round-trip property over all three
+  encoders (device pack, host pack twin, unpack), so they can never
+  drift (satellite: bit layout as one source of truth).
+- **Scorer semantics** — device stage ≡ host reference scorer
+  (shared f32 feature/MLP/band bodies), enrollment precedence, the
+  log2 band thresholds, and the score-off program being bit-identical
+  to the pre-inference pipeline.
+- **Delta builder** — randomized churn property: incrementally built
+  tables ≡ from-scratch rebuilds, with O(changed) rows shipped.
+- **Oracle parity** — pipeline score-band + action verdicts ≡ the
+  host-side InferOracle at every governor-chosen K on BOTH engines,
+  including the quarantine action path (the mock-engine discipline).
+- **Action paths** — quarantine denies + pcap + flight evidence; log/
+  deprioritize count and forward; sharded swaps stay atomic under an
+  injected failure.
+- **Control plane** — CRD parse/validation/controller, renderer
+  delete semantics, and the acceptance e2e: a CRD write enables
+  scoring for a namespace → weights delta-swap with a propagation
+  span → a crafted anomalous flow crosses the threshold → quarantine
+  fires with evidence → all surfaces (inspect/REST/netctl/dashboard/
+  Prometheus) show it.
+"""
+
+import io
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from vpp_tpu.conf import IPAMConfig
+from vpp_tpu.controller import Controller, DBResync, KubeStateChange
+from vpp_tpu.crd import CRDPlugin, InferPolicy, validate_infer_policy
+from vpp_tpu.crd.controller import parse_infer_policy
+from vpp_tpu.crd.plugin import InferPolicyChange
+from vpp_tpu.datapath import (
+    DataplaneRunner,
+    InMemoryRing,
+    NativeRing,
+    ShardedDataplane,
+    VxlanOverlay,
+)
+from vpp_tpu.inference import (
+    InferencePlugin,
+    InferOracle,
+    anomaly_port_model,
+    default_model,
+)
+from vpp_tpu.inference.model import InferModel, model_rows_changed
+from vpp_tpu.ipam import IPAM
+from vpp_tpu.kvstore import KVStore
+from vpp_tpu.models import Pod
+from vpp_tpu.netctl.cli import main as netctl_main
+from vpp_tpu.ops.classify import build_rule_tables
+from vpp_tpu.ops.infer import (
+    INFER_ACT_DEPRIORITIZE,
+    INFER_ACT_LOG,
+    INFER_ACT_NONE,
+    INFER_ACT_QUARANTINE,
+    INFER_BANDS,
+    INFER_FEATURES,
+    _score_band,
+    build_infer_table,
+    infer_scores,
+    score_host,
+)
+from vpp_tpu.ops.infer_delta import (
+    INFER_MODEL_KEY,
+    INFER_POD_PREFIX,
+    InferTableBuilder,
+)
+from vpp_tpu.ops.nat import build_nat_tables, empty_sessions
+from vpp_tpu.ops.packets import PacketBatch, ip_to_u32, make_batch
+from vpp_tpu.ops.pipeline import (
+    INFER_ACTION_MASK,
+    INFER_ACTION_SHIFT,
+    INFER_BAND_MASK,
+    INFER_BAND_SHIFT,
+    INFER_SCORED,
+    VERDICT_NODE_MASK,
+    VERDICT_NODE_SHIFT,
+    make_route_config,
+    pack_verdicts_host,
+    pipeline_flat_safe_ts0_jit,
+    unpack_verdicts,
+)
+from vpp_tpu.policy.renderer.infer import (
+    SchedInferRenderer,
+    TpuInferRenderer,
+    infer_pod_key,
+)
+from vpp_tpu.rest.server import AgentRestServer
+from vpp_tpu.scheduler import TxnScheduler
+from vpp_tpu.scheduler.tpu_applicators import TpuInferApplicator
+from vpp_tpu.testing.frames import build_frame, frame_tuple
+
+POD_IP = "10.1.1.3"
+ANOMALY_FLOOR = 60000
+
+
+def _anomaly_table(action=INFER_ACT_QUARANTINE, threshold=6,
+                   pods=(POD_IP,)):
+    return build_infer_table(
+        anomaly_port_model(ANOMALY_FLOOR).to_dict(),
+        {ip_to_u32(ip): (threshold, action) for ip in pods},
+    )
+
+
+def _make_runner(ring_cls=InMemoryRing, **kw):
+    ipam = IPAM(IPAMConfig(), node_id=1)
+    rx, tx, local, host = (ring_cls() for _ in range(4))
+    kw.setdefault("batch_size", 8)
+    kw.setdefault("max_vectors", 8)
+    runner = DataplaneRunner(
+        acl=build_rule_tables([], {}),
+        nat=build_nat_tables([], snat_enabled=False,
+                             pod_subnet="10.1.0.0/16"),
+        route=make_route_config(ipam),
+        overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"),
+                             local_node_id=1),
+        source=rx, tx=tx, local=local, host=host,
+        **kw,
+    )
+    return runner, (rx, tx, local, host)
+
+
+# ------------------------------------------------------ packed-word layout
+
+
+def test_packed_word_round_trip_property_all_fields():
+    """Satellite: the bit layout has ONE source of truth — random
+    values through the host pack twin and back must round-trip every
+    field bit-for-bit, including the inference leaves and the 16-bit
+    node id."""
+    rng = np.random.RandomState(14)
+    n = 512
+    fields = {
+        "allowed": rng.rand(n) < 0.5,
+        "punt": rng.rand(n) < 0.3,
+        "reply_hit": rng.rand(n) < 0.3,
+        "dnat_hit": rng.rand(n) < 0.3,
+        "snat_hit": rng.rand(n) < 0.3,
+        "route": rng.randint(0, 4, n).astype(np.int32),
+        "node_id": rng.randint(0, VERDICT_NODE_MASK + 1, n).astype(np.int32),
+        "src_ip": rng.randint(0, 2**32, n, dtype=np.uint32),
+        "dst_ip": rng.randint(0, 2**32, n, dtype=np.uint32),
+        "src_port": rng.randint(0, 65536, n).astype(np.int32),
+        "dst_port": rng.randint(0, 65536, n).astype(np.int32),
+    }
+    straggler = rng.rand(n) < 0.2
+    scored = rng.rand(n) < 0.6
+    band = rng.randint(0, INFER_BANDS, n).astype(np.int32)
+    action = rng.randint(0, 4, n).astype(np.int32)
+    pk = pack_verdicts_host(**fields, straggler=straggler,
+                            scored=scored, band=band, action=action)
+    v = unpack_verdicts(pk)
+    for name, want in fields.items():
+        np.testing.assert_array_equal(getattr(v, name), want, err_msg=name)
+    np.testing.assert_array_equal(v.straggler, straggler)
+    np.testing.assert_array_equal(v.scored, scored)
+    np.testing.assert_array_equal(v.band, band)
+    np.testing.assert_array_equal(v.action, action)
+
+
+def test_packed_word_fields_do_not_overlap():
+    """The named shifts/masks carve disjoint bit ranges (bits 30-31
+    reserved)."""
+    ranges = [
+        (0, 0x7F),  # verdict flags + route (bits 0-6)
+        (7, 0x1),   # straggler
+        (VERDICT_NODE_SHIFT, VERDICT_NODE_MASK),
+        (INFER_BAND_SHIFT, INFER_BAND_MASK),
+        (27, 0x1),  # scored
+        (INFER_ACTION_SHIFT, INFER_ACTION_MASK),
+    ]
+    assert INFER_SCORED == 1 << 27
+    seen = 0
+    for shift, mask in ranges:
+        bits = mask << shift
+        assert seen & bits == 0, f"overlap at shift {shift}"
+        seen |= bits
+    assert seen == 0x3FFFFFFF  # bits 30-31 reserved
+
+
+def test_device_pack_matches_host_pack_with_scores():
+    """Device packing of an infer-enabled program ≡ the host twin fed
+    the unpacked leaves — the quarantine stitcher cannot drift from
+    the device tail."""
+    infer = _anomaly_table()
+    acl = build_rule_tables([], {})
+    nat = build_nat_tables([], snat_enabled=False, pod_subnet="10.1.0.0/16")
+    route = make_route_config(IPAM(IPAMConfig(), node_id=1))
+    flows = [("10.1.1.2", POD_IP, 6, 41000 + i,
+              80 if i % 2 == 0 else ANOMALY_FLOOR + 2000)
+             for i in range(16)]
+    batches = jax.tree_util.tree_map(
+        lambda a: a.reshape(2, 8), make_batch(flows))
+    r = pipeline_flat_safe_ts0_jit(
+        acl, nat, route, empty_sessions(1024), batches, jnp.int32(0), infer)
+    pk = np.asarray(r.packed)
+    v = unpack_verdicts(pk)
+    assert v.scored.all()
+    assert set(np.unique(v.band)) == {0, 7}
+    host_pk = pack_verdicts_host(
+        v.allowed, v.punt, v.reply_hit, v.dnat_hit, v.snat_hit,
+        v.route, v.node_id, v.src_ip, v.dst_ip, v.src_port, v.dst_port,
+        straggler=v.straggler, scored=v.scored, band=v.band,
+        action=v.action)
+    np.testing.assert_array_equal(host_pk, pk)
+
+
+# ------------------------------------------------------- scorer semantics
+
+
+def test_score_band_log2_thresholds():
+    """Band k <=> score in [1 - 2^-k, 1 - 2^-(k+1)), clamped to 7 —
+    so a policy threshold t fires exactly at score >= 1 - 2^-t."""
+    scores = np.float32([0.0, 0.3, 0.5, 0.74, 0.75, 0.875, 0.99,
+                         1.0 - 2.0**-7, 0.9999, 1.0])
+    bands = _score_band(scores, np)
+    assert list(bands) == [0, 0, 1, 1, 2, 3, 6, 7, 7, 7]
+
+
+def test_device_host_scorer_parity_random_model():
+    """The device stage and the host reference scorer share the exact
+    f32 bodies: scores agree to float tolerance, bands agree exactly
+    away from band boundaries (the crafted decisive models used by the
+    oracle tests sit far from every boundary)."""
+    model = default_model(seed=3)
+    rng = np.random.RandomState(5)
+    n = 256
+    src = rng.randint(0, 2**32, n, dtype=np.uint32)
+    dst = rng.randint(0, 2**32, n, dtype=np.uint32)
+    proto = rng.choice([6, 17], n).astype(np.int32)
+    sport = rng.randint(1, 65536, n).astype(np.int32)
+    dport = rng.randint(1, 65536, n).astype(np.int32)
+    reply = rng.rand(n) < 0.3
+    dnat = rng.rand(n) < 0.3
+    snat = rng.rand(n) < 0.3
+    # Enroll EVERY src ip so all rows score.
+    table = build_infer_table(
+        model.to_dict(),
+        {int(ip): (0, INFER_ACT_LOG) for ip in src})
+    batch = PacketBatch(
+        src_ip=jnp.asarray(src), dst_ip=jnp.asarray(dst),
+        protocol=jnp.asarray(proto), src_port=jnp.asarray(sport),
+        dst_port=jnp.asarray(dport))
+    scored, band, _ = infer_scores(
+        table, batch, jnp.asarray(reply), jnp.asarray(dnat),
+        jnp.asarray(snat))
+    assert np.asarray(scored).all()
+    host_score, host_band = score_host(
+        model.w1, model.b1, model.w2, model.b2,
+        src, dst, proto, sport, dport, reply, dnat, snat)
+    dev_band = np.asarray(band)
+    # Rows whose score sits within float tolerance of a band edge may
+    # legitimately band either way across backends; everything else
+    # must agree exactly.
+    edges = 1.0 - 2.0 ** -np.arange(1, 8, dtype=np.float64)
+    near_edge = np.min(
+        np.abs(host_score[:, None].astype(np.float64) - edges[None, :]),
+        axis=1) < 1e-5
+    np.testing.assert_array_equal(dev_band[~near_edge],
+                                  host_band[~near_edge])
+    assert near_edge.mean() < 0.05  # the tolerance is a corner, not a veil
+
+
+def test_enrollment_src_precedence_dst_fallback():
+    src_pod = ip_to_u32("10.1.1.5")
+    dst_pod = ip_to_u32("10.1.1.6")
+    table = build_infer_table(
+        anomaly_port_model().to_dict(),
+        {src_pod: (0, INFER_ACT_LOG), dst_pod: (0, INFER_ACT_DEPRIORITIZE)})
+
+    def one(src, dst):
+        batch = PacketBatch(
+            src_ip=jnp.asarray([src], dtype=jnp.uint32),
+            dst_ip=jnp.asarray([dst], dtype=jnp.uint32),
+            protocol=jnp.asarray([6]), src_port=jnp.asarray([1000]),
+            dst_port=jnp.asarray([80]))
+        z = jnp.zeros(1, bool)
+        scored, _, action = infer_scores(table, batch, z, z, z)
+        return bool(np.asarray(scored)[0]), int(np.asarray(action)[0])
+
+    # Both enrolled: the SOURCE binding wins.
+    assert one(src_pod, dst_pod) == (True, INFER_ACT_LOG)
+    # Only the destination enrolled: fallback.
+    assert one(ip_to_u32("99.0.0.1"), dst_pod) == \
+        (True, INFER_ACT_DEPRIORITIZE)
+    # Neither: unscored.
+    assert one(ip_to_u32("99.0.0.1"), ip_to_u32("99.0.0.2")) == \
+        (False, INFER_ACT_NONE)
+
+
+def test_score_off_program_bit_identical():
+    """A disabled table and no table at all compile to the SAME
+    program output — the score-off datapath is the pre-ISSUE-14
+    pipeline bit-for-bit (the acceptance criterion's 'score-off
+    throughput unchanged' in its strongest form)."""
+    acl = build_rule_tables([], {})
+    nat = build_nat_tables([], snat_enabled=False, pod_subnet="10.1.0.0/16")
+    route = make_route_config(IPAM(IPAMConfig(), node_id=1))
+    flows = [("10.1.1.2", POD_IP, 6, 41000 + i, 64000) for i in range(8)]
+    batches = jax.tree_util.tree_map(
+        lambda a: a.reshape(1, 8), make_batch(flows))
+    r_none = pipeline_flat_safe_ts0_jit(
+        acl, nat, route, empty_sessions(256), batches, jnp.int32(0))
+    r_disabled = pipeline_flat_safe_ts0_jit(
+        acl, nat, route, empty_sessions(256), batches, jnp.int32(0),
+        build_infer_table(None, {}))
+    np.testing.assert_array_equal(
+        np.asarray(r_none.packed), np.asarray(r_disabled.packed))
+    v = unpack_verdicts(np.asarray(r_none.packed))
+    assert not v.scored.any() and not v.band.any() and not v.action.any()
+
+
+# --------------------------------------------------------- delta builder
+
+
+def _rand_state(rng, n_pods, model):
+    state = {INFER_MODEL_KEY: model.to_dict()}
+    for i in range(n_pods):
+        ip = ip_to_u32(f"10.1.{1 + i // 200}.{2 + i % 200}")
+        state[f"{INFER_POD_PREFIX}10.1.{1 + i // 200}.{2 + i % 200}"] = (
+            ip, int(rng.randint(0, 8)), int(rng.randint(1, 4)))
+    return state
+
+
+def _tables_equal(a, b):
+    for leaf_a, leaf_b in zip(jax.tree_util.tree_leaves(a),
+                              jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(leaf_a),
+                                      np.asarray(leaf_b))
+    assert a.num_pods == b.num_pods and a.enabled == b.enabled
+
+
+def test_delta_builder_randomized_churn_matches_full_rebuild():
+    """The PR 2 churn property applied to the inference table: after
+    every random step (model row perturbations, threshold/action
+    tweaks, pod adds/removes incl. bucket crossings) the incrementally
+    built table is array-identical to a from-scratch build."""
+    rng = np.random.RandomState(41)
+    builder = InferTableBuilder()
+    model = default_model(seed=1)
+    state = _rand_state(rng, 10, model)
+    tables = builder.sync(dict(state))
+    _tables_equal(tables, build_infer_table(
+        model.to_dict(),
+        InferTableBuilder._desired_slots(state)))
+    for step in range(25):
+        op = rng.rand()
+        if op < 0.35:  # perturb some w1 rows
+            w1 = model.w1.copy()
+            for row in rng.choice(INFER_FEATURES,
+                                  rng.randint(1, 4), replace=False):
+                w1[row] += rng.randn(w1.shape[1]).astype(np.float32) * 0.1
+            model = InferModel(w1=w1, b1=model.b1, w2=model.w2, b2=model.b2)
+            state[INFER_MODEL_KEY] = model.to_dict()
+        elif op < 0.5:  # retune b1/w2/b2
+            model = InferModel(
+                w1=model.w1,
+                b1=model.b1 + np.float32(0.01),
+                w2=model.w2, b2=model.b2 + 0.01)
+            state[INFER_MODEL_KEY] = model.to_dict()
+        elif op < 0.75:  # add pods (may cross the pow2 bucket)
+            for _ in range(rng.randint(1, 9)):
+                i = rng.randint(0, 2000)
+                ip_s = f"10.2.{i // 200}.{2 + i % 200}"
+                state[INFER_POD_PREFIX + ip_s] = (
+                    ip_to_u32(ip_s), int(rng.randint(0, 8)),
+                    int(rng.randint(1, 4)))
+        else:  # remove pods
+            pod_keys = [k for k in state if k.startswith(INFER_POD_PREFIX)]
+            for k in rng.choice(pod_keys,
+                                min(len(pod_keys), rng.randint(1, 5)),
+                                replace=False):
+                del state[k]
+        tables = builder.sync(dict(state))
+        expect = build_infer_table(
+            state[INFER_MODEL_KEY],
+            InferTableBuilder._desired_slots(state))
+        _tables_equal(tables, expect)
+    assert builder.stats.delta_builds > 0
+    assert builder.stats.full_builds >= 1  # first build + bucket crossings
+
+
+def test_delta_model_update_ships_changed_rows_only():
+    """A two-row model retrain ships O(2) w1 rows, not the table."""
+    builder = InferTableBuilder()
+    model = default_model(seed=2)
+    pod = ip_to_u32(POD_IP)
+    state = {INFER_MODEL_KEY: model.to_dict(),
+             INFER_POD_PREFIX + POD_IP: (pod, 6, INFER_ACT_QUARANTINE)}
+    builder.sync(dict(state))
+    w1 = model.w1.copy()
+    w1[3] += 0.5
+    w1[9] -= 0.25
+    new_model = InferModel(w1=w1, b1=model.b1, w2=model.w2, b2=model.b2)
+    assert model_rows_changed(model, new_model) == [3, 9]
+    builder.stats.begin_build()
+    state[INFER_MODEL_KEY] = new_model.to_dict()
+    tables = builder.sync(dict(state))
+    assert builder.stats.last_rows_shipped == 2  # exactly the dirty rows
+    _tables_equal(tables, build_infer_table(
+        new_model.to_dict(), {pod: (6, INFER_ACT_QUARANTINE)}))
+
+
+# ------------------------------------- oracle parity at every governor K
+
+
+def _oracle_for(table_action=INFER_ACT_QUARANTINE, threshold=6):
+    oracle = InferOracle()
+    oracle.set_state(anomaly_port_model(ANOMALY_FLOOR),
+                     {ip_to_u32(POD_IP): (threshold, table_action)})
+    return oracle
+
+
+@pytest.mark.parametrize("ring_cls", [NativeRing, InMemoryRing])
+def test_oracle_parity_at_every_governor_k_both_engines(ring_cls):
+    """Satellite (mock-engine oracle parity): mixed normal/anomalous
+    traffic in waves sized so the governor selects K = 1, 2, 4, 8 —
+    delivery, per-band score histogram, and quarantine counts must
+    match the host-side reference oracle exactly at every chosen K, on
+    both engines."""
+    runner, (rx, tx, local, host) = _make_runner(
+        ring_cls, infer=_anomaly_table())
+    oracle = _oracle_for()
+    flows, expected_delivered, expected_bands = [], [], [0] * INFER_BANDS
+    expected_q = 0
+    port = 40000
+    for wave_k in (1, 2, 4, 8):
+        wave = []
+        for i in range(wave_k * 8):
+            dport = ANOMALY_FLOOR + 2000 + i if i % 3 == 0 else 80 + i % 7
+            flow = ("10.1.1.2", POD_IP, 6, port, dport)
+            wave.append(flow)
+            scored, band, action = oracle.evaluate(*flow)
+            assert scored
+            expected_bands[band] += 1
+            if action == INFER_ACT_QUARANTINE:
+                expected_q += 1
+            else:
+                expected_delivered.append(flow)
+            port += 1
+        flows.append(wave)
+    for wave in flows:
+        rx.send([build_frame(*f) for f in wave])
+        runner.drain()
+    delivered = sorted(frame_tuple(f) for f in local.recv_batch(1 << 12))
+    assert delivered == sorted(expected_delivered)
+    assert set(runner.governor.k_hist) == {1, 2, 4, 8}
+    assert runner.counters.inference_quarantined == expected_q
+    assert runner.counters.inference_scored == sum(
+        len(w) for w in flows)
+    assert runner.inference_bands() == expected_bands
+    assert runner.counters.dropped_denied == 0
+    runner.close()
+
+
+@pytest.mark.parametrize("ring_cls", [NativeRing, InMemoryRing])
+def test_log_and_deprioritize_actions_count_but_forward(ring_cls):
+    runner, (rx, tx, local, host) = _make_runner(
+        ring_cls,
+        infer=build_infer_table(
+            anomaly_port_model(ANOMALY_FLOOR).to_dict(),
+            {ip_to_u32(POD_IP): (6, INFER_ACT_LOG),
+             ip_to_u32("10.1.1.9"): (6, INFER_ACT_DEPRIORITIZE)}))
+    frames = [
+        build_frame("10.1.1.2", POD_IP, 6, 41000, ANOMALY_FLOOR + 2000),
+        build_frame("10.1.1.9", POD_IP, 6, 41001, ANOMALY_FLOOR + 2000),
+        build_frame("10.1.1.2", POD_IP, 6, 41002, 80),
+    ]
+    rx.send(frames)
+    runner.drain()
+    delivered = sorted(frame_tuple(f) for f in local.recv_batch(256))
+    assert len(delivered) == 3          # nothing dropped
+    assert runner.counters.inference_logged == 1
+    assert runner.counters.inference_deprioritized == 1
+    assert runner.counters.inference_quarantined == 0
+    runner.close()
+
+
+def test_quarantine_action_pcap_and_flight_evidence(tmp_path):
+    """The quarantine action steers flagged flows into the PR 3
+    forensics path: dropped + counted + the frame in the quarantine
+    pcap + a flight-recorder snapshot beside it."""
+    pcap = str(tmp_path / "infer.pcap")
+    runner, (rx, tx, local, host) = _make_runner(
+        InMemoryRing, infer=_anomaly_table(), quarantine_pcap=pcap)
+    bad = build_frame("10.1.1.2", POD_IP, 6, 41000, ANOMALY_FLOOR + 2000)
+    rx.send([bad, build_frame("10.1.1.2", POD_IP, 6, 41001, 80)])
+    runner.drain()
+    delivered = [frame_tuple(f) for f in local.recv_batch(256)]
+    assert delivered == [("10.1.1.2", POD_IP, 6, 41001, 80)]
+    assert runner.counters.inference_quarantined == 1
+    assert os.path.exists(pcap)
+    with open(pcap, "rb") as fh:
+        assert bad[14:] in fh.read()  # captured IP payload bytes
+    flight = pcap + ".flight.jsonl"
+    assert os.path.exists(flight)
+    rows = [json.loads(line) for line in open(flight)]
+    assert any(r.get("reason") == "inference-quarantine" for r in rows)
+    runner.close()
+
+
+def test_trace_carries_band_and_action():
+    runner, (rx, tx, local, host) = _make_runner(
+        InMemoryRing, infer=_anomaly_table())
+    runner.tracer.enable()
+    rx.send([build_frame("10.1.1.2", POD_IP, 6, 41000,
+                         ANOMALY_FLOOR + 2000)])
+    runner.drain()
+    entries = runner.tracer.dump()
+    assert entries and entries[-1]["infer_band"] == 7
+    assert entries[-1]["infer_action"] == INFER_ACT_QUARANTINE
+    runner.close()
+
+
+# ------------------------------------------------------------- sharded
+
+
+def _make_sharded(n=2, **kw):
+    ipam = IPAM(IPAMConfig(), node_id=1)
+    ios = [tuple(InMemoryRing() for _ in range(4)) for _ in range(n)]
+    engine = ShardedDataplane(
+        acl=build_rule_tables([], {}),
+        nat=build_nat_tables([], snat_enabled=False,
+                             pod_subnet="10.1.0.0/16"),
+        route=make_route_config(ipam),
+        overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"),
+                             local_node_id=1),
+        shard_ios=ios, batch_size=8, max_vectors=8, **kw,
+    )
+    return engine, ios
+
+
+def test_sharded_infer_swap_atomic_and_rollback():
+    """A model swap lands on every shard or on none: an injected
+    swap-fail on shard 1 rolls ALL shards back to the last-good
+    inference table (same contract as ACL/NAT)."""
+    from vpp_tpu.datapath.runner import TableSwapError
+    from vpp_tpu.testing.faults import SITE_SWAP_FAIL
+
+    engine, _ios = _make_sharded(2)
+    first = _anomaly_table()
+    engine.update_tables(infer=first)
+    assert all(r.infer is first for r in engine.shards)
+    engine.faults.arm(SITE_SWAP_FAIL, shard=1, count=1)
+    with pytest.raises(TableSwapError):
+        engine.update_tables(infer=_anomaly_table(threshold=2))
+    assert all(r.infer is first for r in engine.shards)
+    gens = {r._table_gen for r in engine.shards}
+    assert len(gens) == 1  # generations re-aligned after rollback
+    engine.faults.disarm()
+    engine.close()
+
+
+def test_sharded_inspect_merges_inference():
+    engine, ios = _make_sharded(2, infer=_anomaly_table(threshold=0,
+                                                        action=INFER_ACT_LOG))
+    for i, (rx, _tx, _local, _host) in enumerate(ios):
+        rx.send([build_frame("10.1.1.2", POD_IP, 6, 41000 + i, 80)])
+    engine.drain()
+    inf = engine.inspect()["inference"]
+    assert inf["enabled"] and inf["pods"] == 1
+    assert inf["scored"] == 2           # summed across both shards
+    assert sum(inf["score_bands"]) == 2
+    m = engine.metrics()
+    assert m["datapath_inference_scored_total"] == 2
+    # Swap ticks once per engine-wide swap (shard-0 rule), not N×.
+    engine.update_tables(infer=_anomaly_table())
+    assert engine.metrics()["datapath_inference_swaps_total"] == \
+        engine.shards[0].counters.inference_swaps
+    engine.close()
+
+
+# -------------------------------------------------------- control plane
+
+
+def test_validate_infer_policy_catches_bad_specs():
+    good = {"namespaces": ["prod"], "threshold": 6,
+            "action": "quarantine",
+            "model": anomaly_port_model().to_dict()}
+    assert validate_infer_policy(good) == []
+    assert validate_infer_policy({"namespaces": []})
+    assert any("action" in e for e in validate_infer_policy(
+        {"namespaces": ["a"], "action": "drop"}))
+    assert any("threshold" in e for e in validate_infer_policy(
+        {"namespaces": ["a"], "threshold": 9}))
+    ragged = anomaly_port_model().to_dict()
+    ragged["w1"] = ragged["w1"][:4]
+    assert any("w1" in e for e in validate_infer_policy(
+        {"namespaces": ["a"], "model": ragged}))
+    # The validator's literal feature-row pin matches the ops constant.
+    from vpp_tpu.crd.validator import _INFER_FEATURE_ROWS
+
+    assert _INFER_FEATURE_ROWS == INFER_FEATURES
+
+
+def test_parse_infer_policy_validates_and_parses():
+    obj = {"metadata": {"name": "p1"},
+           "spec": {"namespaces": ["prod", "stage"], "threshold": 5,
+                    "action": "deprioritize",
+                    "model": anomaly_port_model().to_dict()}}
+    policy = parse_infer_policy("p1", obj)
+    assert policy.namespaces == ("prod", "stage")
+    assert policy.threshold == 5 and policy.action == "deprioritize"
+    assert policy.model is not None
+    with pytest.raises(ValueError):
+        parse_infer_policy("p2", {"spec": {"namespaces": ["a"],
+                                           "action": "nuke"}})
+    assert parse_infer_policy("p3", None) is None
+
+
+def test_infer_policy_crd_controller_flows_to_store_and_events():
+    from vpp_tpu.crd.controller import make_infer_policy_controller
+    from vpp_tpu.testing.k8s import FakeK8sCluster
+
+    store = KVStore()
+    loop = type("L", (), {"events": []})()
+    loop.push_event = loop.events.append
+    crd = CRDPlugin(store, event_loop=loop, node_name="node-1")
+    k8s = FakeK8sCluster()
+    ctl = make_infer_policy_controller(k8s, crd)
+    ctl.start()
+    try:
+        k8s.apply("inferpolicies", {
+            "metadata": {"name": "score-prod"},
+            "spec": {"namespaces": ["prod"], "threshold": 6,
+                     "action": "quarantine",
+                     "model": anomaly_port_model().to_dict()},
+        })
+        assert ctl.wait_idle()
+        for _ in range(100):
+            if crd.get_infer_policy("score-prod") is not None:
+                break
+            time.sleep(0.01)
+        policy = crd.get_infer_policy("score-prod")
+        assert policy is not None and policy.action == "quarantine"
+        assert any(isinstance(e, InferPolicyChange) for e in loop.events)
+        # An INVALID spec is refused: retried then dropped, never
+        # stored, never evented.
+        k8s.apply("inferpolicies", {
+            "metadata": {"name": "broken"},
+            "spec": {"namespaces": ["prod"], "action": "explode"},
+        })
+        for _ in range(400):
+            if ctl.dropped:
+                break
+            time.sleep(0.01)
+        assert ctl.dropped == 1
+        assert crd.get_infer_policy("broken") is None
+        # Deletion flows through.
+        k8s.delete("inferpolicies", "score-prod")
+        for _ in range(100):
+            if crd.get_infer_policy("score-prod") is None:
+                break
+            time.sleep(0.01)
+        assert crd.get_infer_policy("score-prod") is None
+    finally:
+        ctl.stop()
+
+
+def test_tpu_infer_renderer_direct_compile():
+    compiled = []
+    renderer = TpuInferRenderer(on_compiled=compiled.append)
+    renderer.render(anomaly_port_model(),
+                    {ip_to_u32(POD_IP): (6, INFER_ACT_QUARANTINE)},
+                    resync=True)
+    assert compiled and compiled[-1].enabled
+    assert renderer.tables.num_pods == 1
+    renderer.render(None, {}, resync=True)
+    assert not compiled[-1].enabled
+    assert renderer.stats()["compile"]["full_builds"] >= 1
+
+
+class _FakeTxn:
+    def __init__(self, resync=False):
+        self.is_resync = resync
+        self.puts = {}
+        self.deletes = []
+
+    def put(self, key, value):
+        self.puts[key] = value
+
+    def delete(self, key):
+        self.deletes.append(key)
+
+
+def test_sched_renderer_deletes_unenrolled_pods():
+    txns = []
+
+    def provider():
+        return txns[-1]
+
+    renderer = SchedInferRenderer(provider)
+    model = anomaly_port_model()
+    ip_a, ip_b = ip_to_u32("10.1.1.3"), ip_to_u32("10.1.1.4")
+    txns.append(_FakeTxn())
+    renderer.render(model, {ip_a: (6, 3), ip_b: (6, 3)}, resync=False)
+    assert set(txns[-1].puts) == {INFER_MODEL_KEY, infer_pod_key(ip_a),
+                                  infer_pod_key(ip_b)}
+    # Pod b leaves the namespace: the update txn must DELETE its key.
+    txns.append(_FakeTxn())
+    renderer.render(model, {ip_a: (6, 3)}, resync=False)
+    assert txns[-1].deletes == [infer_pod_key(ip_b)]
+    # A resync txn never deletes (unmentioned keys die by omission).
+    txns.append(_FakeTxn(resync=True))
+    renderer.render(model, {}, resync=True)
+    assert txns[-1].deletes == []
+
+
+def test_inference_plugin_composes_policies_and_pods():
+    plugin = InferencePlugin()
+    oracle = InferOracle()
+    plugin.register_renderer(oracle)
+    web = Pod(name="web", namespace="prod", ip_address="10.1.1.3")
+    db = Pod(name="db", namespace="stage", ip_address="10.1.1.4")
+    plugin.resync(None, {"pod": {"p/prod/web": web, "p/stage/db": db}},
+                  1, None)
+    assert not oracle.enabled  # pods alone enroll nothing
+    plugin.update(InferPolicyChange("a", None, InferPolicy(
+        name="a", namespaces=("prod",), threshold=6, action="quarantine",
+        model=anomaly_port_model().to_dict())), None)
+    assert oracle.enabled
+    assert set(oracle.bindings) == {ip_to_u32("10.1.1.3")}
+    # A second policy (sorted AFTER "a") claims stage; "a" keeps prod.
+    plugin.update(InferPolicyChange("b", None, InferPolicy(
+        name="b", namespaces=("stage", "prod"), threshold=2,
+        action="log")), None)
+    assert oracle.bindings[ip_to_u32("10.1.1.3")] == (6, 3)  # a wins prod
+    assert oracle.bindings[ip_to_u32("10.1.1.4")] == (2, 1)  # b gets stage
+    # Deleting the model-carrying policy disables scoring (no model).
+    plugin.update(InferPolicyChange("a", InferPolicy(name="a"), None), None)
+    assert not oracle.enabled
+
+
+# --------------------------------------------------- acceptance e2e demo
+
+
+def test_e2e_crd_write_to_quarantine_with_evidence_and_surfaces(tmp_path):
+    """The ISSUE 14 acceptance scenario, end-to-end under test: a CRD
+    write enables scoring for a namespace → the weights delta-swap to
+    the device inside a spanned control-plane txn (compile:infer /
+    swap:infer / adopt stages) → a crafted anomalous flow crosses the
+    threshold → the quarantine action fires with pcap + flight
+    evidence → the score histogram and action counters are visible via
+    inspect(), REST, `netctl inspect`, the dashboard view model, and
+    Prometheus."""
+    pcap = str(tmp_path / "q.pcap")
+    runner, (rx, tx, local, host) = _make_runner(
+        InMemoryRing, quarantine_pcap=pcap)
+    app = TpuInferApplicator()
+    app.on_compiled = lambda t: runner.update_tables(infer=t)
+    app.installed_fn = lambda: runner.infer
+    scheduler = TxnScheduler()
+    scheduler.register_applicator(app)
+    plugin = InferencePlugin()
+    plugin.register_renderer(
+        SchedInferRenderer(lambda: ctl.current_txn, applicator=app))
+    oracle = InferOracle()
+    plugin.register_renderer(oracle)
+    ctl = Controller([plugin], scheduler)
+    ctl.start()
+    rest = None
+    try:
+        web = Pod(name="web", namespace="prod", ip_address=POD_IP)
+        resync = DBResync(kube_state={"pod": {"pod/prod/web": web}})
+        ctl.push_event(resync)
+        assert resync.wait(30) is None
+
+        # --- the CRD write (through the CRDPlugin event path) --------
+        crd = CRDPlugin(KVStore(), event_loop=ctl)
+        crd.apply_infer_policy(InferPolicy(
+            name="quarantine-prod", namespaces=("prod",), threshold=6,
+            action="quarantine",
+            model=anomaly_port_model(ANOMALY_FLOOR).to_dict()))
+        for _ in range(300):
+            if runner.infer is not None and runner.infer.enabled:
+                break
+            time.sleep(0.02)
+        assert runner.infer is not None and runner.infer.enabled
+        assert runner.counters.inference_swaps >= 1
+
+        # --- propagation span recorded -------------------------------
+        spans = ctl.spans.dump()
+        span = next(s for s in reversed(spans)
+                    if s["event"] == "Infer Policy Change")
+        stages = [g["stage"] for g in span["stages"]]
+        for expected in ("handler:inference", "compile:infer",
+                         "swap:infer", "adopt:shard0", "commit"):
+            assert expected in stages, (expected, stages)
+        assert span["propagated"] is True
+
+        # --- the crafted anomalous flow fires quarantine -------------
+        bad = build_frame("10.1.1.2", POD_IP, 6, 41000,
+                          ANOMALY_FLOOR + 2000)
+        good = build_frame("10.1.1.2", POD_IP, 6, 41001, 80)
+        assert oracle.evaluate("10.1.1.2", POD_IP, 6, 41000,
+                               ANOMALY_FLOOR + 2000)[2] == \
+            INFER_ACT_QUARANTINE
+        rx.send([bad, good])
+        runner.drain()
+        delivered = [frame_tuple(f) for f in local.recv_batch(256)]
+        assert delivered == [("10.1.1.2", POD_IP, 6, 41001, 80)]
+        assert runner.counters.inference_quarantined == 1
+        assert os.path.exists(pcap)
+        assert os.path.exists(pcap + ".flight.jsonl")
+
+        # --- surfaces ------------------------------------------------
+        inf = runner.inspect()["inference"]
+        assert inf["enabled"] and inf["quarantined"] == 1
+        assert inf["score_bands"][7] == 1 and inf["score_bands"][0] == 1
+
+        rest = AgentRestServer(node_name="n1", controller=ctl,
+                               datapath=runner)
+        port = rest.start()
+        server = f"127.0.0.1:{port}"
+        import urllib.request
+
+        with urllib.request.urlopen(
+                f"http://{server}/contiv/v1/inspect", timeout=5) as resp:
+            payload = json.loads(resp.read())
+        assert payload["inference"]["quarantined"] == 1
+
+        out = io.StringIO()
+        assert netctl_main(["inspect", "--server", server], out=out) == 0
+        text = out.getvalue()
+        assert "inference: on" in text and "quarantined=1" in text
+        assert "7:1" in text  # band histogram rendered
+
+        from vpp_tpu.uibackend.views import shape_inference
+
+        panel = shape_inference(payload)
+        assert panel["quarantined"] == 1 and panel["score_bands"][7] == 1
+
+        from prometheus_client import CollectorRegistry, generate_latest
+
+        from vpp_tpu.statscollector.plugin import StatsCollector
+
+        collector = StatsCollector(registry=CollectorRegistry())
+        collector.register_datapath(runner)
+        metrics_text = generate_latest(collector.registry).decode()
+        assert "datapath_inference_quarantined_total 1.0" in metrics_text
+        assert 'datapath_inference_score_band_total{band="7"} 1.0' \
+            in metrics_text
+
+        # --- a model retrain delta-swaps (O(changed) rows) -----------
+        swaps0 = runner.counters.inference_swaps
+        crd.apply_infer_policy(InferPolicy(
+            name="quarantine-prod", namespaces=("prod",), threshold=6,
+            action="quarantine",
+            model=anomaly_port_model(ANOMALY_FLOOR + 1000).to_dict()))
+        for _ in range(300):
+            if runner.counters.inference_swaps > swaps0:
+                break
+            time.sleep(0.02)
+        assert runner.counters.inference_swaps > swaps0
+        stats = app.stats()["compile"]
+        assert stats["delta_builds"] >= 1
+        assert stats["last_rows_shipped"] <= 4  # a row tweak, not a re-upload
+    finally:
+        if rest is not None:
+            rest.stop()
+        ctl.stop()
+        runner.close()
+
+
+# ----------------------------------------------------------- prewarm
+
+
+def test_prewarm_signature_keys_on_inference_enable():
+    """Flipping the inference static gate changes the compiled
+    program, so the pre-warm ledger signature must change too — an
+    enable flip must not look pre-warmed while every bucket actually
+    recompiles."""
+    runner, _rings = _make_runner(InMemoryRing)
+    sig_off = runner._bucket_signature(1)
+    runner.update_tables(infer=_anomaly_table())
+    sig_on = runner._bucket_signature(1)
+    assert sig_off != sig_on
+    runner.update_tables(infer=build_infer_table(None, {}))
+    # Disabled ≠ absent in the signature tuple, but both trace the
+    # stage away; what matters is enabled-vs-disabled differ.
+    assert runner._bucket_signature(1) != sig_on
+    runner.close()
+
+
+# ------------------------------------------- review-hardening regressions
+
+
+def test_broadcast_ip_never_matches_pad_slots():
+    """A packet to 255.255.255.255 must not 'enroll' against the
+    pod-array padding slots: it is unscored, and the band histogram
+    (the score-storm triage surface) stays clean."""
+    table = _anomaly_table(threshold=0, action=INFER_ACT_LOG)
+    batch = PacketBatch(
+        src_ip=jnp.asarray([0xFFFFFFFF], dtype=jnp.uint32),
+        dst_ip=jnp.asarray([0xFFFFFFFF], dtype=jnp.uint32),
+        protocol=jnp.asarray([17]), src_port=jnp.asarray([68]),
+        dst_port=jnp.asarray([67]))
+    z = jnp.zeros(1, bool)
+    scored, band, action = infer_scores(table, batch, z, z, z)
+    assert not bool(np.asarray(scored)[0])
+    assert int(np.asarray(action)[0]) == INFER_ACT_NONE
+
+
+@pytest.mark.parametrize("ring_cls", [NativeRing, InMemoryRing])
+def test_quarantine_skips_rows_already_denied(ring_cls):
+    """A flow the ACL denies is not 'dropped by quarantine' even when
+    its score crosses the threshold: inference_quarantined must not
+    claim it and dropped_denied must not be double-subtracted
+    negative."""
+    from vpp_tpu.models import ProtocolType
+    from vpp_tpu.policy.renderer.api import Action, ContivRule
+
+    rules = [ContivRule(action=Action.DENY, protocol=ProtocolType.TCP,
+                        dst_port=ANOMALY_FLOOR + 2000),
+             ContivRule(action=Action.PERMIT)]
+    ipam = IPAM(IPAMConfig(), node_id=1)
+    rx, tx, local, host = (ring_cls() for _ in range(4))
+    runner = DataplaneRunner(
+        acl=build_rule_tables([rules], {ip_to_u32(POD_IP): (0, 0)}),
+        nat=build_nat_tables([], snat_enabled=False,
+                             pod_subnet="10.1.0.0/16"),
+        route=make_route_config(ipam),
+        overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"),
+                             local_node_id=1),
+        source=rx, tx=tx, local=local, host=host,
+        batch_size=8, max_vectors=8, infer=_anomaly_table())
+    rx.send([build_frame("10.1.1.2", POD_IP, 6, 41000,
+                         ANOMALY_FLOOR + 2000)])
+    runner.drain()
+    assert local.recv_batch(16) == []
+    assert runner.counters.inference_scored == 1
+    assert runner.counters.inference_quarantined == 0  # the ACL owns it
+    assert runner.counters.dropped_denied == 1
+    runner.close()
+
+
+def test_route_config_refuses_node_ids_wider_than_packed_field():
+    """A pod-subnet layout minting >16-bit node ids must be refused at
+    table-build time — the packed verdict word would silently truncate
+    them and tunnel frames to the wrong node."""
+    wide = IPAM(IPAMConfig(pod_subnet_cidr="10.0.0.0/8",
+                           pod_subnet_one_node_prefix_len=25), node_id=1)
+    with pytest.raises(ValueError, match="node id"):
+        make_route_config(wide)
+    # The 16-bit boundary itself is fine.
+    ok = IPAM(IPAMConfig(pod_subnet_cidr="10.0.0.0/8",
+                         pod_subnet_one_node_prefix_len=24), node_id=1)
+    make_route_config(ok)
+
+
+def test_infer_policy_store_fanout_reaches_agent_datapath():
+    """Production delivery path (no co-located CRD plugin): an
+    InferPolicy PUBLISHED INTO THE CLUSTER STORE under the registry
+    prefix reaches the agent's controller via the DBWatcher as a
+    KubeStateChange("inferpolicy"), renders, compiles, and swaps the
+    runner's device table; deleting the store key sweeps the
+    enrollment.  This is what makes ONE CRD write enroll every node."""
+    from vpp_tpu.controller.dbwatcher import DBWatcher
+    from vpp_tpu.models import key_for
+
+    runner, (rx, tx, local, host) = _make_runner(InMemoryRing)
+    app = TpuInferApplicator()
+    app.on_compiled = lambda t: runner.update_tables(infer=t)
+    scheduler = TxnScheduler()
+    scheduler.register_applicator(app)
+    plugin = InferencePlugin()
+    plugin.register_renderer(
+        SchedInferRenderer(lambda: ctl.current_txn, applicator=app))
+    ctl = Controller([plugin], scheduler)
+    ctl.start()
+    store = KVStore()
+    watcher = DBWatcher(ctl, store)
+    watcher.start()
+    try:
+        web = Pod(name="web", namespace="prod", ip_address=POD_IP)
+        store.put(key_for(web), web)
+        policy = InferPolicy(
+            name="quarantine-prod", namespaces=("prod",), threshold=6,
+            action="quarantine",
+            model=anomaly_port_model(ANOMALY_FLOOR).to_dict())
+        store.put(key_for(policy), policy)
+        for _ in range(300):
+            if runner.infer is not None and runner.infer.enabled:
+                break
+            time.sleep(0.02)
+        assert runner.infer is not None and runner.infer.enabled
+        assert runner.infer.num_pods == 1
+        # The store delete sweeps the enrollment end-to-end.
+        store.delete(key_for(policy))
+        for _ in range(300):
+            if runner.infer is not None and not runner.infer.enabled:
+                break
+            time.sleep(0.02)
+        assert not runner.infer.enabled
+    finally:
+        watcher.stop()
+        ctl.stop()
+        runner.close()
+
+
+def test_mesh_runner_scores_with_replicated_infer_table():
+    """Mesh (multichip) regression: the inference table must carry a
+    mesh placement like every other dispatch argument — a
+    single-device table mixed into a GSPMD dispatch is an
+    incompatible-devices error that would take the shard down.  Covers
+    BOTH placement paths: table present at construction (_shard_state)
+    and an infer-only swap on a live mesh runner (_adopt_tables)."""
+    from vpp_tpu.parallel import make_mesh
+
+    mesh = make_mesh(8)
+    ipam = IPAM(IPAMConfig(), node_id=1)
+    rx, tx, local, host = (InMemoryRing() for _ in range(4))
+    runner = DataplaneRunner(
+        acl=build_rule_tables([], {}),
+        nat=build_nat_tables([], snat_enabled=False,
+                             pod_subnet="10.1.0.0/16"),
+        route=make_route_config(ipam),
+        overlay=VxlanOverlay(local_ip=ip_to_u32("192.168.16.1"),
+                             local_node_id=1),
+        source=rx, tx=tx, local=local, host=host,
+        batch_size=8, max_vectors=8, mesh=mesh,
+        infer=_anomaly_table())
+    try:
+        frames = [build_frame("10.1.1.2", POD_IP, 6, 41000 + i,
+                              80 if i % 2 == 0 else ANOMALY_FLOOR + 2000)
+                  for i in range(8)]
+        rx.send(frames)
+        runner.drain()
+        delivered = sorted(frame_tuple(f) for f in local.recv_batch(256))
+        assert len(delivered) == 4 and all(t[4] == 80 for t in delivered)
+        assert runner.counters.inference_quarantined == 4
+        # Infer-only swap on the live mesh runner re-places the table.
+        runner.update_tables(infer=_anomaly_table(threshold=0,
+                                                  action=INFER_ACT_LOG))
+        rx.send([build_frame("10.1.1.2", POD_IP, 6, 42000, 80)])
+        runner.drain()
+        assert runner.counters.inference_logged >= 1
+    finally:
+        runner.close()
+
+
+def test_pod_churn_outside_enrolled_namespaces_skips_render():
+    """Cluster-wide pod churn in namespaces no policy claims must not
+    re-render (and so must not re-compile) the inference state."""
+    renders = []
+
+    class Spy:
+        def render(self, model, bindings, resync):
+            renders.append((model, dict(bindings), resync))
+
+    plugin = InferencePlugin()
+    plugin.register_renderer(Spy())
+    plugin.update(InferPolicyChange("a", None, InferPolicy(
+        name="a", namespaces=("prod",), threshold=6, action="log",
+        model=anomaly_port_model().to_dict())), None)
+    n0 = len(renders)
+    other = Pod(name="x", namespace="dev", ip_address="10.1.2.9")
+    plugin.update(KubeStateChange("pod", "p/dev/x", None, other), None)
+    assert len(renders) == n0          # un-enrolled namespace: skipped
+    web = Pod(name="web", namespace="prod", ip_address=POD_IP)
+    plugin.update(KubeStateChange("pod", "p/prod/web", None, web), None)
+    assert len(renders) == n0 + 1      # enrolled namespace: rendered
+    # The parsed model is cached per policy instance, not re-parsed.
+    assert renders[-1][0] is renders[0][0]

@@ -913,3 +913,71 @@ def test_repo_scan_via_api_matches_cli():
     unwaived, waived = run_checks(project)
     assert unwaived == [], [f.format() for f in unwaived]
     assert all(w.waiver_reason for w in waived)
+
+
+def test_obs_must_flag_inference_panel_key_nobody_produces():
+    """ISSUE 14 must-flag: the dashboard inference panel (and the
+    `netctl inspect` inference line) read the inspect_inference
+    literal schema — a renamed action counter would blank the score
+    surface during exactly the score storm it exists to explain."""
+    views = """
+def shape_inference(inspect):
+    inf = inspect.get("inference") or {}
+    return {"q": inf.get("quarantine_total", 0)}
+"""
+    producer = """
+class DataplaneRunner:
+    def inspect_inference(self):
+        return {"enabled": False, "pods": 0, "scored": 0,
+                "quarantined": 0, "score_bands": []}
+
+    def inspect(self):
+        return {"inference": self.inspect_inference()}
+"""
+    project = Project.from_sources({
+        "vpp_tpu/uibackend/views.py": views,
+        "vpp_tpu/datapath/runner.py": producer,
+    })
+    unwaived, _ = _run(project, _obs_checker(
+        schema_pairs=(("shape_inference",
+                       ("DataplaneRunner.inspect_inference",
+                        "DataplaneRunner.inspect")),)))
+    msgs = [f.message for f in unwaived]
+    assert any("quarantine_total" in m for m in msgs)
+    assert not any("'inference'" in m for m in msgs)
+
+
+def test_obs_must_pass_inference_surfaces_alignment():
+    """ISSUE 14 must-pass: dashboard panel + netctl line reading
+    exactly the inspect_inference schema stay clean."""
+    views = """
+def shape_inference(inspect):
+    inf = inspect.get("inference") or {}
+    return {"q": inf.get("quarantined", 0),
+            "bands": inf.get("score_bands") or []}
+
+
+def _render_inference(inf, out):
+    out.append(inf.get("scored"))
+    out.append(inf.get("score_bands"))
+"""
+    producer = """
+class DataplaneRunner:
+    def inspect_inference(self):
+        return {"enabled": False, "pods": 0, "scored": 0,
+                "quarantined": 0, "score_bands": []}
+
+    def inspect(self):
+        return {"inference": self.inspect_inference()}
+"""
+    project = Project.from_sources({
+        "vpp_tpu/uibackend/views.py": views,
+        "vpp_tpu/datapath/runner.py": producer,
+    })
+    unwaived, _ = _run(project, _obs_checker(
+        schema_pairs=(
+            ("shape_inference", ("DataplaneRunner.inspect_inference",
+                                 "DataplaneRunner.inspect")),
+            ("_render_inference", ("DataplaneRunner.inspect_inference",)),
+        )))
+    assert unwaived == [], [f.format() for f in unwaived]

@@ -118,10 +118,11 @@ def test_trace_rest_netctl_and_bug_report(traced_cluster, tmp_path):
         svc_line = next(ln for ln in text.splitlines() if "10.96.0.10" in ln)
         fields = svc_line.split()
         # DNAT flag on the traced row; the ISSUE 8 GEN/K correlation
-        # stamps follow it as the last two columns.
-        assert fields[-3] == "D"
-        assert fields[-2].isdigit() and fields[-1].isdigit()
-        assert int(fields[-1]) >= 1  # the batch's governor-chosen K
+        # stamps and the ISSUE 14 inference band column follow it.
+        assert fields[-4] == "D"
+        assert fields[-3].isdigit() and fields[-2].isdigit()
+        assert int(fields[-2]) >= 1  # the batch's governor-chosen K
+        assert fields[-1] == "0"     # no inference table -> band 0, no action
 
         with urllib.request.urlopen(
             f"http://{server}/contiv/v1/trace", timeout=5

@@ -56,12 +56,18 @@ class Agent:
         from .controller.eventloop import Controller
         from .ipam import IPAM
         from .ipv4net import IPv4Net
+        from .inference import InferencePlugin
         from .nodesync import NodeSync
         from .podmanager import PodManager
         from .policy import PolicyPlugin
+        from .policy.renderer.infer import SchedInferRenderer
         from .policy.renderer.sched import SchedPolicyRenderer
         from .scheduler import TxnScheduler
-        from .scheduler.tpu_applicators import TpuAclApplicator, TpuNatApplicator
+        from .scheduler.tpu_applicators import (
+            TpuAclApplicator,
+            TpuInferApplicator,
+            TpuNatApplicator,
+        )
         from .service import ServicePlugin
         from .service.renderer.sched import SchedNatRenderer
 
@@ -98,6 +104,20 @@ class Agent:
         self.service = ServicePlugin(name, ipam=self.ipam, nodesync=self.nodesync)
         self.service.register_renderer(self.nat_renderer)
 
+        # In-network inference plane (ISSUE 14): InferPolicy CRD events
+        # + pod state render through the scheduler into atomic
+        # InferTable swaps — same transaction discipline as ACL/NAT.
+        self.infer_applicator = None
+        self.inference = None
+        if self.config.inference:
+            self.infer_applicator = TpuInferApplicator()
+            self.infer_renderer = SchedInferRenderer(
+                lambda: self.controller.current_txn,
+                applicator=self.infer_applicator,
+            )
+            self.inference = InferencePlugin()
+            self.inference.register_renderer(self.infer_renderer)
+
         self.scheduler = TxnScheduler()
         self.hostnet = None
         if hostnet != "off":
@@ -108,6 +128,8 @@ class Agent:
             self.scheduler.register_applicator(self.hostnet)
         self.scheduler.register_applicator(self.acl_applicator)
         self.scheduler.register_applicator(self.nat_applicator)
+        if self.infer_applicator is not None:
+            self.scheduler.register_applicator(self.infer_applicator)
 
         # BGP reflection: production kernel route watcher (iproute2
         # monitor stream) in the same netns the hostnet applicator
@@ -123,13 +145,13 @@ class Agent:
             self.config, route_source=self.route_source
         )
 
-        self.controller = Controller(
-            handlers=[
-                self.nodesync, self.podmanager, self.ipv4net,
-                self.service, self.policy, self.bgpreflector,
-            ],
-            sink=self.scheduler,
-        )
+        handlers = [
+            self.nodesync, self.podmanager, self.ipv4net,
+            self.service, self.policy, self.bgpreflector,
+        ]
+        if self.inference is not None:
+            handlers.append(self.inference)
+        self.controller = Controller(handlers=handlers, sink=self.scheduler)
         self.podmanager.event_loop = self.controller
         self.nodesync.event_loop = self.controller
         self.bgpreflector.event_loop = self.controller
@@ -226,13 +248,38 @@ class Agent:
             lambda t: self.runner.update_tables(nat=t)
         self.acl_applicator.installed_fn = installed_acl
         self.nat_applicator.installed_fn = installed_nat
-        self.runner.compile_stats_fn = lambda: {
-            "acl": self.acl_applicator.stats().get("compile", {}),
-            "nat": self.nat_applicator.stats().get("compile", {}),
-        }
+        if self.infer_applicator is not None:
+            # The inference table rides the same hook contract: compile
+            # → atomic swap with last-good rollback, drift-verified by
+            # fingerprinting the runner-resident table (ISSUE 14).
+            self.infer_applicator.on_compiled = \
+                lambda t: self.runner.update_tables(infer=t)
+            self.infer_applicator.installed_fn = lambda: self._runner_infer()
+
+        def compile_stats():
+            stats = {
+                "acl": self.acl_applicator.stats().get("compile", {}),
+                "nat": self.nat_applicator.stats().get("compile", {}),
+            }
+            if self.infer_applicator is not None:
+                stats["infer"] = \
+                    self.infer_applicator.stats().get("compile", {})
+            return stats
+
+        self.runner.compile_stats_fn = compile_stats
         self.runner.update_tables(
-            acl=self.policy_renderer.tables, nat=self.nat_renderer.tables
+            acl=self.policy_renderer.tables, nat=self.nat_renderer.tables,
+            infer=self.infer_applicator.tables
+            if self.infer_applicator is not None else None,
         )
+
+    def _runner_infer(self):
+        """Southbound readback of the RESIDENT inference table (the
+        sharded engine's shards all hold the same object after an
+        atomic swap — shard 0 speaks for the node)."""
+        runner = self.runner
+        shards = getattr(runner, "shards", None)
+        return shards[0].infer if shards else runner.infer
 
     def _start_datapath(self, uplink: str) -> None:
         """Attach the native runner loop to a real interface: AF_PACKET

@@ -67,6 +67,16 @@ DEFAULT_SCHEMA_PAIRS = (
                     # dict (`drain:` line in netctl health); the literal
                     # schema lives in the locked helper.
                     "DrainCoordinator._status_locked")),
+    # ISSUE 14 inference surfaces: the dashboard's inference panel and
+    # the `netctl inspect` inference line both read the literal schema
+    # of DataplaneRunner.inspect_inference (the sharded merge reuses
+    # it) — a renamed action counter or band key would blank the score
+    # histogram on every surface at once, during exactly the score
+    # storm it exists to explain.
+    ("shape_inference", ("DataplaneRunner.inspect_inference",
+                         "DataplaneRunner.inspect",
+                         "ShardedDataplane.inspect_inference")),
+    ("_render_inference", ("DataplaneRunner.inspect_inference",)),
     # ISSUE 10 cluster surfaces: the dashboard's cluster panel and the
     # `netctl cluster` subcommands both read the fleet aggregator's
     # literal schema (ClusterScraper.summary rows + gaps, the stitched

@@ -162,6 +162,12 @@ class NetworkConfig:
     # lists ("0-3;4-7;8,9"), or "auto" to spread the process's usable
     # cores evenly across shards, or "" for no pinning (default).
     shard_cores: str = ""
+    # In-network inference plane (ISSUE 14): register the InferPolicy
+    # event handler + applicator so CRD writes can enable per-vector
+    # DNN scoring per namespace.  The subsystem is dormant (the scoring
+    # stage compiles away) until a policy enrolls a namespace; this
+    # knob removes even the control-plane surface.
+    inference: bool = True
 
     @classmethod
     def from_dict(cls, data: Optional[dict]) -> "NetworkConfig":
@@ -183,6 +189,7 @@ class NetworkConfig:
             max_inflight=data.get("max_inflight", 2),
             datapath_shards=data.get("datapath_shards", 1),
             shard_cores=data.get("shard_cores", ""),
+            inference=data.get("inference", True),
         )
 
     def overlay(self, **kw) -> "NetworkConfig":

@@ -1,12 +1,21 @@
-"""CRD plugin: NodeConfig + TelemetryReport, cluster-wide validation."""
+"""CRD plugin: NodeConfig + InferPolicy + TelemetryReport, cluster-wide
+validation."""
 
-from .models import NodeConfig, NodeInterfaceConfig, TelemetryReport, ValidationReport
+from .models import (
+    InferPolicy,
+    NodeConfig,
+    NodeInterfaceConfig,
+    TelemetryReport,
+    ValidationReport,
+)
 from .telemetry import NodeSnapshot, TelemetryCache
-from .validator import L2Validator, L3Validator
-from .plugin import CRDPlugin, NodeConfigChange
+from .validator import L2Validator, L3Validator, validate_infer_policy
+from .plugin import CRDPlugin, InferPolicyChange, NodeConfigChange
 
 __all__ = [
     "CRDPlugin",
+    "InferPolicy",
+    "InferPolicyChange",
     "L2Validator",
     "L3Validator",
     "NodeConfig",
@@ -16,4 +25,5 @@ __all__ = [
     "TelemetryCache",
     "TelemetryReport",
     "ValidationReport",
+    "validate_infer_policy",
 ]

@@ -17,7 +17,8 @@ import time
 from typing import Callable, Dict, Optional
 
 from ..ksr.listwatch import K8sListWatch
-from .models import NodeConfig, NodeInterfaceConfig
+from .models import InferPolicy, NodeConfig, NodeInterfaceConfig
+from .validator import validate_infer_policy
 
 log = logging.getLogger(__name__)
 
@@ -243,5 +244,49 @@ def make_node_config_controller(
             crd_plugin.delete_node_config(name)
         else:
             crd_plugin.apply_node_config(config)
+
+    return CrdController(kind, list_watch, process)
+
+
+# ---------------------------------------------------------- InferPolicy CRD
+
+
+def parse_infer_policy(name: str, obj: Optional[Dict]) -> Optional[InferPolicy]:
+    """inferpolicy/v1 spec JSON → InferPolicy model (ISSUE 14).  The
+    spec is VALIDATED first — an invalid object raises ValueError (the
+    work queue retries then drops it; a typo'd action must never reach
+    the device compiler)."""
+    if obj is None:
+        return None
+    spec = obj.get("spec", {}) or {}
+    errors = validate_infer_policy(spec)
+    if errors:
+        raise ValueError(
+            f"invalid InferPolicy {name!r}: " + "; ".join(errors))
+    model = spec.get("model")
+    return InferPolicy(
+        name=name,
+        namespaces=tuple(spec.get("namespaces") or ()),
+        threshold=int(spec.get("threshold", 6)),
+        action=spec.get("action", "log"),
+        enabled=bool(spec.get("enabled", True)),
+        model=dict(model) if model is not None else None,
+    )
+
+
+def make_infer_policy_controller(
+    list_watch: K8sListWatch, crd_plugin, kind: str = "inferpolicies",
+) -> CrdController:
+    """The InferPolicy controller: CRD objects → validate + parse →
+    CRDPlugin (store publish + InferPolicyChange events, consumed by
+    the InferencePlugin's render path)."""
+
+    def process(key: str, obj: Optional[Dict]) -> None:
+        name = key.rsplit("/", 1)[-1]
+        policy = parse_infer_policy(name, obj)
+        if policy is None:
+            crd_plugin.delete_infer_policy(name)
+        else:
+            crd_plugin.apply_infer_policy(policy)
 
     return CrdController(kind, list_watch, process)

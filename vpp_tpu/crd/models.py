@@ -1,11 +1,16 @@
 """CRD data models.
 
-Analogs of the reference's two CRDs
-(``plugins/crd/pkg/apis/{nodeconfig,telemetry}/v1/types.go``):
+Analogs of the reference's CRDs
+(``plugins/crd/pkg/apis/{nodeconfig,telemetry}/v1/types.go``), plus the
+reproduction-native inference policy:
 
 - ``NodeConfig`` — per-node configuration overrides consumed by the
   config merge (file < NodeConfig CRD < STN-reported < runtime);
-- ``TelemetryReport`` — the output of periodic cluster validation.
+- ``TelemetryReport`` — the output of periodic cluster validation;
+- ``InferPolicy`` — the in-network inference plane's policy surface
+  (ISSUE 14): enable per-vector DNN scoring per namespace, bind score
+  thresholds to log/deprioritize/quarantine actions, optionally ship
+  model weights inline.
 """
 
 from __future__ import annotations
@@ -35,6 +40,14 @@ class NodeConfig:
     gateway: str = ""
     nat_external_traffic: bool = False
     stealth_interface: str = ""   # StealInterface (STN mode)
+
+
+# InferPolicy (ISSUE 14) lives with the typed models — it is a
+# REFLECTED resource (registry entry "inferpolicy": the CRD controller
+# publishes validated specs into the store; every agent's DBWatcher
+# delivers them as KubeStateChange events) — re-exported here beside
+# the other CRD shapes.
+from ..models.infer import InferPolicy  # noqa: F401  (re-export)
 
 
 @dataclass(frozen=True)

@@ -16,14 +16,24 @@ from typing import Dict, Optional
 
 from ..controller.api import UpdateEvent
 from ..kvstore import KVStore
+from ..models import registry
 from ..models.registry import NODESYNC_PREFIX
-from .models import NodeCollectionStatus, NodeConfig, TelemetryReport
+from .models import (
+    InferPolicy,
+    NodeCollectionStatus,
+    NodeConfig,
+    TelemetryReport,
+)
 from .telemetry import TelemetryCache
 from .validator import L2Validator, L3Validator
 
 log = logging.getLogger(__name__)
 
 NODECONFIG_PREFIX = "/vpp-tpu/crd/nodeconfig/"
+# The inferpolicy prefix is the REGISTRY's (ISSUE 14): publishing under
+# it makes the policy watched state — every agent's DBWatcher delivers
+# it as a KubeStateChange, so one CRD write enrolls the whole fleet.
+INFERPOLICY_PREFIX = registry.resource("inferpolicy").key_prefix
 TELEMETRY_KEY = "/vpp-tpu/crd/telemetry-report"
 
 
@@ -45,6 +55,30 @@ class NodeConfigChange(UpdateEvent):
         elif self.new is None:
             op = "delete"
         return f"{self.name} [{op} {self.node}]"
+
+
+class InferPolicyChange(UpdateEvent):
+    """An in-network inference policy changed (ISSUE 14).  Unlike
+    NodeConfigChange this is CLUSTER-scoped — every node's datapath
+    enrolls the policy's namespaces — so it is always emitted to the
+    local event loop, never filtered by node name."""
+
+    name = "Infer Policy Change"
+
+    def __init__(self, policy_name: str, prev: Optional[InferPolicy],
+                 new: Optional[InferPolicy]):
+        super().__init__()
+        self.policy_name = policy_name
+        self.prev = prev
+        self.new = new
+
+    def __str__(self) -> str:
+        op = "update"
+        if self.prev is None:
+            op = "add"
+        elif self.new is None:
+            op = "delete"
+        return f"{self.name} [{op} {self.policy_name}]"
 
 
 class CRDPlugin:
@@ -90,6 +124,26 @@ class CRDPlugin:
         # (the reference filters by ServiceLabel).
         if self.event_loop is not None and (not self.node_name or name == self.node_name):
             self.event_loop.push_event(NodeConfigChange(name, prev, new))
+
+    # ----------------------------------------------------------- InferPolicy
+
+    def apply_infer_policy(self, policy: InferPolicy) -> None:
+        """Validated CRD create/update → cluster store + local event
+        (ISSUE 14; the inferpolicy controller calls this)."""
+        prev = self.store.get(INFERPOLICY_PREFIX + policy.name)
+        self.store.put(INFERPOLICY_PREFIX + policy.name, policy)
+        if self.event_loop is not None:
+            self.event_loop.push_event(
+                InferPolicyChange(policy.name, prev, policy))
+
+    def delete_infer_policy(self, name: str) -> None:
+        prev = self.store.get(INFERPOLICY_PREFIX + name)
+        if self.store.delete(INFERPOLICY_PREFIX + name):
+            if self.event_loop is not None:
+                self.event_loop.push_event(InferPolicyChange(name, prev, None))
+
+    def get_infer_policy(self, name: str) -> Optional[InferPolicy]:
+        return self.store.get(INFERPOLICY_PREFIX + name)
 
     # ------------------------------------------------------------- telemetry
 

@@ -27,6 +27,84 @@ from ..ipv4net.plugin import VXLAN_BD_NAME, VXLAN_BVI_NAME, VXLAN_VNI
 from .models import ValidationReport
 from .telemetry import NodeSnapshot
 
+# ----------------------------------------------------- InferPolicy spec
+
+# Valid action verbs of the in-network inference plane (ISSUE 14) and
+# the band range the packed verdict word can carry (3 bits).
+INFER_ACTIONS = ("log", "deprioritize", "quarantine")
+INFER_BAND_MAX = 7
+# ops.infer.INFER_FEATURES, kept literal: this validator must stay
+# importable without jax (it runs in the CRD controller of
+# datapath-less agents); a test pins it against the ops constant.
+_INFER_FEATURE_ROWS = 16
+
+
+def validate_infer_policy(spec) -> List[str]:
+    """Spec-level validation of one InferPolicy CRD object (the admission
+    role of the reference's CRD validation webhooks): returns a list of
+    human-readable errors, empty when the spec is deployable.  The CRD
+    controller refuses to apply a failing spec — a typo'd action or a
+    ragged weight matrix must never reach the device compiler."""
+    errors: List[str] = []
+    if not isinstance(spec, dict):
+        return [f"spec must be an object, got {type(spec).__name__}"]
+    namespaces = spec.get("namespaces")
+    if not isinstance(namespaces, (list, tuple)) or not namespaces or \
+            not all(isinstance(ns, str) and ns for ns in namespaces):
+        errors.append("namespaces must be a non-empty list of namespace "
+                      "names")
+    threshold = spec.get("threshold", 6)
+    if not isinstance(threshold, int) or isinstance(threshold, bool) or \
+            not 0 <= threshold <= INFER_BAND_MAX:
+        errors.append(
+            f"threshold must be a score band 0..{INFER_BAND_MAX}, "
+            f"got {threshold!r}")
+    action = spec.get("action", "log")
+    if action not in INFER_ACTIONS:
+        errors.append(
+            f"action must be one of {', '.join(INFER_ACTIONS)}, "
+            f"got {action!r}")
+    model = spec.get("model")
+    if model is not None:
+        errors.extend(_validate_model(model))
+    return errors
+
+
+def _validate_model(model) -> List[str]:
+    if not isinstance(model, dict):
+        return [f"model must be an object, got {type(model).__name__}"]
+    errors: List[str] = []
+    for field_name in ("w1", "b1", "w2", "b2"):
+        if field_name not in model:
+            errors.append(f"model.{field_name} is required")
+    if errors:
+        return errors
+    w1, b1, w2, b2 = model["w1"], model["b1"], model["w2"], model["b2"]
+    if not isinstance(w1, (list, tuple)) or \
+            len(w1) != _INFER_FEATURE_ROWS or \
+            not all(isinstance(r, (list, tuple)) for r in w1):
+        return [f"model.w1 must be a {_INFER_FEATURE_ROWS}-row matrix "
+                "(one row per datapath feature)"]
+    widths = {len(r) for r in w1}
+    if len(widths) != 1:
+        return ["model.w1 rows are ragged"]
+    hidden = widths.pop()
+    if hidden < 1:
+        return ["model.w1 must have at least one hidden column"]
+    for name, vec in (("b1", b1), ("w2", w2)):
+        if not isinstance(vec, (list, tuple)) or len(vec) != hidden:
+            errors.append(
+                f"model.{name} must be a vector of the hidden width "
+                f"({hidden})")
+    flat = [x for r in w1 for x in r]
+    for name, values in (("w1", flat), ("b1", b1), ("w2", w2),
+                         ("b2", [b2])):
+        if isinstance(values, (list, tuple)) and not all(
+                isinstance(x, (int, float)) and not isinstance(x, bool)
+                and x == x and abs(x) != float("inf") for x in values):
+            errors.append(f"model.{name} must contain finite numbers")
+    return errors
+
 
 def _node_id(snap: NodeSnapshot) -> int:
     return int(snap.ipam.get("nodeId", 0))

@@ -35,6 +35,13 @@ from ..ops.nat import (
     retarget_tables, session_occupancy, sweep_affinity, sweep_sessions,
 )
 from ..ops.classify import RuleTables
+from ..ops.infer import (
+    INFER_ACT_DEPRIORITIZE,
+    INFER_ACT_LOG,
+    INFER_ACT_QUARANTINE,
+    INFER_BANDS,
+    InferTable,
+)
 from ..ops.packets import PacketBatch
 from ..ops.pipeline import (
     PACKED_WORD,
@@ -208,6 +215,16 @@ class RunnerCounters:  # owner: shard worker — admit/dispatch/harvest/bypass a
     # ordinary punt path — crafted aliasing corners only).
     straggler_punts: int = 0
     straggler_restores: int = 0
+    # In-network inference (ISSUE 14): rows the device scorer evaluated
+    # (enrolled pod traffic), per-action firings, and inference-table
+    # swap adoptions.  Quarantined rows are dropped + pcap-captured +
+    # flight-recorded through the PR 3 forensics path; they are counted
+    # HERE, not in dropped_denied.
+    inference_scored: int = 0
+    inference_logged: int = 0
+    inference_deprioritized: int = 0
+    inference_quarantined: int = 0
+    inference_swaps: int = 0
 
     def as_dict(self) -> Dict[str, int]:
         return {f"datapath_{k}_total": v for k, v in dataclasses.asdict(self).items()}
@@ -318,6 +335,11 @@ class DataplaneRunner:
         shard_index: int = 0,
         quarantine: bool = True,
         quarantine_pcap: Optional[str] = None,
+        # In-network inference (ISSUE 14): the model-weights +
+        # enrollment table compiled into every dispatch program.  None
+        # (or a disabled table) compiles the scoring stage away — the
+        # score-off program is the pre-inference pipeline bit-for-bit.
+        infer: Optional[InferTable] = None,
     ):
         # Table references are LOCK-FREE atomic swaps by design: a swap
         # publishes whole new objects, in-flight batches keep the
@@ -330,6 +352,11 @@ class DataplaneRunner:
         # versa) would otherwise keep the wrong crossover pick.
         self.nat = retarget_tables(nat, self._target_backend())  # lock-free: atomic ref swap (see acl)
         self.route = route      # lock-free: atomic ref swap (see acl)
+        self.infer = infer      # lock-free: atomic ref swap (see acl)
+        # Score log2-histogram: one counter per 3-bit band the packed
+        # verdicts carry (band k <=> score >= 1 - 2^-k) — THE score
+        # distribution surfaced via inspect()["inference"].
+        self._infer_bands = [0] * INFER_BANDS  # owner: shard worker — harvest-side single writer; readers copy
         # Host-side mirror of the route scalars (filled lazily by
         # _route_of, invalidated per swap) — keeps the slow-path
         # restore from paying device reads per packet.
@@ -474,7 +501,12 @@ class DataplaneRunner:
 
     def _bypass_static_ok(self) -> bool:
         """The device-read-free half of bypass eligibility: trivially
-        permissive tables on a native, mesh-less runner."""
+        permissive tables on a native, mesh-less runner.  An ENABLED
+        inference table disqualifies the bypass even when the ACL/NAT
+        tables are trivial — the scorer (and its quarantine action)
+        only runs on the device dispatch path, and a bypassed frame
+        would silently skip scoring exactly like it would skip a deny
+        rule."""
         return (
             self._native is not None
             and self.mesh is None
@@ -485,6 +517,7 @@ class DataplaneRunner:
             and self.nat.num_mappings == 0
             and not bool(np.asarray(self.nat.snat_enabled))
             and not self.nat.has_affinity
+            and (self.infer is None or not self.infer.enabled)
         )
 
     def _bypass_state_clear(self) -> bool:
@@ -673,19 +706,26 @@ class DataplaneRunner:
 
     def _shard_state(self) -> None:
         """(Re-)place tables + sessions onto the mesh."""
-        from ..parallel.mesh import shard_dataplane
+        from ..parallel.mesh import replicate_on_mesh, shard_dataplane
 
         # static: allow(lock-discipline) — mesh runners are driven single-threaded; placement runs at init/swap with no worker live
         self.acl, self.nat, self.route, self.sessions = shard_dataplane(
             self.mesh, self.acl, self.nat, self.route, self.sessions,
             partition_sessions=self.partition_sessions,
         )
+        if self.infer is not None:
+            # The inference table rides every dispatch too: replicate
+            # it (a few KB of weights) so its leaves carry the mesh
+            # placement — a single-device table mixed into a sharded
+            # dispatch is an incompatible-devices error.
+            self.infer = replicate_on_mesh(self.mesh, self.infer)
 
     def update_tables(
         self,
         acl: Optional[RuleTables] = None,
         nat: Optional[NatTables] = None,
         route: Optional[RouteConfig] = None,
+        infer: Optional[InferTable] = None,
     ) -> None:
         """Atomic table swap: takes effect for the NEXT dispatched batch
         (in-flight batches complete against the tables they saw — the
@@ -701,9 +741,9 @@ class DataplaneRunner:
         :class:`TableSwapError`, so the data plane keeps serving a
         consistent generation and the caller (scheduler applicator)
         retries instead of crashing the agent."""
-        if acl is None and nat is None and route is None:
+        if acl is None and nat is None and route is None and infer is None:
             return
-        last_good = (self.acl, self.nat, self.route)
+        last_good = (self.acl, self.nat, self.route, self.infer)
         # Disarm the host bypass BEFORE the new tables land: a
         # concurrent poll must never forward under a stale
         # bypass=eligible flag once deny rules exist.  The refresh
@@ -715,9 +755,10 @@ class DataplaneRunner:
                 retarget_tables(nat, self._target_backend())
                 if nat is not None else None,
                 route,
+                infer,
             )
         except Exception as err:
-            self.acl, self.nat, self.route = last_good
+            self.acl, self.nat, self.route, self.infer = last_good
             # A worker thread may have refilled the route-scalar cache
             # from the half-adopted generation between _adopt_tables'
             # clear and this rollback — drop it so _route_of re-reads
@@ -743,13 +784,14 @@ class DataplaneRunner:
         acl: Optional[RuleTables],
         nat: Optional[NatTables],
         route: Optional[RouteConfig],
+        infer: Optional[InferTable] = None,
     ) -> None:
         """The swap body minus retarget/bypass derivation — the sharded
         engine retargets ONCE and adopts on every shard (shards.py).
         The ``swap-fail`` site fires BEFORE any reference mutates, so
         an injected failure never leaves THIS shard partially adopted
         (multi-shard atomicity is the sharded engine's rollback)."""
-        if acl is None and nat is None and route is None:
+        if acl is None and nat is None and route is None and infer is None:
             return
         t0 = time.perf_counter()
         self.faults.fire(SITE_SWAP_FAIL, shard=self.shard_index)
@@ -780,6 +822,12 @@ class DataplaneRunner:
             self.counters.route_swaps += 1
             # Host-side route-scalar cache follows the table generation.
             self._route_cache = None
+        if infer is not None:
+            # A model update is just another table swap: atomic ref
+            # publish, in-flight batches keep the weights they saw, and
+            # the last-good rollback above covers a failed adopt.
+            self.infer = infer
+            self.counters.inference_swaps += 1
         if self.mesh is not None and (
             acl is not None or nat is not None or route is not None
         ):
@@ -789,6 +837,14 @@ class DataplaneRunner:
                 self.mesh, self.acl, self.nat, self.route, self.sessions,
                 partition_sessions=self.partition_sessions,
             )
+        if self.mesh is not None and infer is not None:
+            # An infer-only swap must re-place the new table on the
+            # mesh too — the acl/nat/route block above does not cover
+            # it, and an unplaced table would mix devices (see
+            # _shard_state).
+            from ..parallel.mesh import replicate_on_mesh
+
+            self.infer = replicate_on_mesh(self.mesh, self.infer)
         # One generation per adopted swap (whatever mix of tables it
         # carried): flight-recorder rows and packet traces stamp it.
         self._table_gen += 1
@@ -804,9 +860,14 @@ class DataplaneRunner:
         the discipline plus the abstract (shape, dtype) of every table/
         session leaf.  Values never enter — cache keys are avals."""
         leaves = jax.tree_util.tree_leaves(
-            (self.acl, self.nat, self.route, self.sessions))
+            (self.acl, self.nat, self.route, self.sessions, self.infer))
         return (
             self.dispatch, k, self._batch_size,
+            # The inference static gate is part of the compiled program
+            # (enabled=False traces the scoring stage away), so it must
+            # key the warm ledger too — else an enable flip would look
+            # pre-warmed while every bucket actually recompiles.
+            None if self.infer is None else bool(self.infer.enabled),
             tuple(
                 (tuple(getattr(leaf, "shape", ())),
                  str(getattr(leaf, "dtype", type(leaf).__name__)))
@@ -828,7 +889,8 @@ class DataplaneRunner:
         scratch = empty_sessions(self.sessions.capacity)
         if k == 1 and self.dispatch == "scan":
             result = pipeline_step_jit(
-                self.acl, self.nat, self.route, scratch, batch, jnp.int32(1))
+                self.acl, self.nat, self.route, scratch, batch, jnp.int32(1),
+                self.infer)
         else:
             vectors = jax.tree_util.tree_map(
                 lambda a: a.reshape((k, self._batch_size) + a.shape[1:]),
@@ -841,7 +903,7 @@ class DataplaneRunner:
             )
             result = step(
                 self.acl, self.nat, self.route, scratch, vectors,
-                jnp.int32(0))
+                jnp.int32(0), self.infer)
         result.packed.block_until_ready()
 
     def prewarm_buckets(self) -> int:
@@ -1035,7 +1097,7 @@ class DataplaneRunner:
                 batch = shard_batch(self.mesh, batch)
             result = pipeline_step_jit(
                 self.acl, self.nat, self.route, self.sessions, batch,
-                jnp.int32(self._ts),
+                jnp.int32(self._ts), self.infer,
             )
         else:
             vectors = jax.tree_util.tree_map(
@@ -1058,7 +1120,7 @@ class DataplaneRunner:
             )
             result = step(
                 self.acl, self.nat, self.route, self.sessions, vectors,
-                jnp.int32(prev_ts),
+                jnp.int32(prev_ts), self.infer,
             )
         # Chain the session state into the next dispatch WITHOUT
         # materialising — keeps the device busy back-to-back.
@@ -1196,21 +1258,72 @@ class DataplaneRunner:
         if not len(live):
             return 0
         self.counters.dropped_poisoned += len(live)
-        if self.quarantine_pcap:
-            from .io import PcapWriter
-
-            if self._quarantine_writer is None:
-                self._quarantine_writer = PcapWriter(self.quarantine_pcap)
-            self._quarantine_writer.send(
-                [frame_of(int(row)) for row in live])
-            # Forensics must survive a crash — the very scenario the
-            # capture exists for; quarantines are rare, flush per batch.
-            self._quarantine_writer.flush()
-            # The flight recorder rides along: the last N dispatches'
-            # K/backlog/generation context lands NEXT TO the frames
-            # that poisoned the batch (same crash-durability rules).
-            self.snapshot_flight("quarantine")
+        self._capture_forensics(live, frame_of, "quarantine")
         return len(live)
+
+    def _capture_forensics(self, rows, frame_of, reason: str) -> None:
+        """ONE crash-durable forensics capture for every quarantine
+        class (poisoned batches AND inference-quarantined flows): the
+        frames land in the quarantine pcap, flushed per batch (the
+        capture exists precisely for the crash scenario), and the
+        flight-recorder ring snapshots beside it — the last N
+        dispatches' K/backlog/generation context NEXT TO the frames
+        (same durability rules).  Takes (rows, frame_of) rather than
+        materialised frames so the no-pcap case never pays the
+        per-row native frame copies on the harvest path."""
+        if not self.quarantine_pcap:
+            return
+        from .io import PcapWriter
+
+        if self._quarantine_writer is None:
+            self._quarantine_writer = PcapWriter(self.quarantine_pcap)
+        self._quarantine_writer.send([frame_of(int(row)) for row in rows])
+        self._quarantine_writer.flush()
+        self.snapshot_flight(reason)
+
+    def _apply_infer_verdicts(self, v, n: int, frame_of) -> int:
+        """Shared harvest tail (ISSUE 14): account the inference
+        verdicts the packed word carried and FIRE the bound actions.
+        ``log`` and ``deprioritize`` are counted + surfaced (the trace
+        ring carries the band per sampled packet; a deprioritized
+        flow's scheduling is the egress sink's business — both engines
+        keep identical verdicts).  ``quarantine`` steers the flow into
+        the PR 3 forensics path: the frame is DENIED, captured to the
+        quarantine pcap, and the flight-recorder ring is snapshotted
+        beside it — same crash-durability rules as poisoned batches.
+        Returns the number of rows denied here (excluded from
+        dropped_denied like slow-path and poison drops).
+
+        Pure host numpy over the already-unpacked verdict leaves — the
+        scoring itself ran on device inside the dispatch program; this
+        tail adds no device syncs (hot-path-sync stays clean)."""
+        scored = v.scored[:n]
+        if not scored.any():
+            return 0
+        self.counters.inference_scored += int(scored.sum())
+        for band, count in zip(*np.unique(v.band[:n][scored],
+                                          return_counts=True)):
+            self._infer_bands[int(band)] += int(count)
+        act = v.action[:n]
+        self.counters.inference_logged += int((act == INFER_ACT_LOG).sum())
+        self.counters.inference_deprioritized += int(
+            (act == INFER_ACT_DEPRIORITIZE).sum())
+        # Quarantine only rows that are still ALLOWED: a row the ACL
+        # denied or the slow path already dropped is not "dropped by
+        # quarantine" — counting it here would double-subtract it from
+        # dropped_denied (driving that counter negative) and overstate
+        # inference_quarantined with frames that were never going to
+        # forward.
+        rows = np.nonzero((act == INFER_ACT_QUARANTINE)
+                          & v.allowed[:n])[0]
+        if not len(rows):
+            return 0
+        # Deny AFTER the slow path ran: a reply restore must never
+        # resurrect a quarantined flow's frame.
+        v.allowed[rows] = False
+        self.counters.inference_quarantined += len(rows)
+        self._capture_forensics(rows, frame_of, "inference-quarantine")
+        return len(rows)
 
     def sanitize_after_fault(self) -> None:
         """Reset the loop after a dispatch fault so the NEXT batch
@@ -1275,6 +1388,33 @@ class DataplaneRunner:
         """{name: Log2Histogram} for the metrics exporter (host-only;
         the sharded engine merges across shards instead)."""
         return self.telemetry.histograms()
+
+    def inference_bands(self):
+        """Per-band score counts (the score log2-histogram) for the
+        metrics exporter — copied on read, single harvest-side writer
+        (the sharded engine sums across shards instead)."""
+        return list(self._infer_bands)
+
+    def inspect_inference(self) -> Dict[str, object]:
+        """The inference pillar of inspect(): table state + per-action
+        counters + the score log2-histogram.  Host values only — no
+        device reads (the weights' shapes live in the pytree aux and
+        host-side array metadata)."""
+        infer = self.infer
+        return {
+            "enabled": bool(infer.enabled) if infer is not None else False,
+            "pods": infer.num_pods if infer is not None else 0,
+            "features": int(infer.w1.shape[0]) if infer is not None else 0,
+            "hidden": int(infer.w1.shape[1]) if infer is not None else 0,
+            "swaps": self.counters.inference_swaps,
+            "scored": self.counters.inference_scored,
+            "logged": self.counters.inference_logged,
+            "deprioritized": self.counters.inference_deprioritized,
+            "quarantined": self.counters.inference_quarantined,
+            # Band k <=> score in [1 - 2^-k, 1 - 2^-(k+1)) — log2-
+            # spaced in (1 - score), the resolution thresholds live in.
+            "score_bands": self.inference_bands(),
+        }
 
     def inspect_latency(self) -> Dict[str, object]:
         """The latency pillar of inspect(): per-histogram count/sum and
@@ -1389,11 +1529,13 @@ class DataplaneRunner:
         slow_drops = self._slowpath_and_trace(
             orig, rew, v.allowed, v.route, v.node_id,
             v.punt, v.reply_hit, v.dnat_hit, v.snat_hit, ts, k,
-            straggler=v.straggler,
+            straggler=v.straggler, band=v.band, infer_action=v.action,
         )
         t_slow = time.perf_counter()
         poison_drops = self._quarantine_rows(
             result, n, lambda row: self._native.slot_frame(slot, row))
+        infer_drops = self._apply_infer_verdicts(
+            v, n, lambda row: self._native.slot_frame(slot, row))
         c = np.zeros(NativeLoop.HARVEST_COUNTERS, dtype=np.uint64)
         sent = self._native.harvest(
             slot, v.allowed, rew["src_ip"], rew["dst_ip"],
@@ -1404,11 +1546,13 @@ class DataplaneRunner:
         self.counters.tx_remote += int(c[0])
         self.counters.tx_local += int(c[1])
         self.counters.tx_host += int(c[2])
-        # Denied excludes rows the slow path already counted and rows
-        # the quarantine dropped as poisoned; rows permitted but
-        # unforwardable are parse failures, not denials.
+        # Denied excludes rows the slow path already counted, rows the
+        # quarantine dropped as poisoned, and inference-quarantined
+        # rows; rows permitted but unforwardable are parse failures,
+        # not denials.
         denied = int(c[3])
-        self.counters.dropped_denied += denied - slow_drops - poison_drops
+        self.counters.dropped_denied += \
+            denied - slow_drops - poison_drops - infer_drops
         self.counters.dropped_unparseable += int(c[4])
         self.counters.dropped_unroutable += int(c[5])
         if self._bypass_tables:
@@ -1513,10 +1657,11 @@ class DataplaneRunner:
         slow_drops = self._slowpath_and_trace(
             orig, rew, v.allowed, v.route, v.node_id,
             v.punt, v.reply_hit, v.dnat_hit, v.snat_hit, ts, k,
-            straggler=v.straggler,
+            straggler=v.straggler, band=v.band, infer_action=v.action,
         )
         t_slow = time.perf_counter()
         poison_drops = self._quarantine_rows(result, n, fb.frame)
+        infer_drops = self._apply_infer_verdicts(v, n, fb.frame)
 
         # -------------------------------------------- native apply + TX
         allowed, route_tag, node_id = v.allowed, v.route, v.node_id
@@ -1527,11 +1672,12 @@ class DataplaneRunner:
         fwd = self.shim.apply_masked(fb, allowed, rew_batch)
         allowed_bool = allowed.astype(bool)
         # Pipeline/policy denies exclude rows the slow path already
-        # counted and quarantined poisoned rows; rows permitted but
-        # unforwardable are parse failures (non-IPv4 frames), not
-        # denials.
+        # counted, quarantined poisoned rows, and inference-quarantined
+        # rows; rows permitted but unforwardable are parse failures
+        # (non-IPv4 frames), not denials.
         denied = int((~allowed_bool).sum())
-        self.counters.dropped_denied += denied - slow_drops - poison_drops
+        self.counters.dropped_denied += \
+            denied - slow_drops - poison_drops - infer_drops
         self.counters.dropped_unparseable += int((allowed_bool & (fwd == 0)).sum())
 
         is_remote = (route_tag == ROUTE_REMOTE).astype(np.uint8)
@@ -1575,6 +1721,7 @@ class DataplaneRunner:
     def _slowpath_and_trace(
         self, orig, rew, allowed, route_tag, node_id,
         punt, reply_hit, dnat_hit, snat_hit, ts, k=0, straggler=None,
+        band=None, infer_action=None,
     ) -> int:
         """Host slow path (straggler resolution, punt servicing, port
         fixups, reply restores) + sampled packet trace — shared by both
@@ -1591,11 +1738,13 @@ class DataplaneRunner:
             return self._slowpath_and_trace_locked(
                 orig, rew, allowed, route_tag, node_id,
                 punt, reply_hit, dnat_hit, snat_hit, ts, k, straggler,
+                band, infer_action,
             )
 
     def _slowpath_and_trace_locked(
         self, orig, rew, allowed, route_tag, node_id,
         punt, reply_hit, dnat_hit, snat_hit, ts, k=0, straggler=None,
+        band=None, infer_action=None,
     ) -> int:
         slow_drops = 0
         if straggler is not None and straggler.any():
@@ -1653,6 +1802,7 @@ class DataplaneRunner:
             ts, orig, rew, allowed, route_tag, node_id,
             dnat_hit, snat_hit, reply_hit, punt,
             table_gen=self._table_gen, k=k,
+            band=band, infer_action=infer_action,
         )
         return slow_drops
 
@@ -1762,6 +1912,7 @@ class DataplaneRunner:
             "trace": self.tracer.status(),
             "latency": self.inspect_latency(),
             "flight": self.flight.status(),
+            "inference": self.inspect_inference(),
         }
 
     # Host-only inspect slices (NO device reads) — the sharded engine

@@ -619,7 +619,8 @@ class ShardedDataplane:
 
     # ------------------------------------------------------------- tables
 
-    def update_tables(self, acl=None, nat=None, route=None) -> None:
+    def update_tables(self, acl=None, nat=None, route=None,
+                      infer=None) -> None:
         """One ATOMIC swap for all shards: the backend retarget and the
         bypass-eligibility device reads (session/affinity occupancy on
         the SHARED state) are computed ONCE and handed to every shard.
@@ -627,13 +628,16 @@ class ShardedDataplane:
         last-good tables — the shards always agree on one table
         generation — and a retriable :class:`TableSwapError` surfaces
         to the caller (the scheduler applicator absorbs it into its
-        FAILED/retry/healing machinery)."""
-        if not (acl is not None or nat is not None or route is not None):
+        FAILED/retry/healing machinery).  The inference table (ISSUE
+        14) rides the same contract: a model update either lands on
+        every shard or on none."""
+        if not (acl is not None or nat is not None or route is not None
+                or infer is not None):
             return
         from ..ops.nat import retarget_tables
 
         r0 = self.shards[0]
-        last_good = (r0.acl, r0.nat, r0.route)
+        last_good = (r0.acl, r0.nat, r0.route, r0.infer)
         # Disarm every shard's host bypass BEFORE any shard adopts: the
         # adopt + shared occupancy reads below take multiple batches'
         # worth of wall time, and a concurrent poll must not keep
@@ -645,7 +649,7 @@ class ShardedDataplane:
             if nat is not None:
                 nat = retarget_tables(nat, r0._target_backend())
             for idx, r in enumerate(self.shards):
-                r._adopt_tables(acl, nat, route)
+                r._adopt_tables(acl, nat, route, infer)
         except Exception as err:
             # Roll EVERY shard back to last-good (adopted or not — the
             # restore is reference assignment, idempotent), so no two
@@ -653,7 +657,7 @@ class ShardedDataplane:
             # shard's route-scalar cache drops too: a worker may have
             # refilled it from the half-adopted generation.
             for r in self.shards:
-                r.acl, r.nat, r.route = last_good
+                r.acl, r.nat, r.route, r.infer = last_good
                 r._route_cache = None
             # Re-align table generations: shards that adopted before
             # the failure bumped theirs, the failing one did not — left
@@ -766,6 +770,27 @@ class ShardedDataplane:
     def inspect_latency(self) -> Dict[str, object]:
         return {name: hist.snapshot()
                 for name, hist in self.latency_histograms().items()}
+
+    def inference_bands(self):
+        """Whole-node score log2-histogram: per-band counts summed
+        across every shard's single-writer counters."""
+        bands = [0] * len(self.shards[0].inference_bands())
+        for r in self.shards:
+            for i, count in enumerate(r.inference_bands()):
+                bands[i] += count
+        return bands
+
+    def inspect_inference(self) -> Dict[str, object]:
+        """The whole-node inference pillar: table state from shard 0
+        (every shard adopts the same table atomically), action/score
+        counters summed across shards, swaps taken once (one tick per
+        engine-wide swap, same rule as the _swaps_total aggregation)."""
+        base = self.shards[0].inspect_inference()
+        for key in ("scored", "logged", "deprioritized", "quarantined"):
+            base[key] = sum(
+                getattr(r.counters, f"inference_{key}") for r in self.shards)
+        base["score_bands"] = self.inference_bands()
+        return base
 
     def dump_flight(self, limit: int = 0) -> Dict[str, object]:
         """All shards' flight rings, each labelled with its shard index
@@ -882,6 +907,9 @@ class ShardedDataplane:
         # single-writer recorders (shard 0's solo view would miss the
         # other shards' samples); flight status aggregates similarly.
         base["latency"] = self.inspect_latency()
+        # Whole-node inference view: counters + score histogram summed
+        # across shards (the table itself is shard-identical).
+        base["inference"] = self.inspect_inference()
         base["flight"] = {
             "recorded": sum(len(r.flight) for r in self.shards),
             "capacity": sum(r.flight.capacity for r in self.shards),

@@ -58,6 +58,12 @@ class TraceEntry:
     punt: bool
     table_gen: int
     k: int
+    # In-network inference stage (ISSUE 14): the packet's log2 score
+    # band and the action code that fired (0 = none / not scored) —
+    # the trace ring is where a single flagged flow's score is read
+    # next to its verdict during a score-storm triage.
+    infer_band: int
+    infer_action: int
 
     def as_dict(self) -> Dict:
         return asdict(self)
@@ -101,6 +107,7 @@ class PacketTracer:
     def record_batch(
         self, batch_ts, orig, rew, allowed, route_tag, node_id,
         dnat, snat, reply, punt, table_gen: int = 0, k: int = 0,
+        band=None, infer_action=None,
     ) -> None:
         """Record the sampled rows of one harvested batch; ``orig``/``rew``
         are the harvest's field->ndarray dicts.  ``table_gen``/``k``
@@ -130,6 +137,8 @@ class PacketTracer:
                 bool(allowed[i]), int(route_tag[i]), int(node_id[i]),
                 bool(dnat[i]), bool(snat[i]), bool(reply[i]), bool(punt[i]),
                 int(table_gen), int(k),
+                0 if band is None else int(band[i]),
+                0 if infer_action is None else int(infer_action[i]),
             )
             for j, i in enumerate(rows)
         ]
@@ -147,9 +156,12 @@ class PacketTracer:
             allowed=r[11], route=_ROUTE_NAMES.get(r[12], "?"),
             node_id=r[13], dnat=r[14], snat=r[15], reply=r[16], punt=r[17],
             # Entries recorded before the ISSUE 8 stamps existed (an
-            # enable spanning an agent upgrade) degrade to gen 0 / K 0.
+            # enable spanning an agent upgrade) degrade to gen 0 / K 0;
+            # pre-ISSUE-14 entries likewise degrade to band/action 0.
             table_gen=r[18] if len(r) > 18 else 0,
             k=r[19] if len(r) > 19 else 0,
+            infer_band=r[20] if len(r) > 20 else 0,
+            infer_action=r[21] if len(r) > 21 else 0,
         )
 
     def dump(self) -> List[Dict]:

@@ -1,0 +1,23 @@
+"""In-network inference plane (ISSUE 14).
+
+The control-plane half of the in-datapath DNN scoring subsystem: the
+model container (:mod:`model`), the event-handler plugin that turns
+InferPolicy CRDs + pod state into rendered enrollments
+(:mod:`plugin`), and the host-side reference oracle the parity tests
+pin the device scorer against (:mod:`oracle`).  The device half lives
+in ``ops/infer.py`` (the fused scoring stage) and ``ops/infer_delta.py``
+(the incremental weight/table builder); the renderers that bridge the
+two sit beside the policy renderers (``policy/renderer/infer.py``).
+"""
+
+from .model import InferModel, anomaly_port_model, default_model
+from .oracle import InferOracle
+from .plugin import InferencePlugin
+
+__all__ = [
+    "InferModel",
+    "InferOracle",
+    "InferencePlugin",
+    "anomaly_port_model",
+    "default_model",
+]

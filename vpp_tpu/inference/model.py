@@ -1,0 +1,124 @@
+"""Model container for the in-network inference plane.
+
+An :class:`InferModel` is the host-side, JSON-shippable form of the
+fused MLP the datapath scorer runs (ops/infer.py): f32 weights for
+
+    h = relu(f @ w1 + b1);  score = sigmoid(h @ w2 + b2)
+
+over the fixed 16-feature packet vector.  It rides an InferPolicy CRD
+spec (nested lists), the cluster store, and the scheduler transaction
+as a plain dict — the incremental builder (ops/infer_delta) diffs the
+rows and ships only what changed.
+
+Two constructors matter operationally:
+
+- :func:`default_model` — deterministic pseudo-random weights, a
+  stand-in for "whatever the training pipeline produced" in benches
+  and soaks (scores spread across the low bands; nothing fires).
+- :func:`anomaly_port_model` — a hand-crafted detector that saturates
+  (band 7) on flows targeting unusually high destination ports, with a
+  decisive margin on both sides.  It is the demo/drill model: a
+  crafted anomalous flow provably crosses any threshold band while
+  normal traffic provably stays at band 0.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List
+
+import numpy as np
+
+from ..ops.infer import INFER_FEATURES, INFER_HIDDEN
+
+
+@dataclass(frozen=True)
+class InferModel:
+    """f32 MLP weights in wire shape (nested lists via to_dict)."""
+
+    w1: np.ndarray   # [INFER_FEATURES, H]
+    b1: np.ndarray   # [H]
+    w2: np.ndarray   # [H]
+    b2: float
+
+    def __post_init__(self):
+        object.__setattr__(self, "w1",
+                           np.asarray(self.w1, dtype=np.float32))
+        object.__setattr__(self, "b1",
+                           np.asarray(self.b1, dtype=np.float32))
+        object.__setattr__(self, "w2",
+                           np.asarray(self.w2, dtype=np.float32))
+        object.__setattr__(self, "b2", float(np.float32(self.b2)))
+        if self.w1.shape[0] != INFER_FEATURES:
+            raise ValueError(
+                f"w1 has {self.w1.shape[0]} feature rows, expected "
+                f"{INFER_FEATURES}")
+        if not (self.w1.shape[1] == self.b1.shape[0] == self.w2.shape[0]):
+            raise ValueError(
+                f"inconsistent hidden width: w1 {self.w1.shape}, "
+                f"b1 {self.b1.shape}, w2 {self.w2.shape}")
+
+    @property
+    def hidden(self) -> int:
+        return int(self.w1.shape[1])
+
+    def to_dict(self) -> Dict[str, object]:
+        """The JSON/CRD/store wire shape (f32 values as floats)."""
+        return {
+            "w1": [[float(x) for x in row] for row in self.w1],
+            "b1": [float(x) for x in self.b1],
+            "w2": [float(x) for x in self.w2],
+            "b2": float(self.b2),
+        }
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, object]) -> "InferModel":
+        return cls(w1=np.asarray(data["w1"], dtype=np.float32),
+                   b1=np.asarray(data["b1"], dtype=np.float32),
+                   w2=np.asarray(data["w2"], dtype=np.float32),
+                   b2=float(data["b2"]))
+
+
+def default_model(seed: int = 7, hidden: int = INFER_HIDDEN) -> InferModel:
+    """Deterministic pseudo-random weights (the bench/soak stand-in for
+    a trained model): small magnitudes keep scores spread across the
+    low bands, so enrolling traffic against it exercises the scoring
+    stage without firing actions."""
+    rng = np.random.RandomState(seed)
+    return InferModel(
+        w1=(rng.randn(INFER_FEATURES, hidden) * 0.3).astype(np.float32),
+        b1=(rng.randn(hidden) * 0.1).astype(np.float32),
+        w2=(rng.randn(hidden) * 0.3).astype(np.float32),
+        b2=float(rng.randn() * 0.1),
+    )
+
+
+def anomaly_port_model(port_floor: int = 60000,
+                       hidden: int = INFER_HIDDEN) -> InferModel:
+    """The crafted high-port anomaly detector (demo / drill / parity
+    model): one active hidden unit keyed on the normalised destination
+    port (feature f9 = dst_port / 65535),
+
+        h0 = relu(200 * (f9 - port_floor/65535));  z = 2*h0 - 6
+
+    so a flow at or above ``port_floor`` saturates toward score 1.0
+    (band 7) within a couple thousand ports of the floor, while a flow
+    at a conventional service port scores sigmoid(-6) ≈ 0.0025
+    (band 0).  Decisive margins on both sides make the device↔host
+    band parity exact — no boundary rounding to argue about."""
+    w1 = np.zeros((INFER_FEATURES, hidden), dtype=np.float32)
+    b1 = np.zeros(hidden, dtype=np.float32)
+    w2 = np.zeros(hidden, dtype=np.float32)
+    w1[9, 0] = 200.0
+    b1[0] = -200.0 * (port_floor / 65535.0)
+    w2[0] = 2.0
+    return InferModel(w1=w1, b1=b1, w2=w2, b2=-6.0)
+
+
+def model_rows_changed(old: InferModel, new: InferModel) -> List[int]:
+    """Which w1 feature rows differ — handy for tests asserting the
+    delta builder ships O(changed) rows on a model update."""
+    if old.w1.shape != new.w1.shape:
+        return list(range(new.w1.shape[0]))
+    return [int(i) for i in
+            np.nonzero((old.w1 != new.w1).any(axis=1))[0]]

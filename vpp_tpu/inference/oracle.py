@@ -1,0 +1,96 @@
+"""Host-side inference oracle — the mock-engine ground truth.
+
+The same role MockACLEngine plays for the classify kernel
+(testing/aclengine.py): a renderer-shaped reference implementation
+that consumes EXACTLY what the production renderer consumes (the
+rendered model + per-pod enrollments) and evaluates flows host-side
+with the shared reference scorer (:func:`ops.infer.score_host` — the
+same f32 feature/MLP/band bodies the device stage compiles).  The
+parity tests pin the pipeline's score-band and action verdicts against
+this oracle at every governor-chosen K on both engines, including the
+quarantine action path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..ops.infer import INFER_ACT_NONE, INFER_ACTION_CODES, score_host
+from ..ops.packets import ip_to_u32
+from .model import InferModel
+
+
+class InferOracle:
+    """Reference scorer + enrollment evaluator.
+
+    Register it with an InferencePlugin next to the production
+    renderer (it implements the same ``render(model, bindings,
+    resync)`` contract), or feed it directly with ``set_state``."""
+
+    def __init__(self):
+        self.model: Optional[InferModel] = None
+        # pod_ip_u32 -> (threshold band, action code)
+        self.bindings: Dict[int, Tuple[int, int]] = {}
+
+    # ------------------------------------------------------------ renderer
+
+    def render(self, model, bindings, resync: bool) -> None:
+        """The InferencePlugin renderer hook: keep the latest rendered
+        state (the oracle has no transactions — last render wins, which
+        is exactly the post-commit state the datapath converges to)."""
+        self.set_state(model, {ip: (thr, act)
+                               for ip, (thr, act) in bindings.items()})
+
+    def set_state(self, model, bindings: Dict[int, Tuple[int, int]]) -> None:
+        if model is not None and not isinstance(model, InferModel):
+            model = InferModel.from_dict(
+                model.to_dict() if hasattr(model, "to_dict") else model)
+        self.model = model
+        self.bindings = dict(bindings)
+
+    # ---------------------------------------------------------- evaluation
+
+    @property
+    def enabled(self) -> bool:
+        return self.model is not None and bool(self.bindings)
+
+    def evaluate(self, src_ip: str, dst_ip: str, protocol: int,
+                 src_port: int, dst_port: int,
+                 reply: bool = False, dnat: bool = False,
+                 snat: bool = False) -> Tuple[bool, int, int]:
+        """One flow through the reference scorer: (scored, band,
+        action_fired) with the EXACT device semantics — binary-search
+        enrollment on the (rewritten) source pod first, destination
+        fallback; action fires when band >= the enrolled threshold."""
+        if not self.enabled:
+            return False, 0, INFER_ACT_NONE
+        src = ip_to_u32(src_ip)
+        dst = ip_to_u32(dst_ip)
+        binding = self.bindings.get(src)
+        if binding is None:
+            binding = self.bindings.get(dst)
+        if binding is None:
+            return False, 0, INFER_ACT_NONE
+        _, band = score_host(
+            self.model.w1, self.model.b1, self.model.w2, self.model.b2,
+            np.asarray([src], dtype=np.uint32),
+            np.asarray([dst], dtype=np.uint32),
+            np.asarray([protocol], dtype=np.int64),
+            np.asarray([src_port], dtype=np.int64),
+            np.asarray([dst_port], dtype=np.int64),
+            np.asarray([reply]), np.asarray([dnat]), np.asarray([snat]),
+        )
+        band = int(np.asarray(band).reshape(-1)[0])
+        threshold, action = binding
+        fired = action if band >= threshold else INFER_ACT_NONE
+        return True, band, fired
+
+    def expected_quarantined(self, flows) -> int:
+        """Convenience for parity tests: how many (src, dst, proto,
+        sport, dport) tuples the oracle quarantines."""
+        q = INFER_ACTION_CODES["quarantine"]
+        return sum(
+            1 for f in flows if self.evaluate(*f)[2] == q
+        )

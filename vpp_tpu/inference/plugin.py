@@ -1,0 +1,188 @@
+"""InferencePlugin — the event handler of the in-network inference plane.
+
+The same position PolicyPlugin occupies for network policies: an event
+handler on the controller loop that turns declarative intent
+(InferPolicy CRDs, pushed as :class:`~vpp_tpu.crd.plugin.InferPolicyChange`
+events by the CRD controller) plus live pod state (KubeStateChange /
+resync) into RENDERED state — the active model and one
+``(pod_ip, threshold, action)`` enrollment per pod of an enrolled
+namespace — delivered to every registered renderer inside the current
+event transaction.  The scheduler-routed renderer
+(policy/renderer/infer.py) emits the state as ``tpu/infer/*`` KVs; the
+TpuInferApplicator compiles them incrementally and swaps the device
+table atomically, minting ``compile:infer`` / ``swap:infer`` span
+stages.  A model update is therefore an ordinary control-plane
+transaction with a propagation span — never a redeploy.
+
+Policy composition: policies are merged in sorted-name order.  A pod
+in namespaces claimed by several enabled policies gets the FIRST
+policy's (threshold, action) — deterministic, and matching the
+sorted-key table compile discipline everywhere else in the repo.  The
+active model is the first enabled policy (sorted by name) that ships
+weights; policies without weights enroll against it.
+
+InferPolicy delivery has two paths, both handled here:
+
+- **store-fanout (production)**: the CRD controller publishes
+  validated policies into the cluster store under the registry's
+  ``inferpolicy`` prefix; every agent's DBWatcher delivers them as
+  ``KubeStateChange("inferpolicy", ...)`` events, and a DBResync's
+  kube_state snapshot is AUTHORITATIVE (resync rebuilds the policy
+  cache from it, exactly like the pod cache — a policy deleted during
+  a store outage is swept on the reconnect resync);
+- **co-located (harnesses / single-process)**: ``CRDPlugin.
+  apply_infer_policy`` pushes an ``InferPolicyChange`` directly into
+  the local event loop.  When both are wired the second delivery
+  re-renders identical state and the scheduler diff no-ops it.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from ..controller.api import EventHandler, KubeStateChange
+from ..crd.models import InferPolicy
+from ..crd.plugin import InferPolicyChange
+from ..models import PodID
+from ..ops.infer import INFER_ACTION_CODES
+from ..ops.packets import ip_to_u32
+from .model import InferModel
+
+log = logging.getLogger(__name__)
+
+
+class InferencePlugin(EventHandler):
+    """InferPolicy + pod state → rendered model/enrollments."""
+
+    name = "inference"
+
+    def __init__(self):
+        self._policies: Dict[str, InferPolicy] = {}
+        self._pods: Dict[PodID, str] = {}  # pod -> allocated IP
+        self._renderers: List[object] = []
+        # Parsed-weights cache keyed on the source policy INSTANCE
+        # (frozen dataclasses are replaced, never mutated): without it
+        # every pod event in the cluster would re-parse the full
+        # nested-list weight matrix just to reach an identical model.
+        self._model_cache: Tuple[Optional[InferPolicy],
+                                 Optional[InferModel]] = (None, None)
+
+    def register_renderer(self, renderer) -> None:
+        """A renderer exposes ``render(model, bindings, resync)`` with
+        ``bindings = {pod_ip_u32: (threshold_band, action_code)}`` —
+        the production SchedInferRenderer and the test oracle both
+        implement it."""
+        self._renderers.append(renderer)
+
+    # ------------------------------------------------------ event handling
+
+    def handles_event(self, event) -> bool:
+        if isinstance(event, InferPolicyChange):
+            return True
+        if isinstance(event, KubeStateChange):
+            return event.resource in ("pod", "inferpolicy")
+        return event.method.is_resync
+
+    def resync(self, event, kube_state, resync_count, txn) -> None:
+        self._pods = {}
+        for pod in (kube_state.get("pod") or {}).values():
+            if getattr(pod, "ip_address", ""):
+                self._pods[pod.id] = pod.ip_address
+        # The snapshot is authoritative for the policy cache too (the
+        # store is where the CRD controller publishes): a policy
+        # deleted while this agent was partitioned is swept here.
+        self._policies = {
+            policy.name: policy
+            for policy in (kube_state.get("inferpolicy") or {}).values()
+        }
+        self._render(resync=True)
+
+    def update(self, event, txn) -> str:
+        if isinstance(event, InferPolicyChange):
+            if event.new is None:
+                self._policies.pop(event.policy_name, None)
+            else:
+                self._policies[event.policy_name] = event.new
+            self._render(resync=False)
+            return f"re-rendered inference state after {event}"
+        if isinstance(event, KubeStateChange) and \
+                event.resource == "inferpolicy":
+            policy = event.new_value
+            if policy is None:
+                prev = event.prev_value
+                if prev is not None:
+                    self._policies.pop(prev.name, None)
+            else:
+                self._policies[policy.name] = policy
+            self._render(resync=False)
+            return "re-rendered inference state after store policy change"
+        if isinstance(event, KubeStateChange) and event.resource == "pod":
+            pod = event.new_value if event.new_value is not None \
+                else event.prev_value
+            if pod is None:
+                return ""
+            if event.new_value is not None and \
+                    getattr(pod, "ip_address", ""):
+                self._pods[pod.id] = pod.ip_address
+            else:
+                self._pods.pop(pod.id, None)
+            enrolled_namespaces = {
+                ns for policy in self._active() for ns in policy.namespaces
+            }
+            if pod.id.namespace not in enrolled_namespaces:
+                # The pod cannot change the rendered state (no policy
+                # claims its namespace) — skip the render entirely;
+                # cluster-wide pod churn must not cost O(render) each.
+                return ""
+            self._render(resync=False)
+            return "re-rendered inference enrollments after pod change"
+        return ""
+
+    # ------------------------------------------------------------ rendering
+
+    def _active(self) -> List[InferPolicy]:
+        return [self._policies[name] for name in sorted(self._policies)
+                if self._policies[name].enabled]
+
+    def _desired(self) -> Tuple[Optional[InferModel],
+                                Dict[int, Tuple[int, int]]]:
+        """(active model, {pod_ip_u32: (threshold, action_code)})."""
+        active = self._active()
+        model: Optional[InferModel] = None
+        for policy in active:
+            if policy.model is not None:
+                src, cached = self._model_cache
+                if src is not policy:
+                    cached = InferModel.from_dict(dict(policy.model))
+                    self._model_cache = (policy, cached)
+                model = cached
+                break
+        bindings: Dict[int, Tuple[int, int]] = {}
+        pod_binding: Dict[PodID, Tuple[int, int]] = {}
+        for policy in active:
+            namespaces = set(policy.namespaces)
+            code = INFER_ACTION_CODES[policy.action]
+            for pod_id in self._pods:
+                if pod_id.namespace in namespaces and \
+                        pod_id not in pod_binding:
+                    pod_binding[pod_id] = (policy.threshold, code)
+        for pod_id, binding in pod_binding.items():
+            bindings[ip_to_u32(self._pods[pod_id])] = binding
+        return model, bindings
+
+    def _render(self, resync: bool) -> None:
+        model, bindings = self._desired()
+        for renderer in self._renderers:
+            renderer.render(model, bindings, resync)
+
+    # -------------------------------------------------------------- queries
+
+    def status(self) -> Dict[str, object]:
+        model, bindings = self._desired()
+        return {
+            "policies": len(self._policies),
+            "active_policies": len(self._active()),
+            "enrolled_pods": len(bindings),
+            "has_model": model is not None,
+        }

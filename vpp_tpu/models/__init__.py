@@ -26,6 +26,7 @@ from .policy import (
 )
 from .service import Service, ServiceID, ServicePort
 from .endpoints import Endpoints, EndpointSubset, EndpointAddress, EndpointPort
+from .infer import InferPolicy
 from .node import Node, NodeAddress
 from .sfc import Sfc
 from .vppnode import VppNode
@@ -54,6 +55,7 @@ __all__ = [
     "ServiceID",
     "ServicePort",
     "Endpoints",
+    "InferPolicy",
     "EndpointSubset",
     "EndpointAddress",
     "EndpointPort",

@@ -1,0 +1,39 @@
+"""InferPolicy model — the in-network inference plane's CRD (ISSUE 14).
+
+Lives with the other typed models (not under ``crd/``) because it is a
+REFLECTED resource: the CRD controller validates + publishes instances
+into the cluster store under the registry prefix, and every agent's
+DBWatcher delivers them as ``KubeStateChange("inferpolicy", ...)``
+events — the same store-fanout path pods and network policies ride, so
+ONE CRD write enrolls every node's datapath (with the write's store
+revision anchoring cluster-stitchable propagation spans).
+``vpp_tpu.crd.models`` re-exports it beside the other CRD shapes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Mapping, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class InferPolicy:
+    """In-network inference policy — enables per-vector DNN scoring for
+    a set of namespaces and binds a score threshold to an action.
+
+    ``threshold`` is a log2 score band (0..7): the action fires when
+    the device scorer's band reaches it, i.e. when
+    ``score >= 1 - 2^-threshold``.  ``action`` is one of ``log``,
+    ``deprioritize``, ``quarantine`` (the quarantine path drops the
+    frame, captures it to the forensics pcap and snapshots the flight
+    recorder).  ``model`` optionally carries the MLP weights inline
+    (``{"w1","b1","w2","b2"}`` nested lists, 16 feature rows); a
+    policy without weights enrolls its namespaces against whichever
+    model another policy ships."""
+
+    name: str                          # CRD object name
+    namespaces: Tuple[str, ...] = ()   # enrolled namespaces
+    threshold: int = 6                 # score band 0..7
+    action: str = "log"                # log | deprioritize | quarantine
+    enabled: bool = True
+    model: Optional[Mapping] = None    # inline MLP weights (JSON shape)

@@ -13,6 +13,7 @@ from dataclasses import dataclass
 from typing import Callable, Optional, Type
 
 from .endpoints import Endpoints
+from .infer import InferPolicy
 from .namespace import Namespace
 from .node import Node
 from .pod import Pod
@@ -25,6 +26,8 @@ from .vppnode import VppNode
 # (reference: /vnf-agent/contiv-ksr/k8s/...).
 KSR_PREFIX = "/vpp-tpu/ksr/k8s/"
 NODESYNC_PREFIX = "/vpp-tpu/nodesync/"
+# CRD-published resources (the contiv-crd analog writes here).
+CRD_PREFIX = "/vpp-tpu/crd/"
 
 
 @dataclass(frozen=True)
@@ -51,6 +54,13 @@ DB_RESOURCES = (
     DbResource("node", KSR_PREFIX + "node/", Node, lambda o: o.name),
     DbResource("sfc", KSR_PREFIX + "sfc/", Sfc, lambda o: f"{o.namespace}/{o.pod}"),
     DbResource("vppnode", NODESYNC_PREFIX + "vppnode/", VppNode, lambda o: str(o.id)),
+    # ISSUE 14: InferPolicy CRDs are WATCHED state like pods/policies —
+    # the CRD controller publishes validated specs here, and every
+    # agent's DBWatcher delivers them as KubeStateChange events, so one
+    # CRD write enrolls every node's datapath (and its store revision
+    # anchors cluster-stitchable propagation spans).
+    DbResource("inferpolicy", CRD_PREFIX + "inferpolicy/", InferPolicy,
+               lambda o: o.name),
 )
 
 _BY_KEYWORD = {r.keyword: r for r in DB_RESOURCES}

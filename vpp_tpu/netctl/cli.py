@@ -207,9 +207,13 @@ def cmd_trace(server: str, out, action: str = "", sample: int = 1) -> int:
             # keys into `netctl flight` rows and propagation spans.
             str(e.get("table_gen", 0)),
             str(e.get("k", 0)),
+            # Inference stage (ISSUE 14): score band + fired action.
+            f"{e.get('infer_band', 0)}"
+            + (f"!{e.get('infer_action')}" if e.get("infer_action") else ""),
         ])
     print(_table(rows, ["SEQ", "SRC", "DST", "PROTO", "RW-SRC", "RW-DST",
-                        "VERDICT", "ROUTE", "FLAGS", "GEN", "K"]), file=out)
+                        "VERDICT", "ROUTE", "FLAGS", "GEN", "K", "INF"]),
+          file=out)
     return 0
 
 
@@ -384,6 +388,23 @@ def cmd_cluster(out, action: str, servers_spec: str = "", raw: bool = False,
     return 0 if summary.get("nodes_ok") else 1
 
 
+def _render_inference(inf: dict, out) -> None:
+    """The `netctl inspect` inference line (ISSUE 14): enrollment +
+    per-action counters + the score log2-histogram.  Consumes ONLY
+    keys ``DataplaneRunner.inspect_inference`` produces as literals —
+    the obs-parity checker pins the pair, so a renamed counter can
+    never silently blank this line."""
+    bands = inf.get("score_bands") or []
+    bands_s = " ".join(
+        f"{i}:{c}" for i, c in enumerate(bands) if c) or "-"
+    print(f"inference: {'on' if inf.get('enabled') else 'off'}  "
+          f"pods={inf.get('pods')}  model={inf.get('features')}x"
+          f"{inf.get('hidden')}  swaps={inf.get('swaps')}  "
+          f"scored={inf.get('scored')}  log={inf.get('logged')}  "
+          f"deprio={inf.get('deprioritized')}  quarantined="
+          f"{inf.get('quarantined')}  bands: {bands_s}", file=out)
+
+
 def cmd_inspect(server: str, out, watch: float = 0.0, raw: bool = False) -> int:
     """Live datapath interrogation (the ``vppcli`` analog, reference
     plugins/netctl/cmd/root.go:55-134): classify/NAT table stats,
@@ -478,11 +499,14 @@ def cmd_inspect(server: str, out, watch: float = 0.0, raw: bool = False) -> int:
                 parts.append(f"{name} p50={h['p50']}us p99={h['p99']}us")
         if parts:
             print("rounds: " + "   ".join(parts), file=out)
+        inf = d.get("inference") or {}
+        if inf.get("enabled") or inf.get("scored"):
+            _render_inference(inf, out)
         comp = d.get("compile") or {}
         if comp:
             parts = [f"swaps acl={comp.get('acl_swaps', 0)} "
                      f"nat={comp.get('nat_swaps', 0)}"]
-            for name in ("acl", "nat"):
+            for name in ("acl", "nat", "infer"):
                 cs = comp.get(name) or {}
                 if cs:
                     parts.append(

@@ -7,8 +7,11 @@ runs jit-compiled JAX ops over packet-header batches on TPU:
 - ``packets``   packet-header batch representation (struct of arrays)
 - ``classify``  ACL rule-table compilation + first-match classify
 - ``nat``       NAT44 DNAT/SNAT map compilation + rewrite
+- ``infer``     in-network inference: fused MLP/feature-hash scorer +
+                the InferTable weights/enrollment device table
 - ``pipeline``  the combined ingress-ACL -> DNAT -> routing-tag ->
-                SNAT -> egress-ACL step (SERVICES.md:300-307 ordering)
+                SNAT -> egress-ACL (-> score) step (SERVICES.md:300-307
+                ordering; the scoring stage is ISSUE 14)
 
 Everything is static-shape: rule tables and NAT maps are padded to
 power-of-two buckets so XLA compiles one program per bucket size, and

@@ -1,0 +1,342 @@
+"""In-network inference — per-vector DNN scoring on the datapath.
+
+ROADMAP item 3 (FENIX arXiv:2507.14891, INSIGHT arXiv:2505.24269): run
+a small anomaly/priority scorer *inside* the network element.  This
+datapath already dispatches every packet through a jit-compiled device
+program whose cost is floor-bound (NOTES_r05: extra per-vector compute
+is ~free under the dispatch round-trip floor), so a fused scoring stage
+costs near-zero marginal dispatch time — the whole subsystem is "one
+more tensor op" between the classify/NAT verdict settlement and the
+packed-harvest tail.
+
+**Model shape.**  A deliberately small fused MLP over a fixed
+16-feature vector per packet:
+
+    h = relu(f @ w1 + b1)        # [B, D] @ [D, H] -> [B, H]
+    score = sigmoid(h @ w2 + b2) # [B]
+
+The feature vector is built from what the pipeline already holds on
+device — the (rewritten) 5-tuple, session-table state bits
+(reply-restored / DNAT / SNAT hits), and two feature-hash buckets of
+the flow tuple (the INSIGHT-style hashed-feature trick: a learned
+model can key on flow identity without a per-flow table).  Per-flow
+byte/packet counters live host-side only in this architecture (the
+device keeps no per-flow accumulators beyond the session table); the
+honest consequence is documented in docs/ARCHITECTURE.md.
+
+**Score bands.**  The device ships a 3-bit log2 score band in the
+packed verdict word, not the f32 score: band k means
+
+    score in [1 - 2^-k, 1 - 2^-(k+1)),   k = 0..7 (clamped)
+
+i.e. bands are log2-spaced in (1 - score) — fine resolution exactly
+where thresholds live (near 1.0).  A policy threshold t fires when
+band >= t, equivalently score >= 1 - 2^-t.  The per-band counters the
+runner keeps ARE the score log2-histogram surfaced through
+``inspect()["inference"]``.
+
+**Weights as a table.**  :class:`InferTable` is just another device
+table: swapped atomically with ACL/NAT under the runner's last-good
+rollback, shipped incrementally through the PR 2 delta scatter path
+(ops/infer_delta.py), fingerprinted by the same scheduler drift check.
+A model update is a control-plane transaction with a propagation span
+— never a redeploy.
+
+**Enrollment.**  Scoring is enabled per pod IP (the renderer maps
+enrolled namespaces to pod IPs): a sorted pod-IP array with per-slot
+(threshold band, action) — the same binary-search lookup discipline as
+the classify pod tables.  A flow is scored when its (rewritten) source
+OR destination is an enrolled pod; the source binding wins when both
+are enrolled (the flow's originating namespace owns its policy).
+
+``enabled`` is pytree aux (a trace-time static): a disabled table
+compiles to *nothing* — the score-off program is bit-identical to one
+built with no table at all, so un-enrolled clusters pay zero.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .classify import POD_PAD_IP, _next_pow2
+
+# Fixed feature-vector width (f0..f15, see infer_features) and the
+# default hidden width.  D is part of the wire contract (w1 rows ship
+# as delta rows); H is free per model.
+INFER_FEATURES = 16
+INFER_HIDDEN = 8
+
+# Score bands: 3 bits in the packed verdict word.
+INFER_BANDS = 8
+
+# Actions a threshold crossing can fire (2 bits in the packed word).
+# NONE doubles as "scored but below threshold".
+INFER_ACT_NONE = 0
+INFER_ACT_LOG = 1
+INFER_ACT_DEPRIORITIZE = 2
+INFER_ACT_QUARANTINE = 3
+
+INFER_ACTION_NAMES = {
+    INFER_ACT_NONE: "none",
+    INFER_ACT_LOG: "log",
+    INFER_ACT_DEPRIORITIZE: "deprioritize",
+    INFER_ACT_QUARANTINE: "quarantine",
+}
+INFER_ACTION_CODES = {v: k for k, v in INFER_ACTION_NAMES.items()}
+
+# Smallest pod-slot bucket (same pow2 discipline as the classify pod
+# table: content changes swap arrays, only bucket changes recompile).
+POD_BUCKET_MIN = 16
+
+# Feature-hash multipliers (Knuth/xxhash-style odd constants; the same
+# numbers on device and host — the two scorers must agree bit-for-bit
+# on the hash features).
+_HASH_A = 0x9E3779B1
+_HASH_B = 0x85EBCA77
+_HASH_C = 0xC2B2AE3D
+
+
+@dataclass
+class InferTable:
+    """Model weights + per-pod enrollment as one device table."""
+
+    w1: jnp.ndarray             # f32 [D, H]
+    b1: jnp.ndarray             # f32 [H]
+    w2: jnp.ndarray             # f32 [H]
+    b2: jnp.ndarray             # f32 []
+    pod_ip: jnp.ndarray         # uint32 [P] sorted, POD_PAD_IP padding
+    pod_threshold: jnp.ndarray  # int32 [P] band threshold (0..7)
+    pod_action: jnp.ndarray     # int32 [P] INFER_ACT_* fired at threshold
+    num_pods: int = 0           # aux
+    enabled: bool = False       # aux — static gate; False compiles to nothing
+
+    def tree_flatten(self):
+        children = (
+            self.w1, self.b1, self.w2, self.b2,
+            self.pod_ip, self.pod_threshold, self.pod_action,
+        )
+        return children, (self.num_pods, self.enabled)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, num_pods=aux[0], enabled=aux[1])
+
+
+jax.tree_util.register_pytree_node(
+    InferTable, InferTable.tree_flatten, InferTable.tree_unflatten
+)
+
+
+# ---------------------------------------------------------------------------
+# Feature extraction + scoring (device)
+# ---------------------------------------------------------------------------
+
+
+def _flow_hash_u32(src, dst, proto, sport, dport, xp):
+    """Symmetric-free 32-bit flow mix shared by device and host (both
+    sides compute in uint32 wraparound, so the hash features agree
+    exactly).  ``xp`` is jnp or np."""
+    u32 = xp.uint32
+    h = src.astype(u32) * u32(_HASH_A) ^ dst.astype(u32) * u32(_HASH_B)
+    ports = (sport.astype(u32) << u32(16)) | dport.astype(u32)
+    h = h ^ ports * u32(_HASH_C)
+    h = h ^ proto.astype(u32)
+    h = (h ^ (h >> u32(15))) * u32(_HASH_A)
+    return h ^ (h >> u32(13))
+
+
+def _features(src_ip, dst_ip, protocol, src_port, dst_port,
+              reply_hit, dnat_hit, snat_hit, xp):
+    """The fixed 16-feature vector, [B, 16] f32 — ONE implementation
+    shared by the device stage (xp=jnp) and the host reference scorer
+    (xp=np); any drift between the two is a parity-test failure, not a
+    silent mis-scoring.
+
+    f0-f3   src IP octets / 255
+    f4-f7   dst IP octets / 255
+    f8, f9  src/dst port / 65535
+    f10,f11 protocol one-hots (TCP, UDP)
+    f12     session reply restore hit
+    f13     DNAT or SNAT translation hit
+    f14,f15 two 16-bit feature-hash buckets of the flow tuple / 65535
+    """
+    f32 = xp.float32
+    u32 = xp.uint32
+    src = src_ip.astype(u32)
+    dst = dst_ip.astype(u32)
+    h = _flow_hash_u32(src, dst, protocol, src_port, dst_port, xp)
+
+    def octet(ip, shift):
+        return ((ip >> u32(shift)) & u32(0xFF)).astype(f32) * f32(1.0 / 255.0)
+
+    feats = [
+        octet(src, 24), octet(src, 16), octet(src, 8), octet(src, 0),
+        octet(dst, 24), octet(dst, 16), octet(dst, 8), octet(dst, 0),
+        src_port.astype(f32) * f32(1.0 / 65535.0),
+        dst_port.astype(f32) * f32(1.0 / 65535.0),
+        (protocol == 6).astype(f32),
+        (protocol == 17).astype(f32),
+        reply_hit.astype(f32),
+        (dnat_hit | snat_hit).astype(f32),
+        (h & u32(0xFFFF)).astype(f32) * f32(1.0 / 65535.0),
+        ((h >> u32(16)) & u32(0xFFFF)).astype(f32) * f32(1.0 / 65535.0),
+    ]
+    return xp.stack(feats, axis=-1)
+
+
+def _mlp_score(feats, w1, b1, w2, b2, xp):
+    """relu MLP + sigmoid, f32 throughout (shared device/host body).
+    Every scalar is wrapped f32: a bare python float would promote the
+    numpy side to f64 and break device/host band parity."""
+    one = xp.float32(1.0)
+    hidden = xp.maximum(feats @ w1 + b1, xp.float32(0.0))
+    z = hidden @ w2 + b2
+    return one / (one + xp.exp(-z))
+
+
+def _score_band(score, xp):
+    """log2 band of a score: floor(-log2(1 - score)) clamped to 0..7.
+    Band k <=> score >= 1 - 2^-k, so a threshold comparison is a pure
+    integer >=.  The 2^-31 clamp keeps a saturated f32 score (==1.0)
+    finite; it lands in band 7 like everything past 1 - 2^-7."""
+    rem = xp.maximum(xp.float32(1.0) - score, xp.float32(2.0 ** -31))
+    band = xp.floor(-xp.log2(rem))
+    return xp.clip(band, 0, INFER_BANDS - 1).astype(xp.uint32)
+
+
+def _lookup_slot(ip: jnp.ndarray, pod_ip: jnp.ndarray):
+    """(enrolled bool [B], slot int32 [B]) — the classify pod-table
+    binary-search discipline over the sorted enrollment array.  The
+    padding IP itself must never match: a broadcast packet
+    (255.255.255.255) would otherwise "enroll" against the pad slots
+    and pollute the scored counters/band histogram."""
+    idx = jnp.searchsorted(pod_ip, ip)
+    idx = jnp.minimum(idx, pod_ip.shape[0] - 1)
+    hit = (pod_ip[idx] == ip) & (ip != jnp.uint32(POD_PAD_IP))
+    return hit, idx
+
+
+def infer_scores(
+    infer: InferTable,
+    batch,                    # PacketBatch, flat [B] (rewritten headers)
+    reply_hit: jnp.ndarray,   # bool [B]
+    dnat_hit: jnp.ndarray,    # bool [B]
+    snat_hit: jnp.ndarray,    # bool [B]
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The scoring stage: (scored bool [B], band uint32 [B], action
+    uint32 [B]).  ``action`` is nonzero only where the band crossed the
+    enrolled pod's threshold (INFER_ACT_NONE otherwise); ``band`` is 0
+    on un-scored rows.  Runs INSIDE the jit entry points, between the
+    pipeline verdict settlement and the pack_result tail — all
+    batch-parallel tensor ops, no host round trips."""
+    feats = _features(
+        batch.src_ip, batch.dst_ip, batch.protocol,
+        batch.src_port, batch.dst_port,
+        reply_hit, dnat_hit, snat_hit, jnp,
+    )
+    score = _mlp_score(feats, infer.w1, infer.b1, infer.w2, infer.b2, jnp)
+    band = _score_band(score, jnp)
+
+    src_hit, src_slot = _lookup_slot(batch.src_ip, infer.pod_ip)
+    dst_hit, dst_slot = _lookup_slot(batch.dst_ip, infer.pod_ip)
+    scored = src_hit | dst_hit
+    slot = jnp.where(src_hit, src_slot, dst_slot)
+    threshold = infer.pod_threshold[slot]
+    bound_action = infer.pod_action[slot]
+
+    band = jnp.where(scored, band, jnp.uint32(0))
+    fired = scored & (band >= threshold.astype(jnp.uint32))
+    action = jnp.where(fired, bound_action.astype(jnp.uint32),
+                       jnp.uint32(INFER_ACT_NONE))
+    return scored, band, action
+
+
+# ---------------------------------------------------------------------------
+# Host reference scorer (the oracle side)
+# ---------------------------------------------------------------------------
+
+
+def score_host(w1: np.ndarray, b1: np.ndarray, w2: np.ndarray, b2,
+               src_ip, dst_ip, protocol, src_port, dst_port,
+               reply_hit=None, dnat_hit=None, snat_hit=None):
+    """Numpy twin of the device scorer: (score f32 [B], band uint32
+    [B]).  Shares the exact feature/MLP/band bodies with the device
+    stage (same f32 ops, same hash constants), so it is the ground
+    truth the mock-engine parity tests pin the pipeline against."""
+    src_ip = np.asarray(src_ip, dtype=np.uint32)
+    b = src_ip.shape if src_ip.shape else (1,)
+    zeros = np.zeros(b, dtype=bool)
+    feats = _features(
+        src_ip,
+        np.asarray(dst_ip, dtype=np.uint32),
+        np.asarray(protocol, dtype=np.int64),
+        np.asarray(src_port, dtype=np.int64),
+        np.asarray(dst_port, dtype=np.int64),
+        zeros if reply_hit is None else np.asarray(reply_hit, dtype=bool),
+        zeros if dnat_hit is None else np.asarray(dnat_hit, dtype=bool),
+        zeros if snat_hit is None else np.asarray(snat_hit, dtype=bool),
+        np,
+    ).astype(np.float32)
+    score = _mlp_score(
+        feats, np.asarray(w1, dtype=np.float32),
+        np.asarray(b1, dtype=np.float32),
+        np.asarray(w2, dtype=np.float32), np.float32(b2), np,
+    ).astype(np.float32)
+    return score, _score_band(score, np)
+
+
+# ---------------------------------------------------------------------------
+# Direct (non-incremental) table build
+# ---------------------------------------------------------------------------
+
+
+def build_infer_table(
+    model: Optional[Dict[str, object]],
+    bindings: Optional[Dict[int, Tuple[int, int]]] = None,
+) -> InferTable:
+    """Compile a model dict ({"w1","b1","w2","b2"} nested lists or
+    arrays) + {pod_ip_u32: (threshold_band, action_code)} bindings into
+    an InferTable — the from-scratch twin of the incremental builder
+    (ops/infer_delta), used by tests and the builder's full-build path.
+    ``model=None`` or empty bindings produce a DISABLED table (the
+    static gate compiles the scoring stage away)."""
+    bindings = bindings or {}
+    if model is not None:
+        w1 = np.asarray(model["w1"], dtype=np.float32)
+        b1 = np.asarray(model["b1"], dtype=np.float32)
+        w2 = np.asarray(model["w2"], dtype=np.float32)
+        b2 = np.float32(model["b2"])
+        if w1.shape[0] != INFER_FEATURES:
+            raise ValueError(
+                f"model w1 has {w1.shape[0]} feature rows, the datapath "
+                f"feature vector is {INFER_FEATURES}-wide")
+    else:
+        w1 = np.zeros((INFER_FEATURES, INFER_HIDDEN), dtype=np.float32)
+        b1 = np.zeros(INFER_HIDDEN, dtype=np.float32)
+        w2 = np.zeros(INFER_HIDDEN, dtype=np.float32)
+        b2 = np.float32(0.0)
+
+    p = _next_pow2(max(len(bindings), 1), POD_BUCKET_MIN)
+    pod_ip = np.full(p, POD_PAD_IP, dtype=np.uint32)
+    pod_thr = np.zeros(p, dtype=np.int32)
+    pod_act = np.zeros(p, dtype=np.int32)
+    for i, ip in enumerate(sorted(bindings)):
+        thr, act = bindings[ip]
+        pod_ip[i] = ip
+        pod_thr[i] = thr
+        pod_act[i] = act
+    return InferTable(
+        w1=jnp.asarray(w1), b1=jnp.asarray(b1),
+        w2=jnp.asarray(w2), b2=jnp.asarray(b2),
+        pod_ip=jnp.asarray(pod_ip),
+        pod_threshold=jnp.asarray(pod_thr),
+        pod_action=jnp.asarray(pod_act),
+        num_pods=len(bindings),
+        enabled=bool(bindings) and model is not None,
+    )

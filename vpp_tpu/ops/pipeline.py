@@ -104,6 +104,17 @@ def make_route_config(ipam) -> RouteConfig:
 
     all_net = ipam.pod_subnet_all_nodes
     this_net = ipam.pod_subnet_this_node
+    # The packed verdict word carries 16 bits of destination node id
+    # (VERDICT_NODE_MASK; the upper byte was reclaimed for the ISSUE 14
+    # inference verdict).  A layout that can mint a wider node id must
+    # be refused HERE, loudly, at table-build time — packing would
+    # silently truncate it and tunnel frames to the wrong node.
+    node_bits = this_net.prefixlen - all_net.prefixlen
+    if node_bits > 16:
+        raise ValueError(
+            f"pod subnet layout yields {node_bits}-bit node ids "
+            f"({all_net} carved into /{this_net.prefixlen} chunks); the "
+            "packed verdict word carries at most 16 bits of node id")
     all_mask = (0xFFFFFFFF << (32 - all_net.prefixlen)) & 0xFFFFFFFF
     this_mask = (0xFFFFFFFF << (32 - this_net.prefixlen)) & 0xFFFFFFFF
     return RouteConfig(
@@ -655,18 +666,41 @@ def flatten_scan_result(res: PipelineResult) -> PipelineResult:
 # ---------------------------------------------------------------------------
 
 # Verdict-word layout (uint32 per packet, row 0 of the packed array).
+# THIS BLOCK IS THE SINGLE SOURCE OF TRUTH for the bit layout: the
+# three encoders (pack_result on device, pack_verdicts_host for the
+# quarantine stitcher, unpack_verdicts on the harvest) all read these
+# named masks and nothing else, and a bit-for-bit round-trip property
+# test (tests/test_inference.py) holds them together.
+#
+#   bit  0      allowed            bit  7     straggler (flat-punt)
+#   bit  1      punt               bits 8-23  destination node id
+#   bit  2      reply restore      bits 24-26 inference score band
+#   bit  3      dnat hit           bit  27    inference scored
+#   bit  4      snat hit           bits 28-29 inference action fired
+#   bits 5-6    ROUTE_* tag        bits 30-31 reserved
 VERDICT_ALLOWED = 1 << 0
 VERDICT_PUNT = 1 << 1
 VERDICT_REPLY = 1 << 2
 VERDICT_DNAT = 1 << 3
 VERDICT_SNAT = 1 << 4
 VERDICT_ROUTE_SHIFT = 5        # bits 5-6: ROUTE_* tag (0..3)
-VERDICT_STRAGGLER = 1 << 7     # flat-punt: same-dispatch reply, punted
-VERDICT_NODE_SHIFT = 8         # bits 8-31: destination node id
-# node_id fits 24 bits by construction: it is pod-subnet arithmetic
-# ((dst - base) >> host_bits), bounded by 2^(pod_prefixlen span) — a /8
-# cluster subnet with /24 per-node chunks is 2^16 nodes; 2^24 is beyond
-# any representable IPv4 layout the RouteConfig can produce.
+VERDICT_ROUTE_MASK = 0x3
+VERDICT_STRAGGLER_SHIFT = 7    # flat-punt: same-dispatch reply, punted
+VERDICT_STRAGGLER = 1 << VERDICT_STRAGGLER_SHIFT
+VERDICT_NODE_SHIFT = 8         # bits 8-23: destination node id
+VERDICT_NODE_MASK = 0xFFFF
+# node_id fits 16 bits by construction at every deployable layout: it
+# is pod-subnet arithmetic ((dst - base) >> host_bits), and a /8
+# cluster subnet carved into /24 per-node chunks — far beyond the
+# 100-node design point — is exactly 2^16 nodes.  The upper byte was
+# reclaimed for the in-network inference verdict (ISSUE 14); layouts
+# with more than 65536 nodes are not representable in the packed word.
+INFER_BAND_SHIFT = 24          # bits 24-26: log2 score band (0..7)
+INFER_BAND_MASK = 0x7
+INFER_SCORED_SHIFT = 27        # bit 27: row was scored (pod enrolled)
+INFER_SCORED = 1 << INFER_SCORED_SHIFT
+INFER_ACTION_SHIFT = 28        # bits 28-29: INFER_ACT_* fired (0 = none)
+INFER_ACTION_MASK = 0x3
 
 # The packed rows (uint32 [4, B]; row-major so each leaf is ONE
 # contiguous host-side view after the single materialisation).
@@ -688,11 +722,15 @@ class PackedResult(NamedTuple):
 
 
 def pack_result(res: PipelineResult,
-                straggler: Optional[jnp.ndarray] = None) -> PackedResult:
+                straggler: Optional[jnp.ndarray] = None,
+                scores: Optional[Tuple] = None) -> PackedResult:
     """In-program packing tail: fuse the 7 verdict leaves and the
     rewritten 5-tuple (12 separate host materialisations before ISSUE
     11) into one contiguous uint32 [4, B] device array.  ``res`` must
-    carry flat [B] leaves."""
+    carry flat [B] leaves.  ``scores`` is the inference stage's
+    (scored, band, action) triple (ISSUE 14) folded into the reclaimed
+    upper byte — None (scoring off) leaves those bits zero, so the
+    score-off word is bit-identical to the pre-inference layout."""
     word = (
         res.allowed.astype(jnp.uint32)
         | (res.punt.astype(jnp.uint32) << 1)
@@ -700,10 +738,20 @@ def pack_result(res: PipelineResult,
         | (res.dnat_hit.astype(jnp.uint32) << 3)
         | (res.snat_hit.astype(jnp.uint32) << 4)
         | (res.route.astype(jnp.uint32) << VERDICT_ROUTE_SHIFT)
-        | (res.node_id.astype(jnp.uint32) << VERDICT_NODE_SHIFT)
+        | ((res.node_id.astype(jnp.uint32) & jnp.uint32(VERDICT_NODE_MASK))
+           << VERDICT_NODE_SHIFT)
     )
     if straggler is not None:
-        word = word | (straggler.astype(jnp.uint32) << 7)
+        word = word | (straggler.astype(jnp.uint32)
+                       << VERDICT_STRAGGLER_SHIFT)
+    if scores is not None:
+        scored, band, action = scores
+        word = word | (
+            ((band & jnp.uint32(INFER_BAND_MASK)) << INFER_BAND_SHIFT)
+            | (scored.astype(jnp.uint32) << INFER_SCORED_SHIFT)
+            | ((action & jnp.uint32(INFER_ACTION_MASK))
+               << INFER_ACTION_SHIFT)
+        )
     ports = (
         (res.batch.src_port.astype(jnp.uint32) << 16)
         | res.batch.dst_port.astype(jnp.uint32)
@@ -730,6 +778,12 @@ class HostVerdicts(NamedTuple):
     dst_ip: np.ndarray      # uint32 [n]
     src_port: np.ndarray    # int32 [n]
     dst_port: np.ndarray    # int32 [n]
+    # In-network inference verdict (ISSUE 14; all-zero when scoring is
+    # off — appended so positional consumers of the 12 classic leaves
+    # keep their indices).
+    scored: np.ndarray      # bool [n] row was scored (pod enrolled)
+    band: np.ndarray        # int32 [n] log2 score band (0..7)
+    action: np.ndarray      # int32 [n] INFER_ACT_* fired (0 = none)
 
 
 def unpack_verdicts(packed_rows: np.ndarray, n: Optional[int] = None,
@@ -755,24 +809,34 @@ def unpack_verdicts(packed_rows: np.ndarray, n: Optional[int] = None,
         dnat_hit=(word & VERDICT_DNAT) != 0,
         snat_hit=(word & VERDICT_SNAT) != 0,
         straggler=(word & VERDICT_STRAGGLER) != 0,
-        route=((word >> VERDICT_ROUTE_SHIFT) & 0x3).astype(np.int32),
-        node_id=(word >> VERDICT_NODE_SHIFT).astype(np.int32),
+        route=((word >> VERDICT_ROUTE_SHIFT)
+               & VERDICT_ROUTE_MASK).astype(np.int32),
+        node_id=((word >> VERDICT_NODE_SHIFT)
+                 & VERDICT_NODE_MASK).astype(np.int32),
         src_ip=src,
         dst_ip=dst,
         src_port=(ports >> 16).astype(np.int32),
         dst_port=(ports & 0xFFFF).astype(np.int32),
+        scored=(word & INFER_SCORED) != 0,
+        band=((word >> INFER_BAND_SHIFT)
+              & INFER_BAND_MASK).astype(np.int32),
+        action=((word >> INFER_ACTION_SHIFT)
+                & INFER_ACTION_MASK).astype(np.int32),
     )
 
 
 def pack_verdicts_host(allowed, punt, reply_hit, dnat_hit, snat_hit,
                        route, node_id, src_ip, dst_ip, src_port, dst_port,
-                       straggler=None) -> np.ndarray:
+                       straggler=None, scored=None, band=None,
+                       action=None) -> np.ndarray:
     """Numpy twin of :func:`pack_result`'s layout — used by the
     poisoned-batch quarantine to assemble a host-stitched packed
     result, and by the round-trip property tests (host pack ≡ device
     pack bit-for-bit).  Inputs must already be HOST numpy arrays: the
     quarantine path is hot-path-reachable and this function performs
-    no device materialisation (``.astype`` on numpy is a host cast)."""
+    no device materialisation (``.astype`` on numpy is a host cast).
+    The optional inference leaves (ISSUE 14) default to the all-zero
+    score-off encoding."""
     word = (
         allowed.astype(np.uint32)
         | (punt.astype(np.uint32) << 1)
@@ -780,10 +844,21 @@ def pack_verdicts_host(allowed, punt, reply_hit, dnat_hit, snat_hit,
         | (dnat_hit.astype(np.uint32) << 3)
         | (snat_hit.astype(np.uint32) << 4)
         | (route.astype(np.uint32) << VERDICT_ROUTE_SHIFT)
-        | (node_id.astype(np.uint32) << VERDICT_NODE_SHIFT)
+        | ((node_id.astype(np.uint32) & np.uint32(VERDICT_NODE_MASK))
+           << VERDICT_NODE_SHIFT)
     )
     if straggler is not None:
-        word = word | (straggler.astype(np.uint32) << 7)
+        word = word | (straggler.astype(np.uint32)
+                       << VERDICT_STRAGGLER_SHIFT)
+    if scored is not None:
+        word = word | (scored.astype(np.uint32) << INFER_SCORED_SHIFT)
+    if band is not None:
+        word = word | ((band.astype(np.uint32)
+                        & np.uint32(INFER_BAND_MASK)) << INFER_BAND_SHIFT)
+    if action is not None:
+        word = word | ((action.astype(np.uint32)
+                        & np.uint32(INFER_ACTION_MASK))
+                       << INFER_ACTION_SHIFT)
     ports = (src_port.astype(np.uint32) << 16) | dst_port.astype(np.uint32)
     return np.stack([
         word, src_ip.astype(np.uint32), dst_ip.astype(np.uint32), ports,
@@ -794,11 +869,27 @@ def pack_verdicts_host(allowed, punt, reply_hit, dnat_hit, snat_hit,
 # Production jit entry points
 # ---------------------------------------------------------------------------
 
-def _packed_step(acl, nat, route, sessions, batch, timestamp):
+def _score_stage(infer, res: PipelineResult):
+    """The in-network inference stage (ISSUE 14): score every packet
+    of the settled flat result — between the classify/NAT verdict
+    stages and the pack_result tail, for EVERY discipline.  ``infer``
+    is an :class:`~vpp_tpu.ops.infer.InferTable` or None; None or a
+    disabled table is a trace-time static, so the score-off program
+    compiles to exactly the pre-inference pipeline (zero cost when no
+    namespace is enrolled)."""
+    if infer is None or not infer.enabled:
+        return None
+    from .infer import infer_scores
+
+    return infer_scores(infer, res.batch, res.reply_hit,
+                        res.dnat_hit, res.snat_hit)
+
+
+def _packed_step(acl, nat, route, sessions, batch, timestamp, infer=None):
     """Flat single-vector step + packing tail (the K=1 scan-discipline
     dispatch shape)."""
-    return pack_result(
-        pipeline_step(acl, nat, route, sessions, batch, timestamp))
+    res = pipeline_step(acl, nat, route, sessions, batch, timestamp)
+    return pack_result(res, scores=_score_stage(infer, res))
 
 
 def _with_ts0(fn):
@@ -811,23 +902,26 @@ def _with_ts0(fn):
     16k-packet dispatch (r4: it was misattributed to the session
     stages for a full round).  Vector i gets ts0 + 1 + i."""
 
-    def stepped(acl, nat, route, sessions, batches, ts0):
+    def stepped(acl, nat, route, sessions, batches, ts0, infer=None):
         k = batches.src_ip.shape[0]
         tss = ts0 + jnp.arange(1, k + 1, dtype=jnp.int32)
-        return pack_result(
-            flatten_scan_result(fn(acl, nat, route, sessions, batches, tss)))
+        res = flatten_scan_result(
+            fn(acl, nat, route, sessions, batches, tss))
+        return pack_result(res, scores=_score_stage(infer, res))
 
     return stepped
 
 
-def _flat_punt_ts0(acl, nat, route, sessions, batches, ts0):
+def _flat_punt_ts0(acl, nat, route, sessions, batches, ts0, infer=None):
     """flat-punt's ts0 wrapper: same scalar-base-ts contract, plus the
     straggler mask folded into the packed verdict word (bit 7)."""
     k = batches.src_ip.shape[0]
     tss = ts0 + jnp.arange(1, k + 1, dtype=jnp.int32)
     res, straggler = pipeline_flat_punt(acl, nat, route, sessions,
                                         batches, tss)
-    return pack_result(flatten_scan_result(res), straggler.reshape(-1))
+    flat = flatten_scan_result(res)
+    return pack_result(flat, straggler.reshape(-1),
+                       scores=_score_stage(infer, flat))
 
 
 # Production entry points: scalar base-ts in (the ts0 shapes), the

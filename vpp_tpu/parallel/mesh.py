@@ -120,6 +120,21 @@ def shard_dataplane(
     return acl_sharded, nat_sharded, route_sharded, sessions_sharded
 
 
+def replicate_on_mesh(mesh: Mesh, tree):
+    """Place every leaf of a pytree fully REPLICATED on the mesh.
+
+    For small tables with no shardable axis — the inference weights +
+    enrollment table (ISSUE 14) are a few KB, so replication is the
+    right placement (like the NAT mapping tables inside
+    shard_dataplane); what matters is that the leaves carry a mesh
+    sharding at all: mixing single-device committed arrays into a
+    dispatch whose other arguments are mesh-placed is an
+    incompatible-devices error."""
+    spec = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, spec), tree)
+
+
 def shard_batch(mesh: Mesh, batch: PacketBatch) -> PacketBatch:
     """Shard a packet batch over the ``data`` axis.
 

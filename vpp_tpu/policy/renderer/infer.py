@@ -1,0 +1,117 @@
+"""TPU inference renderers — beside the policy renderers (ISSUE 14).
+
+Two renderers behind the InferencePlugin's ``render(model, bindings,
+resync)`` boundary, mirroring the policy pair (tpu.py / sched.py):
+
+- :class:`TpuInferRenderer` — direct-compile: maintains a persistent
+  incremental builder and hands the freshly compiled
+  :class:`~vpp_tpu.ops.infer.InferTable` to an ``on_compiled`` hook.
+  For standalone harnesses and benches that run without a scheduler.
+- :class:`SchedInferRenderer` — the production path: emits the model
+  and the per-pod enrollments as plain ``tpu/infer/*`` KVs into the
+  CURRENT EVENT TRANSACTION; the TpuInferApplicator owns the
+  incremental compile + atomic device swap, so a model update lands in
+  the same atomic, retried, spanned kvscheduler transaction as every
+  other southbound value of its event.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Set, Tuple
+
+from ...ops.infer import InferTable
+from ...ops.infer_delta import (
+    INFER_MODEL_KEY,
+    INFER_POD_PREFIX,
+    InferTableBuilder,
+)
+from ...ops.packets import u32_to_ip
+
+
+def infer_pod_key(pod_ip_u32: int) -> str:
+    """Enrollment key for one pod IP.  Keyed by the dotted IP (not the
+    pod name): the datapath enrolls ADDRESSES, and a pod IP reused
+    after a delete/re-add overwrites the same key — exactly the
+    desired last-writer semantics."""
+    return f"{INFER_POD_PREFIX}{u32_to_ip(pod_ip_u32)}"
+
+
+class TpuInferRenderer:
+    """Direct-compile renderer (the TpuPolicyRenderer analog)."""
+
+    def __init__(self, on_compiled: Optional[Callable[[InferTable], None]] = None):
+        self._lock = threading.Lock()
+        self._builder = InferTableBuilder()
+        self._compiled: Optional[InferTable] = None
+        self._on_compiled = on_compiled
+
+    @property
+    def tables(self) -> Optional[InferTable]:
+        with self._lock:
+            return self._compiled
+
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            compiled = self._compiled
+            return {
+                "enabled": bool(compiled.enabled) if compiled else False,
+                "pods": compiled.num_pods if compiled else 0,
+                "compile": self._builder.stats.as_dict(),
+            }
+
+    def render(self, model, bindings: Dict[int, Tuple[int, int]],
+               resync: bool) -> None:
+        state: Dict[str, object] = {}
+        if model is not None:
+            state[INFER_MODEL_KEY] = model
+        for ip, (threshold, action) in bindings.items():
+            state[infer_pod_key(ip)] = (ip, threshold, action)
+        with self._lock:
+            compiled = self._builder.sync(state)
+            self._compiled = compiled
+        if self._on_compiled is not None:
+            self._on_compiled(compiled)
+
+
+class SchedInferRenderer:
+    """Scheduler-routed renderer: tpu/infer/* KVs into the event txn.
+
+    Tracks the keys it last rendered so an UPDATE transaction deletes
+    enrollments that disappeared (a resync txn removes them by simply
+    not Put()ing — the scheduler's resync semantics)."""
+
+    def __init__(self, txn_provider: Callable[[], object],
+                 applicator=None):
+        self._txn_provider = txn_provider
+        # Kept so callers reach the compiled table through the renderer
+        # (the applicator owns it now) — same shape as SchedPolicyRenderer.
+        self.applicator = applicator
+        self._last_keys: Set[str] = set()
+
+    @property
+    def tables(self) -> Optional[InferTable]:
+        return self.applicator.tables if self.applicator else None
+
+    def stats(self) -> Dict[str, object]:
+        return self.applicator.stats() if self.applicator else {}
+
+    def render(self, model, bindings: Dict[int, Tuple[int, int]],
+               resync: bool) -> None:
+        txn = self._txn_provider()
+        if txn is None:
+            raise RuntimeError(
+                "SchedInferRenderer.render outside an event transaction")
+        keys: Set[str] = set()
+        if model is not None:
+            txn.put(INFER_MODEL_KEY,
+                    model.to_dict() if hasattr(model, "to_dict") else model)
+            keys.add(INFER_MODEL_KEY)
+        for ip, (threshold, action) in bindings.items():
+            key = infer_pod_key(ip)
+            txn.put(key, (ip, threshold, action))
+            keys.add(key)
+        if not txn.is_resync:
+            for gone in self._last_keys - keys:
+                txn.delete(gone)
+        self._last_keys = keys

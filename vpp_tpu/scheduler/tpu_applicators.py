@@ -33,6 +33,15 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..ops.classify import RuleTables
+# In-network inference keyspace (ISSUE 14) — canonical definitions in
+# ops/infer_delta (the builder owns the key shapes), re-exported here
+# beside the ACL/NAT prefixes the scheduler routes on.
+from ..ops.infer import InferTable
+from ..ops.infer_delta import (
+    INFER_MODEL_KEY,
+    INFER_POD_PREFIX,
+    INFER_PREFIX,
+)
 from ..ops.nat import NatMapping, NatTables
 from ..telemetry import record_stage
 from .scheduler import Applicator
@@ -377,3 +386,45 @@ class TpuNatApplicator(_CompilingApplicator):
             snat_enabled=glob.snat_enabled,
             pod_subnet=glob.pod_subnet,
         )
+
+
+class TpuInferApplicator(_CompilingApplicator):
+    """Compiles ``tpu/infer/*`` (the model under ``tpu/infer/model`` +
+    one ``(pod_ip_u32, threshold, action)`` enrollment per
+    ``tpu/infer/pod/<ns>/<name>`` key) into an InferTable for the
+    in-datapath scoring stage (ISSUE 14) — incrementally: the
+    persistent builder diffs weight rows and enrollment slots against
+    its host mirrors and ships only the dirty rows through the shared
+    delta scatter (ops/infer_delta).  A model update is therefore a
+    normal control-plane transaction: spanned (``compile:infer`` /
+    ``swap:infer`` stages), retried, drift-verified, and swapped into
+    the runner atomically under the last-good rollback."""
+
+    prefix = INFER_PREFIX
+    telemetry_name = "infer"
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        from ..ops.infer_delta import InferTableBuilder
+
+        self._builder = InferTableBuilder()
+
+    @property
+    def tables(self) -> Optional[InferTable]:
+        with self._lock:
+            return self._compiled
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            compiled = self._compiled
+            return {
+                "enabled": bool(compiled.enabled) if compiled else False,
+                "pods": compiled.num_pods if compiled else 0,
+                "compile": {
+                    "swaps": self.compile_count,
+                    **self._builder.stats.as_dict(),
+                },
+            }
+
+    def _compile(self, state: Dict[str, Any]) -> InferTable:
+        return self._builder.sync(state)

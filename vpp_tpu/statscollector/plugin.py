@@ -126,6 +126,22 @@ class _DatapathCollector:
             else:
                 yield GaugeMetricFamily(
                     name, f"datapath gauge {name}", value=float(value))
+        # In-network inference score histogram (ISSUE 14): one counter
+        # per log2 score band (band k = score >= 1 - 2^-k), labelled —
+        # the Prometheus face of inspect()["inference"]["score_bands"]
+        # (the datapath_inference_*_total action counters ride the
+        # generic counter export above).
+        bands_fn = getattr(self.runner, "inference_bands", None)
+        if bands_fn is not None:
+            family = CounterMetricFamily(
+                "datapath_inference_score_band_total",
+                "packets scored into each log2 score band "
+                "(band k: score >= 1 - 2^-k)",
+                labels=["band"],
+            )
+            for band, count in enumerate(bands_fn()):
+                family.add_metric([str(band)], float(count))
+            yield family
         hist_fn = getattr(self.runner, "latency_histograms", None)
         if hist_fn is None:
             return

@@ -276,6 +276,32 @@ def shape_latency(inspect: Optional[dict]) -> Dict[str, Any]:
     return out
 
 
+def shape_inference(inspect: Optional[dict]) -> Dict[str, Any]:
+    """The dashboard's inference panel (ISSUE 14): the in-network
+    scoring plane an operator reads during a score storm — enrollment
+    state, per-action firing counters, and the score log2-histogram
+    (band k = score >= 1 - 2^-k).  Every key consumed here is produced
+    by ``DataplaneRunner.inspect_inference`` (sharded engines merge the
+    same schema) — the obs-parity checker holds the pair together so
+    the panel can never silently go blank.  Empty for agents without a
+    live datapath (the page hides the panel)."""
+    if not inspect:
+        return {}
+    inf = inspect.get("inference") or {}
+    return {
+        "enabled": bool(inf.get("enabled")),
+        "pods": inf.get("pods", 0),
+        "features": inf.get("features", 0),
+        "hidden": inf.get("hidden", 0),
+        "swaps": inf.get("swaps", 0),
+        "scored": inf.get("scored", 0),
+        "logged": inf.get("logged", 0),
+        "deprioritized": inf.get("deprioritized", 0),
+        "quarantined": inf.get("quarantined", 0),
+        "score_bands": inf.get("score_bands") or [],
+    }
+
+
 def shape_cluster(summary: Optional[dict]) -> Dict[str, Any]:
     """The dashboard's cluster panel (ISSUE 10): the fleet rollup an
     operator reads when the question is "is the CLUSTER healthy" —
@@ -358,4 +384,5 @@ def shape_views(dump: List[dict], ipam: dict, trace: dict,
     }
     out["dispatch"] = shape_dispatch(inspect)
     out["latency"] = shape_latency(inspect)
+    out["inference"] = shape_inference(inspect)
     return out
